@@ -1,0 +1,55 @@
+"""mxnet_tpu — a TPU-native deep learning framework with MXNet's capability
+surface (the `incubator-mxnet_tpu` project).
+
+Brand-new design for TPU/XLA (NOT a port): jax/XLA is the compute path, Pallas
+for hot kernels, `jax.sharding` meshes for parallelism. The imperative
+NDArray + autograd + Gluon API matches the reference (Laurawly/incubator-mxnet)
+so users can switch; the mechanisms are described in SURVEY.md §7.
+
+Quick start::
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+
+    x = nd.random.uniform(shape=(32, 784), ctx=mx.tpu())
+    net = gluon.nn.Dense(10)
+    net.initialize(ctx=mx.tpu())
+    with autograd.record():
+        loss = gluon.loss.SoftmaxCrossEntropyLoss()(net(x), nd.zeros((32,)))
+    loss.backward()
+"""
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, num_gpus, num_tpus, current_context, cpu_pinned
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from .ndarray import NDArray
+
+from . import initializer
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import io
+from . import gluon
+from . import kvstore as kv
+from . import kvstore
+from . import parallel
+from . import profiler
+from . import runtime
+from . import util
+from . import test_utils
+from . import image
+from . import recordio
+
+from .util import is_np_shape, is_np_array, set_np, reset_np
+
+__version__ = "1.0.0.dev0"
+
+init = gluon.init  # alias: mx.init.Xavier() etc.
+
+
+def waitall():
+    ndarray.waitall()

@@ -1,0 +1,302 @@
+"""Imperative autograd: record/pause scopes, mark_variables, backward, grad.
+
+API parity with the reference's python/mxnet/autograd.py (record :122, pause
+:136, mark_variables :197, backward :246, grad :273, Function :370), but the
+mechanism is TPU-native (SURVEY.md §7): instead of a C++ tape of nnvm nodes
+(src/imperative/imperative.cc AGInfo/RecordOp) we keep a Python tape whose
+entries hold the *compiled transpose* produced by `jax.vjp` at record time —
+forward runs once, backward replays XLA-compiled VJPs in reverse order.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.tape: List["TapeEntry"] = []
+
+
+_STATE = _State()
+
+
+class Node:
+    """Autograd node attached to an NDArray that participates in the graph
+    (analog of reference AGInfo, include/mxnet/imperative.h:53)."""
+
+    __slots__ = ("array_ref", "grad_req", "is_variable", "__weakref__")
+
+    def __init__(self, array=None, grad_req="write", is_variable=False):
+        import weakref
+        self.array_ref = weakref.ref(array) if array is not None else None
+        self.grad_req = grad_req
+        self.is_variable = is_variable
+
+
+class TapeEntry:
+    __slots__ = ("vjp_fn", "in_nodes", "out_nodes", "out_is_tuple", "out_avals")
+
+    def __init__(self, vjp_fn, in_nodes, out_nodes, out_is_tuple, out_avals):
+        self.vjp_fn = vjp_fn
+        self.in_nodes = in_nodes    # list[Node|None] aligned with op inputs
+        self.out_nodes = out_nodes  # list[Node] aligned with op outputs
+        self.out_is_tuple = out_is_tuple
+        self.out_avals = out_avals  # [(shape, dtype)] for zero-fill
+
+
+# ---------------------------------------------------------------------------
+# Scopes
+# ---------------------------------------------------------------------------
+
+class _RecordingScope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec = recording
+        self._train = training
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = (_STATE.recording, _STATE.training)
+        if self._rec is not None:
+            _STATE.recording = self._rec
+        if self._train is not None:
+            _STATE.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.recording, _STATE.training = self._prev
+
+
+def record(train_mode: bool = True):
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(None, True)
+
+
+def predict_mode():
+    return _RecordingScope(None, False)
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev, _STATE.recording = _STATE.recording, flag
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev, _STATE.training = _STATE.training, flag
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# Tape ops
+# ---------------------------------------------------------------------------
+
+def mark_variables(variables, gradients=None, grad_reqs="write"):
+    """Attach grad buffers to arrays (reference autograd.py:197)."""
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+        gradients = [gradients] if gradients is not None else None
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for i, v in enumerate(variables):
+        req = grad_reqs[i]
+        v._ag_node = Node(v, grad_req=req, is_variable=(req != "null"))
+        if gradients is not None and gradients[i] is not None:
+            v._grad = gradients[i]
+
+
+def _participates(arr) -> bool:
+    return getattr(arr, "_ag_node", None) is not None
+
+
+def record_op(vjp_fn, inputs, outputs, out_is_tuple: bool):
+    """Called by the NDArray dispatch layer after a recorded forward."""
+    in_nodes = [getattr(x, "_ag_node", None) for x in inputs]
+    out_nodes = []
+    for o in outputs:
+        n = Node(o, grad_req="write", is_variable=False)
+        o._ag_node = n
+        out_nodes.append(n)
+    avals = [(tuple(o.shape), o.dtype) for o in outputs]
+    _STATE.tape.append(TapeEntry(vjp_fn, in_nodes, out_nodes, out_is_tuple, avals))
+
+
+def _zeros_like_raw(arr):
+    return jnp.zeros(arr.shape, arr.dtype)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Reverse pass over the tape (reference Imperative::Backward,
+    src/imperative/imperative.cc:280)."""
+    from .ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    grads = _run_backward(heads, head_grads, retain_graph)
+    # accumulate into variable .grad buffers
+    for node, g in grads.items():
+        if not node.is_variable or node.grad_req == "null":
+            continue
+        arr = node.array_ref() if node.array_ref else None
+        if arr is None:
+            continue
+        from .ndarray import _wrap_like
+        if node.grad_req == "add" and arr._grad is not None:
+            arr._grad._set_data(arr._grad._data + g)
+        else:
+            if arr._grad is None:
+                arr._grad = _wrap_like(g, arr)
+            else:
+                arr._grad._set_data(g.astype(arr._grad.dtype))
+
+
+def _run_backward(heads, head_grads, retain_graph) -> Dict[Node, Any]:
+    grad_map: Dict[int, Any] = {}
+    node_by_id: Dict[int, Node] = {}
+
+    def add_grad(node, g):
+        if node is None or g is None:
+            return
+        nid = id(node)
+        node_by_id[nid] = node
+        if nid in grad_map:
+            grad_map[nid] = grad_map[nid] + g
+        else:
+            grad_map[nid] = g
+
+    for i, h in enumerate(heads):
+        node = getattr(h, "_ag_node", None)
+        if node is None:
+            raise MXNetError("head array is not part of the recorded graph "
+                             "(was it computed under autograd.record()?)")
+        if head_grads is None or head_grads[i] is None:
+            add_grad(node, jnp.ones(h.shape, h.dtype))
+        else:
+            hg = head_grads[i]
+            add_grad(node, hg._data if hasattr(hg, "_data") else jnp.asarray(hg))
+
+    tape = _STATE.tape
+    for entry in reversed(tape):
+        outs_g = []
+        any_out = False
+        for n, (shp, dt) in zip(entry.out_nodes, entry.out_avals):
+            g = grad_map.get(id(n))
+            if g is not None:
+                any_out = True
+                outs_g.append(g)
+            else:
+                outs_g.append(jnp.zeros(shp, dt))
+        if not any_out:
+            continue
+        cot = tuple(outs_g) if entry.out_is_tuple else outs_g[0]
+        in_gs = entry.vjp_fn(cot)
+        for node, g in zip(entry.in_nodes, in_gs):
+            if node is not None:
+                add_grad(node, g)
+    if not retain_graph:
+        _STATE.tape = []
+    return {node_by_id[nid]: g for nid, g in grad_map.items()}
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Return grads w.r.t. variables instead of accumulating (reference
+    autograd.py:273). create_graph (higher-order) lands with the jaxpr-level
+    tape in a later round."""
+    from .ndarray import NDArray, _wrap_like
+    if create_graph:
+        raise MXNetError("create_graph=True not yet supported on the TPU tape")
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if retain_graph is None:
+        retain_graph = create_graph
+    grads = _run_backward(heads, head_grads, retain_graph)
+    outs = []
+    for v in variables:
+        node = getattr(v, "_ag_node", None)
+        g = grads.get(node) if node is not None else None
+        if g is None:
+            raise MXNetError("one of the variables does not receive gradient "
+                             "(not on any path from heads)")
+        outs.append(_wrap_like(g, v))
+    return outs[0] if single else outs
+
+
+def get_symbol(x):
+    raise MXNetError("get_symbol: use HybridBlock.export on the TPU framework")
+
+
+# ---------------------------------------------------------------------------
+# Custom differentiable Function (reference autograd.py:370)
+# ---------------------------------------------------------------------------
+
+class Function:
+    """User-defined differentiable function with explicit backward.
+
+    class Sigmoid(autograd.Function):
+        def forward(self, x): ...saved = ...; return y
+        def backward(self, dy): return dx
+    """
+
+    def __init__(self):
+        self.saved_tensors = ()
+
+    def save_for_backward(self, *args):
+        self.saved_tensors = args
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray, _wrap_like
+        outs = self.forward(*inputs)
+        single = not isinstance(outs, (list, tuple))
+        outs_t = (outs,) if single else tuple(outs)
+        if is_recording():
+            fn_self = self
+
+            def vjp_fn(cotangents):
+                cots = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                cot_nd = [_wrap_like(c, o) for c, o in zip(cots, outs_t)]
+                with pause():
+                    in_grads = fn_self.backward(*cot_nd)
+                if not isinstance(in_grads, (list, tuple)):
+                    in_grads = (in_grads,)
+                return tuple(g._data if hasattr(g, "_data") else g for g in in_grads)
+
+            record_op(vjp_fn, list(inputs), list(outs_t), out_is_tuple=not single)
+        return outs
+
+
+# hook into the op registry so invoke_raw knows when to build VJPs
+from .ops import registry as _registry  # noqa: E402
+_registry.set_autograd_hooks(is_recording, record_op)

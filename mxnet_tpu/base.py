@@ -1,0 +1,117 @@
+"""Base utilities: errors, env-var config registry, dtype tables.
+
+TPU-native re-design of the reference's dmlc foundations:
+  - MXNetError            <- reference include/mxnet/base.h (dmlc::Error)
+  - environment knobs     <- reference docs .../env_var.md (dmlc::GetEnv call sites)
+  - dtype name table      <- reference include/mxnet/base.h / mshadow type switch
+
+No code is shared with the reference; this is a typed Python config registry
+(SURVEY.md section 5-f recommends mapping env vars to a typed registry).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import numpy as _np
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (name kept for API parity)."""
+
+
+class NotSupportedForSparseNDArray(MXNetError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Typed environment/config registry (replacement for dmlc::GetEnv sprawl)
+# ---------------------------------------------------------------------------
+
+class _EnvRegistry:
+    """Typed registry over MXNET_* environment variables.
+
+    Every knob the framework reads is declared here so `mxnet_tpu.runtime`
+    can enumerate them (the reference documents 85 MXNET_* env vars; we keep
+    the same discoverability with actual typing).
+    """
+
+    def __init__(self) -> None:
+        self._decls: Dict[str, tuple] = {}
+        self._lock = threading.Lock()
+
+    def declare(self, name: str, default: Any, typ: Callable[[str], Any], doc: str = "") -> None:
+        with self._lock:
+            self._decls.setdefault(name, (default, typ, doc))
+
+    def get(self, name: str, default: Any = None, typ: Optional[Callable] = None) -> Any:
+        if name in self._decls:
+            ddefault, dtyp, _ = self._decls[name]
+            default = default if default is not None else ddefault
+            typ = typ or dtyp
+        raw = os.environ.get(name)
+        if raw is None:
+            return default
+        if typ is bool:
+            return raw.lower() in ("1", "true", "yes", "on")
+        return (typ or str)(raw)
+
+    def items(self):
+        return dict(self._decls)
+
+
+env = _EnvRegistry()
+env.declare("MXNET_ENGINE_TYPE", "Async", str, "Async (jax dispatch) or Naive (sync after every op)")
+env.declare("MXNET_ENFORCE_DETERMINISM", False, bool, "Force deterministic reductions")
+env.declare("MXNET_DEFAULT_DTYPE", "float32", str, "Default dtype for new arrays")
+env.declare("MXNET_SAFE_ACCUMULATION", True, bool, "Accumulate reductions in float32 even for bf16 inputs")
+env.declare("MXNET_PROFILER_AUTOSTART", False, bool, "Start profiler at import")
+env.declare("MXNET_EXEC_BULK_EXEC_TRAIN", True, bool, "Kept for API parity; XLA always fuses")
+
+
+# ---------------------------------------------------------------------------
+# dtype tables (mirrors mshadow type codes for serialization parity)
+# ---------------------------------------------------------------------------
+
+# Codes follow the reference's mshadow/base.h enum so .params files and
+# serialized attrs stay interoperable in spirit.
+_DTYPE_TO_CODE = {
+    _np.dtype("float32"): 0,
+    _np.dtype("float64"): 1,
+    _np.dtype("float16"): 2,
+    _np.dtype("uint8"): 3,
+    _np.dtype("int32"): 4,
+    _np.dtype("int8"): 5,
+    _np.dtype("int64"): 6,
+    _np.dtype("bool"): 7,
+    # TPU-native addition: bfloat16 is the workhorse dtype on the MXU.
+    "bfloat16": 8,
+}
+_CODE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_CODE.items()}
+
+
+def dtype_code(dtype) -> int:
+    import jax.numpy as jnp
+    d = jnp.dtype(dtype)
+    if d == jnp.bfloat16:
+        return _DTYPE_TO_CODE["bfloat16"]
+    return _DTYPE_TO_CODE[_np.dtype(str(d))]
+
+
+def code_dtype(code: int):
+    import jax.numpy as jnp
+    d = _CODE_TO_DTYPE[code]
+    return jnp.bfloat16 if d == "bfloat16" else jnp.dtype(d)
+
+
+def default_dtype():
+    import jax.numpy as jnp
+    return jnp.dtype(env.get("MXNET_DEFAULT_DTYPE"))
+
+
+_GRAD_REQ_MAP = {"null": 0, "write": 1, "add": 3}
+
+
+def string_types():
+    return (str,)

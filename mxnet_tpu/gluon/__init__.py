@@ -1,0 +1,21 @@
+"""Gluon imperative API (reference python/mxnet/gluon/)."""
+from .parameter import Parameter, Constant, ParameterDict, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import utils
+from ..import initializer as init  # mx.gluon.init alias parity
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
+           "SymbolBlock", "Trainer", "nn", "rnn", "loss", "data", "utils",
+           "init", "model_zoo"]
+
+
+def __getattr__(name):
+    if name == "model_zoo":
+        from . import model_zoo as mz
+        return mz
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute '{name}'")

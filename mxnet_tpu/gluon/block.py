@@ -1,0 +1,471 @@
+"""Block / HybridBlock (reference python/mxnet/gluon/block.py:228,838).
+
+`hybridize()` is the reference's CachedOp boundary (src/imperative/cached_op.h)
+re-designed for XLA (SURVEY.md §3.3): the block's forward is traced ONCE per
+(input-signature, train-mode) into a jitted function over (rng_key, inputs,
+params); backward is a second jitted function that recomputes forward and
+applies the VJP (rematerialized backward — the XLA-native analog of
+static_alloc, trading FLOPs for memory exactly like MXNET_BACKWARD_DO_MIRROR).
+
+Mutable aux state (BatchNorm running stats) is threaded functionally through
+`defer_aux_update`: under a trace the new value becomes an extra output and is
+written back after the compiled call returns.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray import NDArray
+from .. import ndarray as nd
+from .. import autograd
+from .. import random as _rng
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+
+# ---------------------------------------------------------------------------
+# Aux-state side-channel (BatchNorm moving stats etc.)
+# ---------------------------------------------------------------------------
+
+_AUX_STACK: List[List[Tuple[Parameter, Any]]] = []
+_TRACE_DEPTH = [0]  # >0 while tracing/probing: children fold into the trace
+
+
+def in_trace() -> bool:
+    return _TRACE_DEPTH[0] > 0
+
+
+def defer_aux_update(param: Parameter, new_raw):
+    """Write `new_raw` into param — immediately in eager mode, functionally
+    (as an extra traced output) inside a hybridized trace."""
+    if _AUX_STACK:
+        _AUX_STACK[-1].append((param, jax.lax.stop_gradient(new_raw)))
+    elif not in_trace():
+        param._data._set_data(new_raw)
+    # inside a shape probe (in_trace, no aux stack): drop the abstract update
+
+
+class _NameManager:
+    _lock = threading.Lock()
+    _counters: Dict[str, int] = {}
+
+    @classmethod
+    def fresh(cls, hint: str) -> str:
+        with cls._lock:
+            i = cls._counters.get(hint, 0)
+            cls._counters[hint] = i + 1
+        return f"{hint}{i}_"
+
+
+class Block:
+    """Base container (reference gluon/block.py:228)."""
+
+    def __init__(self, prefix: Optional[str] = None, params: Optional[ParameterDict] = None):
+        self._empty_init_guard = True
+        self._prefix = prefix if prefix is not None else \
+            _NameManager.fresh(type(self).__name__.lower())
+        self._params = ParameterDict(self._prefix, shared=params)
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._forward_hooks: List = []
+        self._forward_pre_hooks: List = []
+
+    # -- naming / params -----------------------------------------------------
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def name_scope(self):
+        class _Noop:
+            def __enter__(self_inner):
+                return self_inner
+
+            def __exit__(self_inner, *a):
+                return False
+        return _Noop()
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = getattr(self, "_children", None)
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = getattr(self, "_reg_params", None)
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return hook
+
+    def collect_params(self, select: Optional[str] = None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self._params)
+        else:
+            pattern = re.compile(select)
+            ret.update({k: v for k, v in self._params.items() if pattern.match(k)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select))
+        return ret
+
+    # -- lifecycle -----------------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        from .. import initializer as init_mod
+        self.collect_params().initialize(init or init_mod.Uniform(), ctx,
+                                         verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._reg_params.values():
+            p.cast(dtype)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # -- checkpointing ---------------------------------------------------------
+    def _collect_params_with_prefix(self, prefix="") -> "OrderedDict[str, Parameter]":
+        """Structural names ('features.0.weight') — stable across instances
+        regardless of global name counters (reference block.py same method)."""
+        if prefix:
+            prefix += "."
+        ret = OrderedDict()
+        for name, p in self._reg_params.items():
+            ret[prefix + name] = p
+        for cname, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + cname))
+        return ret
+
+    def save_parameters(self, filename, deduplicate=False):
+        params = self._collect_params_with_prefix()
+        from ..serialization import save_ndarrays
+        arg = {"arg:" + k: p.data() for k, p in params.items()}
+        save_ndarrays(filename, arg)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False, dtype_source="current"):
+        from ..serialization import load_ndarrays
+        loaded = load_ndarrays(filename)
+        loaded = {k.split(":", 1)[1] if ":" in k else k: v for k, v in loaded.items()}
+        params = self._collect_params_with_prefix()
+        for key, p in params.items():
+            if key in loaded:
+                p.set_data(loaded[key])
+            elif not allow_missing:
+                raise MXNetError(f"parameter {p.name} ({key}) missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(f"extra parameters in file: {sorted(extra)[:5]}")
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    # -- execution -------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        lines = [f"{'Layer':<40}{'Output':<24}{'Params':>12}"]
+        total = 0
+        for name, p in self.collect_params().items():
+            n = 1
+            for s in (p.shape or ()):
+                n *= s
+            total += n
+            lines.append(f"{name:<40}{str(p.shape):<24}{n:>12}")
+        lines.append(f"Total params: {total}")
+        print("\n".join(lines))
+
+    def __repr__(self):
+        mods = "\n".join(f"  ({k}): {v!r}".replace("\n", "\n  ")
+                         for k, v in self._children.items())
+        return f"{type(self).__name__}(\n{mods}\n)" if mods else f"{type(self).__name__}()"
+
+
+# ---------------------------------------------------------------------------
+# HybridBlock
+# ---------------------------------------------------------------------------
+
+def _flatten_nd(args):
+    """Flatten a nested structure of NDArrays -> (raw leaves, treedef)."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        args, is_leaf=lambda x: isinstance(x, NDArray))
+    raw = [l._data if isinstance(l, NDArray) else l for l in leaves]
+    return raw, treedef, [isinstance(l, NDArray) for l in leaves]
+
+
+class _CachedGraph:
+    """One compiled (signature → executable) entry: forward jit + backward jit
+    (recompute-mode VJP) + aux layout."""
+
+    __slots__ = ("fwd", "bwd", "out_treedef", "n_aux", "aux_params", "n_outs")
+
+    def __init__(self):
+        self.fwd = None
+        self.bwd = None
+        self.out_treedef = None
+        self.aux_params = None
+        self.n_outs = 0
+
+
+class HybridBlock(Block):
+    """reference gluon/block.py:838; hybridize() == trace-to-XLA cache."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached_graphs: Dict[Any, _CachedGraph] = {}
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  inline_limit=2, **kwargs):
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc, static_shape=static_shape)
+        self._cached_graphs.clear()
+        super().hybridize(active, **kwargs)
+
+    def clear_cache(self):
+        self._cached_graphs.clear()
+        for c in self._children.values():
+            if isinstance(c, HybridBlock):
+                c.clear_cache()
+
+    def cast(self, dtype):
+        self._cached_graphs.clear()
+        super().cast(dtype)
+
+    # -- deferred shape inference ---------------------------------------------
+    def infer_shape(self, *args):
+        """Layers override to resolve deferred param shapes from inputs."""
+
+    def _ensure_params_ready(self, args):
+        params = self.collect_params()
+        pending = [p for p in params.values() if p._deferred_init is not None]
+        if not pending:
+            return
+        # run shape inference down the tree by a dry eager call per block
+        self._shape_probe(*args)
+        for p in pending:
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    def _shape_probe(self, *args):
+        """Default probe: call infer_shape hooks recursively by executing the
+        forward with ShapeDtypeStruct abstract eval."""
+        def run(*raw):
+            nds = [NDArray(r) for r in raw]
+            with autograd.pause():
+                out = self._forward_unhybridized(*nds)
+            flat, _, _ = _flatten_nd(out)
+            return tuple(flat)
+        raw, _, _ = _flatten_nd(list(args))
+        _TRACE_DEPTH[0] += 1
+        try:
+            try:
+                jax.eval_shape(run, *raw)
+            except DeferredInitializationError:
+                raise
+            except Exception:
+                # some layers need concrete values; fall back to real execution
+                nds = [NDArray(r) for r in raw]
+                with autograd.pause():
+                    self._forward_unhybridized(*nds)
+        finally:
+            _TRACE_DEPTH[0] -= 1
+
+    # -- forward ---------------------------------------------------------------
+    def forward(self, *args):
+        x = args[0] if args else None
+        if not isinstance(x, NDArray):
+            raise MXNetError(f"{type(self).__name__}.forward expects NDArray input")
+        # inside an enclosing trace, fold into the same XLA program instead of
+        # nesting another cached graph (keeps one fused computation)
+        use_cached = self._active and not in_trace()
+        try:
+            if use_cached:
+                return self._call_cached(*args)
+            return self._forward_unhybridized(*args)
+        except DeferredInitializationError:
+            self._ensure_params_ready(list(args))
+            if use_cached:
+                return self._call_cached(*args)
+            return self._forward_unhybridized(*args)
+
+    def _forward_unhybridized(self, *args):
+        kwargs = {}
+        for name, p in self._reg_params.items():
+            try:
+                kwargs[name] = p.data()
+            except DeferredInitializationError:
+                self.infer_shape(*args)
+                if p._deferred_init is not None:
+                    p._finish_deferred_init()
+                kwargs[name] = p.data()
+        return self.hybrid_forward(nd, *args, **kwargs)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- CachedOp path ---------------------------------------------------------
+    def _signature(self, raw_inputs):
+        return (tuple((tuple(r.shape), str(r.dtype)) for r in raw_inputs),
+                autograd.is_training(), autograd.is_recording())
+
+    def _call_cached(self, *args):
+        params_dict = self.collect_params()
+        plist = [p for p in params_dict.values() if p._data is not None or p._deferred_init is not None]
+        for p in plist:
+            if p._deferred_init is not None:
+                raise DeferredInitializationError(p.name)
+        raw_inputs, in_treedef, _ = _flatten_nd(list(args))
+        raw_params = [p._data._data for p in plist]
+        sig = self._signature(raw_inputs)
+        graph = self._cached_graphs.get(sig)
+        if graph is None:
+            graph = self._build_graph(args, in_treedef, plist, sig)
+            self._cached_graphs[sig] = graph
+        key = _rng.next_key_raw()
+        recording = autograd.is_recording()
+        all_raw = tuple(raw_inputs) + tuple(raw_params)
+        outs_flat, aux_vals = graph.fwd(key, *all_raw)
+        # apply aux updates (BN running stats) outside the trace
+        for p, v in zip(graph.aux_params, aux_vals):
+            p._data._set_data(v)
+        ctx = args[0].ctx if isinstance(args[0], NDArray) else current_context()
+        out_nds = [NDArray(o, ctx) for o in outs_flat]
+        if recording:
+            input_nds = [a for a in jax.tree_util.tree_leaves(
+                list(args), is_leaf=lambda x: isinstance(x, NDArray))]
+            param_nds = [p._data for p in plist]
+
+            def vjp_fn(cots, _graph=graph, _key=key, _all_raw=all_raw):
+                cots_t = cots if isinstance(cots, tuple) else (cots,)
+                return _graph.bwd(_key, _all_raw, tuple(cots_t))
+
+            autograd.record_op(vjp_fn, input_nds + param_nds, out_nds,
+                               out_is_tuple=len(out_nds) > 1)
+        out_tree = jax.tree_util.tree_unflatten(graph.out_treedef, out_nds)
+        return out_tree
+
+    def _build_graph(self, args, in_treedef, plist, sig) -> _CachedGraph:
+        graph = _CachedGraph()
+        n_in = len(_flatten_nd(list(args))[0])
+        train_flag, rec_flag = sig[1], sig[2]
+        block = self
+        aux_order: List[Parameter] = []
+        first_trace = {"done": False}
+
+        def pure_fn(key_raw, *flat):
+            raw_inputs = flat[:n_in]
+            raw_params = flat[n_in:]
+            in_nds = [NDArray(r) for r in raw_inputs]
+            args_nd = jax.tree_util.tree_unflatten(in_treedef, in_nds)
+            saved = [p._data._data for p in plist]
+            aux_collector: List[Tuple[Parameter, Any]] = []
+            _AUX_STACK.append(aux_collector)
+            _TRACE_DEPTH[0] += 1
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(train_flag)
+            _rng.push_trace_key(key_raw)
+            try:
+                for p, r in zip(plist, raw_params):
+                    p._data._data = r
+                out = block._forward_unhybridized(*args_nd)
+            finally:
+                _rng.pop_trace_key()
+                for p, s in zip(plist, saved):
+                    p._data._data = s
+                _AUX_STACK.pop()
+                _TRACE_DEPTH[0] -= 1
+                autograd.set_recording(prev_rec)
+                autograd.set_training(prev_train)
+            out_flat, out_treedef, _ = _flatten_nd(out)
+            if not first_trace["done"]:
+                graph.out_treedef = out_treedef
+                aux_order.extend(p for p, _ in aux_collector)
+                first_trace["done"] = True
+            return tuple(out_flat), tuple(v for _, v in aux_collector)
+
+        fwd_jit = jax.jit(pure_fn)
+
+        def bwd_impl(key_raw, all_raw, cots):
+            def fwd_only(*flat):
+                outs, _aux = pure_fn(key_raw, *flat)
+                return outs
+            _, vjp = jax.vjp(fwd_only, *all_raw)
+            return vjp(cots)
+
+        bwd_jit = jax.jit(bwd_impl)
+        graph.fwd = fwd_jit
+        graph.bwd = bwd_jit
+        graph.aux_params = aux_order
+        return graph
+
+    # -- deployment -----------------------------------------------------------
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Save params + architecture manifest (reference HybridBlock.export).
+        The compiled program is XLA's concern; we persist parameters and a
+        config manifest for SymbolBlock-style reload."""
+        import json
+        self.save_parameters(f"{path}-{epoch:04d}.params")
+        manifest = {"framework": "mxnet_tpu", "class": type(self).__name__,
+                    "prefix": self._prefix}
+        with open(f"{path}-symbol.json", "w") as f:
+            json.dump(manifest, f)
+        return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
+
+    def optimize_for(self, x, backend=None, **kwargs):
+        self.hybridize()
+        return self(x)
+
+
+class SymbolBlock(HybridBlock):
+    """Load an exported model (reference gluon/block.py:1193). Until a
+    serialized-jaxpr format lands, SymbolBlock wraps a python-constructed
+    block + params file."""
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        raise MXNetError(
+            "SymbolBlock.imports requires the jaxpr-serialization round; "
+            "reconstruct the architecture in python and load_parameters()")

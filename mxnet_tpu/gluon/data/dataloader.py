@@ -1,0 +1,109 @@
+"""DataLoader (reference python/mxnet/gluon/data/dataloader.py:28-102).
+
+The reference forks worker processes sharing NDArrays through POSIX shm
+(cpu_shared_storage_manager). Forking is hostile to a live PJRT/TPU client,
+so workers here are threads running the numpy-side of the pipeline (decode/
+augment release the GIL in numpy/PIL), with batches staged host-side and
+device_put once per batch — the same overlap the reference's PrefetcherIter
+provides.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+import numpy as _np
+
+from ...ndarray import NDArray, array
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+        return NDArray(jnp.stack([d._data for d in data]), data[0].ctx)
+    if isinstance(data[0], tuple):
+        return tuple(default_batchify_fn(list(x)) for x in zip(*data))
+    arr = _np.asarray(data)
+    if arr.dtype == _np.float64:
+        arr = arr.astype(_np.float32)
+    return array(arr)
+
+
+def default_mp_batchify_fn(data):
+    return default_batchify_fn(data)
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, batch_size=None, shuffle=False,
+                 sampler: Optional[Sampler] = None, last_batch=None,
+                 batch_sampler=None, batchify_fn=None, num_workers=0,
+                 pin_memory=False, pin_device_id=0, prefetch=None,
+                 thread_pool=False, timeout=120):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        self._timeout = timeout
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch_idx in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in batch_idx])
+            return
+        yield from self._threaded_iter()
+
+    def _threaded_iter(self):
+        batches = list(self._batch_sampler)
+        out_q: "queue.Queue" = queue.Queue()
+        n_batches = len(batches)
+        task_q: "queue.Queue" = queue.Queue()
+        results = {}
+        for i, b in enumerate(batches):
+            task_q.put((i, b))
+
+        def worker():
+            while True:
+                try:
+                    i, idx = task_q.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    samples = [self._dataset[j] for j in idx]
+                    out_q.put((i, self._batchify_fn(samples)))
+                except Exception as e:
+                    out_q.put((i, e))
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self._num_workers)]
+        for t in threads:
+            t.start()
+        next_out = 0
+        received = 0
+        while next_out < n_batches:
+            while next_out not in results:
+                i, payload = out_q.get(timeout=self._timeout)
+                results[i] = payload
+                received += 1
+            payload = results.pop(next_out)
+            next_out += 1
+            if isinstance(payload, Exception):
+                raise payload
+            yield payload

@@ -1,0 +1,5 @@
+from . import transforms
+from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageFolderDataset
+
+__all__ = ["transforms", "MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageFolderDataset"]

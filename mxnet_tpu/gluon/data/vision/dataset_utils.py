@@ -1,0 +1,5 @@
+"""Shared helpers for vision datasets."""
+
+
+class SyntheticMixin:
+    pass

@@ -1,0 +1,148 @@
+"""Vision datasets (reference python/mxnet/gluon/data/vision/datasets.py).
+
+Zero-egress environment: when the canonical files are absent under `root`,
+datasets fall back to deterministic synthetic data with the right shapes and
+label structure so examples/tests run hermetically.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as _np
+
+from .dataset_utils import SyntheticMixin
+from ..dataset import Dataset
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, train, transform):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __len__(self):
+        return len(self._label)
+
+    def __getitem__(self, idx):
+        x = self._data[idx]
+        y = self._label[idx]
+        if self._transform is not None:
+            return self._transform(x, y)
+        return x, y
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST; synthetic fallback (28x28x1 uint8, 10 classes)."""
+
+    _shape = (28, 28, 1)
+    _classes = 10
+    _files = {True: ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz"),
+              False: ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz")}
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None, synthetic_size=2048):
+        self._synthetic_size = synthetic_size
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        img_f, lab_f = self._files[self._train]
+        img_p = os.path.join(self._root, img_f)
+        lab_p = os.path.join(self._root, lab_f)
+        if os.path.exists(img_p) and os.path.exists(lab_p):
+            with gzip.open(lab_p, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                label = _np.frombuffer(f.read(), dtype=_np.uint8).astype(_np.int32)
+            with gzip.open(img_p, "rb") as f:
+                _, n, r, c = struct.unpack(">IIII", f.read(16))
+                data = _np.frombuffer(f.read(), dtype=_np.uint8).reshape(n, r, c, 1)
+        else:
+            rng = _np.random.RandomState(42 if self._train else 43)
+            n = self._synthetic_size
+            label = rng.randint(0, self._classes, n).astype(_np.int32)
+            base = rng.rand(self._classes, *self._shape)
+            data = ((base[label] * 0.6 + rng.rand(n, *self._shape) * 0.4) * 255) \
+                .astype(_np.uint8)
+        self._data = data
+        self._label = label
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "fashion-mnist"),
+                 train=True, transform=None, synthetic_size=2048):
+        super().__init__(root, train, transform, synthetic_size)
+
+
+class CIFAR10(_DownloadedDataset):
+    _shape = (32, 32, 3)
+    _classes = 10
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None, synthetic_size=2048):
+        self._synthetic_size = synthetic_size
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        files = [os.path.join(self._root, f"data_batch_{i}.bin") for i in range(1, 6)] \
+            if self._train else [os.path.join(self._root, "test_batch.bin")]
+        if all(os.path.exists(f) for f in files):
+            datas, labels = [], []
+            for fn in files:
+                raw = _np.fromfile(fn, dtype=_np.uint8).reshape(-1, 3073)
+                labels.append(raw[:, 0].astype(_np.int32))
+                datas.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+            self._data = _np.concatenate(datas)
+            self._label = _np.concatenate(labels)
+        else:
+            rng = _np.random.RandomState(44 if self._train else 45)
+            n = self._synthetic_size
+            self._label = rng.randint(0, self._classes, n).astype(_np.int32)
+            base = rng.rand(self._classes, *self._shape)
+            self._data = ((base[self._label] * 0.6 +
+                           rng.rand(n, *self._shape) * 0.4) * 255).astype(_np.uint8)
+
+
+class CIFAR100(CIFAR10):
+    _classes = 100
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar100"),
+                 fine_label=False, train=True, transform=None, synthetic_size=2048):
+        super().__init__(root, train, transform, synthetic_size)
+
+
+class ImageFolderDataset(Dataset):
+    """folder/label_name/image.jpg layout (reference ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fn in sorted(os.listdir(path)):
+                if fn.lower().endswith((".jpg", ".jpeg", ".png", ".npy")):
+                    self.items.append((os.path.join(path, fn), label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        fn, label = self.items[idx]
+        if fn.endswith(".npy"):
+            img = _np.load(fn)
+        else:
+            from ....image import imread
+            img = imread(fn).asnumpy()
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label

@@ -1,0 +1,200 @@
+"""Vision transforms (reference python/mxnet/gluon/data/vision/transforms.py +
+src/operator/image/*). Numpy/host-side: transforms run in DataLoader workers
+on uint8 arrays before device_put — keeping the TPU free for the model."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ....ndarray import NDArray, array
+from ...block import Block, HybridBlock
+from ...nn import Sequential
+
+
+class Compose(Sequential):
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        if isinstance(x, NDArray):
+            return x.astype(self._dtype)
+        return _np.asarray(x, dtype=self._dtype)
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def forward(self, x):
+        a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        a = a.astype(_np.float32) / 255.0
+        if a.ndim == 3:
+            a = a.transpose(2, 0, 1)
+        elif a.ndim == 4:
+            a = a.transpose(0, 3, 1, 2)
+        return a
+
+
+class Normalize(Block):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = _np.asarray(mean, dtype=_np.float32)
+        self._std = _np.asarray(std, dtype=_np.float32)
+
+    def forward(self, x):
+        a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x, dtype=_np.float32)
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return (a - mean) / std
+
+
+def _resize_np(a, size):
+    """Nearest-neighbor host resize (OpenCV-free)."""
+    h, w = a.shape[:2]
+    oh, ow = (size, size) if isinstance(size, int) else (size[1], size[0])
+    ri = (_np.arange(oh) * h / oh).astype(int).clip(0, h - 1)
+    ci = (_np.arange(ow) * w / ow).astype(int).clip(0, w - 1)
+    return a[ri][:, ci]
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+
+    def forward(self, x):
+        a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        return _resize_np(a, self._size)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        ow, oh = self._size
+        h, w = a.shape[:2]
+        if h < oh or w < ow:
+            a = _resize_np(a, (max(ow, w), max(oh, h)))
+            h, w = a.shape[:2]
+        y0 = (h - oh) // 2
+        x0 = (w - ow) // 2
+        return a[y0:y0 + oh, x0:x0 + ow]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3), interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = _np.random.uniform(*self._scale) * area
+            ar = _np.exp(_np.random.uniform(_np.log(self._ratio[0]), _np.log(self._ratio[1])))
+            nw = int(round(_np.sqrt(target_area * ar)))
+            nh = int(round(_np.sqrt(target_area / ar)))
+            if nw <= w and nh <= h:
+                x0 = _np.random.randint(0, w - nw + 1)
+                y0 = _np.random.randint(0, h - nh + 1)
+                crop = a[y0:y0 + nh, x0:x0 + nw]
+                return _resize_np(crop, self._size)
+        return _resize_np(a, self._size)
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+
+    def forward(self, x):
+        a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        if self._pad:
+            p = self._pad
+            a = _np.pad(a, ((p, p), (p, p), (0, 0)), mode="constant")
+        h, w = a.shape[:2]
+        ow, oh = self._size
+        y0 = _np.random.randint(0, max(h - oh, 0) + 1)
+        x0 = _np.random.randint(0, max(w - ow, 0) + 1)
+        return a[y0:y0 + oh, x0:x0 + ow]
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        if _np.random.rand() < 0.5:
+            a = a[:, ::-1].copy()
+        return a
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        if _np.random.rand() < 0.5:
+            a = a[::-1].copy()
+        return a
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        a = _np.asarray(x, dtype=_np.float32)
+        f = 1.0 + _np.random.uniform(-self._b, self._b)
+        return _np.clip(a * f, 0, 255)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        a = _np.asarray(x, dtype=_np.float32)
+        f = 1.0 + _np.random.uniform(-self._c, self._c)
+        mean = a.mean()
+        return _np.clip((a - mean) * f + mean, 0, 255)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        a = _np.asarray(x, dtype=_np.float32)
+        f = 1.0 + _np.random.uniform(-self._s, self._s)
+        gray = a.mean(axis=-1, keepdims=True)
+        return _np.clip(gray + (a - gray) * f, 0, 255)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+
+    def forward(self, x):
+        for t in self._ts:
+            x = t(x)
+        return x

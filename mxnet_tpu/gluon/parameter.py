@@ -1,0 +1,317 @@
+"""Parameter / ParameterDict (reference python/mxnet/gluon/parameter.py).
+
+Parameters hold NDArrays; deferred init (shape with 0 dims) resolves at first
+forward. TPU addition: every Parameter carries an optional `sharding`
+(jax.sharding.PartitionSpec) consumed by the parallel trainer to lay the
+weight out over the device mesh.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray import NDArray, zeros, array
+from .. import initializer as init_mod
+from ..initializer import InitDesc
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default",
+                 sharding=None):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self.stype = stype
+        self.grad_stype = grad_stype
+        self.sharding = sharding  # PartitionSpec | None (TPU-native)
+        self.attrs: Dict[str, str] = {}
+        self._data: Optional[NDArray] = None
+        self._grad: Optional[NDArray] = None
+        self._deferred_init = None  # (init, ctx, default_init)
+        self._ctx_list: List[Context] = []
+
+    # -- properties ----------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise MXNetError(f"invalid grad_req {req}")
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._grad = None
+                self._data._ag_node = None
+            else:
+                self._init_grad()
+
+    def _shape_complete(self):
+        return self.shape is not None and all(s > 0 for s in self.shape)
+
+    # -- init ----------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        default_init = default_init or init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        if not self._shape_complete():
+            if self.allow_deferred_init:
+                self._deferred_init = (init, list(ctx), default_init)
+                return
+            raise MXNetError(
+                f"cannot initialize parameter '{self.name}' with incomplete "
+                f"shape {self.shape}; set allow_deferred_init or give full shape")
+        self._finish_init(init, default_init)
+
+    def _finish_init(self, init, default_init):
+        import jax
+        ctx = self._ctx_list[0] if self._ctx_list else current_context()
+        # deferred init can trigger inside a shape-probe trace: parameter
+        # material must always be concrete, so escape any live trace
+        with jax.ensure_compile_time_eval():
+            data = zeros(self.shape, ctx=ctx, dtype=self.dtype)
+            initializer = init_mod.create(init) if init is not None else \
+                (init_mod.create(self.init) if self.init is not None else
+                 init_mod.create(default_init))
+            initializer(InitDesc(self.name, self.attrs), data)
+            self._data = data
+            self._deferred_init = None
+            if self._grad_req != "null":
+                self._init_grad()
+
+    def _finish_deferred_init(self, in_shape_hint=None):
+        if self._deferred_init is None:
+            raise DeferredInitializationError(
+                f"parameter '{self.name}' not fully initialized")
+        init, ctx, default_init = self._deferred_init
+        if not self._shape_complete():
+            raise DeferredInitializationError(
+                f"deferred parameter '{self.name}' still has unknown shape {self.shape}")
+        self._ctx_list = ctx
+        self._finish_init(init, default_init)
+
+    def _init_grad(self):
+        from .. import autograd
+        self._grad = zeros(self.shape, ctx=self._data.ctx, dtype=self._data.dtype)
+        autograd.mark_variables([self._data], [self._grad], grad_reqs=self._grad_req)
+
+    # -- access --------------------------------------------------------------
+    def _check_initialized(self):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"parameter '{self.name}' deferred; run a forward pass first")
+            raise MXNetError(
+                f"parameter '{self.name}' has not been initialized; call "
+                f".initialize() first")
+
+    def data(self, ctx=None) -> NDArray:
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        self._check_initialized()
+        return [self._data]
+
+    def grad(self, ctx=None) -> NDArray:
+        self._check_initialized()
+        if self._grad is None:
+            raise MXNetError(f"parameter '{self.name}' has grad_req='null'")
+        return self._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None and self._deferred_init is not None:
+            return self._deferred_init[1]
+        self._check_initialized()
+        return self._ctx_list or [self._data.ctx]
+
+    def set_data(self, data):
+        if self.shape is not None and self._shape_complete():
+            if tuple(data.shape) != tuple(self.shape):
+                raise MXNetError(
+                    f"shape mismatch for '{self.name}': {data.shape} vs {self.shape}")
+        self.shape = tuple(data.shape)
+        if not isinstance(data, NDArray):
+            data = array(data, dtype=self.dtype)
+        if self._data is None:
+            self._data = data
+            if self._grad_req != "null":
+                self._init_grad()
+        else:
+            self._data._set_data(data._data.astype(jnp.dtype(self.dtype)))
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._set_data(jnp.zeros(self._grad.shape, self._grad.dtype))
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx[0])
+            if self._grad_req != "null":
+                self._init_grad()
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            self._data._set_data(self._data._data.astype(jnp.dtype(dtype)))
+            if self._grad is not None:
+                self._init_grad()
+
+    def var(self):
+        raise MXNetError("symbolic var() is not part of the TPU framework; "
+                         "hybridize() traces directly to XLA")
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+
+class Constant(Parameter):
+    """Constant parameter (reference gluon/parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = array(value)
+        self.value = value
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=str(value.dtype),
+                         init=init_mod.Constant(0), differentiable=False)
+        self._data = value
+
+    def _finish_init(self, init, default_init):
+        self._data = self.value
+
+
+class ParameterDict:
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def get(self, name, **kwargs) -> Parameter:
+        full = self._prefix + name
+        if full in self._params:
+            p = self._params[full]
+            for k, v in kwargs.items():
+                if v is not None and getattr(p, k, None) in (None, 0, (), "write") \
+                        and k in ("shape", "dtype", "init"):
+                    setattr(p, k, tuple(v) if k == "shape" and isinstance(v, (list, tuple)) else v)
+            return p
+        if self._shared is not None and full in self._shared:
+            self._params[full] = self._shared[full]
+            return self._params[full]
+        p = Parameter(full, **kwargs)
+        self._params[full] = p
+        return p
+
+    def get_constant(self, name, value=None):
+        full = self._prefix + name
+        if full not in self._params:
+            self._params[full] = Constant(full, value)
+        return self._params[full]
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"duplicate parameter name {k}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        for p in self._params.values():
+            p.initialize(init=None, ctx=ctx, default_init=init or init_mod.Uniform(),
+                         force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self._params.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self._params.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..serialization import save_ndarrays
+        d = {}
+        for name, p in self._params.items():
+            key = name[len(strip_prefix):] if name.startswith(strip_prefix) else name
+            d["arg:" + key] = p.data()
+        save_ndarrays(filename, d)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..serialization import load_ndarrays
+        loaded = load_ndarrays(filename)
+        loaded = {k.split(":", 1)[1] if ":" in k else k: v for k, v in loaded.items()}
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        for name, p in self._params.items():
+            if name in loaded:
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise MXNetError(f"parameter {name} missing from {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise MXNetError(f"extra parameters in file: {sorted(extra)[:5]}")
+
+    def __repr__(self):
+        lines = [f"  {p}" for p in self._params.values()]
+        return "ParameterDict(\n" + "\n".join(lines) + "\n)"

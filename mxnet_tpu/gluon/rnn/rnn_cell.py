@@ -1,0 +1,305 @@
+"""RNN cell API (reference python/mxnet/gluon/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...ndarray import NDArray
+from ..block import HybridBlock
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        from ... import ndarray as nd
+        func = func or nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            states.append(func(shape=info["shape"], ctx=ctx, **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as nd
+        axis = layout.find("T")
+        if isinstance(inputs, NDArray):
+            parts = nd.SliceChannel(inputs, num_outputs=length, axis=axis,
+                                    squeeze_axis=True)
+            inputs = parts if isinstance(parts, list) else [parts]
+        batch = inputs[0].shape[0]
+        states = begin_state or self.begin_state(batch, ctx=inputs[0].ctx,
+                                                 dtype=inputs[0].dtype)
+        outputs = []
+        for t in range(length):
+            out, states = self(inputs[t], states)
+            outputs.append(out)
+        if merge_outputs:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def forward(self, x, states):
+        self._counter += 1
+        return super().forward(x, states)
+
+
+class HybridRecurrentCell(RecurrentCell):
+    pass
+
+
+class RNNCell(HybridRecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = self.params.get("i2h_weight", shape=(hidden_size, input_size),
+                                          init=i2h_weight_initializer,
+                                          allow_deferred_init=True)
+        self.h2h_weight = self.params.get("h2h_weight", shape=(hidden_size, hidden_size),
+                                          init=h2h_weight_initializer,
+                                          allow_deferred_init=True)
+        self.i2h_bias = self.params.get("i2h_bias", shape=(hidden_size,),
+                                        init=i2h_bias_initializer,
+                                        allow_deferred_init=True)
+        self.h2h_bias = self.params.get("h2h_bias", shape=(hidden_size,),
+                                        init=h2h_bias_initializer,
+                                        allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias, h2h_bias):
+        prev = states[0] if isinstance(states, (list, tuple)) else states
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(prev, h2h_weight, h2h_bias, num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(HybridRecurrentCell):
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = 4
+        self.i2h_weight = self.params.get("i2h_weight", shape=(ng * hidden_size, input_size),
+                                          init=i2h_weight_initializer,
+                                          allow_deferred_init=True)
+        self.h2h_weight = self.params.get("h2h_weight", shape=(ng * hidden_size, hidden_size),
+                                          init=h2h_weight_initializer,
+                                          allow_deferred_init=True)
+        self.i2h_bias = self.params.get("i2h_bias", shape=(ng * hidden_size,),
+                                        init=i2h_bias_initializer,
+                                        allow_deferred_init=True)
+        self.h2h_bias = self.params.get("h2h_bias", shape=(ng * hidden_size,),
+                                        init=h2h_bias_initializer,
+                                        allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias, h2h_bias):
+        h_prev, c_prev = states
+        nh = self._hidden_size
+        gates = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=4 * nh) + \
+            F.FullyConnected(h_prev, h2h_weight, h2h_bias, num_hidden=4 * nh)
+        parts = F.SliceChannel(gates, num_outputs=4, axis=1)
+        i = F.sigmoid(parts[0])
+        f = F.sigmoid(parts[1])
+        g = F.tanh(parts[2])
+        o = F.sigmoid(parts[3])
+        c = f * c_prev + i * g
+        h = o * F.tanh(c)
+        return h, [h, c]
+
+
+class GRUCell(HybridRecurrentCell):
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._hidden_size = hidden_size
+        ng = 3
+        self.i2h_weight = self.params.get("i2h_weight", shape=(ng * hidden_size, input_size),
+                                          init=i2h_weight_initializer,
+                                          allow_deferred_init=True)
+        self.h2h_weight = self.params.get("h2h_weight", shape=(ng * hidden_size, hidden_size),
+                                          init=h2h_weight_initializer,
+                                          allow_deferred_init=True)
+        self.i2h_bias = self.params.get("i2h_bias", shape=(ng * hidden_size,),
+                                        init=i2h_bias_initializer,
+                                        allow_deferred_init=True)
+        self.h2h_bias = self.params.get("h2h_bias", shape=(ng * hidden_size,),
+                                        init=h2h_bias_initializer,
+                                        allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias, h2h_bias):
+        prev = states[0] if isinstance(states, (list, tuple)) else states
+        nh = self._hidden_size
+        gx = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=3 * nh)
+        gh = F.FullyConnected(prev, h2h_weight, h2h_bias, num_hidden=3 * nh)
+        xp = F.SliceChannel(gx, num_outputs=3, axis=1)
+        hp = F.SliceChannel(gh, num_outputs=3, axis=1)
+        r = F.sigmoid(xp[0] + hp[0])
+        z = F.sigmoid(xp[1] + hp[1])
+        n = F.tanh(xp[2] + r * hp[2])
+        h = (1 - z) * n + z * prev
+        return h, [h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        out = []
+        for c in self._children.values():
+            out.extend(c.state_info(batch_size))
+        return out
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        out = []
+        for c in self._children.values():
+            out.extend(c.begin_state(batch_size, func, ctx=ctx, **kwargs))
+        return out
+
+    def forward(self, x, states):
+        next_states = []
+        p = 0
+        for c in self._children.values():
+            n = len(c.state_info())
+            x, s = c(x, states[p:p + n])
+            next_states.extend(s)
+            p += n
+        return x, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+
+class DropoutCell(HybridRecurrentCell):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, x, states):
+        from ... import autograd
+        if self._rate > 0:
+            x = F.Dropout(x, p=self._rate, axes=self._axes,
+                          training=autograd.is_training() or autograd.is_recording())
+        return x, states
+
+
+class ModifierCell(HybridRecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__()
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        return self.base_cell.begin_state(batch_size, func, ctx=ctx, **kwargs)
+
+
+class ZoneoutCell(ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def hybrid_forward(self, F, x, states):
+        from ... import autograd
+        out, next_states = self.base_cell(x, states)
+        if not (autograd.is_training() or autograd.is_recording()):
+            return out, next_states
+        from ... import ndarray as nd
+        po = self._prev_output if self._prev_output is not None else out * 0
+        if self.zoneout_outputs > 0:
+            mask = nd.random.bernoulli(self.zoneout_outputs, out.shape, ctx=out.ctx)
+            out = mask * po + (1 - mask) * out
+        if self.zoneout_states > 0:
+            blended = []
+            for s_new, s_old in zip(next_states, states):
+                mask = nd.random.bernoulli(self.zoneout_states, s_new.shape, ctx=s_new.ctx)
+                blended.append(mask * s_old + (1 - mask) * s_new)
+            next_states = blended
+        self._prev_output = out
+        return out, next_states
+
+
+class ResidualCell(ModifierCell):
+    def hybrid_forward(self, F, x, states):
+        out, next_states = self.base_cell(x, states)
+        return out + x, next_states
+
+
+class BidirectionalCell(HybridRecurrentCell):
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__()
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + self.r_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        return (self.l_cell.begin_state(batch_size, func, ctx=ctx, **kwargs) +
+                self.r_cell.begin_state(batch_size, func, ctx=ctx, **kwargs))
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as nd
+        axis = layout.find("T")
+        if isinstance(inputs, NDArray):
+            seq = nd.SliceChannel(inputs, num_outputs=length, axis=axis,
+                                  squeeze_axis=True)
+            inputs = list(seq) if isinstance(seq, list) else [seq]
+        batch = inputs[0].shape[0]
+        nl = len(self.l_cell.state_info())
+        states = begin_state or self.begin_state(batch, ctx=inputs[0].ctx,
+                                                 dtype=inputs[0].dtype)
+        l_states, r_states = states[:nl], states[nl:]
+        l_out, l_states = self.l_cell.unroll(length, inputs, l_states, layout, False)
+        r_out, r_states = self.r_cell.unroll(length, list(reversed(inputs)),
+                                             r_states, layout, False)
+        outputs = [nd.Concat(lo, ro, dim=1)
+                   for lo, ro in zip(l_out, reversed(r_out))]
+        if merge_outputs:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, l_states + r_states

@@ -1,0 +1,172 @@
+"""Fused RNN layers (reference python/mxnet/gluon/rnn/rnn_layer.py:307-535).
+
+Parameters are stored per-layer/gate (i2h/h2h weight+bias, cuDNN gate order)
+and packed into the fused RNN op's flat vector at call time — checkpoint
+layout matches the reference's unfused view.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...ndarray import NDArray
+from ..block import HybridBlock
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer, mode,
+                 prefix=None, params=None):
+        super().__init__(prefix, params)
+        assert layout in ("TNC", "NTC"), f"invalid layout {layout}"
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in (["l", "r"] if bidirectional else ["l"]):
+                setattr(self, f"{j}{i}_i2h_weight",
+                        self.params.get(f"{j}{i}_i2h_weight", shape=(ng * nh, ni),
+                                        init=i2h_weight_initializer,
+                                        allow_deferred_init=True))
+                setattr(self, f"{j}{i}_h2h_weight",
+                        self.params.get(f"{j}{i}_h2h_weight", shape=(ng * nh, nh),
+                                        init=h2h_weight_initializer,
+                                        allow_deferred_init=True))
+                setattr(self, f"{j}{i}_i2h_bias",
+                        self.params.get(f"{j}{i}_i2h_bias", shape=(ng * nh,),
+                                        init=i2h_bias_initializer,
+                                        allow_deferred_init=True))
+                setattr(self, f"{j}{i}_h2h_bias",
+                        self.params.get(f"{j}{i}_h2h_bias", shape=(ng * nh,),
+                                        init=h2h_bias_initializer,
+                                        allow_deferred_init=True))
+            ni = nh * self._dir
+
+    def infer_shape(self, x, *args):
+        isz = x.shape[2] if self._layout == "TNC" else x.shape[2]
+        ng, nh = self._gates, self._hidden_size
+        ni = isz
+        for i in range(self._num_layers):
+            for j in (["l", "r"] if self._dir == 2 else ["l"]):
+                getattr(self, f"{j}{i}_i2h_weight").shape = (ng * nh, ni)
+            ni = nh * self._dir
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        from ... import ndarray as nd
+        func = func or nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(func(shape=info["shape"], ctx=ctx, **kwargs)
+                          if "shape" in info else func(**info, **kwargs))
+        return states
+
+    def _collect_param_list(self):
+        names = []
+        for i in range(self._num_layers):
+            for j in (["l", "r"] if self._dir == 2 else ["l"]):
+                names.append((f"{j}{i}_i2h_weight", f"{j}{i}_h2h_weight"))
+        bias = []
+        for i in range(self._num_layers):
+            for j in (["l", "r"] if self._dir == 2 else ["l"]):
+                bias.append((f"{j}{i}_i2h_bias", f"{j}{i}_h2h_bias"))
+        return names, bias
+
+    def hybrid_forward(self, F, x, *states, **params):
+        if self._layout == "NTC":
+            x = F.swapaxes(x, dim1=0, dim2=1)
+        batch = x.shape[1]
+        if not states:
+            states = None
+        if states is None:
+            states = self.begin_state(batch, ctx=x.ctx, dtype=x.dtype)
+            states_given = False
+        else:
+            states = list(states[0]) if isinstance(states[0], (list, tuple)) else list(states)
+            states_given = True
+        # pack flat parameter vector (weights then biases, cuDNN layout)
+        wn, bn = self._collect_param_list()
+        flats = []
+        for a, b in wn:
+            flats.append(params[a].reshape((-1,)))
+            flats.append(params[b].reshape((-1,)))
+        for a, b in bn:
+            flats.append(params[a].reshape((-1,)))
+            flats.append(params[b].reshape((-1,)))
+        flat = F.Concat(*flats, dim=0) if len(flats) > 1 else flats[0]
+        rnn_args = [x, flat, states[0]]
+        if self._mode == "lstm":
+            rnn_args.append(states[1])
+        outs = F.RNN(*rnn_args, state_size=self._hidden_size,
+                     num_layers=self._num_layers, mode=self._mode,
+                     bidirectional=self._dir == 2, p=self._dropout,
+                     state_outputs=True)
+        out = outs[0]
+        out_states = list(outs[1:])
+        if self._layout == "NTC":
+            out = F.swapaxes(out, dim1=0, dim2=1)
+        if states_given:
+            return out, out_states
+        return out
+
+    def __call__(self, x, *states):
+        return super().__call__(x, *states)
+
+
+class RNN(_RNNLayer):
+    """Vanilla multi-layer Elman RNN (reference rnn_layer.py:307)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu", layout="TNC",
+                 dropout=0, bidirectional=False, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None, params=None):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer, mode,
+                         prefix, params)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size, self._hidden_size)}]
+
+
+class LSTM(_RNNLayer):
+    """reference rnn_layer.py:389."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer, "lstm",
+                         prefix, params)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape}, {"shape": shape}]
+
+
+class GRU(_RNNLayer):
+    """reference rnn_layer.py:476."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer, "gru",
+                         prefix, params)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size, self._hidden_size)}]

@@ -1,0 +1,154 @@
+"""gluon.Trainer (reference python/mxnet/gluon/trainer.py:28).
+
+Eager training driver: applies an Optimizer to a ParameterDict, optionally
+through a KVStore (push/pull facade). On TPU the heavy path is
+`mxnet_tpu.parallel.DataParallelTrainer` which fuses forward+backward+
+allreduce+update into one jitted step; this class keeps the reference's
+imperative semantics for flexibility and parity.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from .. import kvstore as kvs_mod
+from .parameter import Parameter, ParameterDict
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a ParameterDict/dict/list of Parameter")
+        self._params: List[Parameter] = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p!r}")
+            self._param2idx[p.name] = i
+            self._params.append(p)
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._compression_params = compression_params
+        self._kvstore_str = kvstore
+        self._kvstore: Optional[kvs_mod.KVStore] = None
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = False
+        self._params_to_init: List[Parameter] = []
+        self._contains_sparse_weight = False
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params and set(optimizer_params) - {"rescale_grad"}:
+                raise MXNetError("optimizer_params must be None when optimizer "
+                                 "is an Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer, param_dict=param_dict,
+                                             **optimizer_params)
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        if self._kvstore_str:
+            kv = kvs_mod.create(self._kvstore_str) if isinstance(self._kvstore_str, str) \
+                else self._kvstore_str
+            self._kvstore = kv
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
+            update_on_kv = self._update_on_kvstore
+            if update_on_kv is None:
+                update_on_kv = kv.type.startswith("dist")
+            self._update_on_kvstore_flag = update_on_kv
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    kv.init(i, p.data())
+            if update_on_kv:
+                kv.set_optimizer(self._optimizer)
+        else:
+            self._kvstore = None
+            self._update_on_kvstore_flag = False
+        self._kv_initialized = True
+
+    # -- properties ----------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- step ------------------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """rescale grads by 1/batch_size, allreduce, update (reference
+        trainer.py:320)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            if self._update_on_kvstore_flag:
+                # weights live on the store: fused pushpull applies update there
+                self._kvstore.pushpull(i, p.grad(), out=p.data())
+            else:
+                self._kvstore.push(i, p.grad())
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._kvstore is not None and self._update_on_kvstore_flag:
+            return  # already applied in pushpull
+        updater = self._updaters[0]
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            updater(i, p.grad(), p.data())
+
+    def zero_grad(self):
+        for p in self._params:
+            p.zero_grad()
+
+    # -- states ----------------------------------------------------------------
+    def save_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is not None and self._update_on_kvstore_flag:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is not None and self._update_on_kvstore_flag:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updaters[0].set_states(f.read())
+            self._optimizer = self._updaters[0].optimizer

@@ -1,0 +1,76 @@
+"""gluon.utils (reference python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+from typing import List
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray import NDArray
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data of shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list: List[Context], batch_axis=0, even_split=True):
+    """Slice batch across contexts (reference utils.py split_and_load). On a
+    one-chip host this is the identity; across a mesh prefer the fused
+    parallel path."""
+    from ..ndarray import array
+    if not isinstance(data, NDArray):
+        data = array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: List[NDArray], max_norm: float, check_isfinite=True):
+    """reference utils.py clip_global_norm."""
+    import jax.numpy as jnp
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(a._data.astype(jnp.float32)))
+                         for a in arrays))
+    total_f = float(total)
+    if check_isfinite and not jnp.isfinite(total):
+        import warnings
+        warnings.warn("nan or inf in clip_global_norm")
+    scale = max_norm / (total_f + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._set_data(a._data * scale)
+    return total_f
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    h = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            d = f.read(1048576)
+            if not d:
+                break
+            h.update(d)
+    return h.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    raise MXNetError("network egress is disabled in this environment; place "
+                     "files locally and pass their path")
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    return all(s > 0 for s in shape)

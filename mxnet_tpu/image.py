@@ -1,0 +1,89 @@
+"""mx.image namespace (reference python/mxnet/image/). Host-side image ops;
+cv2 used when present, with numpy fallbacks for .npy/array inputs."""
+from __future__ import annotations
+
+import os
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+
+
+def imread(filename, flag=1, to_rgb=True):
+    if filename.endswith(".npy"):
+        return array(_np.load(filename))
+    try:
+        import cv2
+    except ImportError:
+        raise MXNetError("imread requires cv2 for encoded images; "
+                         ".npy arrays are supported natively")
+    img = cv2.imread(filename, flag)
+    if img is None:
+        raise MXNetError(f"cannot read {filename}")
+    if to_rgb and img.ndim == 3:
+        img = img[:, :, ::-1]
+    return array(img.copy())
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    try:
+        import cv2
+    except ImportError:
+        raise MXNetError("imdecode requires cv2")
+    img = cv2.imdecode(_np.frombuffer(buf, dtype=_np.uint8), flag)
+    if to_rgb and img is not None and img.ndim == 3:
+        img = img[:, :, ::-1]
+    return array(img.copy())
+
+
+def imresize(src, w, h, interp=1):
+    a = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    ri = (_np.arange(h) * a.shape[0] / h).astype(int).clip(0, a.shape[0] - 1)
+    ci = (_np.arange(w) * a.shape[1] / w).astype(int).clip(0, a.shape[1] - 1)
+    return array(a[ri][:, ci])
+
+
+def resize_short(src, size, interp=1):
+    a = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    h, w = a.shape[:2]
+    if h < w:
+        nh, nw = size, int(w * size / h)
+    else:
+        nh, nw = int(h * size / w), size
+    return imresize(a, nw, nh, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    a = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    out = a[y0:y0 + h, x0:x0 + w]
+    if size is not None:
+        return imresize(out, size[0], size[1], interp)
+    return array(out)
+
+
+def center_crop(src, size, interp=1):
+    a = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    h, w = a.shape[:2]
+    ow, oh = size
+    x0 = (w - ow) // 2
+    y0 = (h - oh) // 2
+    return fixed_crop(a, x0, y0, ow, oh), (x0, y0, ow, oh)
+
+
+def random_crop(src, size, interp=1):
+    a = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    h, w = a.shape[:2]
+    ow, oh = size
+    x0 = _np.random.randint(0, max(w - ow, 0) + 1)
+    y0 = _np.random.randint(0, max(h - oh, 0) + 1)
+    return fixed_crop(a, x0, y0, ow, oh), (x0, y0, ow, oh)
+
+
+def color_normalize(src, mean, std=None):
+    a = src.asnumpy().astype("float32") if isinstance(src, NDArray) else \
+        _np.asarray(src, dtype="float32")
+    a = a - _np.asarray(mean)
+    if std is not None:
+        a = a / _np.asarray(std)
+    return array(a)

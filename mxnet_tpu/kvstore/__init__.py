@@ -1,0 +1,3 @@
+from .kvstore import KVStore, KVStoreLocal, KVStoreTPU, create
+
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreTPU", "create"]

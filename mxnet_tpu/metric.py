@@ -1,0 +1,335 @@
+"""Evaluation metrics (reference python/mxnet/metric.py:68-1798)."""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import numpy as _np
+
+from .base import MXNetError
+
+_METRIC_REGISTRY = {}
+
+
+def register(*names):
+    def deco(cls):
+        for n in names or (cls.__name__.lower(),):
+            _METRIC_REGISTRY[n.lower()] = cls
+        return cls
+    return deco
+
+
+def _as_numpy(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else _np.asarray(x)
+
+
+def check_label_shapes(labels, preds, shape=False):
+    if len(labels) != len(preds):
+        raise MXNetError(f"label/pred count mismatch: {len(labels)} vs {len(preds)}")
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
+
+    def reset_local(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[n] for n in self.output_names]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[n] for n in self.label_names]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_global(self):
+        if self.global_num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.global_sum_metric / self.global_num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name, value = [name], [value]
+        return list(zip(name, value))
+
+    def _update(self, metric, inst):
+        self.sum_metric += metric
+        self.num_inst += inst
+        self.global_sum_metric += metric
+        self.global_num_inst += inst
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.metrics = [create(m) if isinstance(m, str) else m for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric) if isinstance(metric, str) else metric)
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return (names, values)
+
+
+@register("accuracy", "acc")
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        if not isinstance(labels, (list, tuple)):
+            labels, preds = [labels], [preds]
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            p = _as_numpy(pred)
+            l = _as_numpy(label).astype("int64")
+            if p.ndim > l.ndim:
+                p = _np.argmax(p, axis=self.axis)
+            p = p.astype("int64").reshape(-1)
+            l = l.reshape(-1)
+            correct = (p == l).sum()
+            self._update(float(correct), len(l))
+
+
+@register("top_k_accuracy", "topkaccuracy")
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None, label_names=None):
+        super().__init__(f"{name}_{top_k}", output_names, label_names)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            p = _as_numpy(pred)
+            l = _as_numpy(label).astype("int64").reshape(-1)
+            topk = _np.argsort(p, axis=-1)[:, -self.top_k:]
+            hit = (topk == l[:, None]).any(axis=1).sum()
+            self._update(float(hit), len(l))
+
+
+@register("f1")
+class F1(EvalMetric):
+    def __init__(self, name="f1", average="macro", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.average = average
+        self._tp = self._fp = self._fn = 0.0
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._fn = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            p = _as_numpy(pred)
+            l = _as_numpy(label).reshape(-1).astype("int64")
+            ph = (p[:, 1] > 0.5).astype("int64") if p.ndim == 2 else (p > 0.5).astype("int64").reshape(-1)
+            self._tp += float(((ph == 1) & (l == 1)).sum())
+            self._fp += float(((ph == 1) & (l == 0)).sum())
+            self._fn += float(((ph == 0) & (l == 1)).sum())
+            prec = self._tp / max(self._tp + self._fp, 1e-12)
+            rec = self._tp / max(self._tp + self._fn, 1e-12)
+            f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+            self.sum_metric = f1
+            self.num_inst = 1
+            self.global_sum_metric = f1
+            self.global_num_inst = 1
+
+
+@register("mae")
+class MAE(EvalMetric):
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            l, p = _as_numpy(label), _as_numpy(pred)
+            self._update(float(_np.abs(l.reshape(p.shape) - p).mean()) * l.shape[0], l.shape[0])
+
+
+@register("mse")
+class MSE(EvalMetric):
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            l, p = _as_numpy(label), _as_numpy(pred)
+            self._update(float(((l.reshape(p.shape) - p) ** 2).mean()) * l.shape[0], l.shape[0])
+
+
+@register("rmse")
+class RMSE(MSE):
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.sqrt(self.sum_metric / self.num_inst))
+
+
+@register("cross-entropy", "ce")
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            l = _as_numpy(label).astype("int64").reshape(-1)
+            p = _as_numpy(pred)
+            prob = p[_np.arange(l.shape[0]), l]
+            self._update(float(-_np.log(prob + self.eps).sum()), l.shape[0])
+
+
+@register("nll_loss")
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", output_names=None, label_names=None):
+        super().__init__(eps, name, output_names, label_names)
+
+
+@register("perplexity")
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 output_names=None, label_names=None):
+        super().__init__(name=name, output_names=output_names, label_names=label_names)
+        self.ignore_label = ignore_label
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            l = _as_numpy(label).astype("int64").reshape(-1)
+            p = _as_numpy(pred).reshape(l.shape[0], -1)
+            prob = p[_np.arange(l.shape[0]), l]
+            if self.ignore_label is not None:
+                keep = l != self.ignore_label
+                prob = prob[keep]
+            self._update(float(-_np.log(prob + self.eps).sum()), int(prob.shape[0]))
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+@register("pearsonr")
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            l = _as_numpy(label).reshape(-1)
+            p = _as_numpy(pred).reshape(-1)
+            r = _np.corrcoef(l, p)[0, 1]
+            self._update(float(r), 1)
+
+
+@register("mcc")
+class MCC(EvalMetric):
+    def __init__(self, name="mcc", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+        self._counts = _np.zeros(4)
+
+    def reset(self):
+        super().reset()
+        self._counts = _np.zeros(4)
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            l = _as_numpy(label).astype("int64").reshape(-1)
+            p = _as_numpy(pred)
+            ph = _np.argmax(p, axis=-1).reshape(-1) if p.ndim > 1 else (p > 0.5).astype("int64").reshape(-1)
+            tp = float(((ph == 1) & (l == 1)).sum()); fp = float(((ph == 1) & (l == 0)).sum())
+            fn = float(((ph == 0) & (l == 1)).sum()); tn = float(((ph == 0) & (l == 0)).sum())
+            self._counts += [tp, fp, fn, tn]
+            tp, fp, fn, tn = self._counts
+            denom = math.sqrt(max((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn), 1e-12))
+            self.sum_metric = (tp * tn - fp * fn) / denom
+            self.num_inst = 1
+            self.global_sum_metric, self.global_num_inst = self.sum_metric, 1
+
+
+@register("loss")
+class Loss(EvalMetric):
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, _, preds):
+        if not isinstance(preds, (list, tuple)):
+            preds = [preds]
+        for pred in preds:
+            loss = float(_as_numpy(pred).sum())
+            self._update(loss, int(_np.prod(_as_numpy(pred).shape)))
+
+
+@register("custom")
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        super().__init__(f"custom({name})", output_names, label_names)
+        self._feval = feval
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            v = self._feval(_as_numpy(label), _as_numpy(pred))
+            if isinstance(v, tuple):
+                self._update(v[0], v[1])
+            else:
+                self._update(float(v), 1)
+
+
+def np(numpy_feval, name="custom", allow_extra_outputs=False):
+    return CustomMetric(numpy_feval, name, allow_extra_outputs)
+
+
+def create(metric, *args, **kwargs) -> EvalMetric:
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        return CompositeEvalMetric([create(m) for m in metric])
+    try:
+        return _METRIC_REGISTRY[metric.lower()](*args, **kwargs)
+    except KeyError:
+        raise MXNetError(f"unknown metric {metric!r}") from None

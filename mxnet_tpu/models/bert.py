@@ -1,0 +1,158 @@
+"""BERT / transformer encoder — the flagship shardable model.
+
+Reference counterpart: GluonNLP BERT built on the contrib attention matmuls
+(reference src/operator/contrib/transformer.cc:650-819) and fused layernorm/
+gelu. TPU-native design:
+
+  - names follow the TP sharding rules in parallel/tensor_parallel.py
+    (qkv/ffn1 column-parallel, proj/ffn2 row-parallel);
+  - attention uses parallel.blockwise_attention (flash-style lax.scan) so
+    long sequences fit; under an 'sp' mesh axis the trainer swaps it for
+    ring_attention;
+  - everything bf16-friendly: matmuls accumulate f32 via the op layer.
+"""
+from __future__ import annotations
+
+import math
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+
+__all__ = ["TransformerEncoderCell", "BertEncoder", "BertModel", "bert_base",
+           "bert_large", "bert_tiny"]
+
+
+class SelfAttention(HybridBlock):
+    def __init__(self, units, num_heads, dropout=0.0, use_blockwise=True, **kwargs):
+        super().__init__(**kwargs)
+        assert units % num_heads == 0
+        self._units = units
+        self._heads = num_heads
+        self._use_blockwise = use_blockwise
+        self.qkv = nn.Dense(3 * units, flatten=False, in_units=units)
+        self.proj = nn.Dense(units, flatten=False, in_units=units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x, mask=None):
+        # x: (B, T, C)
+        B, T, C = x.shape
+        H = self._heads
+        d = C // H
+        qkv = self.qkv(x)  # (B, T, 3C)
+        qkv = qkv.reshape((B, T, 3, H, d)).transpose((2, 0, 3, 1, 4))  # (3,B,H,T,d)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        if self._use_blockwise and mask is None:
+            from ..parallel.ring_attention import blockwise_attention
+            from ..ndarray import NDArray
+            out_raw = blockwise_attention(q._data, k._data, v._data,
+                                          block_size=min(512, T), causal=False)
+            out = NDArray(out_raw, x.ctx)
+        else:
+            scores = F.batch_dot(q.reshape((B * H, T, d)),
+                                 k.reshape((B * H, T, d)), transpose_b=True)
+            scores = scores / math.sqrt(d)
+            if mask is not None:
+                scores = scores + (1.0 - mask) * -1e9
+            att = F.softmax(scores, axis=-1)
+            out = F.batch_dot(att, v.reshape((B * H, T, d)))
+            out = out.reshape((B, H, T, d))
+        out = out.transpose((0, 2, 1, 3)).reshape((B, T, C))
+        out = self.proj(out)
+        if self.dropout:
+            out = self.dropout(out)
+        return out
+
+
+class PositionwiseFFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.ffn1 = nn.Dense(hidden_size, flatten=False, in_units=units)
+        self.ffn2 = nn.Dense(units, flatten=False, in_units=hidden_size)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        h = F.gelu(self.ffn1(x))
+        h = self.ffn2(h)
+        if self.dropout:
+            h = self.dropout(h)
+        return h
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Pre-LN encoder block."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.attn = SelfAttention(units, num_heads, dropout)
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout)
+
+    def hybrid_forward(self, F, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.ffn(self.ln2(x))
+        return x
+
+
+class BertEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.layers = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.layers.add(TransformerEncoderCell(units, hidden_size,
+                                                   num_heads, dropout))
+        self.ln = nn.LayerNorm(in_channels=units)
+
+    def hybrid_forward(self, F, x):
+        return self.ln(self.layers(x))
+
+
+class BertModel(HybridBlock):
+    """Token + position + segment embeddings -> encoder -> MLM head."""
+
+    def __init__(self, vocab_size=30522, num_layers=12, units=768,
+                 hidden_size=3072, num_heads=12, max_length=512,
+                 dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self.word_embed = nn.Embedding(vocab_size, units)
+        self.pos_embed = nn.Embedding(max_length, units)
+        self.seg_embed = nn.Embedding(2, units)
+        self.embed_ln = nn.LayerNorm(in_channels=units)
+        self.embed_drop = nn.Dropout(dropout) if dropout else None
+        self.encoder = BertEncoder(num_layers, units, hidden_size, num_heads,
+                                   dropout)
+        self.mlm_dense = nn.Dense(units, flatten=False, activation="gelu",
+                                  in_units=units)
+        self.mlm_ln = nn.LayerNorm(in_channels=units)
+        self.mlm_decoder = nn.Dense(vocab_size, flatten=False, in_units=units)
+
+    def hybrid_forward(self, F, token_ids, segment_ids=None):
+        B, T = token_ids.shape
+        from .. import ndarray as nd
+        pos = nd.arange(0, T, dtype="int32", ctx=token_ids.ctx)
+        x = self.word_embed(token_ids) + self.pos_embed(pos).expand_dims(0)
+        if segment_ids is not None:
+            x = x + self.seg_embed(segment_ids)
+        x = self.embed_ln(x)
+        if self.embed_drop:
+            x = self.embed_drop(x)
+        x = self.encoder(x)
+        h = self.mlm_ln(self.mlm_dense(x))
+        return self.mlm_decoder(h)
+
+
+def bert_tiny(vocab_size=8192, **kw):
+    return BertModel(vocab_size, num_layers=2, units=128, hidden_size=512,
+                     num_heads=2, **kw)
+
+
+def bert_base(vocab_size=30522, **kw):
+    return BertModel(vocab_size, num_layers=12, units=768, hidden_size=3072,
+                     num_heads=12, **kw)
+
+
+def bert_large(vocab_size=30522, **kw):
+    return BertModel(vocab_size, num_layers=24, units=1024, hidden_size=4096,
+                     num_heads=16, **kw)

@@ -1,0 +1,95 @@
+"""`mx.nd` namespace: NDArray + creation functions + every registered op.
+
+Replaces the reference's import-time ctypes codegen
+(python/mxnet/ndarray/register.py:116-271) with PEP-562 lazy wrappers over the
+op registry — same surface (`nd.Convolution(data, w, b, kernel=(3,3), ...)`),
+no C ABI.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..ops.registry import all_ops, get_op
+from .ndarray import (NDArray, invoke, array, zeros, ones, full, empty, arange,
+                      eye, linspace, concat, stack, waitall, from_numpy, from_jax,
+                      _wrap_like)
+from . import random  # noqa: F401
+from . import linalg  # noqa: F401
+from . import sparse  # noqa: F401
+
+__all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
+           "arange", "eye", "linspace", "concat", "stack", "waitall", "random",
+           "linalg", "sparse"]
+
+
+def zeros_like(a):
+    return invoke("zeros_like", [a], {})
+
+
+def ones_like(a):
+    return invoke("ones_like", [a], {})
+
+
+def save(fname, data):
+    from ..serialization import save_ndarrays
+    save_ndarrays(fname, data)
+
+
+def load(fname):
+    from ..serialization import load_ndarrays
+    return load_ndarrays(fname)
+
+
+_SPECIAL_KEY_OPS = {"Dropout"}
+
+
+def _make_wrapper(op_name: str):
+    op = get_op(op_name)
+
+    def wrapper(*args, out=None, **kwargs):
+        inputs = []
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+            elif a is None:
+                continue
+            else:
+                # allow raw numpy/list positional data
+                inputs.append(array(a))
+        if op.name in _SPECIAL_KEY_OPS:
+            from .. import autograd as _ag
+            from .. import random as _rnd
+            kwargs.setdefault("training", _ag.is_training() or _ag.is_recording())
+            if kwargs.get("training") and kwargs.get("p", 0.5) > 0 and len(inputs) == 1:
+                inputs.append(NDArray(_rnd.next_key_raw()))
+            elif len(inputs) == 1:
+                import jax.numpy as jnp
+                inputs.append(NDArray(jnp.zeros((2,), jnp.uint32)))
+        return invoke(op, inputs, kwargs, out=out)
+
+    wrapper.__name__ = op_name
+    wrapper.__doc__ = op.doc
+    return wrapper
+
+
+_wrapper_cache = {}
+
+
+def __getattr__(name: str):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    if name in _wrapper_cache:
+        return _wrapper_cache[name]
+    try:
+        get_op(name)
+    except Exception:
+        raise AttributeError(f"module 'mxnet_tpu.ndarray' has no attribute '{name}'") from None
+    w = _make_wrapper(name)
+    _wrapper_cache[name] = w
+    return w
+
+
+def __dir__():
+    import sys
+    mod = sys.modules[__name__]
+    return sorted(set(list(mod.__dict__) + list(all_ops().keys())))

@@ -1,0 +1,585 @@
+"""NDArray: imperative array with async semantics over jax.Array.
+
+Reference: include/mxnet/ndarray.h (1486 l) + src/ndarray/ndarray.cc +
+python/mxnet/ndarray/ndarray.py. TPU-native redesign (SURVEY.md §7):
+
+  - the payload is an immutable `jax.Array`; "mutation" (+=, x[...]=v, out=)
+    swaps the payload and bumps a version counter — this gives the reference's
+    var-version semantics (engine.h:44-61) without a dependency engine, since
+    XLA/PJRT already orders async work on its streams.
+  - `wait_to_read()` == `block_until_ready()`; dispatch is async exactly like
+    the reference engine's PushAsync, but scheduling is owned by PJRT.
+  - every operator call routes through `invoke()` below: raw jax arrays in,
+    compiled (jit-cached) op out, optional VJP recorded on the autograd tape.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError, default_dtype
+from ..context import Context, current_context
+from ..ops.registry import Op, env, get_op, invoke_raw
+
+__all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
+           "arange", "eye", "linspace", "concat", "stack", "waitall",
+           "from_numpy", "from_jax"]
+
+
+# ---------------------------------------------------------------------------
+# waitall support: weak tracking of in-flight arrays (engine.WaitForAll parity)
+# ---------------------------------------------------------------------------
+import collections
+import weakref
+
+_INFLIGHT: collections.deque = collections.deque(maxlen=4096)
+
+
+def _track(arr: "NDArray"):
+    _INFLIGHT.append(weakref.ref(arr))
+
+
+def waitall():
+    """Block until all dispatched work completes (reference Engine::WaitForAll)."""
+    while _INFLIGHT:
+        ref = _INFLIGHT.pop()
+        a = ref()
+        if a is not None:
+            try:
+                a._data.block_until_ready()
+            except Exception:
+                pass
+    jax.effects_barrier()
+
+
+class NDArray:
+    __slots__ = ("_data", "_ctx", "_version", "_grad", "_grad_req", "_ag_node",
+                 "__weakref__")
+
+    # numpy interop priority
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx: Optional[Context] = None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        self._ctx = ctx or current_context()
+        self._data = data
+        self._version = 0
+        self._grad: Optional[NDArray] = None
+        self._grad_req = "null"
+        self._ag_node = None
+
+    # -- payload management -------------------------------------------------
+    def _set_data(self, raw):
+        self._data = raw
+        self._version += 1
+
+    @property
+    def handle(self):  # API parity; the jax.Array IS the handle
+        return self._data
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    # -- basic properties ----------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self) -> int:
+        return int(self._data.size)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def ctx(self) -> Context:
+        return self._ctx
+
+    context = ctx
+
+    @property
+    def stype(self) -> str:
+        return "default"
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    # -- sync / transfer -----------------------------------------------------
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self._data.block_until_ready()
+
+    def asnumpy(self) -> _np.ndarray:
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("ambiguous truth value of multi-element NDArray")
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of 0-d array")
+        return self.shape[0]
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def copy(self) -> "NDArray":
+        return NDArray(self._data, self._ctx)
+
+    def copyto(self, other: Union["NDArray", Context]) -> "NDArray":
+        if isinstance(other, Context):
+            return self.as_in_context(other)
+        other._set_data(jax.device_put(self._data, other._ctx.jax_device)
+                        .astype(other.dtype))
+        return other
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self._ctx:
+            return self
+        out = NDArray(jax.device_put(self._data, ctx.jax_device), ctx)
+        return out
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def astype(self, dtype, copy=True) -> "NDArray":
+        d = jnp.dtype(dtype)
+        if not copy and d == self.dtype:
+            return self
+        return invoke("Cast", [self], {"dtype": str(d) if d != jnp.bfloat16 else "bfloat16"})
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self._data, self._ctx)
+        return out
+
+    def tostype(self, stype):
+        if stype != "default":
+            from ..base import NotSupportedForSparseNDArray
+            raise NotSupportedForSparseNDArray(
+                "sparse storage is emulated; see mxnet_tpu.ndarray.sparse")
+        return self
+
+    # -- autograd ------------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        from .. import autograd
+        self._grad = zeros(self.shape, dtype=self.dtype, ctx=self._ctx)
+        self._grad_req = grad_req
+        autograd.mark_variables([self], [self._grad], grad_reqs=grad_req)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- repr ---------------------------------------------------------------
+    def __repr__(self):
+        return f"\n{self.asnumpy()}\n<NDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
+
+    # -- elementwise dunders -------------------------------------------------
+    def _binary(self, other, op_name, scalar_op_name, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return invoke(op_name, [a, b], {})
+        if isinstance(other, (int, float, bool, _np.number)):
+            name = scalar_op_name
+            return invoke(name, [self], {"scalar": float(other)})
+        if isinstance(other, _np.ndarray):
+            o = NDArray(jnp.asarray(other), self._ctx)
+            a, b = (o, self) if reverse else (self, o)
+            return invoke(op_name, [a, b], {})
+        return NotImplemented
+
+    def __add__(self, o): return self._binary(o, "broadcast_add", "_plus_scalar")
+    def __radd__(self, o): return self._binary(o, "broadcast_add", "_plus_scalar")
+    def __sub__(self, o): return self._binary(o, "broadcast_sub", "_minus_scalar")
+    def __rsub__(self, o): return self._binary(o, "broadcast_sub", "_rminus_scalar", reverse=True)
+    def __mul__(self, o): return self._binary(o, "broadcast_mul", "_mul_scalar")
+    def __rmul__(self, o): return self._binary(o, "broadcast_mul", "_mul_scalar")
+    def __truediv__(self, o): return self._binary(o, "broadcast_div", "_div_scalar")
+    def __rtruediv__(self, o): return self._binary(o, "broadcast_div", "_rdiv_scalar", reverse=True)
+    def __mod__(self, o): return self._binary(o, "broadcast_mod", "_mod_scalar")
+    def __rmod__(self, o): return self._binary(o, "broadcast_mod", "_rmod_scalar", reverse=True)
+    def __pow__(self, o): return self._binary(o, "broadcast_power", "_power_scalar")
+    def __rpow__(self, o): return self._binary(o, "broadcast_power", "_rpower_scalar", reverse=True)
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binary(o, "broadcast_equal", "_equal_scalar")
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binary(o, "broadcast_not_equal", "_not_equal_scalar")
+    def __gt__(self, o): return self._binary(o, "broadcast_greater", "_greater_scalar")
+    def __ge__(self, o): return self._binary(o, "broadcast_greater_equal", "_greater_equal_scalar")
+    def __lt__(self, o): return self._binary(o, "broadcast_lesser", "_lesser_scalar")
+    def __le__(self, o): return self._binary(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __neg__(self): return invoke("negative", [self], {})
+    def __abs__(self): return invoke("abs", [self], {})
+
+    # in-place: swap payload (version bump == write dependency)
+    def __iadd__(self, o):
+        out = self.__add__(o)
+        self._set_data(out._data)
+        return self
+
+    def __isub__(self, o):
+        out = self.__sub__(o)
+        self._set_data(out._data)
+        return self
+
+    def __imul__(self, o):
+        out = self.__mul__(o)
+        self._set_data(out._data)
+        return self
+
+    def __itruediv__(self, o):
+        out = self.__truediv__(o)
+        self._set_data(out._data)
+        return self
+
+    # -- indexing ------------------------------------------------------------
+    def _norm_index(self, key):
+        if isinstance(key, NDArray):
+            return key._data
+        if isinstance(key, tuple):
+            return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+        return key
+
+    def __getitem__(self, key):
+        key = self._norm_index(key)
+        if isinstance(key, (int, _np.integer)):
+            out_raw = self._data[key]
+        else:
+            out_raw = self._data[key]
+        out = NDArray(out_raw, self._ctx)
+        # record slice on tape if needed
+        from .. import autograd
+        if autograd.is_recording() and self._ag_node is not None:
+            def vjp_fn(cot, _key=key, _shape=self.shape, _dtype=self.dtype):
+                z = jnp.zeros(_shape, _dtype)
+                return (z.at[_key].add(cot),)
+            autograd.record_op(vjp_fn, [self], [out], out_is_tuple=False)
+        _track(out)
+        return out
+
+    def __setitem__(self, key, value):
+        key = self._norm_index(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        if isinstance(key, slice) and key == slice(None) and not isinstance(value, jax.Array):
+            self._set_data(jnp.full(self.shape, value, self.dtype))
+            return
+        v = jnp.asarray(value, dtype=self.dtype) if not isinstance(value, jax.Array) else value.astype(self.dtype)
+        self._set_data(self._data.at[key].set(v))
+
+    # -- op-backed methods ---------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if not shape and "shape" in kwargs:
+            shape = tuple(kwargs["shape"])
+        return invoke("Reshape", [self], {"shape": shape,
+                                          "reverse": kwargs.get("reverse", False)})
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def expand_dims(self, axis):
+        return invoke("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return invoke("squeeze", [self], {"axis": axis})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return invoke("transpose", [self], {"axes": axes or None})
+
+    def flatten(self):
+        return invoke("Flatten", [self], {})
+
+    def broadcast_to(self, shape):
+        return invoke("broadcast_to", [self], {"shape": tuple(shape)})
+
+    def broadcast_like(self, other):
+        return invoke("broadcast_like", [self, other], {})
+
+    def slice(self, begin, end, step=None):
+        return invoke("slice", [self], {"begin": tuple(begin), "end": tuple(end),
+                                        "step": tuple(step) if step else None})
+
+    def slice_axis(self, axis, begin, end):
+        return invoke("slice_axis", [self], {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return invoke("take", [self, indices], {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, **kw):
+        return invoke("one_hot", [self], {"depth": depth, **kw})
+
+    def pick(self, index, axis=-1, keepdims=False):
+        return invoke("pick", [self, index], {"axis": axis, "keepdims": keepdims})
+
+    def clip(self, a_min, a_max):
+        return invoke("clip", [self], {"a_min": a_min, "a_max": a_max})
+
+    def abs(self): return invoke("abs", [self], {})
+    def sign(self): return invoke("sign", [self], {})
+    def sqrt(self): return invoke("sqrt", [self], {})
+    def square(self): return invoke("square", [self], {})
+    def exp(self): return invoke("exp", [self], {})
+    def log(self): return invoke("log", [self], {})
+    def relu(self): return invoke("relu", [self], {})
+    def sigmoid(self): return invoke("sigmoid", [self], {})
+    def tanh(self): return invoke("tanh", [self], {})
+    def softmax(self, axis=-1): return invoke("softmax", [self], {"axis": axis})
+    def log_softmax(self, axis=-1): return invoke("log_softmax", [self], {"axis": axis})
+    def round(self): return invoke("round", [self], {})
+    def floor(self): return invoke("floor", [self], {})
+    def ceil(self): return invoke("ceil", [self], {})
+
+    def _reduce(self, name, axis=None, keepdims=False, **kw):
+        return invoke(name, [self], {"axis": axis, "keepdims": keepdims, **kw})
+
+    def sum(self, axis=None, keepdims=False):
+        return self._reduce("sum", axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._reduce("mean", axis, keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._reduce("prod", axis, keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._reduce("max", axis, keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._reduce("min", axis, keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return invoke("norm", [self], {"ord": ord, "axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return invoke("argmax", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return invoke("argmin", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return invoke("argsort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def sort(self, axis=-1, is_ascend=True):
+        return invoke("sort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return invoke("topk", [self], {"axis": axis, "k": k, "ret_typ": ret_typ,
+                                       "is_ascend": is_ascend})
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return invoke("dot", [self, other], {"transpose_a": transpose_a,
+                                             "transpose_b": transpose_b})
+
+    def zeros_like(self): return invoke("zeros_like", [self], {})
+    def ones_like(self): return invoke("ones_like", [self], {})
+
+    def tile(self, reps): return invoke("tile", [self], {"reps": tuple(reps)})
+
+    def repeat(self, repeats, axis=None):
+        return invoke("repeat", [self], {"repeats": repeats, "axis": axis})
+
+    def flip(self, axis): return invoke("reverse", [self], {"axis": axis})
+
+    def swapaxes(self, dim1, dim2):
+        return invoke("swapaxes", [self], {"dim1": dim1, "dim2": dim2})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke("SliceChannel", [self], {"num_outputs": num_outputs,
+                                               "axis": axis,
+                                               "squeeze_axis": squeeze_axis})
+
+    # dlpack / numpy protocols
+    def __dlpack__(self, stream=None):
+        return self._data.__dlpack__(stream=stream)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype else a
+
+
+def _wrap_like(raw, like: NDArray) -> NDArray:
+    return NDArray(raw, like._ctx)
+
+
+# ---------------------------------------------------------------------------
+# Eager dispatch
+# ---------------------------------------------------------------------------
+
+def invoke(op: Union[str, Op], inputs: Sequence[NDArray], params: Dict[str, Any],
+           out: Optional[Union[NDArray, Sequence[NDArray]]] = None):
+    """The imperative path (reference MXImperativeInvokeEx →
+    Imperative::Invoke, SURVEY.md §3.1 — here it is a jit-cache lookup)."""
+    if isinstance(op, str):
+        op = get_op(op)
+    params = {k: v for k, v in params.items() if v is not None} if None in params.values() else params
+    raw = [x._data for x in inputs]
+    from .. import autograd
+    need_grad = (op.differentiable and autograd.is_recording()
+                 and any(x._ag_node is not None for x in inputs))
+    fn = op.bound(params)
+    vjp_fn = None
+    was_tuple = False
+    if need_grad:
+        outs_raw, vjp_fn = jax.vjp(fn, *raw)
+    else:
+        outs_raw = fn(*raw)
+    if isinstance(outs_raw, tuple):
+        was_tuple = True
+    else:
+        outs_raw = (outs_raw,)
+    if env.get("MXNET_ENGINE_TYPE") == "Naive":
+        jax.block_until_ready(outs_raw)
+    ctx = inputs[0]._ctx if inputs else current_context()
+    outs = [NDArray(o, ctx) for o in outs_raw]
+    for o in outs:
+        _track(o)
+    if need_grad:
+        autograd.record_op(vjp_fn, list(inputs), outs, out_is_tuple=was_tuple)
+    if out is not None:
+        targets = [out] if isinstance(out, NDArray) else list(out)
+        for t, o in zip(targets, outs):
+            t._set_data(o._data)
+        return out
+    if len(outs) == 1 and not was_tuple:
+        return outs[0]
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# Creation functions (reference src/operator/tensor/init_op.cc + ndarray.py)
+# ---------------------------------------------------------------------------
+
+def _ctx_dev(ctx):
+    ctx = ctx or current_context()
+    return ctx, ctx.jax_device
+
+
+def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    if isinstance(source, NDArray):
+        source = source._data
+    ctx, dev = _ctx_dev(ctx)
+    if dtype is None and not isinstance(source, jax.Array):
+        probe = source if isinstance(source, _np.ndarray) else _np.asarray(source)
+        # jax runs x64-disabled: f64 sources land as the default dtype (f32)
+        dtype = default_dtype() if probe.dtype == _np.float64 else probe.dtype
+        source = probe
+    raw = jax.device_put(jnp.asarray(source, dtype=dtype), dev)
+    return NDArray(raw, ctx)
+
+
+def from_numpy(a: _np.ndarray, ctx=None) -> NDArray:
+    return array(a, ctx=ctx)
+
+
+def from_jax(a: jax.Array, ctx=None) -> NDArray:
+    return NDArray(a, ctx or current_context())
+
+
+def zeros(shape, ctx=None, dtype=None) -> NDArray:
+    ctx, dev = _ctx_dev(ctx)
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jax.device_put(jnp.zeros(shape, dtype or default_dtype()), dev), ctx)
+
+
+def ones(shape, ctx=None, dtype=None) -> NDArray:
+    ctx, dev = _ctx_dev(ctx)
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jax.device_put(jnp.ones(shape, dtype or default_dtype()), dev), ctx)
+
+
+def full(shape, val, ctx=None, dtype=None) -> NDArray:
+    ctx, dev = _ctx_dev(ctx)
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jax.device_put(jnp.full(shape, val, dtype or default_dtype()), dev), ctx)
+
+
+def empty(shape, ctx=None, dtype=None) -> NDArray:
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None) -> NDArray:
+    ctx, dev = _ctx_dev(ctx)
+    raw = jnp.arange(start, stop, step, dtype=dtype or default_dtype())
+    if repeat > 1:
+        raw = jnp.repeat(raw, repeat)
+    return NDArray(jax.device_put(raw, dev), ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None) -> NDArray:
+    ctx, dev = _ctx_dev(ctx)
+    raw = jnp.eye(N, M if M else N, k=k, dtype=dtype or default_dtype())
+    return NDArray(jax.device_put(raw, dev), ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None) -> NDArray:
+    ctx, dev = _ctx_dev(ctx)
+    raw = jnp.linspace(start, stop, num, endpoint=endpoint, dtype=dtype or default_dtype())
+    return NDArray(jax.device_put(raw, dev), ctx)
+
+
+def concat(*arrays, dim=1):
+    return invoke("Concat", list(arrays), {"dim": dim})
+
+
+def stack(*arrays, axis=0):
+    return invoke("stack", list(arrays), {"axis": axis})

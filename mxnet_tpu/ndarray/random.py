@@ -1,0 +1,116 @@
+"""`mx.nd.random` — sampling functions (reference src/operator/random/sample_op.cc,
+python/mxnet/ndarray/random.py). Counter-based threefry keys under the hood."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import default_dtype
+from ..context import current_context
+from .. import random as _rng
+from .ndarray import NDArray, _track
+
+
+def _make(raw, ctx):
+    ctx = ctx or current_context()
+    out = NDArray(jax.device_put(raw, ctx.jax_device), ctx)
+    _track(out)
+    return out
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None):
+    dtype = dtype or default_dtype()
+    raw = jax.random.uniform(_rng.next_key(), _shape(shape), dtype=jnp.float32,
+                             minval=low, maxval=high).astype(dtype)
+    r = _make(raw, ctx)
+    if out is not None:
+        out._set_data(r._data)
+        return out
+    return r
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None):
+    dtype = dtype or default_dtype()
+    raw = loc + scale * jax.random.normal(_rng.next_key(), _shape(shape), dtype=jnp.float32)
+    r = _make(raw.astype(dtype), ctx)
+    if out is not None:
+        out._set_data(r._data)
+        return out
+    return r
+
+
+randn = normal
+
+
+def randint(low, high=None, shape=None, dtype="int32", ctx=None):
+    if high is None:
+        low, high = 0, low
+    raw = jax.random.randint(_rng.next_key(), _shape(shape), low, high,
+                             dtype=jnp.dtype(dtype))
+    return _make(raw, ctx)
+
+
+def poisson(lam=1.0, shape=None, dtype=None, ctx=None):
+    raw = jax.random.poisson(_rng.next_key(), lam, _shape(shape))
+    return _make(raw.astype(dtype or default_dtype()), ctx)
+
+
+def exponential(scale=1.0, shape=None, dtype=None, ctx=None):
+    raw = scale * jax.random.exponential(_rng.next_key(), _shape(shape))
+    return _make(raw.astype(dtype or default_dtype()), ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None):
+    raw = beta * jax.random.gamma(_rng.next_key(), alpha, _shape(shape))
+    return _make(raw.astype(dtype or default_dtype()), ctx)
+
+
+def negative_binomial(k=1, p=1, shape=None, dtype=None, ctx=None):
+    g = jax.random.gamma(_rng.next_key(), k, _shape(shape)) * (1 - p) / p
+    raw = jax.random.poisson(_rng.next_key(), g, _shape(shape))
+    return _make(raw.astype(dtype or default_dtype()), ctx)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None, ctx=None):
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    return negative_binomial(r, p, shape, dtype, ctx)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32"):
+    """Sample category indices from probability rows (reference sample_multinomial_op)."""
+    logits = jnp.log(jnp.maximum(data._data, 1e-30))
+    n = 1 if shape is None else (shape if isinstance(shape, int) else int(jnp.prod(jnp.asarray(shape))))
+    if logits.ndim == 1:
+        out = jax.random.categorical(_rng.next_key(), logits, shape=(n,))
+        if shape is None:
+            out = out[0]
+    else:
+        out = jax.random.categorical(_rng.next_key(), logits, axis=-1,
+                                     shape=(n, logits.shape[0])).T
+        if shape is None:
+            out = out[:, 0]
+    res = _make(out.astype(jnp.dtype(dtype)), data.ctx)
+    if get_prob:
+        lp = jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
+                                 jnp.atleast_2d(out.astype(jnp.int32)), axis=-1)
+        return res, _make(lp, data.ctx)
+    return res
+
+
+def shuffle(data):
+    idx = jax.random.permutation(_rng.next_key(), data.shape[0])
+    return _make(jnp.take(data._data, idx, axis=0), data.ctx)
+
+
+def bernoulli(p=0.5, shape=None, dtype=None, ctx=None):
+    raw = jax.random.bernoulli(_rng.next_key(), p, _shape(shape))
+    return _make(raw.astype(dtype or default_dtype()), ctx)

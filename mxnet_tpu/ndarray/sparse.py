@@ -1,0 +1,118 @@
+"""Sparse NDArray emulation (reference python/mxnet/ndarray/sparse.py,
+include/mxnet/ndarray.h storage types kRowSparseStorage/kCSRStorage).
+
+XLA has no dynamic sparsity, so these are *dense-backed* views that preserve
+the reference API (`.indices`, `.data`, `.tostype`, `row_sparse_array`,
+`csr_matrix`) with documented semantic deltas (SURVEY.md §7 hard-part 4):
+storage is dense on device; `indices` are recovered by scanning. Sparse
+*gradients* for embeddings are instead handled natively by XLA scatter in the
+optimizer path, which is the part that matters for performance.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..context import current_context
+from .ndarray import NDArray, array, zeros
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Dense-backed row_sparse array."""
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self) -> NDArray:
+        nz = _np.nonzero(_np.any(self.asnumpy().reshape(self.shape[0], -1) != 0, axis=1))[0]
+        return array(nz.astype(_np.int64), ctx=self.ctx, dtype="int64")
+
+    @property
+    def data(self) -> NDArray:
+        idx = self.indices.asnumpy().astype(int)
+        return array(self.asnumpy()[idx], ctx=self.ctx)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        if stype == "row_sparse":
+            return self
+        raise ValueError(stype)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indices(self) -> NDArray:
+        import scipy.sparse as sp
+        m = sp.csr_matrix(self.asnumpy())
+        return array(m.indices.astype(_np.int64), ctx=self.ctx, dtype="int64")
+
+    @property
+    def indptr(self) -> NDArray:
+        import scipy.sparse as sp
+        m = sp.csr_matrix(self.asnumpy())
+        return array(m.indptr.astype(_np.int64), ctx=self.ctx, dtype="int64")
+
+    @property
+    def data(self) -> NDArray:
+        import scipy.sparse as sp
+        m = sp.csr_matrix(self.asnumpy())
+        return array(m.data, ctx=self.ctx)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        if stype == "csr":
+            return self
+        raise ValueError(stype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Build a row_sparse array from (data, indices) or dense source."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = _np.asarray(data.asnumpy() if isinstance(data, NDArray) else data)
+        indices = _np.asarray(indices.asnumpy() if isinstance(indices, NDArray) else indices).astype(int)
+        if shape is None:
+            nrows = int(indices.max()) + 1 if indices.size else 0
+            shape = (nrows,) + data.shape[1:]
+        dense = _np.zeros(shape, dtype=dtype or data.dtype)
+        dense[indices] = data
+        return RowSparseNDArray(jnp.asarray(dense), ctx)
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    return RowSparseNDArray(jnp.asarray(src, dtype=dtype), ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = (
+            _np.asarray(x.asnumpy() if isinstance(x, NDArray) else x) for x in arg1)
+        import scipy.sparse as sp
+        m = sp.csr_matrix((data, indices.astype(int), indptr.astype(int)), shape=shape)
+        return CSRNDArray(jnp.asarray(m.toarray(), dtype=dtype), ctx)
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    return CSRNDArray(jnp.asarray(src, dtype=dtype), ctx)
+
+
+def zeros_sparse(stype, shape, ctx=None, dtype=None):
+    z = zeros(shape, ctx=ctx, dtype=dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(z._data, z.ctx)
+    if stype == "csr":
+        return CSRNDArray(z._data, z.ctx)
+    return z

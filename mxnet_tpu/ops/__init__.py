@@ -1,0 +1,16 @@
+"""Operator library (TPU-native re-implementation of reference src/operator/).
+
+Importing this package registers all operators. Op modules hold only pure jax
+functions + registration; dispatch lives in .registry, the NDArray wrapper in
+..ndarray.
+"""
+from . import registry  # noqa: F401
+from .registry import get_op, list_ops, all_ops, register  # noqa: F401
+
+from . import elemwise   # noqa: F401
+from . import reduce     # noqa: F401
+from . import matrix     # noqa: F401
+from . import nn         # noqa: F401
+from . import linalg     # noqa: F401
+from . import contrib    # noqa: F401
+from . import attention  # noqa: F401

@@ -1,0 +1,126 @@
+"""Attention kernels: blockwise (flash-style) single-device and ring/Ulysses
+sequence-parallel variants.
+
+Capability uplift over the reference (SURVEY.md §2.4, §5-g: no SP/ring
+attention; closest are the contrib interleaved attention matmuls,
+src/operator/contrib/transformer.cc:650-819). Implemented as lax.scan over
+key blocks with log-sum-exp accumulation in f32 — O(T) memory, MXU-sized
+matmul blocks; the ring variant rotates kv shards with ppermute so comm
+overlaps compute on the ICI ring.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _block_attn(q, k, v, bias, scale):
+    """One attention block in f32 LSE form. q:(B,H,Tq,D) k/v:(B,H,Tk,D)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    num = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    den = jnp.sum(p, axis=-1, keepdims=True)
+    return num, den, m
+
+
+def blockwise_attention(q, k, v, block_size: int = 512, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Flash-style attention via lax.scan over key blocks."""
+    B, H, T, D = q.shape
+    scale = scale if scale is not None else (1.0 / (D ** 0.5))
+    block_size = min(block_size, k.shape[2])
+    Tk = k.shape[2]
+    nblk = (Tk + block_size - 1) // block_size
+    pad = nblk * block_size - Tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = jnp.moveaxis(k.reshape(B, H, nblk, block_size, D), 2, 0)  # (n,B,H,bs,D)
+    vb = jnp.moveaxis(v.reshape(B, H, nblk, block_size, D), 2, 0)
+    qf = q.astype(jnp.float32)
+    q_pos = jnp.arange(T)[:, None]
+
+    def body(carry, inp):
+        i, kblk, vblk = inp
+        acc_num, acc_den, acc_max = carry
+        k_pos = i * block_size + jnp.arange(block_size)[None, :]
+        mask = k_pos < Tk
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        bias = jnp.where(mask, 0.0, -jnp.inf)[None, None]
+        num, den, m = _block_attn(qf, kblk.astype(jnp.float32), vblk, bias, scale)
+        new_max = jnp.maximum(acc_max, m)
+        corr_old = jnp.exp(acc_max - new_max)
+        corr_new = jnp.exp(m - new_max)
+        return (acc_num * corr_old + num * corr_new,
+                acc_den * corr_old + den * corr_new, new_max), None
+
+    acc = (jnp.zeros((B, H, T, D), jnp.float32),
+           jnp.zeros((B, H, T, 1), jnp.float32),
+           jnp.full((B, H, T, 1), -jnp.inf, jnp.float32))
+    (num, den, _), _ = lax.scan(body, acc, (jnp.arange(nblk), kb, vb))
+    return (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
+
+
+@register("_contrib_flash_attention")
+def flash_attention_op(q, k, v, *, causal=False, block_size=512):
+    """Registered op form so the eager autograd tape records its VJP."""
+    return blockwise_attention(q, k, v, block_size=block_size, causal=causal)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Ring attention over mesh axis `axis_name` (call inside shard_map).
+    q/k/v: local sequence shards (B, H, T_local, D)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, T, D = q.shape
+    scale = scale if scale is not None else (1.0 / (D ** 0.5))
+    qf = q.astype(jnp.float32)
+    q_pos_base = idx * T + jnp.arange(T)[:, None]
+
+    def body(carry, step):
+        acc_num, acc_den, acc_max, kb, vb = carry
+        kv_rank = (idx - step) % n
+        bias = None
+        if causal:
+            k_pos = kv_rank * T + jnp.arange(T)[None, :]
+            bias = jnp.where(q_pos_base >= k_pos, 0.0, -jnp.inf)[None, None]
+        num, den, m = _block_attn(qf, kb.astype(jnp.float32), vb, bias, scale)
+        new_max = jnp.maximum(acc_max, m)
+        corr_old = jnp.exp(acc_max - new_max)
+        corr_new = jnp.exp(m - new_max)
+        acc_num = acc_num * corr_old + num * corr_new
+        acc_den = acc_den * corr_old + den * corr_new
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (acc_num, acc_den, new_max, kb, vb), None
+
+    acc = (jnp.zeros((B, H, T, D), jnp.float32),
+           jnp.zeros((B, H, T, 1), jnp.float32),
+           jnp.full((B, H, T, 1), -jnp.inf, jnp.float32), k, v)
+    (num, den, _, _, _), _ = lax.scan(body, acc, jnp.arange(n))
+    return (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Ulysses SP: all-to-all sequence<->head reshard, full attention per head
+    group, reshard back. Inside shard_map over `axis_name`."""
+    def a2a(x, split_axis, concat_axis):
+        return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+    qh = a2a(q, 1, 2)
+    kh = a2a(k, 1, 2)
+    vh = a2a(v, 1, 2)
+    out = blockwise_attention(qh, kh, vh, causal=causal)
+    return a2a(out, 2, 1)

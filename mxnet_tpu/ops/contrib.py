@@ -1,0 +1,179 @@
+"""Contrib ops: transformer attention building blocks, boolean mask, resize,
+fused adamw kernels, detection helpers.
+
+Reference: src/operator/contrib/transformer.cc:650-819 (interleaved attention
+matmuls used by GluonNLP BERT), boolean_mask.cc, bilinear_resize.cc,
+adamw.cc, allfinite.cc, reset_arrays.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# Transformer self/enc-dec attention matmuls (interleaved QKV layout).
+# queries_keys_values: (T, B, H*3*head_dim) with per-head interleaved [q;k;v].
+# ---------------------------------------------------------------------------
+
+def _split_qkv(qkv, heads):
+    T, B, D3 = qkv.shape
+    d = D3 // (heads * 3)
+    x = qkv.reshape(T, B, heads, 3, d)
+    q = x[:, :, :, 0]
+    k = x[:, :, :, 1]
+    v = x[:, :, :, 2]
+    return q, k, v  # (T, B, H, d)
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk")
+def interleaved_matmul_selfatt_qk(queries_keys_values, *, heads):
+    q, k, _ = _split_qkv(queries_keys_values, heads)
+    T, B, H, d = q.shape
+    qh = q.transpose(1, 2, 0, 3).reshape(B * H, T, d)
+    kh = k.transpose(1, 2, 0, 3).reshape(B * H, T, d)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32)).astype(q.dtype)
+    return jnp.matmul(qh * scale, jnp.swapaxes(kh, -1, -2))  # (B*H, T, T)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt")
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, *, heads):
+    _, _, v = _split_qkv(queries_keys_values, heads)
+    T, B, H, d = v.shape
+    vh = v.transpose(1, 2, 0, 3).reshape(B * H, T, d)
+    out = jnp.matmul(attention, vh)  # (B*H, T, d)
+    return out.reshape(B, H, T, d).transpose(2, 0, 1, 3).reshape(T, B, H * d)
+
+
+@register("_contrib_interleaved_matmul_encdec_qk")
+def interleaved_matmul_encdec_qk(queries, keys_values, *, heads):
+    Tq, B, D = queries.shape
+    d = D // heads
+    q = queries.reshape(Tq, B, heads, d).transpose(1, 2, 0, 3).reshape(B * heads, Tq, d)
+    Tk = keys_values.shape[0]
+    kv = keys_values.reshape(Tk, B, heads, 2, d)
+    k = kv[:, :, :, 0].transpose(1, 2, 0, 3).reshape(B * heads, Tk, d)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32)).astype(q.dtype)
+    return jnp.matmul(q * scale, jnp.swapaxes(k, -1, -2))
+
+
+@register("_contrib_interleaved_matmul_encdec_valatt")
+def interleaved_matmul_encdec_valatt(keys_values, attention, *, heads):
+    Tk, B, D2 = keys_values.shape
+    d = D2 // (heads * 2)
+    kv = keys_values.reshape(Tk, B, heads, 2, d)
+    v = kv[:, :, :, 1].transpose(1, 2, 0, 3).reshape(B * heads, Tk, d)
+    out = jnp.matmul(attention, v)
+    Tq = attention.shape[1]
+    return out.reshape(B, heads, Tq, d).transpose(2, 0, 1, 3).reshape(Tq, B, heads * d)
+
+
+@register("_contrib_div_sqrt_dim")
+def div_sqrt_dim(x):
+    return x / jnp.sqrt(jnp.asarray(x.shape[-1], jnp.float32)).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# boolean_mask: dynamic output shape — padded TPU semantics.
+# ---------------------------------------------------------------------------
+
+@register("_contrib_boolean_mask", differentiable=False)
+def boolean_mask(data, index, *, axis=0):
+    """XLA needs static shapes: rows where mask==0 are moved to the end and
+    zero-filled; pair with _contrib_boolean_mask_len to get the live count
+    (documented semantic delta vs the reference, SURVEY.md §7 hard-part 3)."""
+    mask = index.astype(bool)
+    n = data.shape[axis]
+    order = jnp.argsort(~mask, stable=True)  # True rows first
+    gathered = jnp.take(data, order, axis=axis)
+    keep = jnp.sort(mask)[::-1]
+    shape = [1] * data.ndim
+    shape[axis] = n
+    return gathered * keep.reshape(shape).astype(data.dtype)
+
+
+@register("_contrib_boolean_mask_len", differentiable=False)
+def boolean_mask_len(index):
+    return jnp.sum(index.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Image resize
+# ---------------------------------------------------------------------------
+
+@register("_contrib_BilinearResize2D")
+def bilinear_resize_2d(data, *, height=None, width=None, scale_height=None,
+                       scale_width=None, mode="size", align_corners=True):
+    n, c, h, w = data.shape
+    oh = int(height) if height else int(h * scale_height)
+    ow = int(width) if width else int(w * scale_width)
+    x = data.transpose(0, 2, 3, 1)  # NHWC for image resize
+    out = jax.image.resize(x, (n, oh, ow, c), method="bilinear")
+    return out.transpose(0, 3, 1, 2)
+
+
+@register("_contrib_AdaptiveAvgPooling2D")
+def adaptive_avg_pooling(data, *, output_size=None):
+    n, c, h, w = data.shape
+    if output_size is None:
+        oh = ow = 1
+    elif isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    x = data.reshape(n, c, oh, h // oh, ow, w // ow)
+    return jnp.mean(x, axis=(3, 5))
+
+
+# ---------------------------------------------------------------------------
+# Fused optimizer helpers (reference contrib/adamw.cc, all_finite.cc)
+# ---------------------------------------------------------------------------
+
+@register("all_finite", differentiable=False)
+def all_finite(*arrays, init_output=True):
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a.astype(jnp.float32))))
+    return ok.astype(jnp.float32).reshape(1)
+
+
+@register("multi_all_finite", differentiable=False)
+def multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    return all_finite(*arrays)
+
+
+@register("reset_arrays", differentiable=False, multi_output=True)
+def reset_arrays(*arrays, num_arrays=1):
+    return tuple(jnp.zeros_like(a) for a in arrays)
+
+
+@register("_contrib_quadratic")
+def quadratic(x, *, a=0.0, b=0.0, c=0.0):
+    """Tutorial op (reference src/operator/contrib/quadratic_op.cc)."""
+    return a * x * x + b * x + c
+
+
+# ---------------------------------------------------------------------------
+# Detection building blocks (SSD path; full multibox suite in round >=2)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_box_iou", differentiable=False)
+def box_iou(lhs, rhs, *, format="corner"):
+    def to_corner(b):
+        if format == "center":
+            cx, cy, w, h = jnp.split(b, 4, axis=-1)
+            return jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        return b
+    a = to_corner(lhs)[..., :, None, :]
+    b = to_corner(rhs)[..., None, :, :]
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:], b[..., 2:])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1])
+    area_b = (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1])
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)

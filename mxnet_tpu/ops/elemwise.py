@@ -1,0 +1,212 @@
+"""Elementwise / broadcast / scalar operator families.
+
+Covers the reference families in src/operator/tensor/
+(elemwise_unary_op_*.cc, elemwise_binary_op_*.cc, elemwise_binary_scalar_op_*.cc,
+elemwise_binary_broadcast_op_*.cc — reference src/operator/tensor/, SURVEY.md §2.2).
+
+Every op is a pure jax function; broadcasting is numpy-style (the reference's
+`broadcast_*` ops and `elemwise_*` ops collapse into one family here because
+XLA handles broadcast natively — the separate non-broadcast registration only
+existed to skip shape checks in C++).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# Unary math ops (reference: elemwise_unary_op_basic.cc, *_trig.cc, *_logexp.cc, *_pow.cc)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "rint": jnp.rint, "ceil": jnp.ceil,
+    "floor": jnp.floor, "trunc": jnp.trunc, "fix": jnp.trunc, "round": jnp.round,
+    "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log10": jnp.log10,
+    "log2": jnp.log2, "log1p": jnp.log1p, "sqrt": jnp.sqrt,
+    "square": jnp.square, "cbrt": jnp.cbrt,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "negative": jnp.negative, "reciprocal": lambda x: 1.0 / x,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "rsqrt": lax.rsqrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp2": jnp.exp2,
+}
+
+for _name, _f in _UNARY.items():
+    register(_name)(_f)
+
+register("identity", aliases=("_copy", "stop_gradient_off"))(lambda x: x)
+register("BlockGrad", aliases=("stop_gradient",))(lax.stop_gradient)
+register("make_loss")(lambda x: x)
+register("zeros_like")(jnp.zeros_like)
+register("ones_like")(jnp.ones_like)
+register("shape_array", differentiable=False)(
+    lambda x: jnp.asarray(x.shape, dtype=jnp.int64 if False else jnp.int32))
+register("size_array", differentiable=False)(
+    lambda x: jnp.asarray([x.size], dtype=jnp.int32))
+
+
+@register("Cast", aliases=("cast",), differentiable=True)
+def cast(x, *, dtype):
+    return x.astype(jnp.dtype(dtype))
+
+
+@register("amp_cast")
+def amp_cast(x, *, dtype):
+    """AMP insert-cast op (reference src/operator/tensor/amp_cast.cc)."""
+    return x.astype(jnp.dtype(dtype))
+
+
+@register("clip")
+def clip(x, *, a_min, a_max):
+    return jnp.clip(x, a_min, a_max)
+
+
+@register("LeakyReLU")
+def leaky_relu(x, *, act_type="leaky", slope=0.25):
+    """reference src/operator/leaky_relu.cc (leaky/elu/selu/gelu modes)."""
+    if act_type == "leaky":
+        return jnp.where(x > 0, x, slope * x)
+    if act_type == "elu":
+        return jnp.where(x > 0, x, slope * jnp.expm1(x))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    raise ValueError(f"LeakyReLU act_type {act_type} not supported")
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(x, *, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+@register("softrelu")
+def softrelu(x):
+    return jax.nn.softplus(x)
+
+
+@register("gelu")
+def gelu(x, *, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@register("silu", aliases=("swish",))
+def silu(x):
+    return jax.nn.silu(x)
+
+
+@register("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+# ---------------------------------------------------------------------------
+# Binary (broadcasting) ops
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "elemwise_add": jnp.add, "broadcast_add": jnp.add, "broadcast_plus": jnp.add,
+    "elemwise_sub": jnp.subtract, "broadcast_sub": jnp.subtract, "broadcast_minus": jnp.subtract,
+    "elemwise_mul": jnp.multiply, "broadcast_mul": jnp.multiply,
+    "elemwise_div": jnp.divide, "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod, "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum, "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "_power": jnp.power, "_mod": jnp.mod, "_maximum": jnp.maximum, "_minimum": jnp.minimum,
+    "arctan2": jnp.arctan2,
+    "ldexp": lambda x, y: x * jnp.exp2(y),
+}
+for _name, _f in _BINARY.items():
+    register(_name)(_f)
+
+_BINARY_CMP = {
+    "broadcast_equal": jnp.equal, "broadcast_not_equal": jnp.not_equal,
+    "broadcast_greater": jnp.greater, "broadcast_greater_equal": jnp.greater_equal,
+    "broadcast_lesser": jnp.less, "broadcast_lesser_equal": jnp.less_equal,
+    "broadcast_logical_and": jnp.logical_and, "broadcast_logical_or": jnp.logical_or,
+    "broadcast_logical_xor": jnp.logical_xor,
+}
+for _name, _f in _BINARY_CMP.items():
+    def _cmp(x, y, _f=_f):
+        return _f(x, y).astype(jnp.promote_types(x.dtype, y.dtype))
+    register(_name, differentiable=False)(_cmp)
+
+register("_equal", differentiable=False)(lambda x, y: (x == y).astype(x.dtype))
+register("_not_equal", differentiable=False)(lambda x, y: (x != y).astype(x.dtype))
+register("_greater", differentiable=False)(lambda x, y: (x > y).astype(x.dtype))
+register("_greater_equal", differentiable=False)(lambda x, y: (x >= y).astype(x.dtype))
+register("_lesser", differentiable=False)(lambda x, y: (x < y).astype(x.dtype))
+register("_lesser_equal", differentiable=False)(lambda x, y: (x <= y).astype(x.dtype))
+
+
+@register("_hypot")
+def _hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+@register("smooth_l1")
+def smooth_l1(x, *, scalar=1.0):
+    s2 = scalar * scalar
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# Scalar ops (reference: elemwise_binary_scalar_op_*.cc; scalar baked as param)
+# ---------------------------------------------------------------------------
+
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(s, x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
+}
+for _name, _f in _SCALAR.items():
+    def _sfn(x, *, scalar, _f=_f):
+        return _f(x, scalar)
+    register(_name)(_sfn)
+
+_SCALAR_CMP = {
+    "_equal_scalar": lambda x, s: x == s,
+    "_not_equal_scalar": lambda x, s: x != s,
+    "_greater_scalar": lambda x, s: x > s,
+    "_greater_equal_scalar": lambda x, s: x >= s,
+    "_lesser_scalar": lambda x, s: x < s,
+    "_lesser_equal_scalar": lambda x, s: x <= s,
+    "_logical_and_scalar": lambda x, s: jnp.logical_and(x, s),
+    "_logical_or_scalar": lambda x, s: jnp.logical_or(x, s),
+    "_logical_xor_scalar": lambda x, s: jnp.logical_xor(x, s),
+}
+for _name, _f in _SCALAR_CMP.items():
+    def _scfn(x, *, scalar, _f=_f):
+        return _f(x, scalar).astype(x.dtype)
+    register(_name, differentiable=False)(_scfn)
+
+
+@register("_scatter_elemwise_div")
+def _scatter_elemwise_div(x, y):
+    return x / y

@@ -1,0 +1,126 @@
+"""Linear-algebra ops (reference src/operator/tensor/la_op.cc — linalg_* family)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+
+from .registry import register
+
+
+@register("linalg_gemm")
+def linalg_gemm(A, B, C, *, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg_gemm2")
+def linalg_gemm2(A, B, *, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf")
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_potri")
+def linalg_potri(L):
+    eye = jnp.broadcast_to(jnp.eye(L.shape[-1], dtype=L.dtype), L.shape)
+    linv = jsl.solve_triangular(L, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("linalg_trsm")
+def linalg_trsm(A, B, *, transpose=False, rightside=False, lower=True, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    low = lower != transpose
+    if rightside:
+        x = jsl.solve_triangular(jnp.swapaxes(a, -1, -2), jnp.swapaxes(B, -1, -2),
+                                 lower=not low)
+        return alpha * jnp.swapaxes(x, -1, -2)
+    return alpha * jsl.solve_triangular(a, B, lower=low)
+
+
+@register("linalg_trmm")
+def linalg_trmm(A, B, *, transpose=False, rightside=False, lower=True, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    a = jnp.tril(a) if (lower != transpose) else jnp.triu(a)
+    if rightside:
+        return alpha * jnp.matmul(B, a)
+    return alpha * jnp.matmul(a, B)
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_syrk")
+def linalg_syrk(A, *, transpose=False, alpha=1.0):
+    if transpose:
+        return alpha * jnp.matmul(jnp.swapaxes(A, -1, -2), A)
+    return alpha * jnp.matmul(A, jnp.swapaxes(A, -1, -2))
+
+
+@register("linalg_extractdiag")
+def linalg_extractdiag(A, *, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag")
+def linalg_makediag(x, *, offset=0):
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    return out.at[..., r, c].set(x)
+
+
+@register("linalg_extracttrian")
+def linalg_extracttrian(A, *, offset=0, lower=True):
+    n = A.shape[-1]
+    mask = jnp.tril(jnp.ones((n, n), bool), k=offset) if lower else \
+        jnp.triu(jnp.ones((n, n), bool), k=offset)
+    rows, cols = jnp.where(mask, size=int(mask.sum()))
+    return A[..., rows, cols]
+
+
+@register("linalg_gelqf", multi_output=True)
+def linalg_gelqf(A):
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg_inverse", aliases=("inverse",))
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("linalg_det", aliases=("det",))
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("linalg_slogdet", aliases=("slogdet",), multi_output=True)
+def linalg_slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet
+
+
+@register("linalg_maketrian")
+def linalg_maketrian(x, *, offset=0, lower=True):
+    # inverse of extracttrian for square output
+    import math
+    L = x.shape[-1]
+    n = int((math.isqrt(8 * L + 1) - 1) // 2) + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
+    import numpy as _np
+    m = _np.tril(_np.ones((n, n), bool), k=offset) if lower else \
+        _np.triu(_np.ones((n, n), bool), k=offset)
+    rows, cols = _np.where(m)
+    return out.at[..., rows, cols].set(x)

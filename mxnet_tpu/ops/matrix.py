@@ -1,0 +1,460 @@
+"""Shape / layout / indexing / ordering ops.
+
+Reference: src/operator/tensor/matrix_op.cc (Reshape/transpose/slice/...),
+indexing_op.cc (take/one_hot/gather_nd/scatter_nd), ordering_op.cc (sort/topk/
+argsort), init_op.cc handled in creation functions, diag_op.cc, dot.
+All static-shape by construction (XLA requirement) — ops with data-dependent
+output shapes (e.g. boolean mask) live in ops/contrib.py with padded semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# Reshape with MXNet's special codes (reference matrix_op-inl.h InferReshapeShape)
+# ---------------------------------------------------------------------------
+
+def infer_reshape(src_shape, target):
+    """Implements MXNet reshape codes: 0 copy-dim, -1 infer, -2 copy-rest,
+    -3 merge-two, -4 split-two (reference src/operator/tensor/matrix_op-inl.h:100)."""
+    src = list(src_shape)
+    tgt = list(target)
+    out = []
+    i = 0  # index into src
+    j = 0
+    while j < len(tgt):
+        t = tgt[j]
+        if t == 0:
+            out.append(src[i]); i += 1
+        elif t == -1:
+            out.append(-1); i += 1
+        elif t == -2:
+            out.extend(src[i:]); i = len(src)
+        elif t == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif t == -4:
+            d1, d2 = tgt[j + 1], tgt[j + 2]
+            cur = src[i]
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(t)
+            if i < len(src):
+                i += 1
+        j += 1
+    # resolve a single -1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in src:
+            total *= d
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)
+
+
+@register("Reshape", aliases=("reshape",))
+def reshape(x, *, shape=None, reverse=False):
+    tgt = infer_reshape(x.shape[::-1] if reverse else x.shape,
+                        tuple(shape)[::-1] if reverse else tuple(shape))
+    if reverse:
+        tgt = tgt[::-1]
+    return jnp.reshape(x, tgt)
+
+
+@register("Flatten", aliases=("flatten",))
+def flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose")
+def transpose(x, *, axes=None):
+    if axes is None or len(axes) == 0:
+        return jnp.transpose(x)
+    return jnp.transpose(x, axes)
+
+
+@register("expand_dims")
+def expand_dims(x, *, axis):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze")
+def squeeze(x, *, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+@register("broadcast_to")
+def broadcast_to(x, *, shape):
+    shape = tuple(s if s != 0 else x.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@register("broadcast_like")
+def broadcast_like(x, y, *, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(x, y.shape)
+    tgt = list(x.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        tgt[la] = y.shape[ra]
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(x, *, axis, size):
+    if isinstance(axis, int):
+        axis, size = (axis,), (size,)
+    tgt = list(x.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+# ---------------------------------------------------------------------------
+# Slicing
+# ---------------------------------------------------------------------------
+
+@register("slice")
+def slice_op(x, *, begin, end, step=None):
+    nd = x.ndim
+    begin = tuple(begin) + (None,) * (nd - len(begin))
+    end = tuple(end) + (None,) * (nd - len(end))
+    step = tuple(step or ()) + (None,) * (nd - len(step or ()))
+    idx = tuple(slice(b, e, s if s != 0 else None) for b, e, s in zip(begin, end, step))
+    return x[idx]
+
+
+@register("slice_axis")
+def slice_axis(x, *, axis, begin, end):
+    axis = axis % x.ndim
+    if end is None:
+        end = x.shape[axis]
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(x, y, *, axes=None):
+    if axes is None or len(axes) == 0:
+        axes = range(x.ndim)
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a % x.ndim] = slice(0, y.shape[a % x.ndim])
+    return x[tuple(idx)]
+
+
+@register("reverse", aliases=("flip",))
+def reverse(x, *, axis):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(x, axis=axis)
+
+
+@register("tile")
+def tile(x, *, reps):
+    return jnp.tile(x, reps)
+
+
+@register("repeat")
+def repeat(x, *, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("pad", aliases=("Pad",))
+def pad(x, *, mode="constant", pad_width=None, constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(x, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(x, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pw, mode="reflect")
+    raise MXNetError(f"pad mode {mode}")
+
+
+@register("Concat", aliases=("concat",))
+def concat(*args, dim=1):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack")
+def stack(*args, axis=0):
+    return jnp.stack(args, axis=axis)
+
+
+@register("SliceChannel", aliases=("split",), multi_output=True)
+def split(x, *, num_outputs, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("split_v2", multi_output=True)
+def split_v2(x, *, indices_or_sections, axis=0, squeeze_axis=False):
+    if isinstance(indices_or_sections, int):
+        parts = jnp.split(x, indices_or_sections, axis=axis)
+    else:
+        parts = jnp.split(x, list(indices_or_sections), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("space_to_depth")
+def space_to_depth(x, *, block_size):
+    n, c, h, w = x.shape
+    b = block_size
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("depth_to_space")
+def depth_to_space(x, *, block_size):
+    n, c, h, w = x.shape
+    b = block_size
+    x = x.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+# ---------------------------------------------------------------------------
+# Indexing (reference indexing_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("take")
+def take(a, indices, *, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    return jnp.take(a, idx, axis=axis, mode=mode if mode != "wrap" else "wrap")
+
+
+@register("pick")
+def pick(x, index, *, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, x.shape[axis] - 1)
+    out = jnp.take_along_axis(x, jnp.expand_dims(idx, axis % x.ndim), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis % x.ndim)
+    return out
+
+
+@register("one_hot", differentiable=False)
+def one_hot(indices, *, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype)) \
+        * (on_value - off_value) + off_value
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, *, shape):
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].set(data)
+
+
+@register("_scatter_set_nd")
+def scatter_set_nd(lhs, indices, rhs, *, shape=None):
+    idx = tuple(indices.astype(jnp.int32))
+    return lhs.at[idx].set(rhs)
+
+
+@register("where")
+def where(cond, x, y):
+    return jnp.where(cond.astype(bool) if cond.dtype != jnp.bool_ else cond, x, y)
+
+
+@register("SequenceMask", aliases=("sequence_mask",))
+def sequence_mask(data, sequence_length=None, *, use_sequence_length=False, value=0.0, axis=0):
+    """reference src/operator/sequence_mask.cc — mask positions past seq len."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    # axis is the time axis; batch is the other leading axis (0 or 1)
+    batch_axis = 1 if axis == 0 else 0
+    mask = steps[:, None] < sequence_length[None, :].astype(jnp.int32)  # (T, B)
+    if axis == 1:
+        mask = mask.T  # (B, T)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    shape[batch_axis] = data.shape[batch_axis]
+    mask = mask.reshape(shape)
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceReverse", aliases=("sequence_reverse",))
+def sequence_reverse(data, sequence_length=None, *, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    T = data.shape[axis]
+    steps = jnp.arange(T)
+    seq = sequence_length.astype(jnp.int32)
+    # index mapping: i < len -> len-1-i else i  (per batch)
+    idx = jnp.where(steps[:, None] < seq[None, :], seq[None, :] - 1 - steps[:, None], steps[:, None])
+    if axis != 0:
+        raise MXNetError("SequenceReverse supports axis=0 (time-major)")
+    return jnp.take_along_axis(data, idx.reshape((T, -1) + (1,) * (data.ndim - 2)).astype(jnp.int32), axis=0)
+
+
+@register("SequenceLast", aliases=("sequence_last",))
+def sequence_last(data, sequence_length=None, *, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    return jnp.take_along_axis(
+        moved, idx.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0
+    )[0]
+
+
+# ---------------------------------------------------------------------------
+# Ordering (reference ordering_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("sort", differentiable=False)
+def sort(x, *, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort", differentiable=False)
+def argsort(x, *, axis=-1, is_ascend=True, dtype="float32"):
+    out = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.dtype(dtype))
+
+
+@register("topk", differentiable=False, multi_output=True)
+def topk(x, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = lax.top_k(-xm if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(jnp.dtype(dtype))
+    if ret_typ == "indices":
+        return (idx,)
+    if ret_typ == "value":
+        return (vals,)
+    if ret_typ == "both":
+        return (vals, idx)
+    if ret_typ == "mask":
+        raise MXNetError("topk ret_typ='mask' not supported on TPU path yet")
+    raise MXNetError(f"topk ret_typ {ret_typ}")
+
+
+# ---------------------------------------------------------------------------
+# Linear algebra entry points
+# ---------------------------------------------------------------------------
+
+@register("dot")
+def dot(a, b, *, transpose_a=False, transpose_b=False):
+    """MXNet dot: contracts last axis of a with first axis of b (reference dot-inl.h)."""
+    if transpose_a:
+        a = jnp.transpose(a)
+    if transpose_b:
+        b = jnp.transpose(b)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(a, b, *, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao")
+def khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[-1])
+    return out
+
+
+@register("diag")
+def diag(x, *, k=0, axis1=0, axis2=1):
+    if x.ndim == 1:
+        return jnp.diag(x, k=k)
+    return jnp.diagonal(x, offset=k, axis1=axis1, axis2=axis2)
+
+
+@register("eye_like", differentiable=False)
+def eye_like(x):
+    return jnp.eye(x.shape[0], x.shape[1], dtype=x.dtype)
+
+
+@register("L2Normalization")
+def l2_normalization(x, *, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        ax = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    elif mode == "spatial":
+        ax = tuple(range(2, x.ndim))
+    else:
+        raise MXNetError(f"L2Normalization mode {mode}")
+    nrm = jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=True) + eps)
+    return x / nrm
+
+
+@register("norm_like_ord")
+def _norm_like(x):
+    return jnp.linalg.norm(x)
+
+
+@register("cumsum")
+def cumsum(x, *, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis, dtype=jnp.dtype(dtype) if dtype else None)
+
+
+@register("cumprod")
+def cumprod(x, *, axis=None):
+    return jnp.cumprod(x, axis=axis)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def swapaxes(x, *, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("ravel_multi_index", differentiable=False)
+def ravel_multi_index(indices, *, shape):
+    out = jnp.zeros(indices.shape[1:], dtype=jnp.int32)
+    stride = 1
+    for i in range(len(shape) - 1, -1, -1):
+        out = out + indices[i].astype(jnp.int32) * stride
+        stride *= shape[i]
+    return out.astype(jnp.float32)
+
+
+@register("unravel_index", differentiable=False)
+def unravel_index(indices, *, shape):
+    idx = indices.astype(jnp.int32)
+    outs = []
+    for s in reversed(shape):
+        outs.append(idx % s)
+        idx = idx // s
+    return jnp.stack(outs[::-1], axis=0).astype(jnp.float32)

@@ -1,0 +1,98 @@
+"""Reduction ops (reference src/operator/tensor/broadcast_reduce_op_*.cc).
+
+sum/mean/prod/max/min/norm/argmax/argmin/... with MXNet's axis/keepdims/exclude
+semantics. Reductions over bf16 inputs accumulate in float32 when
+MXNET_SAFE_ACCUMULATION is on (TPU-first: bf16 inputs are the common case).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import env
+from .registry import register
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % ndim for a in axis)
+    if exclude:
+        axis = tuple(a for a in range(ndim) if a not in axis)
+    return axis
+
+
+def _acc_dtype(x):
+    if env.get("MXNET_SAFE_ACCUMULATION") and x.dtype in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return None
+
+
+def _reduce(fn_name):
+    fn = getattr(jnp, fn_name)
+
+    def impl(x, *, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis, x.ndim, exclude)
+        acc = _acc_dtype(x) if fn_name in ("sum", "mean", "prod") else None
+        if acc is not None:
+            out = fn(x.astype(acc), axis=ax, keepdims=keepdims).astype(x.dtype)
+        else:
+            out = fn(x, axis=ax, keepdims=keepdims)
+        return out
+    impl.__name__ = fn_name
+    return impl
+
+
+register("sum", aliases=("sum_axis",))(_reduce("sum"))
+register("mean")(_reduce("mean"))
+register("prod")(_reduce("prod"))
+register("max", aliases=("max_axis",))(_reduce("max"))
+register("min", aliases=("min_axis",))(_reduce("min"))
+register("nansum")(_reduce("nansum"))
+register("nanprod")(_reduce("nanprod"))
+
+
+@register("norm")
+def norm(x, *, ord=2, axis=None, keepdims=False):
+    ax = _norm_axis(axis, x.ndim)
+    acc = _acc_dtype(x)
+    xx = x.astype(acc) if acc else x
+    if ord == 1:
+        out = jnp.sum(jnp.abs(xx), axis=ax, keepdims=keepdims)
+    elif ord == 2:
+        out = jnp.sqrt(jnp.sum(jnp.square(xx), axis=ax, keepdims=keepdims))
+    else:
+        out = jnp.power(jnp.sum(jnp.power(jnp.abs(xx), ord), axis=ax, keepdims=keepdims), 1.0 / ord)
+    return out.astype(x.dtype) if acc else out
+
+
+@register("argmax", differentiable=False)
+def argmax(x, *, axis=None, keepdims=False):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)  # MXNet returns float indices
+
+
+@register("argmin", differentiable=False)
+def argmin(x, *, axis=None, keepdims=False):
+    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register("argmax_channel", differentiable=False)
+def argmax_channel(x):
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register("logsumexp")
+def logsumexp(x, *, axis=None, keepdims=False):
+    import jax.scipy.special as jsp
+    ax = _norm_axis(axis, x.ndim)
+    return jsp.logsumexp(x, axis=ax, keepdims=keepdims)
+
+
+@register("moments", multi_output=True)
+def moments(x, *, axes=None, keepdims=False):
+    ax = _norm_axis(axes, x.ndim)
+    mean = jnp.mean(x, axis=ax, keepdims=keepdims)
+    var = jnp.var(x, axis=ax, keepdims=keepdims)
+    return mean, var

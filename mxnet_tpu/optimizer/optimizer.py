@@ -1,0 +1,821 @@
+"""Optimizers (reference python/mxnet/optimizer/optimizer.py + fused update
+kernels in src/operator/optimizer_op.cc).
+
+TPU-native: each optimizer's update rule is ONE jitted pure function
+`(weight, grad, *states, lr, wd, ...) -> (new_weight, *new_states)`; scalars
+enter as traced 0-d arrays so changing the learning rate never recompiles.
+Multi-precision (`mp_*` kernels in the reference) falls out naturally: the
+master weight is the f32 state and the bf16 copy is refreshed per step.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, zeros
+
+_OPT_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls):
+    _OPT_REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(name, **kwargs) -> "Optimizer":
+    if isinstance(name, Optimizer):
+        return name
+    try:
+        return _OPT_REGISTRY[name.lower()](**kwargs)
+    except KeyError:
+        raise MXNetError(f"unknown optimizer {name!r}") from None
+
+
+def _f(x):
+    return jnp.float32(x)
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer.py:31)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, begin_num_update=0,
+                 aggregate_num=0, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        self.param_idx2name = dict(param_idx2name or {})
+        self.param_dict = dict(param_dict or {})
+        self.idx2name = self.param_idx2name
+        self.num_update = begin_num_update
+        self.begin_num_update = begin_num_update
+        self._index_update_count: Dict[Any, int] = {}
+        self._all_index_update_counts = {0: self._index_update_count}
+        self.lr_mult: Dict[str, float] = {}
+        self.wd_mult: Dict[str, float] = {}
+
+    # pickling (Updater.get_states ships the optimizer to kvstore servers):
+    # drop the live Parameter references, they are re-bound on the worker
+    def __getstate__(self):
+        st = dict(self.__dict__)
+        st["param_dict"] = {}
+        return st
+
+    def __setstate__(self, st):
+        self.__dict__.update(st)
+
+    # -- bookkeeping --------------------------------------------------------
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for i in index:
+            self._index_update_count.setdefault(i, self.begin_num_update)
+            self._index_update_count[i] += 1
+            self.num_update = max(self._index_update_count[i], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("lr_scheduler is set; use the scheduler to change lr")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        return self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    # -- state --------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype in (jnp.float16, jnp.bfloat16):
+            master = NDArray(weight._data.astype(jnp.float32), weight.ctx)
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    # -- update -------------------------------------------------------------
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype in (jnp.float16, jnp.bfloat16):
+            master, base_state = state
+            g32 = NDArray(grad._data.astype(jnp.float32), grad.ctx)
+            self.update(index, master, g32, base_state)
+            weight._set_data(master._data.astype(weight.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    # list-form update used by kvstore trainer path
+    def _update_list(self, indices, weights, grads, states):
+        for i, w, g, s in zip(indices, weights, grads, states):
+            self.update_multi_precision(i, w, g, s)
+
+    def _preprocess(self, grad_raw, wd=None, weight_raw=None):
+        g = grad_raw * _f(self.rescale_grad)
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+
+class Updater:
+    """Serializable state-holder applying an optimizer (reference
+    optimizer.py:2018 — the object shipped to kvstore servers)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+        self.states_synced: Dict[Any, bool] = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            index, grad, weight = [index], [grad], [weight]
+        for i, g, w in zip(index, grad, weight):
+            if i not in self.states:
+                self.states[i] = self.optimizer.create_state_multi_precision(i, w)
+                self.states_synced[i] = True
+            self.optimizer._update_count(i)
+            self.optimizer.update_multi_precision(i, w, g, self.states[i])
+
+    def get_states(self, dump_optimizer=False):
+        def conv(s):
+            if isinstance(s, NDArray):
+                return ("nd", s.asnumpy(), str(s.dtype))
+            if isinstance(s, (tuple, list)):
+                return ("tuple", [conv(x) for x in s])
+            return ("raw", s)
+        payload = {k: conv(v) for k, v in self.states.items()}
+        blob = {"states": payload}
+        if dump_optimizer:
+            blob["optimizer"] = self.optimizer
+        return pickle.dumps(blob)
+
+    def set_states(self, states_blob):
+        from ..ndarray import array
+        blob = pickle.loads(states_blob)
+
+        def unconv(s):
+            tag = s[0]
+            if tag == "nd":
+                return array(s[1], dtype=s[2])
+            if tag == "tuple":
+                return tuple(unconv(x) for x in s[1])
+            return s[1]
+        self.states = {k: unconv(v) for k, v in blob["states"].items()}
+        if "optimizer" in blob:
+            self.optimizer = blob["optimizer"]
+        self.states_synced = {k: False for k in self.states}
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)
+
+
+# ---------------------------------------------------------------------------
+# Jitted update kernels
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _k_sgd(w, g, lr, wd, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    return w - lr * g
+
+
+@jax.jit
+def _k_sgd_mom(w, g, mom, lr, wd, rescale, clip, momentum):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    mom2 = momentum * mom - lr * g
+    return w + mom2, mom2
+
+
+@jax.jit
+def _k_nag(w, g, mom, lr, wd, rescale, clip, momentum):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    mom2 = momentum * mom + g
+    return w - lr * (g + momentum * mom2), mom2
+
+
+@jax.jit
+def _k_adam(w, g, m, v, lr, wd, rescale, clip, beta1, beta2, eps, coef1, coef2):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    m2 = beta1 * m + (1 - beta1) * g
+    v2 = beta2 * v + (1 - beta2) * g * g
+    lr_t = lr * jnp.sqrt(coef2) / coef1
+    return w - lr_t * m2 / (jnp.sqrt(v2) + eps), m2, v2
+
+
+@jax.jit
+def _k_adamw(w, g, m, v, lr, eta, wd, rescale, clip, beta1, beta2, eps, coef1, coef2):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    m2 = beta1 * m + (1 - beta1) * g
+    v2 = beta2 * v + (1 - beta2) * g * g
+    mhat = m2 / coef1
+    vhat = v2 / coef2
+    return w - eta * (lr * mhat / (jnp.sqrt(vhat) + eps) + wd * w), m2, v2
+
+
+@jax.jit
+def _k_rmsprop(w, g, n, lr, wd, rescale, clip, rho, eps):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    n2 = rho * n + (1 - rho) * g * g
+    return w - lr * g / jnp.sqrt(n2 + eps), n2
+
+
+@jax.jit
+def _k_rmsprop_alex(w, g, n, gavg, delta, lr, wd, rescale, clip, rho, momentum, eps):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    n2 = rho * n + (1 - rho) * g * g
+    gavg2 = rho * gavg + (1 - rho) * g
+    delta2 = momentum * delta - lr * g / jnp.sqrt(n2 - gavg2 * gavg2 + eps)
+    return w + delta2, n2, gavg2, delta2
+
+
+@jax.jit
+def _k_adagrad(w, g, h, lr, wd, rescale, clip, eps):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    h2 = h + g * g
+    return w - lr * g / (jnp.sqrt(h2) + eps), h2
+
+
+@jax.jit
+def _k_adadelta(w, g, acc_g, acc_d, wd, rescale, clip, rho, eps):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    acc_g2 = rho * acc_g + (1 - rho) * g * g
+    d = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g2 + eps) * g
+    acc_d2 = rho * acc_d + (1 - rho) * d * d
+    return w - d, acc_g2, acc_d2
+
+
+@jax.jit
+def _k_ftrl(w, g, z, n, lr, wd, rescale, clip, lamda1, beta):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    n2 = n + g * g
+    sigma = (jnp.sqrt(n2) - jnp.sqrt(n)) / lr
+    z2 = z + g - sigma * w
+    w2 = jnp.where(
+        jnp.abs(z2) > lamda1,
+        -(z2 - jnp.sign(z2) * lamda1) / ((beta + jnp.sqrt(n2)) / lr + wd),
+        0.0).astype(w.dtype)
+    return w2, z2, n2
+
+
+@jax.jit
+def _k_adamax(w, g, m, u, lr, wd, rescale, clip, beta1, beta2, coef1):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    m2 = beta1 * m + (1 - beta1) * g
+    u2 = jnp.maximum(beta2 * u, jnp.abs(g))
+    return w - (lr / coef1) * m2 / (u2 + 1e-8), m2, u2
+
+
+@jax.jit
+def _k_nadam(w, g, m, v, lr, wd, rescale, clip, beta1, beta2, eps, mschedule, mnext, coef2):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    ghat = g / (1 - mschedule)
+    m2 = beta1 * m + (1 - beta1) * g
+    v2 = beta2 * v + (1 - beta2) * g * g
+    mhat = m2 / (1 - mschedule * mnext)
+    vhat = v2 / coef2
+    mbar = (1 - mnext / (1 - mschedule)) * ghat + (mnext / (1 - mschedule * mnext)) * m2
+    mbar = (1.0 - mnext) * ghat + mnext * mhat
+    return w - lr * mbar / (jnp.sqrt(vhat) + eps), m2, v2
+
+
+@jax.jit
+def _k_signum(w, g, mom, lr, wd, rescale, clip, momentum, wd_lh):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    mom2 = momentum * mom - (1 - momentum) * (g + wd * w)
+    return (1 - lr * wd_lh) * w + lr * jnp.sign(mom2), mom2
+
+
+@jax.jit
+def _k_ftml(w, g, d, v, z, lr, wd, rescale, clip, beta1, beta2, eps, t):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    v2 = beta2 * v + (1 - beta2) * g * g
+    d2 = (1 - beta1 ** t) / lr * (jnp.sqrt(v2 / (1 - beta2 ** t)) + eps)
+    sigma = d2 - beta1 * d
+    z2 = beta1 * z + (1 - beta1) * g - sigma * w
+    return -z2 / d2, d2, v2, z2
+
+
+@jax.jit
+def _k_dcasgd(w, g, prev_w, lr, wd, rescale, clip, lamda):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    comp = lamda * g * g * (w - prev_w)
+    return w - lr * (g + comp), w
+
+
+@jax.jit
+def _k_sgld(w, g, noise, lr, wd, rescale, clip):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    g = g + wd * w
+    return w - 0.5 * lr * g + jnp.sqrt(lr) * noise
+
+
+def _norm(x):
+    return jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+
+
+@jax.jit
+def _k_lars(w, g, mom, lr, wd, rescale, clip, momentum, eta, eps):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    wn = _norm(w)
+    gn = _norm(g)
+    trust = jnp.where((wn > 0) & (gn > 0), eta * wn / (gn + wd * wn + eps), 1.0)
+    g = g + wd * w
+    mom2 = momentum * mom + trust * lr * g
+    return w - mom2, mom2
+
+
+@jax.jit
+def _k_lamb(w, g, m, v, lr, wd, rescale, clip, beta1, beta2, eps, coef1, coef2,
+            lower, upper, bias_correction):
+    g = g * rescale
+    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+    m2 = beta1 * m + (1 - beta1) * g
+    v2 = beta2 * v + (1 - beta2) * g * g
+    mhat = jnp.where(bias_correction, m2 / coef1, m2)
+    vhat = jnp.where(bias_correction, v2 / coef2, v2)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * w
+    wn = jnp.clip(_norm(w), lower, upper)
+    rn = _norm(r)
+    trust = jnp.where(rn > 0, wn / rn, 1.0)
+    return w - lr * trust * r, m2, v2
+
+
+# ---------------------------------------------------------------------------
+# Optimizer classes
+# ---------------------------------------------------------------------------
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum + multi-precision (reference optimizer.py:526)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        if self.momentum == 0.0:
+            weight._set_data(_k_sgd(weight._data, grad._data, _f(lr), _f(wd),
+                                    _f(self.rescale_grad), _f(clip)))
+        else:
+            w2, m2 = _k_sgd_mom(weight._data, grad._data, state._data, _f(lr),
+                                _f(wd), _f(self.rescale_grad), _f(clip),
+                                _f(self.momentum))
+            weight._set_data(w2)
+            state._set_data(m2)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        w2, m2 = _k_nag(weight._data, grad._data, state._data, _f(lr), _f(wd),
+                        _f(self.rescale_grad), _f(clip), _f(self.momentum))
+        weight._set_data(w2)
+        state._set_data(m2)
+
+
+@register
+class SGLD(Optimizer):
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        from .. import random as _rng
+        noise = jax.random.normal(_rng.next_key(), weight.shape, jnp.float32).astype(weight.dtype)
+        weight._set_data(_k_sgld(weight._data, grad._data, noise, _f(lr), _f(wd),
+                                 _f(self.rescale_grad), _f(clip)))
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        w2, m2 = _k_signum(weight._data, grad._data, state._data, _f(lr), _f(wd),
+                           _f(self.rescale_grad), _f(clip), _f(self.momentum),
+                           _f(self.wd_lh))
+        weight._set_data(w2)
+        state._set_data(m2)
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        return NDArray(weight._data, weight.ctx)  # previous weight snapshot
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        w2, prev = _k_dcasgd(weight._data, grad._data, state._data, _f(lr), _f(wd),
+                             _f(self.rescale_grad), _f(clip), _f(self.lamda))
+        weight._set_data(w2)
+        state._set_data(prev)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+        return (zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype), z)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        d, v, z = state
+        w2, d2, v2, z2 = _k_ftml(weight._data, grad._data, d._data, v._data,
+                                 z._data, _f(lr), _f(wd), _f(self.rescale_grad),
+                                 _f(clip), _f(self.beta1), _f(self.beta2),
+                                 _f(self.epsilon), _f(t))
+        weight._set_data(w2); d._set_data(d2); v._set_data(v2); z._set_data(z2)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference optimizer.py:797)."""
+
+    def __init__(self, momentum=0.0, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        w2, m2 = _k_lars(weight._data, grad._data, state._data, _f(lr), _f(wd),
+                         _f(self.rescale_grad), _f(clip), _f(self.momentum),
+                         _f(self.eta), _f(self.epsilon))
+        weight._set_data(w2)
+        state._set_data(m2)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with warmup (reference optimizer.py LBSGD); the
+    layer-wise scaling part is LARS — compose with lr warmup schedulers."""
+
+    def __init__(self, warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(**kwargs)
+
+
+@register
+class LAMB(Optimizer):
+    """reference optimizer.py:1250."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 lower_bound=None, upper_bound=None, bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.ctx, dtype="float32"),
+                zeros(weight.shape, ctx=weight.ctx, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        m, v = state
+        w2, m2, v2 = _k_lamb(weight._data, grad._data, m._data, v._data, _f(lr),
+                             _f(wd), _f(self.rescale_grad), _f(clip),
+                             _f(self.beta1), _f(self.beta2), _f(self.epsilon),
+                             _f(1 - self.beta1 ** t), _f(1 - self.beta2 ** t),
+                             _f(self.lower_bound or 0.0),
+                             _f(self.upper_bound or jnp.inf),
+                             jnp.bool_(self.bias_correction))
+        weight._set_data(w2); m._set_data(m2); v._set_data(v2)
+
+
+@register
+class Adam(Optimizer):
+    """reference optimizer.py:1495."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        m, v = state
+        w2, m2, v2 = _k_adam(weight._data, grad._data, m._data, v._data, _f(lr),
+                             _f(wd), _f(self.rescale_grad), _f(clip),
+                             _f(self.beta1), _f(self.beta2), _f(self.epsilon),
+                             _f(1 - self.beta1 ** t), _f(1 - self.beta2 ** t))
+        weight._set_data(w2); m._set_data(m2); v._set_data(v2)
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay (reference contrib adamw.cc); eta is the
+    schedule multiplier."""
+
+    def __init__(self, eta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.eta = eta
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        m, v = state
+        w2, m2, v2 = _k_adamw(weight._data, grad._data, m._data, v._data, _f(lr),
+                              _f(self.eta), _f(wd), _f(self.rescale_grad), _f(clip),
+                              _f(self.beta1), _f(self.beta2), _f(self.epsilon),
+                              _f(1 - self.beta1 ** t), _f(1 - self.beta2 ** t))
+        weight._set_data(w2); m._set_data(m2); v._set_data(v2)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        w2, h2 = _k_adagrad(weight._data, grad._data, state._data, _f(lr), _f(wd),
+                            _f(self.rescale_grad), _f(clip), _f(self.float_stable_eps))
+        weight._set_data(w2)
+        state._set_data(h2)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2, self.epsilon = gamma1, gamma2, epsilon
+        self.centered = centered
+
+    def create_state(self, index, weight):
+        n = zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+        if self.centered:
+            return (n, zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                    zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype))
+        return n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        if self.centered:
+            n, gavg, delta = state
+            w2, n2, gavg2, d2 = _k_rmsprop_alex(
+                weight._data, grad._data, n._data, gavg._data, delta._data,
+                _f(lr), _f(wd), _f(self.rescale_grad), _f(clip), _f(self.gamma1),
+                _f(self.gamma2), _f(self.epsilon))
+            weight._set_data(w2); n._set_data(n2); gavg._set_data(gavg2); delta._set_data(d2)
+        else:
+            w2, n2 = _k_rmsprop(weight._data, grad._data, state._data, _f(lr),
+                                _f(wd), _f(self.rescale_grad), _f(clip),
+                                _f(self.gamma1), _f(self.epsilon))
+            weight._set_data(w2)
+            state._set_data(n2)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        acc_g, acc_d = state
+        w2, g2, d2 = _k_adadelta(weight._data, grad._data, acc_g._data, acc_d._data,
+                                 _f(wd), _f(self.rescale_grad), _f(clip),
+                                 _f(self.rho), _f(self.epsilon))
+        weight._set_data(w2); acc_g._set_data(g2); acc_d._set_data(d2)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        z, n = state
+        w2, z2, n2 = _k_ftrl(weight._data, grad._data, z._data, n._data, _f(lr),
+                             _f(wd), _f(self.rescale_grad), _f(clip),
+                             _f(self.lamda1), _f(self.beta))
+        weight._set_data(w2); z._set_data(z2); n._set_data(n2)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        m, u = state
+        w2, m2, u2 = _k_adamax(weight._data, grad._data, m._data, u._data, _f(lr),
+                               _f(wd), _f(self.rescale_grad), _f(clip),
+                               _f(self.beta1), _f(self.beta2),
+                               _f(1 - self.beta1 ** t))
+        weight._set_data(w2); m._set_data(m2); u._set_data(u2)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m, v = state
+        w2, m2, v2 = _k_nadam(weight._data, grad._data, m._data, v._data, _f(lr),
+                              _f(wd), _f(self.rescale_grad), _f(clip),
+                              _f(self.beta1), _f(self.beta2), _f(self.epsilon),
+                              _f(self.m_schedule), _f(momentum_t1),
+                              _f(1 - self.beta2 ** t))
+        weight._set_data(w2); m._set_data(m2); v._set_data(v2)
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer (reference optimizer.py:1979) — w -= lr*g, keeps a
+    state buffer for kvstore-server round-trip tests."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        weight._set_data(_k_sgd(weight._data, grad._data, _f(self._get_lr(index)),
+                                _f(self._get_wd(index)), _f(self.rescale_grad),
+                                _f(-1.0)))
+
+
+ccSGD = SGD

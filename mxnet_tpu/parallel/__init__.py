@@ -1,0 +1,27 @@
+"""Parallelism over jax.sharding meshes (SURVEY.md §2.4 / §5-h).
+
+The reference's entire distributed stack (Comm trees, NCCL kvstore, ps-lite
+parameter server — src/kvstore/) collapses here into XLA collectives driven
+by sharding annotations:
+
+  - data parallel:   batch sharded over 'dp'; grad allreduce inserted by XLA
+  - tensor parallel: weight matrices sharded over 'tp' (Megatron col/row)
+  - sequence/context parallel: ring attention over 'sp' via ppermute
+  - pipeline:        layer stages over 'pp' with microbatch scan
+  - multi-host:      same collectives; DCN is just an outer mesh axis
+
+Capability uplift vs the reference (which had none of TP/PP/SP — SURVEY §2.4).
+"""
+from .mesh import (make_mesh, local_mesh, replicate, shard_batch, P,
+                   current_mesh, set_default_mesh)
+from .data_parallel import DataParallelTrainer, functional_optimizer
+from .ring_attention import ring_attention, blockwise_attention
+from .tensor_parallel import (column_parallel_spec, row_parallel_spec,
+                              shard_params_megatron)
+from .pipeline import pipeline_spec
+
+__all__ = ["make_mesh", "local_mesh", "replicate", "shard_batch", "P",
+           "current_mesh", "set_default_mesh", "DataParallelTrainer",
+           "functional_optimizer", "ring_attention", "blockwise_attention",
+           "column_parallel_spec", "row_parallel_spec", "shard_params_megatron",
+           "pipeline_spec"]

@@ -1,0 +1,301 @@
+"""Fused data-parallel training step (the TPU path that replaces reference
+SURVEY.md §3.5: Trainer.step → kvstore pushpull → Comm/NCCL/ps-lite).
+
+One `jax.jit` computes forward + backward + allreduce + optimizer update:
+batch enters sharded over the 'dp' mesh axis, parameters stay replicated (or
+sharded per their Parameter.sharding spec for TP), and XLA inserts the grad
+all-reduce over ICI. Weight update runs replicated (or sharded — ZeRO-style —
+when the optimizer state spec says so).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .. import autograd
+from .. import random as _rng
+from ..gluon.block import HybridBlock, _AUX_STACK
+from ..gluon.parameter import Parameter
+from .. import optimizer as opt_mod
+from .mesh import current_mesh, P
+
+
+# ---------------------------------------------------------------------------
+# Functional adapters over the eager Optimizer kernels
+# ---------------------------------------------------------------------------
+
+def functional_optimizer(opt: "opt_mod.Optimizer"):
+    """Return (init_state(w_tree)->s_tree, update(g,w,s,t)->(w,s)) for an
+    Optimizer instance, reusing its jitted kernels."""
+    from ..optimizer.optimizer import (SGD, NAG, Adam, AdamW, LAMB, LARS,
+                                       RMSProp, AdaGrad, _k_sgd, _k_sgd_mom,
+                                       _k_nag, _k_adam, _k_adamw, _k_lamb,
+                                       _k_lars, _k_rmsprop, _k_adagrad)
+
+    def _f(x):
+        return jnp.float32(x)
+
+    clip = opt.clip_gradient if opt.clip_gradient is not None else -1.0
+
+    if isinstance(opt, AdamW):
+        def init(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(g, w, s, t, lr, wd):
+            m, v = s
+            c1 = 1 - opt.beta1 ** t
+            c2 = 1 - opt.beta2 ** t
+            w2, m2, v2 = _k_adamw(w, g, m, v, lr, _f(opt.eta), wd,
+                                  _f(opt.rescale_grad), _f(clip), _f(opt.beta1),
+                                  _f(opt.beta2), _f(opt.epsilon), c1, c2)
+            return w2, (m2, v2)
+        return init, update
+
+    if isinstance(opt, LAMB):
+        def init(w):
+            return (jnp.zeros_like(w, dtype=jnp.float32),
+                    jnp.zeros_like(w, dtype=jnp.float32))
+
+        def update(g, w, s, t, lr, wd):
+            m, v = s
+            c1 = 1 - opt.beta1 ** t
+            c2 = 1 - opt.beta2 ** t
+            w2, m2, v2 = _k_lamb(w, g, m, v, lr, wd, _f(opt.rescale_grad),
+                                 _f(clip), _f(opt.beta1), _f(opt.beta2),
+                                 _f(opt.epsilon), c1, c2,
+                                 _f(opt.lower_bound or 0.0),
+                                 _f(opt.upper_bound or jnp.inf),
+                                 jnp.bool_(opt.bias_correction))
+            return w2, (m2, v2)
+        return init, update
+
+    if isinstance(opt, Adam):
+        def init(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(g, w, s, t, lr, wd):
+            m, v = s
+            c1 = 1 - opt.beta1 ** t
+            c2 = 1 - opt.beta2 ** t
+            w2, m2, v2 = _k_adam(w, g, m, v, lr, wd, _f(opt.rescale_grad),
+                                 _f(clip), _f(opt.beta1), _f(opt.beta2),
+                                 _f(opt.epsilon), c1, c2)
+            return w2, (m2, v2)
+        return init, update
+
+    if isinstance(opt, LARS):
+        def init(w):
+            return jnp.zeros_like(w)
+
+        def update(g, w, s, t, lr, wd):
+            w2, s2 = _k_lars(w, g, s, lr, wd, _f(opt.rescale_grad), _f(clip),
+                             _f(opt.momentum), _f(opt.eta), _f(opt.epsilon))
+            return w2, s2
+        return init, update
+
+    if isinstance(opt, NAG):
+        def init(w):
+            return jnp.zeros_like(w)
+
+        def update(g, w, s, t, lr, wd):
+            w2, s2 = _k_nag(w, g, s, lr, wd, _f(opt.rescale_grad), _f(clip),
+                            _f(opt.momentum))
+            return w2, s2
+        return init, update
+
+    if isinstance(opt, RMSProp) and not opt.centered:
+        def init(w):
+            return jnp.zeros_like(w)
+
+        def update(g, w, s, t, lr, wd):
+            w2, s2 = _k_rmsprop(w, g, s, lr, wd, _f(opt.rescale_grad), _f(clip),
+                                _f(opt.gamma1), _f(opt.epsilon))
+            return w2, s2
+        return init, update
+
+    if isinstance(opt, AdaGrad):
+        def init(w):
+            return jnp.zeros_like(w)
+
+        def update(g, w, s, t, lr, wd):
+            w2, s2 = _k_adagrad(w, g, s, lr, wd, _f(opt.rescale_grad), _f(clip),
+                                _f(opt.float_stable_eps))
+            return w2, s2
+        return init, update
+
+    if isinstance(opt, SGD):
+        mom = getattr(opt, "momentum", 0.0)
+        if mom == 0.0:
+            def init(w):
+                return ()
+
+            def update(g, w, s, t, lr, wd):
+                return _k_sgd(w, g, lr, wd, _f(opt.rescale_grad), _f(clip)), ()
+            return init, update
+
+        def init(w):
+            return jnp.zeros_like(w)
+
+        def update(g, w, s, t, lr, wd):
+            w2, s2 = _k_sgd_mom(w, g, s, lr, wd, _f(opt.rescale_grad), _f(clip),
+                                _f(mom))
+            return w2, s2
+        return init, update
+
+    raise MXNetError(f"no functional adapter for optimizer "
+                     f"{type(opt).__name__}; use gluon.Trainer or add one")
+
+
+def _make_apply_fn(block: HybridBlock, plist: List[Parameter], train: bool):
+    """Pure fn(key_raw, params_raw_list, *inputs_raw) -> (outputs, aux_list).
+    Same parameter-swap trick as HybridBlock's cached graph."""
+    def apply_fn(key_raw, params_raw, *raw_inputs):
+        in_nds = [NDArray(r) for r in raw_inputs]
+        saved = [p._data._data for p in plist]
+        aux: List[Tuple[Parameter, Any]] = []
+        _AUX_STACK.append(aux)
+        from ..gluon.block import _TRACE_DEPTH
+        _TRACE_DEPTH[0] += 1
+        prev_rec = autograd.set_recording(False)
+        prev_train = autograd.set_training(train)
+        _rng.push_trace_key(key_raw)
+        try:
+            for p, r in zip(plist, params_raw):
+                p._data._data = r
+            out = block._forward_unhybridized(*in_nds)
+        finally:
+            _rng.pop_trace_key()
+            for p, s in zip(plist, saved):
+                p._data._data = s
+            _AUX_STACK.pop()
+            _TRACE_DEPTH[0] -= 1
+            autograd.set_recording(prev_rec)
+            autograd.set_training(prev_train)
+        leaves = jax.tree_util.tree_leaves(
+            out, is_leaf=lambda x: isinstance(x, NDArray))
+        raw_out = [l._data if isinstance(l, NDArray) else l for l in leaves]
+        return raw_out[0] if len(raw_out) == 1 else tuple(raw_out), \
+            [v for _, v in aux]
+    return apply_fn
+
+
+class DataParallelTrainer:
+    """One-jit data-parallel trainer.
+
+    net must be a HybridBlock already initialized; loss_fn(F-less) maps
+    (pred_raw, label_raw) -> scalar raw loss, built from jax ops, OR pass a
+    gluon Loss block.
+
+    step(x, y) -> float loss. Parameters/optimizer state live on device as
+    raw arrays between steps (donated — no host round-trip), synced back into
+    the gluon Parameters on `sync()` / checkpoint.
+    """
+
+    def __init__(self, net: HybridBlock, loss, optimizer="sgd",
+                 optimizer_params=None, mesh: Optional[Mesh] = None,
+                 batch_axis_name: str = "dp", dtype=None):
+        self.net = net
+        self.mesh = mesh if mesh is not None else current_mesh()
+        self.batch_axis = batch_axis_name
+        self.optimizer = optimizer if isinstance(optimizer, opt_mod.Optimizer) \
+            else opt_mod.create(optimizer, **(optimizer_params or {}))
+        self._init_fn, self._update_fn = functional_optimizer(self.optimizer)
+        self.loss = loss
+        self._plist = [p for p in net.collect_params().values()
+                       if p._data is not None]
+        self._trainable = [p.grad_req != "null" for p in self._plist]
+        self._params_raw = [p._data._data for p in self._plist]
+        self._opt_state = [self._init_fn(w) if t else ()
+                           for w, t in zip(self._params_raw, self._trainable)]
+        self._t = 0
+        self._step_jit: Dict[Any, Callable] = {}
+
+        # shardings: params per their spec (default replicated)
+        self._param_shardings = [
+            NamedSharding(self.mesh, p.sharding if p.sharding is not None else P())
+            for p in self._plist]
+        self._params_raw = [jax.device_put(w, s) for w, s in
+                            zip(self._params_raw, self._param_shardings)]
+
+    # -- loss plumbing -------------------------------------------------------
+    def _loss_raw(self, pred_raw, label_raw):
+        from ..gluon.loss import Loss as GluonLoss
+        if isinstance(self.loss, GluonLoss):
+            out = self.loss._forward_unhybridized(NDArray(pred_raw), NDArray(label_raw))
+            return jnp.mean(out._data)
+        return jnp.mean(self.loss(pred_raw, label_raw))
+
+    def _build_step(self, x_shape_dtype, y_shape_dtype):
+        apply_fn = _make_apply_fn(self.net, self._plist, train=True)
+        update_fn = self._update_fn
+        loss_raw = self._loss_raw
+        wds = [self.optimizer._get_wd(i) for i in range(len(self._plist))]
+        trainable = self._trainable
+        mesh = self.mesh
+        batch_axis = self.batch_axis
+
+        x_sh = NamedSharding(mesh, P(batch_axis))
+        rep = NamedSharding(mesh, P())
+        p_sh = self._param_shardings
+
+        # params/opt_state/x/y arrive pre-placed (device_put with NamedSharding);
+        # XLA propagates shardings and inserts the dp all-reduce on grads.
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, key, x, y, lr, t):
+            def lossf(ps):
+                out, aux = apply_fn(key, ps, x)
+                pred = out if not isinstance(out, tuple) else out[0]
+                return loss_raw(pred, y), aux
+            (lossv, aux), grads = jax.value_and_grad(lossf, has_aux=True)(params)
+            new_params, new_state = [], []
+            for i, (g, w, s) in enumerate(zip(grads, params, opt_state)):
+                if trainable[i]:
+                    w2, s2 = update_fn(g, w, s, t, lr, jnp.float32(wds[i]))
+                    new_params.append(w2.astype(w.dtype))
+                    new_state.append(s2)
+                else:
+                    new_params.append(w)
+                    new_state.append(s)
+            return new_params, new_state, lossv, aux
+        return step
+
+    def step(self, x, y, batch_size=None):
+        """Run one fused training step; x/y are NDArrays (global batch)."""
+        xr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        yr = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+        bs = batch_size or xr.shape[0]
+        self.optimizer.rescale_grad = 1.0
+        sig = (xr.shape, str(xr.dtype), yr.shape, str(yr.dtype))
+        fn = self._step_jit.get(sig)
+        if fn is None:
+            fn = self._build_step(None, None)
+            self._step_jit[sig] = fn
+        self._t += 1
+        self.optimizer.num_update = self._t
+        lr = jnp.float32(self.optimizer.learning_rate)
+        key = _rng.next_key_raw()
+        xr = jax.device_put(xr, NamedSharding(self.mesh, P(self.batch_axis)))
+        yr = jax.device_put(yr, NamedSharding(self.mesh, P(self.batch_axis)))
+        self._params_raw, self._opt_state, lossv, aux = fn(
+            self._params_raw, self._opt_state, key, xr, yr, lr,
+            jnp.float32(self._t))
+        return lossv
+
+    def sync(self):
+        """Write device params back into the gluon Parameters."""
+        for p, w in zip(self._plist, self._params_raw):
+            p._data._set_data(w)
+
+    def save_checkpoint(self, prefix: str):
+        self.sync()
+        self.net.save_parameters(prefix + ".params")
+
+    @property
+    def num_update(self):
+        return self._t

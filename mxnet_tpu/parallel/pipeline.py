@@ -1,0 +1,49 @@
+"""Pipeline parallelism scaffolding (SURVEY.md §2.4: PP "No" in reference).
+
+Round-1 surface: stage specs + a microbatched GPipe-style schedule helper
+usable inside shard_map over a 'pp' axis. The full pipeline trainer (1F1B
+schedule fused with dp/tp) lands in a later round.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_spec(num_stages: int, axis: str = "pp"):
+    return {"num_stages": num_stages, "axis": axis}
+
+
+def gpipe_schedule(stage_fn: Callable, n_microbatch: int, axis_name: str):
+    """Run stage_fn over microbatches inside shard_map over `axis_name`.
+
+    stage_fn(carry, x_mb) -> y_mb for the local stage; activations move to the
+    next stage with ppermute each tick. Returns a function mapping the local
+    microbatch stack (M, ...) -> output stack for the last stage.
+    """
+    def run(x_stack):
+        n = lax.axis_size(axis_name)
+        idx = lax.axis_index(axis_name)
+        M = x_stack.shape[0]
+        steps = M + n - 1
+        buf = jnp.zeros_like(x_stack)
+
+        def body(carry, t):
+            buf, inflight = carry
+            mb = jnp.clip(t - idx, 0, M - 1)
+            x_in = jnp.where(idx == 0, x_stack[jnp.clip(t, 0, M - 1)], inflight)
+            y = stage_fn(x_in)
+            active = jnp.logical_and(t - idx >= 0, t - idx < M)
+            buf = jnp.where(active & (idx == n - 1),
+                            buf.at[mb].set(y), buf)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            inflight = lax.ppermute(y, axis_name, perm)
+            return (buf, inflight), None
+
+        inflight0 = jnp.zeros_like(stage_fn(x_stack[0]))
+        (buf, _), _ = lax.scan(body, (buf, inflight0), jnp.arange(steps))
+        return buf
+    return run

@@ -1,0 +1,8 @@
+"""Sequence/context parallel attention — re-exported from ops.attention
+(implementation lives there so the op registry can record VJPs for the
+eager tape; see that module for the design notes)."""
+from ..ops.attention import (blockwise_attention, ring_attention,
+                             ulysses_attention, flash_attention_op)
+
+__all__ = ["blockwise_attention", "ring_attention", "ulysses_attention",
+           "flash_attention_op"]

@@ -1,0 +1,52 @@
+"""Tensor (Megatron-style) parallelism helpers.
+
+Capability uplift vs the reference (SURVEY.md §2.4: TP "No"). Weights carry
+PartitionSpecs on their Parameters; under pjit XLA partitions the matmuls over
+the 'tp' axis and inserts the minimal collectives (all-gather / reduce-scatter
+over ICI).
+
+Convention for Dense (weight shape = (out, in), y = x @ W.T):
+  column-parallel: shard the OUT dim  -> P('tp', None); activation gets 'tp'
+  row-parallel:    shard the IN dim   -> P(None, 'tp'); output needs psum
+  (XLA derives both from the specs — no manual collectives.)
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional
+
+from jax.sharding import PartitionSpec as P
+
+from ..gluon.block import Block
+from ..gluon.parameter import Parameter
+
+
+def column_parallel_spec(axis: str = "tp") -> P:
+    return P(axis, None)
+
+
+def row_parallel_spec(axis: str = "tp") -> P:
+    return P(None, axis)
+
+
+def shard_params_megatron(block: Block, rules: Optional[Dict[str, P]] = None,
+                          axis: str = "tp"):
+    """Attach TP PartitionSpecs by name pattern. Default rules cover the
+    transformer blocks in mxnet_tpu.models.bert: qkv/ffn-in column-parallel,
+    proj/ffn-out row-parallel, embeddings sharded on vocab."""
+    default_rules = {
+        r".*(qkv|query|key|value|ffn1|inter|fc1).*weight$": column_parallel_spec(axis),
+        r".*(proj|ffn2|output|fc2).*weight$": row_parallel_spec(axis),
+        r".*(qkv|query|key|value|ffn1|inter|fc1).*bias$": P(axis),
+        r".*word_embed.*weight$": P(axis, None),
+    }
+    rules = rules or default_rules
+    compiled = [(re.compile(k), v) for k, v in rules.items()]
+    n = 0
+    for name, p in block.collect_params().items():
+        for pat, spec in compiled:
+            if pat.match(name):
+                p.sharding = spec
+                n += 1
+                break
+    return n

@@ -1,0 +1,178 @@
+"""Profiler (reference src/profiler/profiler.h:251, python/mxnet/profiler.py).
+
+TPU-native: wraps the JAX/XLA profiler (xplane traces, viewable in
+TensorBoard/Perfetto) and adds host-side scopes/markers + an aggregate-stats
+table, keeping the reference's set_config/set_state/dumps API.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Optional
+
+import jax
+
+from .base import MXNetError, env
+
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": True,
+}
+_state = {"running": False, "trace_dir": None}
+_stats_lock = threading.Lock()
+_agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])  # count, total, min, max
+_events = []
+
+
+def set_config(**kwargs):
+    _config.update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        if not _state["running"]:
+            d = os.path.splitext(_config["filename"])[0] + "_xplane"
+            os.makedirs(d, exist_ok=True)
+            try:
+                jax.profiler.start_trace(d)
+                _state["trace_dir"] = d
+            except Exception:
+                _state["trace_dir"] = None
+            _state["running"] = True
+    elif state == "stop":
+        if _state["running"]:
+            if _state["trace_dir"]:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+            _state["running"] = False
+    else:
+        raise MXNetError(f"profiler state {state!r}")
+
+
+def _record(name: str, category: str, start: float, end: float):
+    dur_us = (end - start) * 1e6
+    with _stats_lock:
+        _events.append({"name": name, "cat": category, "ph": "X",
+                        "ts": start * 1e6, "dur": dur_us, "pid": 0, "tid": threading.get_ident()})
+        st = _agg[(category, name)]
+        st[0] += 1
+        st[1] += dur_us
+        st[2] = min(st[2], dur_us)
+        st[3] = max(st[3], dur_us)
+
+
+@contextmanager
+def scope(name: str, category: str = "operator"):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _record(name, category, t0, time.perf_counter())
+
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_counter(self, name, value=0):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class Task:
+    def __init__(self, domain, name):
+        self.domain, self.name = domain, name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is not None:
+            _record(self.name, f"task:{self.domain.name}", self._t0, time.perf_counter())
+            self._t0 = None
+
+
+Frame = Task
+
+
+class Counter:
+    def __init__(self, domain, name, value=0):
+        self.domain, self.name, self.value = domain, name, value
+
+    def set_value(self, v):
+        self.value = v
+        with _stats_lock:
+            _events.append({"name": self.name, "cat": f"counter:{self.domain.name}",
+                            "ph": "C", "ts": time.perf_counter() * 1e6, "pid": 0,
+                            "args": {"value": v}})
+
+    def increment(self, d=1):
+        self.set_value(self.value + d)
+
+    def decrement(self, d=1):
+        self.set_value(self.value - d)
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.domain, self.name = domain, name
+
+    def mark(self, scope="process"):
+        with _stats_lock:
+            _events.append({"name": self.name, "cat": f"marker:{self.domain.name}",
+                            "ph": "i", "ts": time.perf_counter() * 1e6, "pid": 0,
+                            "s": "p"})
+
+
+def dumps(reset=False, format="table") -> str:
+    """Aggregate stats table (reference aggregate_stats.cc)."""
+    with _stats_lock:
+        rows = [(cat, name, c, tot, tot / max(c, 1), mn, mx)
+                for (cat, name), (c, tot, mn, mx) in sorted(_agg.items())]
+        if reset:
+            _agg.clear()
+    if format == "json":
+        return json.dumps([dict(zip(("category", "name", "count", "total_us",
+                                     "avg_us", "min_us", "max_us"), r)) for r in rows])
+    lines = [f"{'Category':<16}{'Name':<40}{'Count':>8}{'Total(us)':>14}"
+             f"{'Avg(us)':>12}{'Min(us)':>12}{'Max(us)':>12}"]
+    for cat, name, c, tot, avg, mn, mx in rows:
+        lines.append(f"{cat:<16}{name:<40}{c:>8}{tot:>14.1f}{avg:>12.1f}{mn:>12.1f}{mx:>12.1f}")
+    return "\n".join(lines)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON (reference DumpProfile profiler.h:299)."""
+    with _stats_lock:
+        data = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    with open(_config["filename"], "w") as f:
+        json.dump(data, f)
+
+
+def pause(profile_process="worker"):
+    pass
+
+
+def resume(profile_process="worker"):
+    pass
+
+
+if env.get("MXNET_PROFILER_AUTOSTART"):
+    set_state("run")

@@ -1,0 +1,53 @@
+"""Counter-based RNG (reference include/mxnet/random_generator.h + src/operator/random/).
+
+TPU-native: one global threefry key, split per call — deterministic given
+mx.random.seed(n), parallel-safe (each draw gets a fresh subkey), and the same
+mechanism works inside jit traces (keys are plain arrays).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_lock = threading.Lock()
+_key = jax.random.key(0)
+
+# Inside a hybridize() trace the key must be a traced input, not a baked-in
+# constant: blocks push the trace's key here and next_key() splits from it.
+_trace_keys = []
+
+
+def push_trace_key(raw_key):
+    k = raw_key
+    if not jnp.issubdtype(k.dtype, jax.dtypes.prng_key):
+        k = jax.random.wrap_key_data(k.astype(jnp.uint32), impl="threefry2x32")
+    _trace_keys.append(k)
+
+
+def pop_trace_key():
+    _trace_keys.pop()
+
+
+def seed(seed_state: int, ctx="all"):
+    """mx.random.seed parity (ctx arg accepted and ignored — keys are global)."""
+    global _key
+    with _lock:
+        _key = jax.random.key(int(seed_state))
+
+
+def next_key():
+    global _key
+    if _trace_keys:
+        k1, k2 = jax.random.split(_trace_keys[-1])
+        _trace_keys[-1] = k1
+        return k2
+    with _lock:
+        _key, sub = jax.random.split(_key)
+    return sub
+
+
+def next_key_raw():
+    """Raw uint32 key data (for feeding key arrays through op boundaries)."""
+    return jax.random.key_data(next_key())

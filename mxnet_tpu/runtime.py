@@ -1,0 +1,53 @@
+"""Runtime feature detection (reference src/libinfo.cc, python/mxnet/runtime.py)."""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import jax
+
+Feature = namedtuple("Feature", ["name", "enabled"])
+
+
+def _detect():
+    plats = {d.platform for d in jax.devices()}
+    feats = {
+        "TPU": any(p != "cpu" for p in plats),
+        "CPU": True,
+        "XLA": True,
+        "PALLAS": True,
+        "BF16": True,
+        "INT64_TENSOR_SIZE": True,
+        "SHARDING": True,
+        "DIST_KVSTORE": True,
+        "PROFILER": True,
+        "OPENMP": False,
+        "CUDA": False,
+        "CUDNN": False,
+        "MKLDNN": False,
+        "TENSORRT": False,
+        "OPENCV": _has("cv2"),
+        "SIGNAL_HANDLER": True,
+    }
+    return {k: Feature(k, v) for k, v in feats.items()}
+
+
+def _has(mod):
+    import importlib.util
+    return importlib.util.find_spec(mod) is not None
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__(_detect())
+
+    def is_enabled(self, name: str) -> bool:
+        f = self.get(name.upper())
+        return bool(f and f.enabled)
+
+    def __repr__(self):
+        return "[" + ", ".join(("✔" if f.enabled else "✖") + " " + f.name
+                               for f in self.values()) + "]"
+
+
+def feature_list():
+    return list(Features().values())

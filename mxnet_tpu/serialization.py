@@ -1,0 +1,74 @@
+"""NDArray / parameter serialization (reference src/ndarray/ndarray.cc
+Save/Load dmlc stream format; python mx.nd.save/load).
+
+Format: numpy .npz container with a manifest — portable, mmap-friendly,
+and safe (no pickle). Keys keep MXNet conventions (`arg:`/`aux:` prefixes
+are preserved verbatim so Gluon save/load round-trips).
+"""
+from __future__ import annotations
+
+import json
+import os
+import zipfile
+from typing import Dict, List, Union
+
+import numpy as _np
+
+from .base import MXNetError
+
+_MAGIC = "mxnet_tpu_ndarray_v1"
+
+
+def _to_numpy(arr):
+    a = arr.asnumpy() if hasattr(arr, "asnumpy") else _np.asarray(arr)
+    if a.dtype.name == "bfloat16":  # ml_dtypes bfloat16 -> store as f32 + tag
+        return a.astype(_np.float32), "bfloat16"
+    return a, str(a.dtype)
+
+
+def save_ndarrays(fname: str, data) -> None:
+    from .ndarray import NDArray
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        keys = [f"__list__{i}" for i in range(len(data))]
+        vals = list(data)
+    elif isinstance(data, dict):
+        keys = list(data.keys())
+        vals = list(data.values())
+    else:
+        raise MXNetError("save: expected NDArray, list, or dict")
+    arrays = {}
+    manifest = {"magic": _MAGIC, "entries": []}
+    for i, (k, v) in enumerate(zip(keys, vals)):
+        a, dt = _to_numpy(v)
+        arrays[f"a{i}"] = a
+        manifest["entries"].append({"key": k, "dtype": dt, "slot": f"a{i}"})
+    tmp = fname + ".tmp"
+    with open(tmp, "wb") as f:
+        _np.savez(f, __manifest__=_np.frombuffer(
+            json.dumps(manifest).encode(), dtype=_np.uint8), **arrays)
+    os.replace(tmp, fname)
+
+
+def load_ndarrays(fname: str):
+    from .ndarray import array
+    import jax.numpy as jnp
+    with _np.load(fname, allow_pickle=False) as z:
+        manifest = json.loads(bytes(z["__manifest__"]).decode())
+        if manifest.get("magic") != _MAGIC:
+            raise MXNetError(f"{fname}: not a mxnet_tpu ndarray file")
+        out = {}
+        is_list = True
+        for e in manifest["entries"]:
+            a = z[e["slot"]]
+            if e["dtype"] == "bfloat16":
+                nd = array(a, dtype=jnp.bfloat16)
+            else:
+                nd = array(a, dtype=a.dtype)
+            out[e["key"]] = nd
+            if not e["key"].startswith("__list__"):
+                is_list = False
+    if is_list and out:
+        return [out[f"__list__{i}"] for i in range(len(out))]
+    return out

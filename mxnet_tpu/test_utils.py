@@ -1,0 +1,217 @@
+"""Test utilities (reference python/mxnet/test_utils.py, 2400 l).
+
+Ports the reference's numeric test harness: dtype-aware assert_almost_equal,
+finite-difference check_numeric_gradient (test_utils.py:981), cross-context
+check_consistency (:1422 — CPU interpreter is the 'fake backend' reference
+for the TPU, exactly like CPU-vs-GPU in the reference).
+"""
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context, tpu
+from .ndarray import NDArray, array, zeros
+from . import autograd
+
+_DEFAULT_RTOL = {
+    _np.dtype(_np.float16): 1e-2,
+    _np.dtype(_np.float32): 1e-4,
+    _np.dtype(_np.float64): 1e-12,
+    "bfloat16": 2e-2,
+}
+_DEFAULT_ATOL = {
+    _np.dtype(_np.float16): 1e-3,
+    _np.dtype(_np.float32): 1e-5,
+    _np.dtype(_np.float64): 1e-14,
+    "bfloat16": 1e-2,
+}
+
+
+def default_context() -> Context:
+    return current_context()
+
+
+def set_default_context(ctx: Context):
+    from . import context as ctx_mod
+    ctx_mod._INITIAL_DEFAULT = ctx
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        a = x.asnumpy()
+    else:
+        a = _np.asarray(x)
+    if a.dtype.name == "bfloat16":
+        a = a.astype(_np.float32)
+    return a
+
+
+def _tols(a, b, rtol, atol):
+    def tol(tbl, arr):
+        key = "bfloat16" if getattr(arr.dtype, "name", "") == "bfloat16" else arr.dtype
+        return tbl.get(key, tbl[_np.dtype(_np.float32)])
+    if rtol is None:
+        rtol = max(tol(_DEFAULT_RTOL, a), tol(_DEFAULT_RTOL, b))
+    if atol is None:
+        atol = max(tol(_DEFAULT_ATOL, a), tol(_DEFAULT_ATOL, b))
+    return rtol, atol
+
+
+def same(a, b):
+    return _np.array_equal(_as_np(a), _as_np(b))
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """reference test_utils.py:534."""
+    a_raw = a if hasattr(a, "dtype") else _np.asarray(a)
+    a, b = _as_np(a), _as_np(b)
+    rtol, atol = _tols(a_raw, b, rtol, atol)
+    if not _np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        err = _np.abs(a - b)
+        denom = _np.abs(b) + atol
+        idx = _np.unravel_index(_np.argmax(err / denom), err.shape)
+        raise AssertionError(
+            f"{names[0]} and {names[1]} differ: max rel err "
+            f"{(err / denom).max():.3e} at {idx}: {a[idx]} vs {b[idx]} "
+            f"(rtol={rtol}, atol={atol})")
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    a2, b2 = _as_np(a), _as_np(b)
+    rtol, atol = _tols(a if hasattr(a, "dtype") else a2, b2, rtol, atol)
+    return _np.allclose(a2, b2, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def rand_ndarray(shape, dtype="float32", ctx=None, low=-1.0, high=1.0):
+    a = _np.random.uniform(low, high, size=shape).astype("float32")
+    return array(a, ctx=ctx, dtype=dtype)
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return tuple(_np.random.randint(1, d + 1) for d in (dim0, dim1, dim2))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(_np.random.randint(1, dim + 1, size=ndim).tolist())
+
+
+def check_numeric_gradient(fn: Callable[..., NDArray], inputs: List[NDArray],
+                           eps: float = 1e-3, rtol: float = 1e-2,
+                           atol: float = 1e-3, argnums: Optional[List[int]] = None):
+    """Finite-difference vs autograd (reference test_utils.py:981).
+
+    fn: NDArray... -> NDArray (scalar or any shape; summed internally).
+    """
+    argnums = argnums if argnums is not None else list(range(len(inputs)))
+    for x in inputs:
+        if x._ag_node is None:
+            x.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+        loss = out.sum()
+    loss.backward()
+    analytic = [inputs[i].grad.asnumpy().astype(_np.float64) for i in argnums]
+
+    numeric = []
+    for i in argnums:
+        x = inputs[i]
+        base = x.asnumpy().astype(_np.float64)
+        g = _np.zeros_like(base)
+        flat = base.reshape(-1)
+        gf = g.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            x._set_data(array(base.reshape(x.shape), dtype=x.dtype)._data)
+            fp = float(fn(*inputs).sum().asscalar())
+            flat[j] = orig - eps
+            x._set_data(array(base.reshape(x.shape), dtype=x.dtype)._data)
+            fm = float(fn(*inputs).sum().asscalar())
+            flat[j] = orig
+            x._set_data(array(base.reshape(x.shape), dtype=x.dtype)._data)
+            gf[j] = (fp - fm) / (2 * eps)
+        numeric.append(g)
+
+    for i, (an, nu) in enumerate(zip(analytic, numeric)):
+        if not _np.allclose(an, nu, rtol=rtol, atol=atol):
+            err = _np.abs(an - nu)
+            idx = _np.unravel_index(_np.argmax(err), err.shape)
+            raise AssertionError(
+                f"numeric/analytic gradient mismatch for input {argnums[i]} at "
+                f"{idx}: analytic={an[idx]:.6f} numeric={nu[idx]:.6f} "
+                f"(max abs err {err.max():.3e})")
+    return True
+
+
+def check_consistency(fn: Callable[..., NDArray], inputs_np: List[_np.ndarray],
+                      ctx_list: Optional[List[Context]] = None,
+                      dtypes=("float32",), rtol=None, atol=None):
+    """Run fn across contexts/dtypes and compare (reference :1422)."""
+    from .context import num_tpus
+    if ctx_list is None:
+        ctx_list = [cpu()]
+        if num_tpus():
+            ctx_list.append(tpu())
+    ref = None
+    for ctx in ctx_list:
+        for dt in dtypes:
+            ins = [array(a, ctx=ctx, dtype=dt) for a in inputs_np]
+            out = fn(*ins)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            res = [_as_np(o) for o in outs]
+            if ref is None:
+                ref = res
+            else:
+                for r, o in zip(ref, res):
+                    assert_almost_equal(r, o, rtol=rtol, atol=atol,
+                                        names=("ref", f"{ctx}/{dt}"))
+    return True
+
+
+@contextmanager
+def environment(*args):
+    """EnvManager parity (reference test_utils.py:2306): environment(k, v) or
+    environment({k: v})."""
+    if len(args) == 2:
+        env_dict = {args[0]: args[1]}
+    else:
+        env_dict = dict(args[0])
+    saved = {k: os.environ.get(k) for k in env_dict}
+    try:
+        for k, v in env_dict.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+EnvManager = environment
+
+
+def assert_raises(exc, fn, *args, **kwargs):
+    try:
+        fn(*args, **kwargs)
+    except exc:
+        return
+    raise AssertionError(f"{exc.__name__} not raised")
+
+
+def discard_stderr(fn):
+    return fn

@@ -1,0 +1,77 @@
+"""Misc utilities: numpy-shape scopes (reference python/mxnet/util.py)."""
+from __future__ import annotations
+
+import functools
+import threading
+
+_state = threading.local()
+
+
+def _flags():
+    if not hasattr(_state, "np_shape"):
+        _state.np_shape = True   # TPU build is numpy-semantics by default
+        _state.np_array = True
+    return _state
+
+
+def is_np_shape() -> bool:
+    return _flags().np_shape
+
+
+def is_np_array() -> bool:
+    return _flags().np_array
+
+
+def set_np_shape(active: bool) -> bool:
+    st = _flags()
+    prev, st.np_shape = st.np_shape, active
+    return prev
+
+
+def set_np(shape=True, array=True):
+    st = _flags()
+    st.np_shape, st.np_array = shape, array
+
+
+def reset_np():
+    set_np(True, True)
+
+
+class np_shape:
+    """Context manager parity with mx.util.np_shape."""
+
+    def __init__(self, active=True):
+        self._active = active
+
+    def __enter__(self):
+        self._prev = set_np_shape(self._active)
+        return self
+
+    def __exit__(self, *exc):
+        set_np_shape(self._prev)
+
+
+def use_np_shape(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with np_shape(True):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+def use_np(func):
+    return use_np_shape(func)
+
+
+def get_gpu_count():
+    from .context import num_tpus
+    return num_tpus()
+
+
+def get_gpu_memory(dev_id=0):
+    import jax
+    try:
+        stats = jax.devices()[dev_id].memory_stats()
+        return stats.get("bytes_in_use", 0), stats.get("bytes_limit", 0)
+    except Exception:
+        return (0, 0)

@@ -1,0 +1,41 @@
+"""Test configuration.
+
+The image force-registers the axon TPU backend (sitecustomize), so tests pin
+jax's default device to CPU and request 8 virtual CPU devices — giving the
+8-way mesh for sharding/collective tests without hardware (SURVEY.md §4's
+N-process local pod pattern, realized as N virtual devices). The single real
+TPU chip is exercised by bench.py, not the unit suite.
+"""
+import os
+
+# must be set before the CPU backend initializes
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+_cpu0 = jax.devices("cpu")[0]
+jax.config.update("jax_default_device", _cpu0)
+
+import numpy as _np
+import pytest
+
+import mxnet_tpu as mx
+
+# default context = cpu so every eager op runs on the local CPU backend
+mx.test_utils.set_default_context(mx.cpu())
+
+
+def cpu_devices():
+    return jax.devices("cpu")
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything(request):
+    """with_seed parity (reference tests/python/unittest/common.py:161):
+    deterministic seeds per test, logged for repro."""
+    seed = abs(hash(request.node.nodeid)) % (2 ** 31)
+    _np.random.seed(seed)
+    mx.random.seed(seed)
+    yield

@@ -1,0 +1,149 @@
+"""Autograd semantics (reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_basic_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, np.array([2.0, 4.0, 6.0]))
+
+
+def test_chain_and_broadcast():
+    x = nd.array(np.random.rand(3, 4).astype(np.float32))
+    w = nd.array(np.random.rand(4,).astype(np.float32))
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = (x * w).sum()
+    y.backward()
+    assert_almost_equal(x.grad, np.broadcast_to(w.asnumpy(), (3, 4)))
+    assert_almost_equal(w.grad, x.asnumpy().sum(0))
+
+
+def test_recording_scopes():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    assert not autograd.is_recording()
+
+
+def test_no_grad_outside_record():
+    x = nd.array([1.0])
+    x.attach_grad()
+    y = x * 2  # not recorded
+    with pytest.raises(Exception):
+        y.backward()
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, np.array([30.0, 300.0]))
+
+
+def test_grad_add_req():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward(retain_graph=False)
+    assert_almost_equal(x.grad, 2 * np.array([2.0, 4.0]))
+
+
+def test_detach_blocks_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach()
+        w = z * x
+    w.backward()
+    assert_almost_equal(x.grad, np.array([4.0]))  # only z*x path
+
+
+def test_autograd_grad_api():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad([y], [x])
+    assert_almost_equal(g, np.array([27.0]))
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 1.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * 5).sum()
+    y.backward()
+    assert_almost_equal(x.grad, np.array([5.0, 5.0]))
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return dy * 2 * x
+
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, np.array([2.0, 4.0, 6.0]))
+
+
+def test_numeric_gradient_matmul():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 2).astype(np.float32))
+    check_numeric_gradient(lambda x, y: nd.dot(x, y), [a, b], eps=1e-2,
+                           rtol=2e-2, atol=2e-2)
+
+
+def test_numeric_gradient_ops():
+    x = nd.array(np.random.rand(2, 3).astype(np.float32) + 0.5)
+    check_numeric_gradient(lambda a: a.sqrt(), [x], eps=1e-3, rtol=2e-2, atol=2e-2)
+    check_numeric_gradient(lambda a: a.sigmoid(), [x], eps=1e-2, rtol=2e-2, atol=2e-2)
+    check_numeric_gradient(lambda a: nd.softmax(a, axis=-1), [x], eps=1e-2,
+                           rtol=2e-2, atol=2e-2)
+
+
+def test_slice_gradient():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = x[0] * 2
+    y.backward()
+    assert_almost_equal(x.grad, np.array([[2, 2, 2], [0, 0, 0]]))
+
+
+def test_exception_propagation():
+    # errors inside async dispatch must surface at wait (reference
+    # test_exc_handling.py — engine Throw/WaitToRead)
+    x = nd.array([1.0])
+    with pytest.raises(Exception):
+        y = nd.Reshape(x, shape=(7, 7))  # impossible reshape
+        y.wait_to_read()

@@ -1,0 +1,319 @@
+"""Gluon blocks / hybridize / trainer (reference tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _train_step(net, loss_fn, trainer, x, y, bs):
+    with autograd.record():
+        out = net(x)
+        l = loss_fn(out, y)
+    l.backward()
+    trainer.step(bs)
+    return float(l.mean().asscalar())
+
+
+def test_dense_shapes_and_deferred_init():
+    net = nn.Dense(5)
+    net.initialize()
+    x = nd.random.uniform(shape=(4, 7))
+    out = net(x)
+    assert out.shape == (4, 5)
+    assert net.weight.shape == (5, 7)
+    # flatten semantics
+    net2 = nn.Dense(3, flatten=False)
+    net2.initialize()
+    out2 = net2(nd.random.uniform(shape=(2, 4, 7)))
+    assert out2.shape == (2, 4, 3)
+
+
+def test_sequential_and_children():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    assert len(net) == 2
+    assert len(net.collect_params()) == 4
+    out = net(nd.ones((2, 3)))
+    assert out.shape == (2, 4)
+
+
+def test_hybridize_matches_eager():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net.initialize()
+    x = nd.random.uniform(shape=(4, 12))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-5, atol=1e-5)
+    # cache hit on second call
+    hybrid2 = net(x).asnumpy()
+    assert_almost_equal(hybrid, hybrid2)
+
+
+def test_hybridize_gradients_match():
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="tanh", in_units=6),
+                nn.Dense(1, in_units=16))
+        return net
+
+    mx.random.seed(7)
+    net_a = build()
+    net_a.initialize()  # in_units given -> immediate init, same draws
+    mx.random.seed(7)
+    net_b = build()
+    net_b.initialize()
+    net_b.hybridize()
+    x = nd.random.uniform(shape=(4, 6))
+    for net in (net_a, net_b):
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+    ga = list(net_a.collect_params().values())[0].grad().asnumpy()
+    gb = list(net_b.collect_params().values())[0].grad().asnumpy()
+    assert_almost_equal(ga, gb, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_block_and_pooling():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(16, kernel_size=3, padding=1),
+            nn.GlobalAvgPool2D(),
+            nn.Flatten(),
+            nn.Dense(10))
+    net.initialize()
+    out = net(nd.random.uniform(shape=(2, 3, 16, 16)))
+    assert out.shape == (2, 10)
+    assert net[0].weight.shape == (8, 3, 3, 3)
+
+
+def test_batchnorm_running_stats_update():
+    bn = nn.BatchNorm(momentum=0.5)
+    bn.initialize()
+    x = nd.random.uniform(shape=(8, 4, 5, 5), low=1.0, high=2.0)
+    bn(x)  # trigger deferred init (eval pass: stats untouched)
+    before = bn.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        bn(x)
+    after = bn.running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+    # eval mode: stats not updated
+    before2 = after.copy()
+    bn(x)
+    after2 = bn.running_mean.data().asnumpy()
+    assert_almost_equal(before2, after2)
+
+
+def test_batchnorm_stats_update_under_hybridize():
+    bn = nn.BatchNorm(momentum=0.5)
+    bn.initialize()
+    bn.hybridize()
+    x = nd.random.uniform(shape=(8, 4), low=1.0, high=2.0)
+    bn(x)  # trigger deferred init
+    before = bn.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        bn(x)
+    after = bn.running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+
+
+def test_dropout_train_vs_eval():
+    do = nn.Dropout(0.5)
+    do.initialize()
+    x = nd.ones((100, 100))
+    eval_out = do(x)
+    assert_almost_equal(eval_out, np.ones((100, 100)))
+    with autograd.record():
+        train_out = do(x)
+    frac_zero = float((train_out == 0).mean().asscalar())
+    assert 0.3 < frac_zero < 0.7
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(6, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 5))
+    ref = net(x).asnumpy()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(6, activation="relu"), nn.Dense(3))
+    net2.initialize()
+    _ = net2(x)  # trigger deferred init with right shapes
+    net2.load_parameters(f)
+    assert_almost_equal(net2(x).asnumpy(), ref, rtol=1e-6)
+
+
+def test_trainer_sgd_converges_linear_regression():
+    true_w = np.array([[2.0, -3.4]], dtype=np.float32)
+    true_b = 4.2
+    net = nn.Dense(1)
+    net.initialize(mx.init.Normal(0.1))
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    for _ in range(60):
+        x = nd.random.normal(shape=(32, 2))
+        y = nd.array(x.asnumpy() @ true_w.T + true_b)
+        _train_step(net, loss_fn, trainer, x, y, 32)
+    w = net.weight.data().asnumpy()
+    b = float(net.bias.data().asnumpy()[0])
+    assert_almost_equal(w, true_w, rtol=0.1, atol=0.1)
+    assert abs(b - true_b) < 0.2
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2)
+    net.initialize()
+    _ = net(nd.ones((1, 3)))
+    trainer = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    loss_fn = gluon.loss.L2Loss()
+    _train_step(net, loss_fn, trainer, nd.ones((4, 3)), nd.ones((4, 2)), 4)
+    f = str(tmp_path / "trainer.states")
+    trainer.save_states(f)
+    trainer2 = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    trainer2.load_states(f)
+    assert trainer2._updaters[0].states
+
+
+def test_losses_values():
+    pred = nd.array([[1.0, 2.0], [0.5, 0.5]])
+    label = nd.array([[1.5, 2.0], [0.0, 1.0]])
+    l2 = gluon.loss.L2Loss()(pred, label).asnumpy()
+    assert_almost_equal(l2, np.array([0.0625, 0.125]), rtol=1e-4)
+    l1 = gluon.loss.L1Loss()(pred, label).asnumpy()
+    assert_almost_equal(l1, np.array([0.25, 0.5]), rtol=1e-4)
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    logits = nd.array([[10.0, 0.0], [0.0, 10.0]])
+    labels = nd.array([0.0, 1.0])
+    out = sce(logits, labels).asnumpy()
+    assert (out < 1e-3).all()
+    # hinge
+    h = gluon.loss.HingeLoss()(nd.array([[0.5]]), nd.array([[1.0]])).asnumpy()
+    assert_almost_equal(h, np.array([0.5]), rtol=1e-4)
+
+
+def test_embedding_layer_grad():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = nd.array(np.array([1, 2, 1]), dtype="int32")
+    with autograd.record():
+        out = emb(idx).sum()
+    out.backward()
+    g = emb.weight.grad().asnumpy()
+    assert g[1].sum() == pytest.approx(8.0)  # row 1 used twice
+    assert g[2].sum() == pytest.approx(4.0)
+    assert g[3].sum() == 0
+
+
+def test_layernorm_layer():
+    ln = nn.LayerNorm()
+    ln.initialize()
+    x = nd.random.uniform(shape=(4, 8))
+    out = ln(x).asnumpy()
+    assert abs(out.mean()) < 1e-4
+    assert abs(out.std() - 1.0) < 0.1
+
+
+def test_lambda_blocks():
+    blk = nn.HybridLambda("relu")
+    out = blk(nd.array([-1.0, 2.0]))
+    assert_almost_equal(out, np.array([0.0, 2.0]))
+
+
+def test_block_repr_and_summary():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(3))
+    net.initialize()
+    _ = net(nd.ones((1, 2)))
+    repr(net)
+    net.summary()
+
+
+def test_rnn_layers_forward():
+    for cls, nstates in ((gluon.rnn.LSTM, 2), (gluon.rnn.GRU, 1), (gluon.rnn.RNN, 1)):
+        layer = cls(hidden_size=8, num_layers=2)
+        layer.initialize()
+        x = nd.random.uniform(shape=(5, 3, 4))  # TNC
+        out = layer(x)
+        assert out.shape == (5, 3, 8)
+    # bidirectional + explicit states
+    lstm = gluon.rnn.LSTM(hidden_size=8, bidirectional=True)
+    lstm.initialize()
+    x = nd.random.uniform(shape=(5, 3, 4))
+    states = lstm.begin_state(3)
+    out, new_states = lstm(x, states)
+    assert out.shape == (5, 3, 16)
+    assert new_states[0].shape == (2, 3, 8)
+
+
+def test_rnn_cells_unroll():
+    cell = gluon.rnn.LSTMCell(6)
+    cell.initialize()
+    inputs = [nd.random.uniform(shape=(2, 4)) for _ in range(3)]
+    outputs, states = cell.unroll(3, inputs)
+    assert len(outputs) == 3
+    assert outputs[0].shape == (2, 6)
+    assert len(states) == 2
+
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.GRUCell(6))
+    stack.add(gluon.rnn.GRUCell(5))
+    stack.initialize()
+    out, st = stack(nd.random.uniform(shape=(2, 4)),
+                    stack.begin_state(2))
+    assert out.shape == (2, 5)
+
+
+def test_dataloader_and_dataset():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    xs = np.random.rand(20, 3).astype(np.float32)
+    ys = np.arange(20).astype(np.float32)
+    ds = ArrayDataset(xs, ys)
+    assert len(ds) == 20
+    loader = DataLoader(ds, batch_size=6, shuffle=True, last_batch="keep")
+    seen = 0
+    for bx, by in loader:
+        assert bx.shape[1] == 3
+        seen += bx.shape[0]
+    assert seen == 20
+    # transform + workers
+    ds2 = ds.transform_first(lambda x: x * 2)
+    loader2 = DataLoader(ds2, batch_size=5, num_workers=2)
+    for bx, by in loader2:
+        assert bx.shape == (5, 3)
+
+
+def test_vision_dataset_and_transforms():
+    from mxnet_tpu.gluon.data.vision import MNIST, transforms
+    ds = MNIST(train=True, synthetic_size=64)
+    x, y = ds[0]
+    assert x.shape == (28, 28, 1)
+    tf = transforms.Compose([transforms.ToTensor(),
+                             transforms.Normalize(0.13, 0.31)])
+    ds2 = ds.transform_first(tf)
+    x2, _ = ds2[0]
+    assert x2.shape == (1, 28, 28)
+
+
+def test_split_and_load():
+    data = nd.arange(0, 12).reshape((6, 2))
+    parts = gluon.utils.split_data(data, 3)
+    assert [p.shape for p in parts] == [(2, 2)] * 3
+    loaded = gluon.utils.split_and_load(data, [mx.cpu()])
+    assert loaded[0].shape == (6, 2)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    assert total > 1.0
+    new_total = float(np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays)))
+    assert abs(new_total - 1.0) < 1e-4

@@ -1,0 +1,139 @@
+"""NDArray semantics (reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert float(a.sum().asscalar()) == 0
+    b = nd.ones((2, 2), dtype="float32")
+    assert b.asnumpy().tolist() == [[1, 1], [1, 1]]
+    c = nd.full((2,), 7)
+    assert c.asnumpy().tolist() == [7, 7]
+    d = nd.arange(0, 10, 2)
+    assert d.asnumpy().tolist() == [0, 2, 4, 6, 8]
+    e = nd.array(np.eye(3))
+    assert_almost_equal(e, np.eye(3))
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal(a + b, np.array([[6, 8], [10, 12]]))
+    assert_almost_equal(a - b, np.array([[-4, -4], [-4, -4]]))
+    assert_almost_equal(a * b, np.array([[5, 12], [21, 32]]))
+    assert_almost_equal(b / a, np.array([[5, 3], [7 / 3, 2]]))
+    assert_almost_equal(a ** 2, np.array([[1, 4], [9, 16]]))
+    assert_almost_equal(2 + a, np.array([[3, 4], [5, 6]]))
+    assert_almost_equal(2 - a, np.array([[1, 0], [-1, -2]]))
+    assert_almost_equal(2 / a, np.array([[2, 1], [2 / 3, 0.5]]))
+    assert_almost_equal(-a, np.array([[-1, -2], [-3, -4]]))
+
+
+def test_inplace_version_counter():
+    a = nd.zeros((2, 2))
+    v0 = a.version
+    a += 1
+    assert a.version > v0
+    assert_almost_equal(a, np.ones((2, 2)))
+    a *= 3
+    assert_almost_equal(a, 3 * np.ones((2, 2)))
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(4, 6).astype(np.float32))
+    assert_almost_equal(a[1], np.arange(6, 12))
+    assert_almost_equal(a[1:3], np.arange(6, 18).reshape(2, 6))
+    assert_almost_equal(a[:, 2], np.array([2, 8, 14, 20]))
+    a[0] = 0
+    assert float(a[0].sum().asscalar()) == 0
+    a[1, 2] = 99
+    assert float(a[1, 2].asscalar()) == 99
+    idx = nd.array(np.array([0, 2]), dtype="int32")
+    assert a.take(idx).shape == (2, 6)
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    b = nd.zeros((2, 6, 4))
+    assert b.reshape((0, -4, 2, 3, 0)).shape == (2, 2, 3, 4)
+
+
+def test_dtype_cast():
+    a = nd.ones((2, 2), dtype="float32")
+    b = a.astype("float16")
+    assert str(b.dtype) == "float16"
+    c = a.astype("int32")
+    assert c.asnumpy().dtype == np.int32
+    bf = a.astype("bfloat16")
+    assert "bfloat16" in str(bf.dtype)
+
+
+def test_copy_and_context():
+    a = nd.ones((2, 2))
+    b = a.copy()
+    b += 1
+    assert float(a.sum().asscalar()) == 4  # copy is independent
+    c = a.as_in_context(mx.cpu())
+    assert c.ctx.device_type == "cpu"
+
+
+def test_wait_to_read_and_waitall():
+    a = nd.random.uniform(shape=(64, 64))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    nd.waitall()
+
+
+def test_concat_stack_split():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.SliceChannel(c, num_outputs=2, axis=0)
+    assert parts[0].shape == (2, 3)
+    assert_almost_equal(parts[0], np.ones((2, 3)))
+
+
+def test_reductions():
+    a = nd.array(np.arange(12).reshape(3, 4).astype(np.float32))
+    assert float(a.sum().asscalar()) == 66
+    assert_almost_equal(a.sum(axis=0), np.arange(12).reshape(3, 4).sum(0))
+    assert_almost_equal(a.mean(axis=1), np.arange(12).reshape(3, 4).mean(1))
+    assert float(a.max().asscalar()) == 11
+    assert float(a.min().asscalar()) == 0
+    assert_almost_equal(a.argmax(axis=1), np.array([3, 3, 3]))
+    # exclude semantics
+    out = nd.sum(a, axis=0, exclude=True)
+    assert_almost_equal(out, np.arange(12).reshape(3, 4).sum(1))
+
+
+def test_serialization_roundtrip(tmp_path):
+    a = nd.random.uniform(shape=(3, 4))
+    b = nd.arange(0, 5)
+    f = str(tmp_path / "arrs")
+    nd.save(f, {"a": a, "b": b})
+    loaded = nd.load(f)
+    assert_almost_equal(loaded["a"], a)
+    assert_almost_equal(loaded["b"], b)
+    nd.save(f, [a, b])
+    lst = nd.load(f)
+    assert isinstance(lst, list) and len(lst) == 2
+    assert_almost_equal(lst[0], a)
+
+
+def test_dlpack_numpy_protocols():
+    a = nd.ones((2, 2))
+    n = np.asarray(a)
+    assert n.shape == (2, 2)

@@ -1,0 +1,297 @@
+"""Operator correctness vs numpy (reference tests/python/unittest/test_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_unary_ops():
+    x = np.random.rand(3, 4).astype(np.float32) + 0.5
+    a = nd.array(x)
+    assert_almost_equal(nd.exp(a), np.exp(x))
+    assert_almost_equal(nd.log(a), np.log(x))
+    assert_almost_equal(nd.sqrt(a), np.sqrt(x))
+    assert_almost_equal(nd.square(a), x * x)
+    assert_almost_equal(nd.tanh(a), np.tanh(x))
+    assert_almost_equal(nd.sigmoid(a), 1 / (1 + np.exp(-x)))
+    assert_almost_equal(nd.relu(a - 1), np.maximum(x - 1, 0))
+    assert_almost_equal(nd.abs(a - 1), np.abs(x - 1))
+    assert_almost_equal(nd.rsqrt(a), 1 / np.sqrt(x), rtol=1e-3)
+
+
+def test_broadcast_binary():
+    x = np.random.rand(3, 1).astype(np.float32)
+    y = np.random.rand(1, 4).astype(np.float32)
+    assert_almost_equal(nd.broadcast_add(nd.array(x), nd.array(y)), x + y)
+    assert_almost_equal(nd.broadcast_mul(nd.array(x), nd.array(y)), x * y)
+    assert_almost_equal(nd.broadcast_maximum(nd.array(x), nd.array(y)),
+                        np.maximum(x, y))
+    assert_almost_equal(nd.broadcast_power(nd.array(x) + 1, nd.array(y)),
+                        (x + 1) ** y, rtol=1e-3)
+
+
+def test_dot_semantics():
+    # mxnet dot contracts last axis of a with first of b
+    a = np.random.rand(2, 3, 4).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32)
+    out = nd.dot(nd.array(a), nd.array(b))
+    assert out.shape == (2, 3, 5)
+    assert_almost_equal(out, np.tensordot(a, b, axes=([2], [0])), rtol=1e-4)
+    # transpose flags
+    c = np.random.rand(4, 3).astype(np.float32)
+    d = np.random.rand(4, 5).astype(np.float32)
+    assert_almost_equal(nd.dot(nd.array(c), nd.array(d), transpose_a=True),
+                        c.T @ d, rtol=1e-4)
+
+
+def test_batch_dot():
+    a = np.random.rand(5, 2, 3).astype(np.float32)
+    b = np.random.rand(5, 3, 4).astype(np.float32)
+    assert_almost_equal(nd.batch_dot(nd.array(a), nd.array(b)), a @ b, rtol=1e-4)
+
+
+def test_fully_connected():
+    x = np.random.rand(4, 10).astype(np.float32)
+    w = np.random.rand(3, 10).astype(np.float32)
+    b = np.random.rand(3).astype(np.float32)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=3)
+    assert_almost_equal(out, x @ w.T + b, rtol=1e-4)
+
+
+def test_convolution_shapes_and_values():
+    # identity kernel conv
+    x = np.random.rand(1, 1, 5, 5).astype(np.float32)
+    w = np.zeros((1, 1, 3, 3), dtype=np.float32)
+    w[0, 0, 1, 1] = 1.0
+    out = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3), num_filter=1,
+                         pad=(1, 1), no_bias=True)
+    assert_almost_equal(out, x, rtol=1e-5)
+    # strided shape
+    x2 = nd.random.uniform(shape=(2, 3, 8, 8))
+    w2 = nd.random.uniform(shape=(4, 3, 3, 3))
+    out2 = nd.Convolution(x2, w2, kernel=(3, 3), num_filter=4, stride=(2, 2),
+                          pad=(1, 1), no_bias=True)
+    assert out2.shape == (2, 4, 4, 4)
+    # grouped
+    xg = nd.random.uniform(shape=(2, 4, 6, 6))
+    wg = nd.random.uniform(shape=(4, 2, 3, 3))
+    outg = nd.Convolution(xg, wg, kernel=(3, 3), num_filter=4, num_group=2,
+                          no_bias=True)
+    assert outg.shape == (2, 4, 4, 4)
+
+
+def test_convolution_grad():
+    x = nd.array(np.random.rand(1, 2, 5, 5).astype(np.float32))
+    w = nd.array(np.random.rand(3, 2, 3, 3).astype(np.float32))
+    check_numeric_gradient(
+        lambda a, b: nd.Convolution(a, b, kernel=(3, 3), num_filter=3, no_bias=True),
+        [x, w], eps=1e-2, rtol=5e-2, atol=5e-2)
+
+
+def test_deconvolution():
+    x = nd.random.uniform(shape=(1, 2, 4, 4))
+    w = nd.random.uniform(shape=(2, 3, 3, 3))
+    out = nd.Deconvolution(x, w, kernel=(3, 3), num_filter=3, stride=(2, 2),
+                           no_bias=True)
+    # out = (in-1)*s - 2p + k = 3*2 + 3 = 9
+    assert out.shape == (1, 3, 9, 9)
+    out2 = nd.Deconvolution(x, w, kernel=(3, 3), num_filter=3, stride=(2, 2),
+                            pad=(1, 1), adj=(1, 1), no_bias=True)
+    assert out2.shape == (1, 3, 8, 8)
+
+
+def test_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert_almost_equal(out, np.array([[[[5, 7], [13, 15]]]], dtype=np.float32))
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    assert_almost_equal(out, np.array([[[[2.5, 4.5], [10.5, 12.5]]]]))
+    gout = nd.Pooling(nd.array(x), pool_type="max", global_pool=True)
+    assert gout.shape == (1, 1, 1, 1)
+    assert float(gout.asscalar()) == 15.0
+
+
+def test_batchnorm_train_stats():
+    x = np.random.rand(8, 3, 4, 4).astype(np.float32) * 5 + 2
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mean = np.zeros(3, np.float32)
+    var = np.ones(3, np.float32)
+    out, m, v = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                             nd.array(mean), nd.array(var), fix_gamma=False,
+                             training=True)
+    np_m = x.mean(axis=(0, 2, 3))
+    np_v = x.var(axis=(0, 2, 3))
+    assert_almost_equal(m, np_m, rtol=1e-3)
+    assert_almost_equal(v, np_v, rtol=1e-3)
+    normed = out.asnumpy()
+    assert abs(normed.mean()) < 1e-2
+    assert abs(normed.std() - 1) < 1e-2
+
+
+def test_layernorm_groupnorm():
+    x = np.random.rand(4, 6).astype(np.float32)
+    g = np.ones(6, np.float32)
+    b = np.zeros(6, np.float32)
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b))
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(out, ref, rtol=1e-3)
+    xg = np.random.rand(2, 4, 3, 3).astype(np.float32)
+    out = nd.GroupNorm(nd.array(xg), nd.array(np.ones(4, np.float32)),
+                       nd.array(np.zeros(4, np.float32)), num_groups=2)
+    r = xg.reshape(2, 2, 2, 3, 3)
+    ref = (r - r.mean((2, 3, 4), keepdims=True)) / \
+        np.sqrt(r.var((2, 3, 4), keepdims=True) + 1e-5)
+    assert_almost_equal(out, ref.reshape(xg.shape), rtol=1e-3)
+
+
+def test_softmax_family():
+    x = np.random.rand(3, 5).astype(np.float32)
+    a = nd.array(x)
+    e = np.exp(x - x.max(-1, keepdims=True))
+    ref = e / e.sum(-1, keepdims=True)
+    assert_almost_equal(nd.softmax(a), ref, rtol=1e-4)
+    assert_almost_equal(nd.log_softmax(a), np.log(ref), rtol=1e-4)
+    # temperature
+    assert_almost_equal(nd.softmax(a, temperature=2.0),
+                        np.exp(x / 2 - (x / 2).max(-1, keepdims=True)) /
+                        np.exp(x / 2 - (x / 2).max(-1, keepdims=True)).sum(-1, keepdims=True),
+                        rtol=1e-4)
+    # masked softmax by length
+    length = nd.array(np.array([2, 5, 3]), dtype="int32")
+    out = nd.softmax(a, length, axis=-1, use_length=True)
+    o = out.asnumpy()
+    assert o[0, 2:].sum() == 0
+    assert abs(o[0, :2].sum() - 1) < 1e-5
+
+
+def test_softmax_output_grad():
+    x = nd.array(np.random.rand(4, 3).astype(np.float32))
+    y = nd.array(np.array([0, 1, 2, 1], dtype=np.float32))
+    x.attach_grad()
+    from mxnet_tpu import autograd
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, y)
+    out.backward()
+    p = out.asnumpy()
+    onehot = np.eye(3, dtype=np.float32)[[0, 1, 2, 1]]
+    assert_almost_equal(x.grad, p - onehot, rtol=1e-4)
+
+
+def test_take_pick_onehot_gather():
+    x = np.random.rand(5, 4).astype(np.float32)
+    idx = np.array([0, 2, 4])
+    assert_almost_equal(nd.take(nd.array(x), nd.array(idx, dtype="int32")), x[idx])
+    picked = nd.pick(nd.array(x), nd.array(np.array([0, 1, 2, 3, 0]), dtype="int32"), axis=1)
+    assert_almost_equal(picked, x[np.arange(5), [0, 1, 2, 3, 0]])
+    oh = nd.one_hot(nd.array(np.array([1, 0, 2]), dtype="int32"), depth=4)
+    assert_almost_equal(oh, np.eye(4, dtype=np.float32)[[1, 0, 2]])
+
+
+def test_ordering_ops():
+    x = np.random.rand(3, 6).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(nd.sort(a, axis=1), np.sort(x, 1))
+    assert_almost_equal(nd.argsort(a, axis=1), np.argsort(x, 1, kind="stable"))
+    vals, idx = nd.topk(a, k=2, ret_typ="both")
+    ref_idx = np.argsort(-x, 1)[:, :2]
+    assert_almost_equal(idx, ref_idx)
+
+
+def test_embedding():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = np.array([[1, 2], [3, 4]])
+    out = nd.Embedding(nd.array(idx, dtype="int32"), nd.array(w),
+                       input_dim=10, output_dim=4)
+    assert_almost_equal(out, w[idx])
+
+
+def test_rnn_op_lstm_shapes():
+    from mxnet_tpu.ops.nn import rnn_param_size
+    T, B, I, H, L = 5, 3, 4, 6, 2
+    x = nd.random.uniform(shape=(T, B, I))
+    psize = rnn_param_size("lstm", L, I, H)
+    params = nd.random.uniform(shape=(psize,), low=-0.1, high=0.1)
+    h0 = nd.zeros((L, B, H))
+    c0 = nd.zeros((L, B, H))
+    outs = nd.RNN(x, params, h0, c0, state_size=H, num_layers=L, mode="lstm")
+    assert outs[0].shape == (T, B, H)
+    assert outs[1].shape == (L, B, H)
+    assert outs[2].shape == (L, B, H)
+    # bidirectional
+    psize = rnn_param_size("gru", 1, I, H, True)
+    params = nd.random.uniform(shape=(psize,), low=-0.1, high=0.1)
+    h0 = nd.zeros((2, B, H))
+    outs = nd.RNN(x, params, h0, state_size=H, num_layers=1, mode="gru",
+                  bidirectional=True)
+    assert outs[0].shape == (T, B, 2 * H)
+
+
+def test_ctc_loss_known_value():
+    # single batch, T=2, C=3 (blank=0): label [1]
+    # p(path) where paths = {(1,blank),(blank,1),(1,1)}
+    logits = np.zeros((2, 1, 3), dtype=np.float32)  # uniform -> each p=1/3
+    label = np.array([[1, 0]], dtype=np.float32)
+    loss = nd.CTCLoss(nd.array(logits), nd.array(label))
+    p = 3 * (1 / 9)
+    assert abs(float(loss.asscalar()) + np.log(p)) < 1e-4
+
+
+def test_sequence_ops():
+    x = np.arange(12, dtype=np.float32).reshape(3, 2, 2)  # (T,B,·)
+    seqlen = nd.array(np.array([2, 3], dtype=np.float32))
+    out = nd.SequenceMask(nd.array(x), seqlen, use_sequence_length=True, value=-1)
+    o = out.asnumpy()
+    assert (o[2, 0] == -1).all()
+    assert (o[2, 1] == x[2, 1]).all()
+    last = nd.SequenceLast(nd.array(x), seqlen, use_sequence_length=True)
+    assert_almost_equal(last, np.stack([x[1, 0], x[2, 1]]))
+
+
+def test_where_clip_tile():
+    x = np.random.rand(3, 4).astype(np.float32)
+    cond = (x > 0.5).astype(np.float32)
+    out = nd.where(nd.array(cond), nd.array(x), nd.array(-x))
+    assert_almost_equal(out, np.where(cond > 0, x, -x))
+    assert_almost_equal(nd.clip(nd.array(x), a_min=0.2, a_max=0.8),
+                        np.clip(x, 0.2, 0.8))
+    assert_almost_equal(nd.tile(nd.array(x), reps=(2, 1)), np.tile(x, (2, 1)))
+
+
+def test_linalg_ops():
+    a = np.random.rand(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    L = nd.linalg.potrf(nd.array(spd))
+    assert_almost_equal(nd.batch_dot(L.expand_dims(0), L.expand_dims(0),
+                                     transpose_b=True)[0], spd, rtol=1e-3)
+    x = np.random.rand(3, 4).astype(np.float32)
+    y = np.random.rand(4, 5).astype(np.float32)
+    out = nd.linalg.gemm2(nd.array(x), nd.array(y))
+    assert_almost_equal(out, x @ y, rtol=1e-4)
+
+
+def test_attention_interleaved_matmul():
+    T, B, H, d = 4, 2, 2, 3
+    qkv = np.random.rand(T, B, H * 3 * d).astype(np.float32)
+    att = nd._contrib_interleaved_matmul_selfatt_qk(nd.array(qkv), heads=H)
+    assert att.shape == (B * H, T, T)
+    probs = nd.softmax(att, axis=-1)
+    out = nd._contrib_interleaved_matmul_selfatt_valatt(nd.array(qkv), probs, heads=H)
+    assert out.shape == (T, B, H * d)
+
+
+def test_cast_amp():
+    x = nd.random.uniform(shape=(2, 2))
+    y = nd.amp_cast(x, dtype="bfloat16")
+    assert "bfloat16" in str(y.dtype)
+
+
+def test_bf16_matmul_accumulation():
+    # MXU contract: bf16 inputs, f32 accumulation
+    a = nd.random.uniform(shape=(32, 32)).astype("bfloat16")
+    b = nd.random.uniform(shape=(32, 32)).astype("bfloat16")
+    out = nd.dot(a, b)
+    ref = a.asnumpy().astype(np.float32) @ b.asnumpy().astype(np.float32)
+    assert_almost_equal(out, ref, rtol=5e-2, atol=5e-2)
