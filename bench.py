@@ -1,0 +1,83 @@
+"""Headline benchmark: ResNet-50 training throughput (img/s), batch 32.
+
+Reference baseline: 109 img/s on 1x K80, batch 32
+(example/image-classification/README.md:154; BASELINE.md training table).
+Runs the fused data-parallel training step (forward+backward+update in one
+jit) on the available accelerator — one real TPU chip under the driver.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import os
+
+BASELINE_IMG_S = 109.0  # reference resnet-50 train, 1 device, batch 32
+BATCH = int(os.environ.get("BENCH_BATCH", 32))
+WARMUP = int(os.environ.get("BENCH_WARMUP", 3))
+STEPS = int(os.environ.get("BENCH_STEPS", 20))
+IMAGE = int(os.environ.get("BENCH_IMAGE", 224))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+
+    devices = jax.devices()
+    mesh = make_mesh({"dp": 1}, devices=devices[:1])
+
+    net = resnet50_v1()
+    # Initialize + finish deferred shape inference on CPU: the eager per-op
+    # path would trigger dozens of separate accelerator compiles, while the
+    # CPU backend compiles each in ms. DataParallelTrainer then device_puts
+    # the finished parameters onto the accelerator mesh, so the TPU sees
+    # exactly one compile — the fused train step.
+    with mx.cpu():
+        net.initialize(ctx=mx.cpu())
+        net(nd.zeros((1, 3, IMAGE, IMAGE), ctx=mx.cpu()))
+
+    def loss_fn(logits, labels):
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[:, None].astype(jnp.int32),
+                                   axis=-1)[:, 0]
+        return jnp.mean(logz - gold)
+
+    trainer = DataParallelTrainer(
+        net, loss_fn, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4},
+        mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.uniform(-1, 1, size=(BATCH, 3, IMAGE, IMAGE)).astype(np.float32))
+    y = nd.array(rng.randint(0, 1000, size=(BATCH,)), dtype="int32")
+
+    for _ in range(WARMUP):
+        trainer.step(x, y).block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        lossv = trainer.step(x, y)
+    lossv.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    img_s = BATCH * STEPS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput_bs32",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

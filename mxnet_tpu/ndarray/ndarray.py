@@ -473,13 +473,14 @@ def invoke(op: Union[str, Op], inputs: Sequence[NDArray], params: Dict[str, Any]
     from .. import autograd
     need_grad = (op.differentiable and autograd.is_recording()
                  and any(x._ag_node is not None for x in inputs))
-    fn = op.bound(params)
     vjp_fn = None
     was_tuple = False
     if need_grad:
-        outs_raw, vjp_fn = jax.vjp(fn, *raw)
+        # vjp over the unjitted fn: linearizing through an inner pjit breaks
+        # for some primitives (reduce_window_max) on this jax version
+        outs_raw, vjp_fn = jax.vjp(op.unbound(params), *raw)
     else:
-        outs_raw = fn(*raw)
+        outs_raw = op(*raw, **params)
     if isinstance(outs_raw, tuple):
         was_tuple = True
     else:

@@ -155,26 +155,28 @@ def pooling(data, *, kernel=(), pool_type="max", global_pool=False, stride=None,
     else:
         padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
 
+    # init values must be Python scalars: an array init defeats jax's monoid
+    # detection for reduce_window and its grad cannot linearize under jit
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
-                                 window, strides, padding)
+        # typed numpy scalar for ints so the identity matches the operand
+        # dtype (a weak Python int would defeat monoid detection for int8 &c)
+        init = -_np.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else _np.dtype(data.dtype).type(_np.iinfo(_np.dtype(data.dtype)).min)
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
     if pool_type in ("avg", "sum"):
-        s = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
-                              window, strides, padding)
+        s = lax.reduce_window(data, 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0,
+                              lax.add, window, strides, padding)
         if pool_type == "sum":
             return s
         if count_include_pad:
             denom = float(_np.prod(kernel))
             return s / denom
         ones = jnp.ones_like(data)
-        cnt = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add,
-                                window, strides, padding)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
         return s / cnt
     if pool_type == "lp":
-        s = lax.reduce_window(jnp.power(jnp.abs(data), p_value),
-                              jnp.asarray(0, data.dtype), lax.add,
-                              window, strides, padding)
+        s = lax.reduce_window(jnp.power(jnp.abs(data), p_value), 0.0,
+                              lax.add, window, strides, padding)
         return jnp.power(s, 1.0 / p_value)
     raise MXNetError(f"pool_type {pool_type}")
 

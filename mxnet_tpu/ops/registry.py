@@ -69,7 +69,19 @@ class Op:
             self._jit_cache[key] = cached
         return cached
 
+    def unbound(self, params: Dict[str, Any]) -> Callable:
+        """The raw (unjitted) closure. Used (a) under an enclosing trace —
+        nesting jit would slow compiles and this jax version cannot linearize
+        through an inner pjit for some primitives (reduce_window_max), and
+        (b) for eager jax.vjp at record time, same reason."""
+        fn = self.fn
+        if params:
+            fn = functools.partial(fn, **params)
+        return fn
+
     def __call__(self, *arrays, **params):
+        if any(isinstance(a, jax.core.Tracer) for a in arrays):
+            return self.unbound(params)(*arrays)
         return self.bound(params)(*arrays)
 
     def __repr__(self):

@@ -199,14 +199,24 @@ class DataParallelTrainer:
 
     def __init__(self, net: HybridBlock, loss, optimizer="sgd",
                  optimizer_params=None, mesh: Optional[Mesh] = None,
-                 batch_axis_name: str = "dp", dtype=None):
+                 batch_axis_name: str = "dp", dtype=None, data_spec=None):
         self.net = net
         self.mesh = mesh if mesh is not None else current_mesh()
         self.batch_axis = batch_axis_name
+        # input PartitionSpec; default = batch over the dp axis only. Pass
+        # e.g. P('dp', 'sp') to also shard the sequence dim (context parallel).
+        self.data_spec = data_spec if data_spec is not None else P(batch_axis_name)
         self.optimizer = optimizer if isinstance(optimizer, opt_mod.Optimizer) \
             else opt_mod.create(optimizer, **(optimizer_params or {}))
         self._init_fn, self._update_fn = functional_optimizer(self.optimizer)
         self.loss = loss
+        deferred = [p.name for p in net.collect_params().values()
+                    if p._data is None and p._deferred_init is not None]
+        if deferred:
+            raise MXNetError(
+                "net has deferred-init parameters (%s…); run one eager "
+                "forward pass before constructing DataParallelTrainer"
+                % deferred[0])
         self._plist = [p for p in net.collect_params().values()
                        if p._data is not None]
         self._trainable = [p.grad_req != "null" for p in self._plist]
@@ -280,8 +290,10 @@ class DataParallelTrainer:
         self.optimizer.num_update = self._t
         lr = jnp.float32(self.optimizer.learning_rate)
         key = _rng.next_key_raw()
-        xr = jax.device_put(xr, NamedSharding(self.mesh, P(self.batch_axis)))
-        yr = jax.device_put(yr, NamedSharding(self.mesh, P(self.batch_axis)))
+        xr = jax.device_put(xr, NamedSharding(self.mesh, self.data_spec))
+        y_spec = self.data_spec if yr.ndim >= len(self.data_spec) \
+            else P(*self.data_spec[:yr.ndim])
+        yr = jax.device_put(yr, NamedSharding(self.mesh, y_spec))
         self._params_raw, self._opt_state, lossv, aux = fn(
             self._params_raw, self._opt_state, key, xr, yr, lr,
             jnp.float32(self._t))

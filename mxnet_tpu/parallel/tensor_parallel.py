@@ -43,7 +43,9 @@ def shard_params_megatron(block: Block, rules: Optional[Dict[str, P]] = None,
     rules = rules or default_rules
     compiled = [(re.compile(k), v) for k, v in rules.items()]
     n = 0
-    for name, p in block.collect_params().items():
+    # structural names ('encoder.layers.0.attn.qkv.weight') — stable and
+    # pattern-matchable, unlike the global-counter flat names
+    for name, p in block._collect_params_with_prefix().items():
         for pat, spec in compiled:
             if pat.match(name):
                 p.sharding = spec
