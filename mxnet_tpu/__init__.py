@@ -28,6 +28,8 @@ from . import autograd
 from . import random
 from .ndarray import NDArray
 
+from . import symbol
+from . import symbol as sym
 from . import initializer
 from . import optimizer
 from . import lr_scheduler

@@ -14,3 +14,4 @@ from . import nn         # noqa: F401
 from . import linalg     # noqa: F401
 from . import contrib    # noqa: F401
 from . import attention  # noqa: F401
+from . import extra      # noqa: F401

@@ -1,0 +1,104 @@
+"""Init ops and legacy output-layer ops.
+
+Reference: src/operator/tensor/init_op.cc (_zeros/_ones/_full/_arange/_eye/
+_linspace) and src/operator/regression_output.cc (LinearRegressionOutput,
+MAERegressionOutput, LogisticRegressionOutput). The regression outputs follow
+the reference's semantics: forward is identity (after the link function),
+backward IGNORES the incoming head gradient and emits grad_scale-scaled
+residuals (pred - label) / batch — that is what makes them usable as loss
+layers in the symbolic API.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import default_dtype
+from .registry import register
+
+
+def _dt(dtype):
+    return _np.dtype(dtype if dtype is not None else default_dtype())
+
+
+@register("_zeros", aliases=("zeros",), differentiable=False)
+def _zeros(*, shape, dtype=None, ctx=None):
+    return jnp.zeros(shape, _dt(dtype))
+
+
+@register("_ones", aliases=("ones",), differentiable=False)
+def _ones(*, shape, dtype=None, ctx=None):
+    return jnp.ones(shape, _dt(dtype))
+
+
+@register("_full", aliases=("full",), differentiable=False)
+def _full(*, shape, value, dtype=None, ctx=None):
+    return jnp.full(shape, value, _dt(dtype))
+
+
+@register("_arange", aliases=("arange",), differentiable=False)
+def _arange(*, start=0, stop=None, step=1.0, repeat=1, dtype=None, ctx=None,
+            infer_range=False):
+    out = jnp.arange(start, stop, step, _dt(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_eye", aliases=("eye",), differentiable=False)
+def _eye(*, N, M=0, k=0, dtype=None, ctx=None):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=_dt(dtype))
+
+
+@register("_linspace", aliases=("linspace",), differentiable=False)
+def _linspace(*, start, stop, num, endpoint=True, dtype=None, ctx=None):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint, dtype=_dt(dtype))
+
+
+# ---------------------------------------------------------------------------
+# Regression output layers (loss-defining ops)
+# ---------------------------------------------------------------------------
+
+def _regression(link, grad_fn):
+    """Build a regression-output op: custom VJP ignoring the head gradient."""
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def op(data, label, grad_scale=1.0):
+        return link(data)
+
+    def fwd(data, label, grad_scale):
+        return link(data), (data, label)
+
+    def bwd(grad_scale, res, g):
+        data, label = res
+        pred = link(data)
+        num = label.size // label.shape[0] if label.ndim else 1
+        scale = grad_scale / max(num, 1)
+        gd = grad_fn(pred, label.reshape(pred.shape).astype(pred.dtype)) * scale
+        return gd.astype(data.dtype), jnp.zeros_like(label)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+_lin = _regression(lambda x: x, lambda p, l: p - l)
+_mae = _regression(lambda x: x, lambda p, l: jnp.sign(p - l))
+_log = _regression(jax.nn.sigmoid, lambda p, l: p - l)
+
+
+@register("LinearRegressionOutput")
+def linear_regression_output(data, label, *, grad_scale=1.0):
+    return _lin(data, label, grad_scale)
+
+
+@register("MAERegressionOutput")
+def mae_regression_output(data, label, *, grad_scale=1.0):
+    return _mae(data, label, grad_scale)
+
+
+@register("LogisticRegressionOutput")
+def logistic_regression_output(data, label, *, grad_scale=1.0):
+    return _log(data, label, grad_scale)

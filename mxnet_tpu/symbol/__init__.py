@@ -1,0 +1,63 @@
+"""``mx.sym`` / ``mx.symbol`` — the declarative graph namespace.
+
+Reference: python/mxnet/symbol/ — op functions are code-generated at import
+from the C op registry (python/mxnet/symbol/register.py). Here they are
+generated from the same Python op registry the imperative API uses, so the
+two namespaces are always in sync by construction.
+"""
+from __future__ import annotations
+
+import functools as _functools
+
+from ..base import MXNetError
+from ..ops.registry import all_ops as _all_ops, get_op as _get_op
+from .symbol import (Symbol, Variable, var, Group, load, load_json, fromjson,
+                     _apply_op)
+from .executor import Executor
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "fromjson", "Executor", "zeros", "ones", "full", "arange"]
+
+
+def _make_symbol_function(op):
+    def fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        return _apply_op(op, *args, name=name, attr=attr, **kwargs)
+
+    fn.__name__ = op.name
+    fn.__doc__ = (op.doc or "") + \
+        f"\n\n(symbolic form of operator `{op.name}`)"
+    return fn
+
+
+_seen = set()
+for _name, _op in sorted(_all_ops().items()):
+    if _name in ("Variable", "Group"):
+        continue
+    if _name not in _seen:
+        globals()[_name] = _make_symbol_function(_op)
+        _seen.add(_name)
+
+
+def zeros(shape, dtype=None, **kwargs):
+    return _apply_op(_get_op("_zeros"), shape=tuple(shape)
+                     if isinstance(shape, (list, tuple)) else (shape,),
+                     dtype=dtype, **kwargs)
+
+
+def ones(shape, dtype=None, **kwargs):
+    return _apply_op(_get_op("_ones"), shape=tuple(shape)
+                     if isinstance(shape, (list, tuple)) else (shape,),
+                     dtype=dtype, **kwargs)
+
+
+def full(shape, val, dtype=None, **kwargs):
+    return _apply_op(_get_op("_full"), shape=tuple(shape)
+                     if isinstance(shape, (list, tuple)) else (shape,),
+                     value=float(val), dtype=dtype, **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype=None, **kwargs):
+    return _apply_op(_get_op("_arange"), start=start, stop=stop, step=step,
+                     repeat=repeat, dtype=dtype, **kwargs)

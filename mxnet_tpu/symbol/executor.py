@@ -1,0 +1,351 @@
+"""Executor: compiled evaluation of a Symbol graph.
+
+Reference: src/executor/graph_executor.cc (Bind :2043, SimpleBind :1959,
+Forward :80, Backward :93) + python/mxnet/executor.py. TPU-native redesign
+(SURVEY.md §7): instead of a memory-planned per-op engine schedule, ``bind``
+lowers the whole DAG to ONE jitted XLA computation per (is_train) mode;
+``backward`` is a second jitted computation that rematerializes the forward
+and applies the VJP (the reference's mirror-recompute, gradient.cc:147, as the
+default — XLA's scheduler handles memory planning/fusion that the reference's
+MXPlanMemory/FusePointwise passes did by hand).
+
+BatchNorm auxiliary-state semantics (reference mutates aux in-op): the
+executor computes the momentum blend of the batch statistics as extra traced
+outputs and writes them back into ``aux_arrays`` after each training forward.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError, default_dtype
+from ..context import Context, current_context
+from ..ndarray import NDArray
+from .. import ndarray as nd
+from .symbol import (Symbol, _Node, _num_outputs, _resolved_params,
+                     _op_param_names)
+
+__all__ = ["Executor"]
+
+
+def _graph_runner(symbol: Symbol, is_train: bool):
+    """Build the pure function (arg_vals, aux_vals, rng_key) ->
+    (outputs, aux_updates) by a topological walk over the DAG."""
+    topo = symbol._topo()
+    arg_nodes = [n for n in topo if n.kind == "var" and not n.is_aux()
+                 and not n.is_rng()]
+    aux_nodes = [n for n in topo if n.kind == "var" and n.is_aux()]
+    rng_nodes = [n for n in topo if n.kind == "var" and n.is_rng()]
+    heads = symbol._heads
+
+    def run(arg_vals: Tuple, aux_vals: Tuple, rng_key):
+        env: Dict[int, Tuple] = {}
+        for node, val in zip(arg_nodes, arg_vals):
+            env[id(node)] = (val,)
+        for node, val in zip(aux_nodes, aux_vals):
+            env[id(node)] = (val,)
+        if rng_nodes:
+            keys = jax.random.split(rng_key, len(rng_nodes))
+            for node, k in zip(rng_nodes, keys):
+                env[id(node)] = (k,)
+        aux_updates: Dict[int, Any] = {}
+        for node in topo:
+            if node.kind == "var":
+                continue
+            ins = [env[id(i)][oi] for i, oi in node.inputs]
+            params = _resolved_params(node, training=is_train)
+            outs = node.op.unbound(params)(*ins)
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            node.num_outputs = len(outs)
+            env[id(node)] = outs
+            if node.op.name == "BatchNorm" and is_train \
+                    and not params.get("use_global_stats", False):
+                momentum = float(params.get("momentum", 0.9))
+                _, bmean, bvar = outs
+                for (inp, _), argpos in zip(node.inputs[3:5], (1, 2)):
+                    if inp.kind == "var" and inp.is_aux():
+                        old = env[id(inp)][0]
+                        newv = outs[argpos]
+                        aux_updates[id(inp)] = (
+                            momentum * old.astype(jnp.float32)
+                            + (1.0 - momentum) * newv).astype(old.dtype)
+        out_vals = tuple(env[id(n)][oi] for n, oi in heads)
+        upd = tuple(aux_updates.get(id(n), env[id(n)][0]) for n in aux_nodes)
+        return out_vals, upd
+
+    return run, arg_nodes, aux_nodes, rng_nodes
+
+
+class Executor:
+    """Holds bound argument/gradient/aux arrays + the compiled graph."""
+
+    def __init__(self, symbol: Symbol, ctx: Context,
+                 arg_dict: "Dict[str, NDArray]",
+                 grad_dict: "Dict[str, Optional[NDArray]]",
+                 grad_req: Dict[str, str],
+                 aux_dict: "Dict[str, NDArray]"):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = arg_dict
+        self.grad_dict = grad_dict
+        self.aux_dict = aux_dict
+        self._grad_req = grad_req
+        self.outputs: List[NDArray] = []
+        self._runner_cache: Dict[bool, Any] = {}
+        self._bwd_cache: Dict[Any, Any] = {}
+        self._rng_seed = 0
+        self._last_key = None
+        self._monitor_callback = None
+
+    # -- construction --------------------------------------------------------
+    @staticmethod
+    def _ctx_of(ctx) -> Context:
+        return ctx if isinstance(ctx, Context) else current_context()
+
+    @classmethod
+    def _bind(cls, symbol: Symbol, ctx, args, args_grad, grad_req, aux_states):
+        ctx = cls._ctx_of(ctx)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        dupes = {n for n in arg_names if arg_names.count(n) > 1}
+        if dupes:
+            raise MXNetError(
+                f"bind: duplicate argument names {sorted(dupes)} — two "
+                "distinct variables share a name; reuse the SAME Variable "
+                "object for shared weights")
+
+        def to_dict(vals, names, what):
+            if vals is None:
+                return {}
+            if isinstance(vals, dict):
+                return {k: (v if isinstance(v, NDArray) else nd.array(v))
+                        for k, v in vals.items()}
+            if len(vals) != len(names):
+                raise MXNetError(
+                    f"bind: {what} has {len(vals)} entries, expected "
+                    f"{len(names)} ({names})")
+            return {k: (v if isinstance(v, NDArray) else nd.array(v))
+                    for k, v in zip(names, vals)}
+
+        arg_dict = to_dict(args, arg_names, "args")
+        missing = [n for n in arg_names if n not in arg_dict]
+        if missing:
+            raise MXNetError(f"bind: missing argument arrays for {missing}")
+        aux_dict = to_dict(aux_states, aux_names, "aux_states")
+        for n in aux_names:
+            if n not in aux_dict:
+                raise MXNetError(f"bind: missing auxiliary state {n}")
+
+        if isinstance(grad_req, str):
+            req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            req = dict(zip(arg_names, grad_req))
+        else:
+            req = {n: grad_req.get(n, "null") for n in arg_names}
+        grad_dict = to_dict(args_grad, arg_names, "args_grad")
+        for n in arg_names:
+            if req.get(n, "null") != "null" and n not in grad_dict:
+                grad_dict[n] = nd.zeros(arg_dict[n].shape,
+                                        dtype=arg_dict[n].dtype)
+        return cls(symbol, ctx, arg_dict, grad_dict, req, aux_dict)
+
+    @classmethod
+    def _simple_bind(cls, symbol: Symbol, ctx, grad_req, type_dict, kwargs):
+        ctx = cls._ctx_of(ctx)
+        shapes = {k: tuple(v) for k, v in kwargs.items()}
+        dtypes = dict(type_dict or {})
+        arg_s, _, aux_s, arg_t, _, aux_t = symbol._infer(shapes, dtypes,
+                                                         partial=False)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_dict = {}
+        for name, s, t in zip(arg_names, arg_s, arg_t):
+            if s is None:
+                raise MXNetError(f"simple_bind: could not infer shape of {name}")
+            arg_dict[name] = nd.zeros(s, dtype=t)
+        aux_dict = {}
+        for name, s, t in zip(aux_names, aux_s, aux_t):
+            init = nd.ones if name.endswith("_var") or name.endswith("var") \
+                else nd.zeros
+            aux_dict[name] = init(s, dtype=t)
+        return cls._bind(symbol, ctx, arg_dict, None, grad_req, aux_dict)
+
+    # -- properties ----------------------------------------------------------
+    @property
+    def arg_arrays(self) -> List[NDArray]:
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self) -> List[Optional[NDArray]]:
+        return [self.grad_dict.get(n)
+                for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self) -> List[NDArray]:
+        return [self.aux_dict[n] for n in self._symbol.list_auxiliary_states()]
+
+    @property
+    def output_dict(self) -> "Dict[str, NDArray]":
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    # -- execution -----------------------------------------------------------
+    def _fwd(self, is_train: bool):
+        cached = self._runner_cache.get(is_train)
+        if cached is None:
+            run, arg_nodes, aux_nodes, rng_nodes = _graph_runner(
+                self._symbol, is_train)
+            cached = (jax.jit(run), arg_nodes, aux_nodes, rng_nodes)
+            self._runner_cache[is_train] = cached
+        return cached
+
+    def _next_key(self):
+        self._rng_seed += 1
+        return jax.random.PRNGKey(self._rng_seed)
+
+    def _current_key(self):
+        # backward must replay the SAME dropout masks as the most recent
+        # TRAINING forward (an intervening eval forward must not disturb it)
+        if self._last_key is None:
+            self._last_key = self._next_key()
+        return self._last_key
+
+    def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"forward: unknown argument {k}")
+            self.arg_dict[k]._set_data(
+                (v.handle if isinstance(v, NDArray) else jnp.asarray(v)))
+        fn, arg_nodes, aux_nodes, _ = self._fwd(bool(is_train))
+        arg_vals = tuple(self.arg_dict[n.name].handle for n in arg_nodes)
+        aux_vals = tuple(self.aux_dict[n.name].handle for n in aux_nodes)
+        key = self._next_key()
+        if is_train:
+            self._last_key = key
+        outs, aux_upd = fn(arg_vals, aux_vals, key)
+        if is_train:
+            for node, newv in zip(aux_nodes, aux_upd):
+                self.aux_dict[node.name]._set_data(newv)
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        if self._monitor_callback is not None:
+            for name, arr in self.output_dict.items():
+                self._monitor_callback(name, arr)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train: bool = True):
+        wrt_names = [n for n in self._symbol.list_arguments()
+                     if self._grad_req.get(n, "null") != "null"]
+        if not wrt_names:
+            return
+        key = tuple(wrt_names)
+        cached = self._bwd_cache.get(key)
+        if cached is None:
+            run, arg_nodes_b, _, _ = _graph_runner(self._symbol, True)
+            arg_names_all = [n.name for n in arg_nodes_b]
+            wrt_idx = [arg_names_all.index(n) for n in wrt_names]
+
+            def bwd(arg_vals, aux_vals, rng_key, head_grads):
+                sel = tuple(arg_vals[i] for i in wrt_idx)
+
+                def fn(sel_vals):
+                    vals = list(arg_vals)
+                    for i, v in zip(wrt_idx, sel_vals):
+                        vals[i] = v
+                    outs, _ = run(tuple(vals), aux_vals, rng_key)
+                    return outs
+
+                outs, vjp = jax.vjp(fn, sel)
+                cot = tuple(
+                    (jnp.ones_like(o) if g is None else g)
+                    for o, g in zip(outs, head_grads))
+                (grads,) = vjp(cot)
+                return grads
+
+            cached = jax.jit(bwd)
+            self._bwd_cache[key] = cached
+        _, arg_nodes, aux_nodes, _ = self._fwd(True)
+        arg_vals = tuple(self.arg_dict[n.name].handle for n in arg_nodes)
+        aux_vals = tuple(self.aux_dict[n.name].handle for n in aux_nodes)
+        nout = len(self._symbol._heads)
+        if out_grads is None:
+            heads: List[Optional[Any]] = [None] * nout
+        else:
+            if isinstance(out_grads, (NDArray, jnp.ndarray, _np.ndarray)):
+                out_grads = [out_grads]
+            heads = [g.handle if isinstance(g, NDArray) else jnp.asarray(g)
+                     for g in out_grads]
+        if any(h is None for h in heads):
+            # jit needs concrete cotangents: ones shaped like the heads. Use
+            # the last forward's outputs when available; else infer once.
+            if len(self.outputs) == nout:
+                shapes = [(o.shape, o.dtype) for o in self.outputs]
+            else:
+                _, out_s, _, _, out_t, _ = self._symbol._infer(
+                    {n.name: tuple(self.arg_dict[n.name].shape)
+                     for n in arg_nodes},
+                    {n.name: self.arg_dict[n.name].dtype for n in arg_nodes},
+                    partial=True)
+                shapes = list(zip(out_s, out_t))
+            heads = [jnp.ones(s, t) if h is None else h
+                     for h, (s, t) in zip(heads, shapes)]
+        grads = cached(arg_vals, aux_vals, self._current_key(), tuple(heads))
+        for name, g in zip(wrt_names, grads):
+            tgt = self.grad_dict[name]
+            if self._grad_req[name] == "add":
+                tgt._set_data(tgt.handle + g)
+            else:
+                tgt._set_data(g)
+
+    # -- misc API parity ----------------------------------------------------
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(
+                    v.handle if isinstance(v, NDArray) else jnp.asarray(v))
+            elif not allow_extra_params:
+                raise MXNetError(f"copy_params_from: unknown param {k}")
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._set_data(
+                    v.handle if isinstance(v, NDArray) else jnp.asarray(v))
+            elif not allow_extra_params:
+                raise MXNetError(f"copy_params_from: unknown aux {k}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        shapes = {n: tuple(a.shape) for n, a in self.arg_dict.items()}
+        shapes.update({k: tuple(v) for k, v in kwargs.items()})
+        arg_s, _, aux_s, arg_t, _, aux_t = self._symbol._infer(
+            shapes, {}, partial=False)
+        arg_dict = {}
+        for name, s, t in zip(self._symbol.list_arguments(), arg_s, arg_t):
+            old = self.arg_dict[name]
+            arg_dict[name] = old if tuple(old.shape) == s \
+                else nd.zeros(s, dtype=t)
+        aux_dict = {}
+        for name, s, t in zip(self._symbol.list_auxiliary_states(), aux_s,
+                              aux_t):
+            old = self.aux_dict[name]
+            aux_dict[name] = old if tuple(old.shape) == s \
+                else nd.zeros(s, dtype=t)
+        grad_dict = {}
+        for name, g in self.grad_dict.items():
+            if g is None or name not in arg_dict:
+                grad_dict[name] = g
+            elif tuple(g.shape) == tuple(arg_dict[name].shape):
+                grad_dict[name] = g
+            else:
+                grad_dict[name] = nd.zeros(arg_dict[name].shape,
+                                           dtype=arg_dict[name].dtype)
+        return Executor(self._symbol, self._ctx, arg_dict,
+                        grad_dict, dict(self._grad_req), aux_dict)
+
+    def __repr__(self):
+        return f"<Executor {self._symbol!r} ctx={self._ctx}>"

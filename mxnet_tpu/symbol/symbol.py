@@ -1,0 +1,873 @@
+"""Symbol: the declarative graph API (``mx.sym``).
+
+Reference: python/mxnet/symbol/symbol.py (Symbol class :55, infer_shape :1045,
+simple_bind :1504, bind :1806, tojson/save :1336-1369) + the NNVM graph it
+wraps. TPU-native redesign: a Symbol is a lightweight Python DAG over the SAME
+registered pure-jax operators the imperative API uses; ``bind`` lowers the DAG
+to one jitted XLA computation (the reference lowers to a GraphExecutor with
+memory planning — XLA does that planning for us, SURVEY.md §7).
+
+Shape/type inference (reference src/executor/infer_graph_attr_pass.cc) is a
+single forward topological sweep: per-op *weight rules* fill in learnable-input
+shapes (the only place the reference's backward-inference matters in practice),
+then ``jax.eval_shape`` on the op's jax function yields output shapes+dtypes
+simultaneously — no separate FInferShape/FInferType fixpoint needed.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError, default_dtype
+from ..ops.registry import Op, all_ops, get_op
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "fromjson"]
+
+
+# ---------------------------------------------------------------------------
+# Graph nodes
+# ---------------------------------------------------------------------------
+
+class _Node:
+    """One vertex of the symbolic DAG: a variable or an op application."""
+
+    __slots__ = ("kind", "name", "op", "params", "inputs", "attrs",
+                 "num_outputs")
+
+    def __init__(self, kind: str, name: str, op: Optional[Op] = None,
+                 params: Optional[Dict[str, Any]] = None,
+                 inputs: Optional[List[Tuple["_Node", int]]] = None,
+                 attrs: Optional[Dict[str, str]] = None):
+        self.kind = kind              # 'var' | 'op'
+        self.name = name
+        self.op = op
+        self.params = params or {}
+        self.inputs = inputs or []
+        self.attrs = attrs or {}
+        self.num_outputs: Optional[int] = 1 if kind == "var" else None
+
+    def is_rng(self) -> bool:
+        return self.attrs.get("__rng__") == "True"
+
+    def is_aux(self) -> bool:
+        return self.attrs.get("__aux__") == "True"
+
+
+class _SymNameManager:
+    _lock = threading.Lock()
+    _counters: Dict[str, int] = {}
+
+    @classmethod
+    def fresh(cls, hint: str) -> str:
+        with cls._lock:
+            i = cls._counters.get(hint, 0)
+            cls._counters[hint] = i + 1
+        return f"{hint}{i}"
+
+
+# ---------------------------------------------------------------------------
+# Weight-shape rules: learnable-input inference (the practical subset of the
+# reference's bidirectional shape inference). Each rule maps
+# (params, input_shapes_by_argname) -> {argname: shape} for still-unknown args.
+# ---------------------------------------------------------------------------
+
+def _tup(v, n=None):
+    if v is None:
+        return None
+    t = tuple(int(x) for x in v) if isinstance(v, (tuple, list)) else (int(v),)
+    return t
+
+
+def _rule_fully_connected(p, shp):
+    d = shp.get("data")
+    if d is None:
+        return {}
+    units = int(_np.prod(d[1:])) if p.get("flatten", True) else d[-1]
+    nh = int(p["num_hidden"])
+    return {"weight": (nh, units), "bias": (nh,)}
+
+
+def _rule_convolution(p, shp):
+    d = shp.get("data")
+    if d is None:
+        return {}
+    k = _tup(p["kernel"])
+    nf, ng = int(p["num_filter"]), int(p.get("num_group", 1))
+    return {"weight": (nf, d[1] // ng) + k, "bias": (nf,)}
+
+
+def _rule_deconvolution(p, shp):
+    d = shp.get("data")
+    if d is None:
+        return {}
+    k = _tup(p["kernel"])
+    nf, ng = int(p["num_filter"]), int(p.get("num_group", 1))
+    return {"weight": (d[1], nf // ng) + k, "bias": (nf,)}
+
+
+def _rule_channel_stats(p, shp):
+    d = shp.get("data")
+    if d is None:
+        return {}
+    ax = int(p.get("axis", 1)) % len(d)
+    c = (d[ax],)
+    return {"gamma": c, "beta": c, "moving_mean": c, "moving_var": c}
+
+
+def _rule_layer_norm(p, shp):
+    d = shp.get("data")
+    if d is None:
+        return {}
+    ax = int(p.get("axis", -1)) % len(d)
+    return {"gamma": (d[ax],), "beta": (d[ax],)}
+
+
+def _rule_instance_norm(p, shp):
+    d = shp.get("data")
+    if d is None:
+        return {}
+    return {"gamma": (d[1],), "beta": (d[1],)}
+
+
+def _rule_embedding(p, shp):
+    return {"weight": (int(p["input_dim"]), int(p["output_dim"]))}
+
+
+def _rule_rnn(p, shp):
+    from ..ops.nn import rnn_param_size
+    d = shp.get("data")
+    if d is None:
+        return {}
+    mode = p["mode"]
+    nl = int(p.get("num_layers", 1))
+    ss = int(p["state_size"])
+    bidir = bool(p.get("bidirectional", False))
+    total = nl * (2 if bidir else 1)
+    out = {
+        "parameters": (rnn_param_size(mode, nl, d[2], ss, bidir),),
+        "state": (total, d[1], ss),
+    }
+    if mode == "lstm":
+        out["state_cell"] = (total, d[1], ss)
+    return out
+
+
+def _rule_label_like_batch(p, shp):
+    d = shp.get("data")
+    if d is None:
+        return {}
+    return {"label": tuple(d[:-1])}
+
+
+def _rule_label_like_data(p, shp):
+    d = shp.get("data")
+    if d is None:
+        return {}
+    return {"label": tuple(d)}
+
+
+_WEIGHT_RULES = {
+    "FullyConnected": _rule_fully_connected,
+    "Convolution": _rule_convolution,
+    "Deconvolution": _rule_deconvolution,
+    "BatchNorm": _rule_channel_stats,
+    "GroupNorm": _rule_instance_norm,  # gamma/beta are (C,) on channel axis 1
+    "LayerNorm": _rule_layer_norm,
+    "InstanceNorm": _rule_instance_norm,
+    "Embedding": _rule_embedding,
+    "RNN": _rule_rnn,
+    "SoftmaxOutput": _rule_label_like_batch,
+    "Softmax": _rule_label_like_batch,
+    "LinearRegressionOutput": _rule_label_like_data,
+    "MAERegressionOutput": _rule_label_like_data,
+    "LogisticRegressionOutput": _rule_label_like_data,
+}
+
+# ops whose listed arg names are auxiliary states, not learnable arguments
+_AUX_ARGS = {"BatchNorm": ("moving_mean", "moving_var")}
+
+
+
+# ---------------------------------------------------------------------------
+# Symbol
+# ---------------------------------------------------------------------------
+
+class Symbol:
+    """An output list over the symbolic DAG (single symbol == one output)."""
+
+    __slots__ = ("_heads",)
+
+    def __init__(self, heads: List[Tuple[_Node, int]]):
+        self._heads = heads
+
+    # -- identity ------------------------------------------------------------
+    @property
+    def name(self) -> Optional[str]:
+        if len(self._heads) == 1:
+            return self._heads[0][0].name
+        return None
+
+    def __repr__(self):
+        if len(self._heads) == 1:
+            return f"<Symbol {self.name}>"
+        return f"<Symbol group [{', '.join(n.name for n, _ in self._heads)}]>"
+
+    def __iter__(self):
+        return (Symbol([h]) for h in self._heads)
+
+    def __len__(self):
+        return len(self._heads)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError(f"no output named {index!r}")
+            index = names.index(index)
+        if isinstance(index, int):
+            if len(self._heads) > 1:
+                if not 0 <= index < len(self._heads):
+                    raise MXNetError(
+                        f"output index {index} out of range "
+                        f"({len(self._heads)} outputs)")
+                return Symbol([self._heads[index]])
+            node, cur = self._heads[0]
+            if cur != 0:
+                # already an explicit output selection: it has ONE output
+                if index != 0:
+                    raise MXNetError(
+                        f"output index {index} out of range (1 output)")
+                return Symbol([(node, cur)])
+            nout = _num_outputs(node)
+            if not 0 <= index < nout:
+                raise MXNetError(
+                    f"output index {index} out of range for {node.name} "
+                    f"({nout} outputs)")
+            return Symbol([(node, index)])
+        raise TypeError(index)
+
+    # -- graph walking -------------------------------------------------------
+    def _topo(self) -> List[_Node]:
+        seen: Dict[int, _Node] = {}
+        order: List[_Node] = []
+
+        def visit(node: _Node):
+            if id(node) in seen:
+                return
+            seen[id(node)] = node
+            for inp, _ in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for n, _ in self._heads:
+            visit(n)
+        return order
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in self._topo()
+                if n.kind == "var" and not n.is_aux() and not n.is_rng()]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n.name for n in self._topo() if n.kind == "var" and n.is_aux()]
+
+    def _rng_vars(self) -> List[_Node]:
+        return [n for n in self._topo() if n.kind == "var" and n.is_rng()]
+
+    def list_outputs(self) -> List[str]:
+        outs = []
+        for node, idx in self._heads:
+            if node.kind == "var":
+                outs.append(node.name)
+            elif _num_outputs(node) == 1:
+                outs.append(f"{node.name}_output")
+            else:
+                outs.append(f"{node.name}_output{idx}")
+        return outs
+
+    def list_inputs(self) -> List[str]:
+        return [n.name for n in self._topo() if n.kind == "var" and not n.is_rng()]
+
+    def get_internals(self) -> "Symbol":
+        heads = []
+        for node in self._topo():
+            for i in range(_num_outputs(node) or 1):
+                heads.append((node, i))
+        return Symbol(heads)
+
+    def get_children(self) -> Optional["Symbol"]:
+        node, _ = self._heads[0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # -- attrs ---------------------------------------------------------------
+    def attr(self, key: str) -> Optional[str]:
+        return self._heads[0][0].attrs.get(key)
+
+    def list_attr(self) -> Dict[str, str]:
+        return dict(self._heads[0][0].attrs)
+
+    def attr_dict(self) -> Dict[str, Dict[str, str]]:
+        out = {}
+        for n in self._topo():
+            d = dict(n.attrs)
+            if n.kind == "op":
+                d.update({k: _attr_str(v) for k, v in n.params.items()})
+            if d:
+                out[n.name] = d
+        return out
+
+    def _set_attr(self, **kwargs):
+        self._heads[0][0].attrs.update({k: str(v) for k, v in kwargs.items()})
+
+    # -- inference -----------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        arg_s, out_s, aux_s, _, _, _ = self._infer(
+            self._shape_kwargs(args, kwargs), {}, partial=False)
+        return arg_s, out_s, aux_s
+
+    def infer_shape_partial(self, *args, **kwargs):
+        arg_s, out_s, aux_s, _, _, _ = self._infer(
+            self._shape_kwargs(args, kwargs), {}, partial=True)
+        return arg_s, out_s, aux_s
+
+    def infer_type(self, *args, **kwargs):
+        dtypes = {}
+        if args:
+            for name, t in zip(self.list_arguments(), args):
+                if t is not None:
+                    dtypes[name] = _np.dtype(t)
+        for k, v in kwargs.items():
+            dtypes[k] = _np.dtype(v)
+        _, _, _, arg_t, out_t, aux_t = self._infer({}, dtypes, partial=True)
+        return arg_t, out_t, aux_t
+
+    def _shape_kwargs(self, args, kwargs) -> Dict[str, Tuple[int, ...]]:
+        shapes: Dict[str, Tuple[int, ...]] = {}
+        if args:
+            for name, s in zip(self.list_arguments(), args):
+                if s is not None:
+                    shapes[name] = tuple(s)
+        for k, v in kwargs.items():
+            shapes[k] = tuple(v)
+        return shapes
+
+    def _infer(self, shapes: Dict[str, Tuple[int, ...]],
+               dtypes: Dict[str, Any], partial: bool):
+        """Single forward sweep; returns (arg_shapes, out_shapes, aux_shapes,
+        arg_dtypes, out_dtypes, aux_dtypes) aligned with list_arguments /
+        list_outputs / list_auxiliary_states."""
+        topo = self._topo()
+        info: Dict[int, Optional[List[Tuple[Tuple[int, ...], Any]]]] = {}
+
+        def var_info(node: _Node):
+            shape = shapes.get(node.name)
+            if shape is None and "__shape__" in node.attrs:
+                shape = ast.literal_eval(node.attrs["__shape__"])
+            dt = dtypes.get(node.name)
+            if dt is None and "__dtype__" in node.attrs:
+                dt = _np.dtype(node.attrs["__dtype__"])
+            if dt is None:
+                dt = _np.dtype("uint32" if node.is_rng() else default_dtype())
+            if node.is_rng() and shape is None:
+                shape = (2,)
+            return shape, dt
+
+        for node in topo:
+            if node.kind == "var":
+                s, d = var_info(node)
+                info[id(node)] = [(tuple(s) if s is not None else None, d)]
+                continue
+            # try weight rules for unknown var inputs
+            rule = _WEIGHT_RULES.get(node.op.name)
+            argnames = _op_arg_names(node.op)
+            in_info = []
+            by_name = {}
+            for i, (inp, oi) in enumerate(node.inputs):
+                cell = info.get(id(inp))
+                sh = cell[oi][0] if cell and cell[oi] else None
+                nm = argnames[i] if i < len(argnames) else f"arg{i}"
+                by_name[nm] = sh
+            if rule is not None:
+                try:
+                    fills = rule(node.params, by_name)
+                except Exception:
+                    fills = {}
+                for i, (inp, oi) in enumerate(node.inputs):
+                    nm = argnames[i] if i < len(argnames) else f"arg{i}"
+                    if inp.kind == "var" and by_name.get(nm) is None \
+                            and nm in fills:
+                        cell = info[id(inp)]
+                        dt = cell[oi][1]
+                        info[id(inp)] = [(tuple(fills[nm]), dt)]
+                        by_name[nm] = tuple(fills[nm])
+            unknown = False
+            structs = []
+            for i, (inp, oi) in enumerate(node.inputs):
+                cell = info[id(inp)]
+                sh, dt = cell[oi]
+                if sh is None:
+                    unknown = True
+                    break
+                structs.append(jax.ShapeDtypeStruct(sh, dt))
+            if unknown:
+                if not partial:
+                    raise MXNetError(
+                        f"infer_shape: cannot infer input shapes of node "
+                        f"'{node.name}' (op {node.op.name}); provide shapes "
+                        f"for its variables")
+                info[id(node)] = [(None, _np.dtype(default_dtype()))] * \
+                    max(node.num_outputs or 1, 1)
+                continue
+            params = _resolved_params(node)
+            try:
+                out = jax.eval_shape(node.op.unbound(params), *structs)
+            except Exception as e:  # noqa: BLE001
+                raise MXNetError(
+                    f"infer_shape failed at node '{node.name}' "
+                    f"(op {node.op.name}): {e}") from None
+            outs = out if isinstance(out, tuple) else (out,)
+            node.num_outputs = len(outs)
+            info[id(node)] = [(tuple(o.shape), _np.dtype(o.dtype)) for o in outs]
+
+        def collect(names_nodes):
+            sh, dt = [], []
+            for n in names_nodes:
+                cell = info.get(id(n))
+                s, d = cell[0] if cell else (None, None)
+                sh.append(s)
+                dt.append(d)
+            return sh, dt
+
+        arg_nodes = [n for n in topo if n.kind == "var" and not n.is_aux()
+                     and not n.is_rng()]
+        aux_nodes = [n for n in topo if n.kind == "var" and n.is_aux()]
+        arg_s, arg_t = collect(arg_nodes)
+        aux_s, aux_t = collect(aux_nodes)
+        out_s, out_t = [], []
+        for node, idx in self._heads:
+            cell = info.get(id(node))
+            s, d = cell[idx] if cell and idx < len(cell) else (None, None)
+            out_s.append(s)
+            out_t.append(d)
+        return arg_s, out_s, aux_s, arg_t, out_t, aux_t
+
+    # -- serialization -------------------------------------------------------
+    def tojson(self) -> str:
+        topo = self._topo()
+        nid = {id(n): i for i, n in enumerate(topo)}
+        nodes = []
+        for n in topo:
+            if n.kind == "var":
+                entry = {"op": "null", "name": n.name, "inputs": []}
+                if n.attrs:
+                    entry["attrs"] = dict(n.attrs)
+            else:
+                entry = {
+                    "op": n.op.name,
+                    "name": n.name,
+                    "attrs": {k: _attr_str(v) for k, v in n.params.items()
+                              if v is not None},
+                    "inputs": [[nid[id(i)], oi, 0] for i, oi in n.inputs],
+                }
+                if n.attrs:
+                    entry["attrs"].update(n.attrs)
+            nodes.append(entry)
+        return json.dumps({
+            "nodes": nodes,
+            "arg_nodes": [i for i, n in enumerate(topo) if n.kind == "var"],
+            "heads": [[nid[id(n)], oi, 0] for n, oi in self._heads],
+            "attrs": {"mxnet_version": ["int", 20000]},
+        }, indent=2)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- composition helpers -------------------------------------------------
+    def __copy__(self):
+        return Symbol(list(self._heads))
+
+    def __deepcopy__(self, memo):
+        # graph nodes are immutable-after-construction; sharing is fine
+        return Symbol(list(self._heads))
+
+    # -- binding / eval (implemented in executor.py, attached below) ---------
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+        return Executor._bind(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        from .executor import Executor
+        return Executor._simple_bind(self, ctx, grad_req, type_dict, kwargs)
+
+    def eval(self, ctx=None, **kwargs):
+        from .executor import Executor
+        args = {k: v for k, v in kwargs.items()}
+        ex = Executor._bind(self, ctx, args, None, "null", None)
+        return ex.forward(is_train=False)
+
+    # hybrid-friendly: calling a symbol on other symbols re-binds its free
+    # variables (reference Symbol.__call__ composition)
+    def __call__(self, *args, **kwargs):
+        mapping: Dict[str, Symbol] = {}
+        names = self.list_arguments()
+        for n, s in zip(names, args):
+            mapping[n] = s
+        mapping.update(kwargs)
+        for v in mapping.values():
+            if not isinstance(v, Symbol):
+                raise TypeError("Symbol composition requires Symbols")
+        return self._substitute(mapping)
+
+    def _substitute(self, mapping: Dict[str, "Symbol"]) -> "Symbol":
+        memo: Dict[int, _Node] = {}
+
+        def clone(node: _Node) -> _Node:
+            if id(node) in memo:
+                return memo[id(node)]
+            if node.kind == "var":
+                if node.name in mapping:
+                    rep, ridx = mapping[node.name]._heads[0]
+                    if ridx != 0:
+                        raise MXNetError("cannot substitute multi-output head")
+                    memo[id(node)] = rep
+                    return rep
+                memo[id(node)] = node
+                return node
+            new = _Node("op", node.name, node.op, dict(node.params),
+                        [(clone(i), oi) for i, oi in node.inputs],
+                        dict(node.attrs))
+            new.num_outputs = node.num_outputs
+            memo[id(node)] = new
+            return new
+
+        return Symbol([(clone(n), oi) for n, oi in self._heads])
+
+
+# static output-arity rules for multi-output ops (arity depends only on
+# params, so it is known at composition time — no inference pass needed)
+_NUM_OUTPUT_RULES = {
+    "BatchNorm": lambda p: 3,
+    "moments": lambda p: 2,
+    "SliceChannel": lambda p: int(p.get("num_outputs", 1)),
+    "split_v2": lambda p: (len(p["indices_or_sections"]) + 1
+                           if isinstance(p.get("indices_or_sections"),
+                                         (tuple, list))
+                           else int(p.get("indices_or_sections", 1))),
+    "topk": lambda p: 2 if p.get("ret_typ", "indices") == "both" else 1,
+    "linalg_gelqf": lambda p: 2,
+    "linalg_slogdet": lambda p: 2,
+    "RNN": lambda p: ((3 if p.get("mode") == "lstm" else 2)
+                      if p.get("state_outputs", False) else 1),
+}
+
+
+def _num_outputs(node: _Node) -> int:
+    if node.num_outputs is not None:
+        return node.num_outputs
+    if node.kind == "var" or not node.op.multi_output:
+        node.num_outputs = 1
+        return 1
+    rule = _NUM_OUTPUT_RULES.get(node.op.name)
+    if rule is not None:
+        try:
+            node.num_outputs = int(rule(node.params))
+        except Exception:
+            return 1
+    return node.num_outputs or 1
+
+
+def _attr_str(v) -> str:
+    if isinstance(v, (list, tuple)):
+        return str(tuple(v))
+    return str(v)
+
+
+def _parse_attr(s: str):
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+# ---------------------------------------------------------------------------
+# op arg-name introspection (cached)
+# ---------------------------------------------------------------------------
+
+_ARG_NAMES_CACHE: Dict[str, Tuple[Tuple[str, bool], ...]] = {}
+
+
+def _op_arg_spec(op: Op) -> Tuple[Tuple[str, bool], ...]:
+    """[(argname, required)] for the op's array inputs, from its signature."""
+    import inspect
+    cached = _ARG_NAMES_CACHE.get(op.name)
+    if cached is not None:
+        return cached
+    spec = []
+    try:
+        sig = inspect.signature(op.fn)
+        for p in sig.parameters.values():
+            if p.kind == p.POSITIONAL_OR_KEYWORD:
+                spec.append((p.name, p.default is p.empty))
+            elif p.kind == p.VAR_POSITIONAL:
+                spec.append(("*" + p.name, False))
+            else:
+                break
+    except (TypeError, ValueError):
+        pass
+    out = tuple(spec)
+    _ARG_NAMES_CACHE[op.name] = out
+    return out
+
+
+def _op_arg_names(op: Op) -> List[str]:
+    return [n.lstrip("*") for n, _ in _op_arg_spec(op)]
+
+
+def _op_param_names(op: Op) -> set:
+    import inspect
+    try:
+        sig = inspect.signature(op.fn)
+        return {p.name for p in sig.parameters.values()
+                if p.kind == p.KEYWORD_ONLY}
+    except (TypeError, ValueError):
+        return set()
+
+
+def _resolved_params(node: _Node, training: Optional[bool] = None) -> dict:
+    params = dict(node.params)
+    if training is not None and "training" in _op_param_names(node.op):
+        params["training"] = training
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Variable / Group / op-node construction
+# ---------------------------------------------------------------------------
+
+def Variable(name: str, attr: Optional[dict] = None, shape=None, dtype=None,
+             lr_mult=None, wd_mult=None, init=None, stype=None, **kwargs) -> Symbol:
+    """Create a symbolic variable (reference symbol.py var())."""
+    attrs = {str(k): str(v) for k, v in (attr or {}).items()}
+    if shape is not None:
+        attrs["__shape__"] = str(tuple(shape))
+    if dtype is not None:
+        attrs["__dtype__"] = str(_np.dtype(dtype))
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = str(wd_mult)
+    if init is not None:
+        attrs["__init__"] = init if isinstance(init, str) else \
+            init.__class__.__name__
+    for k, v in kwargs.items():
+        attrs[k] = str(v)
+    return Symbol([(_Node("var", name, attrs=attrs), 0)])
+
+
+var = Variable
+v = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    heads: List[Tuple[_Node, int]] = []
+    for s in symbols:
+        if not isinstance(s, Symbol):
+            raise TypeError("Group expects Symbols")
+        heads.extend(s._heads)
+    return Symbol(heads)
+
+
+def _apply_op(op: Op, *args, name: Optional[str] = None,
+              attr: Optional[dict] = None, **kwargs) -> Symbol:
+    """Create an op node; auto-create variables for absent learnable inputs
+    (the reference does this in the generated symbol functions)."""
+    spec = _op_arg_spec(op)
+    node_name = name or _SymNameManager.fresh(op.name.lower().lstrip("_"))
+    aux_names = _AUX_ARGS.get(op.name, ())
+
+    # collect positional symbol inputs; varargs ops swallow all positionals
+    pos = list(args)
+    inputs: List[Tuple[_Node, int]] = []
+    params: Dict[str, Any] = {}
+
+    def as_head(s, argname):
+        if isinstance(s, Symbol):
+            if len(s._heads) != 1:
+                raise MXNetError(
+                    f"op {op.name} input {argname}: expected single-output "
+                    "symbol")
+            return s._heads[0]
+        raise TypeError(
+            f"op {op.name} input {argname}: expected Symbol, got {type(s)}")
+
+    consumed = set()
+    for i, (argname, required) in enumerate(spec):
+        if argname.startswith("*"):
+            for j, s in enumerate(pos[i:]):
+                inputs.append(as_head(s, f"{argname}[{j}]"))
+            consumed.update(range(i, len(pos)))
+            break
+        val = None
+        if i < len(pos):
+            val = pos[i]
+            consumed.add(i)
+        elif argname in kwargs and isinstance(kwargs[argname], Symbol):
+            val = kwargs.pop(argname)
+        if val is None:
+            # optional input elision: bias under no_bias, absent state_cell…
+            if not required:
+                if argname == "bias" and not kwargs.get("no_bias", False):
+                    pass  # create the bias variable
+                elif argname == "state_cell" and kwargs.get("mode") == "lstm":
+                    pass  # LSTM needs a cell state
+                else:
+                    continue
+            attrs = {}
+            if argname in aux_names:
+                attrs["__aux__"] = "True"
+            if argname == "key":
+                attrs["__rng__"] = "True"
+            vnode = _Node("var", f"{node_name}_{argname}", attrs=attrs)
+            inputs.append((vnode, 0))
+        else:
+            inputs.append(as_head(val, argname))
+    if len(consumed) < len(pos):
+        raise MXNetError(f"op {op.name}: too many positional inputs")
+
+    params.update({k: _coerce_param(v) for k, v in kwargs.items()})
+    attrs = {str(k): str(v) for k, v in (attr or {}).items()}
+    node = _Node("op", node_name, op, params, inputs, attrs)
+    return Symbol([(node, 0)])
+
+
+def _coerce_param(v):
+    if isinstance(v, str):
+        parsed = _parse_attr(v)
+        if parsed is None:
+            return None
+        if isinstance(parsed, (int, float, bool, tuple, list)):
+            return tuple(parsed) if isinstance(parsed, list) else parsed
+        return v
+    if isinstance(v, list):
+        return tuple(v)
+    if isinstance(v, _np.dtype):
+        return str(v)
+    return v
+
+
+# ---------------------------------------------------------------------------
+# JSON load
+# ---------------------------------------------------------------------------
+
+def load_json(json_str: str) -> Symbol:
+    g = json.loads(json_str)
+    nodes: List[_Node] = []
+    for entry in g["nodes"]:
+        attrs = {k: str(v) for k, v in entry.get("attrs", entry.get("param", {})).items()}
+        if entry["op"] == "null":
+            nodes.append(_Node("var", entry["name"], attrs=attrs))
+        else:
+            op = get_op(entry["op"])
+            pnames = _op_param_names(op)
+            params = {k: _coerce_param(v) for k, v in attrs.items()
+                      if k in pnames}
+            extra = {k: v for k, v in attrs.items() if k not in pnames}
+            inputs = [(nodes[i], oi) for i, oi, *_ in entry["inputs"]]
+            nodes.append(_Node("op", entry["name"], op, params, inputs, extra))
+    heads = [(nodes[i], oi) for i, oi, *_ in g["heads"]]
+    return Symbol(heads)
+
+
+fromjson = load_json
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ---------------------------------------------------------------------------
+# Operator overloads & tensor methods on Symbol
+# ---------------------------------------------------------------------------
+
+def _binary(op_name, scalar_op, rscalar_op=None):
+    def fwd(self, other):
+        if isinstance(other, Symbol):
+            return _apply_op(get_op(op_name), self, other)
+        return _apply_op(get_op(scalar_op), self, scalar=float(other))
+
+    def rev(self, other):
+        if rscalar_op is None:
+            return fwd(self, other)
+        return _apply_op(get_op(rscalar_op), self, scalar=float(other))
+
+    return fwd, rev
+
+
+for _name, _ops in {
+    "add": ("elemwise_add", "_plus_scalar", None),
+    "sub": ("elemwise_sub", "_minus_scalar", "_rminus_scalar"),
+    "mul": ("elemwise_mul", "_mul_scalar", None),
+    "truediv": ("elemwise_div", "_div_scalar", "_rdiv_scalar"),
+    "mod": ("_mod", "_mod_scalar", "_rmod_scalar"),
+    "pow": ("_power", "_power_scalar", "_rpower_scalar"),
+}.items():
+    _f, _r = _binary(*_ops)
+    setattr(Symbol, f"__{_name}__", _f)
+    setattr(Symbol, f"__r{_name}__", _r)
+
+for _name, _opn, _sopn in [
+    ("eq", "_equal", "_equal_scalar"),
+    ("ne", "_not_equal", "_not_equal_scalar"),
+    ("gt", "_greater", "_greater_scalar"),
+    ("ge", "_greater_equal", "_greater_equal_scalar"),
+    ("lt", "_lesser", "_lesser_scalar"),
+    ("le", "_lesser_equal", "_lesser_equal_scalar"),
+]:
+    _f, _ = _binary(_opn, _sopn)
+    setattr(Symbol, f"__{_name}__", _f)
+
+Symbol.__neg__ = lambda self: _apply_op(get_op("negative"), self)
+Symbol.__hash__ = lambda self: id(self._heads[0][0]) ^ self._heads[0][1]
+
+
+def _method(op_name):
+    def m(self, *args, **kwargs):
+        return _apply_op(get_op(op_name), self, *args, **kwargs)
+    m.__name__ = op_name
+    return m
+
+
+for _meth, _opn in {
+    "reshape": "Reshape", "transpose": "transpose", "flatten": "Flatten",
+    "sum": "sum", "mean": "mean", "max": "max", "min": "min", "prod": "prod",
+    "abs": "abs", "exp": "exp", "log": "log", "sqrt": "sqrt", "square": "square",
+    "dot": "dot", "astype": "Cast", "cast": "Cast", "slice": "slice",
+    "slice_axis": "slice_axis", "expand_dims": "expand_dims",
+    "squeeze": "squeeze", "clip": "clip", "sigmoid": "sigmoid",
+    "tanh": "tanh", "relu": "relu", "softmax": "softmax",
+    "log_softmax": "log_softmax", "argmax": "argmax", "argmin": "argmin",
+    "take": "take", "tile": "tile", "repeat": "repeat", "norm": "norm",
+    "round": "round", "rsqrt": "rsqrt", "reciprocal": "reciprocal",
+    "one_hot": "one_hot", "broadcast_like": "broadcast_like",
+    "diag": "diag", "topk": "topk", "sort": "sort", "argsort": "argsort",
+    "split": "split",
+}.items():
+    try:
+        get_op(_opn)
+    except MXNetError:
+        continue
+    setattr(Symbol, _meth, _method(_opn))

@@ -1,0 +1,333 @@
+"""Symbol API tests (reference tests/python/unittest/test_symbol.py +
+executor paths of test_executor.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+
+
+def _mlp():
+    data = sym.Variable('data')
+    fc1 = sym.FullyConnected(data, num_hidden=16, name='fc1')
+    act = sym.Activation(fc1, act_type='relu', name='relu1')
+    fc2 = sym.FullyConnected(act, num_hidden=10, name='fc2')
+    return sym.SoftmaxOutput(fc2, name='softmax')
+
+
+def test_compose_and_arguments():
+    out = _mlp()
+    assert out.list_arguments() == [
+        'data', 'fc1_weight', 'fc1_bias', 'fc2_weight', 'fc2_bias',
+        'softmax_label']
+    assert out.list_outputs() == ['softmax_output']
+    assert out.name == 'softmax'
+
+
+def test_infer_shape_mlp():
+    out = _mlp()
+    arg_s, out_s, aux_s = out.infer_shape(data=(4, 20))
+    assert arg_s == [(4, 20), (16, 20), (16,), (10, 16), (10,), (4,)]
+    assert out_s == [(4, 10)]
+    assert aux_s == []
+
+
+def test_infer_shape_conv_bn():
+    x = sym.Variable('data')
+    c = sym.Convolution(x, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name='conv0')
+    b = sym.BatchNorm(c, name='bn0')
+    p = sym.Pooling(b, kernel=(2, 2), stride=(2, 2), pool_type='max')
+    arg_s, out_s, aux_s = p.infer_shape(data=(2, 3, 8, 8))
+    assert arg_s[1] == (8, 3, 3, 3)          # conv weight OIHW
+    assert aux_s == [(8,), (8,)]             # moving mean/var
+    assert out_s == [(2, 8, 4, 4)]
+
+
+def test_infer_type():
+    out = _mlp()
+    arg_t, out_t, _ = out.infer_type(data=np.float32)
+    assert all(t == np.float32 for t in arg_t)
+    assert out_t == [np.float32]
+
+
+def test_infer_shape_partial():
+    out = _mlp()
+    arg_s, out_s, _ = out.infer_shape_partial()
+    assert arg_s[0] is None and out_s[0] is None
+
+
+def test_infer_shape_raises_when_underdetermined():
+    out = _mlp()
+    with pytest.raises(mx.MXNetError):
+        out.infer_shape()
+
+
+def test_simple_bind_forward_backward_matches_autograd():
+    out = _mlp()
+    ex = out.simple_bind(ctx=mx.cpu(), data=(4, 20))
+    rng = np.random.RandomState(7)
+    w1 = rng.uniform(-0.1, 0.1, (16, 20)).astype(np.float32)
+    w2 = rng.uniform(-0.1, 0.1, (10, 16)).astype(np.float32)
+    x = rng.uniform(-1, 1, (4, 20)).astype(np.float32)
+    y = np.array([1, 3, 5, 7], dtype=np.float32)
+    ex.arg_dict['fc1_weight']._set_data(w1)
+    ex.arg_dict['fc2_weight']._set_data(w2)
+    outs = ex.forward(is_train=True, data=x, softmax_label=y)
+    ex.backward()
+
+    # same computation via the imperative API + autograd
+    xa = nd.array(x); w1a = nd.array(w1); w2a = nd.array(w2)
+    for a in (xa, w1a, w2a):
+        a.attach_grad()
+    with mx.autograd.record():
+        h = nd.relu(nd.FullyConnected(xa, w1a, nd.zeros((16,)), num_hidden=16))
+        logits = nd.FullyConnected(h, w2a, nd.zeros((10,)), num_hidden=10)
+        probs = nd.softmax(logits)
+        # SoftmaxOutput grad = softmax - onehot (normalization='null');
+        # replicate via an unnormalized CE loss
+        onehot = nd.one_hot(nd.array(y), depth=10)
+        loss = -(nd.log(probs + 1e-12) * onehot).sum()
+    loss.backward()
+    np.testing.assert_allclose(outs[0].asnumpy(), probs.asnumpy(), rtol=1e-5)
+    np.testing.assert_allclose(ex.grad_dict['fc1_weight'].asnumpy(),
+                               w1a.grad.asnumpy(), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(ex.grad_dict['fc2_weight'].asnumpy(),
+                               w2a.grad.asnumpy(), rtol=1e-4, atol=1e-6)
+
+
+def test_batchnorm_aux_update():
+    x = sym.Variable('data')
+    c = sym.Convolution(x, kernel=(3, 3), num_filter=4, pad=(1, 1), name='c0')
+    b = sym.BatchNorm(c, name='bn0')
+    ex = b.simple_bind(ctx=mx.cpu(), data=(2, 3, 8, 8))
+    ex.arg_dict['c0_weight']._set_data(
+        np.random.rand(4, 3, 3, 3).astype(np.float32))
+    before = ex.aux_dict['bn0_moving_mean'].asnumpy().copy()
+    ex.forward(is_train=True,
+               data=np.random.rand(2, 3, 8, 8).astype(np.float32) + 1.0)
+    after = ex.aux_dict['bn0_moving_mean'].asnumpy()
+    assert not np.allclose(before, after)
+    # inference mode must NOT touch aux
+    snap = after.copy()
+    ex.forward(is_train=False,
+               data=np.random.rand(2, 3, 8, 8).astype(np.float32))
+    np.testing.assert_allclose(snap, ex.aux_dict['bn0_moving_mean'].asnumpy())
+
+
+def test_grad_req_add_and_null():
+    x = sym.Variable('x')
+    y = (x * 2.0).sum()
+    ex = y.bind(ctx=mx.cpu(), args={'x': nd.array([1.0, 2.0])},
+                grad_req='add')
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict['x'].asnumpy(), [4.0, 4.0])
+
+    ex2 = y.bind(ctx=mx.cpu(), args={'x': nd.array([1.0, 2.0])},
+                 grad_req='null')
+    ex2.forward(is_train=True)
+    ex2.backward()   # no-op
+    assert ex2.grad_dict.get('x') is None
+
+
+def test_json_round_trip():
+    out = _mlp()
+    js = out.tojson()
+    back = sym.load_json(js)
+    assert back.list_arguments() == out.list_arguments()
+    a1, o1, _ = out.infer_shape(data=(4, 20))
+    a2, o2, _ = back.infer_shape(data=(4, 20))
+    assert a1 == a2 and o1 == o2
+    # param fidelity: num_hidden survives
+    ex = back.simple_bind(ctx=mx.cpu(), data=(2, 20))
+    assert ex.arg_dict['fc2_weight'].shape == (10, 16)
+
+
+def test_save_load_file(tmp_path):
+    out = _mlp()
+    f = str(tmp_path / "net.json")
+    out.save(f)
+    back = sym.load(f)
+    assert back.list_outputs() == out.list_outputs()
+
+
+def test_group_and_getitem():
+    d = sym.Variable('d')
+    a = (d * 2.0)
+    b = (d + 1.0)
+    g = sym.Group([a, b])
+    assert len(g.list_outputs()) == 2
+    outs = g.eval(d=nd.array([3.0]))
+    np.testing.assert_allclose(outs[0].asnumpy(), [6.0])
+    np.testing.assert_allclose(outs[1].asnumpy(), [4.0])
+    first = g[0]
+    np.testing.assert_allclose(first.eval(d=nd.array([3.0]))[0].asnumpy(),
+                               [6.0])
+
+
+def test_multi_output_indexing():
+    x = sym.Variable('x')
+    b = sym.BatchNorm(x, name='bn')
+    mean_out = b[1]
+    assert mean_out.list_outputs() == ['bn_output1']
+    s = sym.SliceChannel(x, num_outputs=3, axis=1, name='sc')
+    assert len(s[2].list_outputs()) == 1
+
+
+def test_get_internals():
+    out = _mlp()
+    ints = out.get_internals()
+    names = ints.list_outputs()
+    assert 'relu1_output' in names
+    feat = ints['relu1_output']
+    arg_s, out_s, _ = feat.infer_shape(data=(4, 20))
+    assert out_s == [(4, 16)]
+
+
+def test_symbol_composition_call():
+    x = sym.Variable('x')
+    net = sym.FullyConnected(x, num_hidden=4, name='fc')
+    z = sym.Variable('z')
+    composed = net(x=z * 2.0)
+    assert 'z' in composed.list_arguments()
+    assert 'x' not in composed.list_arguments()
+
+
+def test_scalar_overloads_eval():
+    a = sym.Variable('a')
+    s = (a * 2.0 + 1.0) ** 2 - a / 2.0
+    r = s.eval(a=nd.array([2.0]))[0].asnumpy()
+    np.testing.assert_allclose(r, [(2 * 2 + 1) ** 2 - 1.0])
+    cmp = (a > 1.5).eval(a=nd.array([1.0, 2.0]))[0].asnumpy()
+    np.testing.assert_allclose(cmp, [0.0, 1.0])
+
+
+def test_init_ops():
+    z = sym.zeros((2, 3))
+    o = sym.ones((2, 3)) * 5.0
+    r = sym.Group([z, o]).eval()
+    assert r[0].shape == (2, 3)
+    np.testing.assert_allclose(r[1].asnumpy(), np.full((2, 3), 5.0))
+    ar = sym.arange(0, 6, 1.0).eval()[0].asnumpy()
+    np.testing.assert_allclose(ar, np.arange(6, dtype=np.float32))
+
+
+def test_regression_outputs():
+    d = sym.Variable('data')
+    lro = sym.LinearRegressionOutput(d, name='lro')
+    ex = lro.simple_bind(ctx=mx.cpu(), data=(3, 2))
+    pred = np.array([[1., 2.], [3., 4.], [5., 6.]], dtype=np.float32)
+    label = np.zeros((3, 2), dtype=np.float32)
+    out = ex.forward(is_train=True, data=pred, lro_label=label)
+    np.testing.assert_allclose(out[0].asnumpy(), pred)
+    ex.backward()
+    # reference semantics: grad = (pred - label) * grad_scale / num_output
+    np.testing.assert_allclose(ex.grad_dict['data'].asnumpy(), (pred - label) / 2.0,
+                               rtol=1e-6)
+
+
+def test_executor_reshape():
+    out = _mlp()
+    ex = out.simple_bind(ctx=mx.cpu(), data=(4, 20))
+    ex2 = ex.reshape(data=(8, 20))
+    assert ex2.arg_dict['data'].shape == (8, 20)
+    # weights are shared (same NDArray objects)
+    assert ex2.arg_dict['fc1_weight'] is ex.arg_dict['fc1_weight']
+    outs = ex2.forward(is_train=False,
+                       data=np.zeros((8, 20), dtype=np.float32))
+    assert outs[0].shape == (8, 10)
+
+
+def test_rnn_symbol_infer():
+    d = sym.Variable('seq')
+    r = sym.RNN(d, state_size=8, num_layers=1, mode='lstm',
+                state_outputs=False, name='lstm0')
+    arg_s, out_s, _ = r.infer_shape(seq=(5, 2, 4))   # (T, N, C)
+    assert out_s == [(5, 2, 8)]
+
+
+def test_attr_and_var_shape():
+    a = sym.Variable('a', shape=(2, 2), lr_mult=2.0)
+    assert a.attr('__lr_mult__') == '2.0'
+    s = a * 1.0
+    arg_s, out_s, _ = s.infer_shape()
+    assert out_s == [(2, 2)]
+
+
+def test_dropout_backward_uses_forward_mask():
+    x = sym.Variable('x')
+    d = sym.Dropout(x, p=0.5, name='drop')
+    ex = d.bind(ctx=mx.cpu(), args={'x': nd.ones((64, 64))}, grad_req='write')
+    out = ex.forward(is_train=True)[0].asnumpy()
+    ex.backward()
+    g = ex.grad_dict['x'].asnumpy()
+    # the same elements must be kept in forward and backward
+    np.testing.assert_array_equal(out != 0, g != 0)
+
+
+def test_json_round_trip_preserves_user_attrs():
+    a = sym.Variable('a')
+    b = sym.FullyConnected(a, num_hidden=4, name='fc',
+                           attr={'ctx_group': 'dev1'})
+    back = sym.load_json(b.tojson())
+    assert back.attr('ctx_group') == 'dev1'
+
+
+def test_getitem_invalid_index_raises():
+    x = sym.Variable('x')
+    b = sym.BatchNorm(x, name='bn')
+    with pytest.raises(mx.MXNetError):
+        b[-1]
+    with pytest.raises(mx.MXNetError):
+        b[3]
+
+
+def test_reshape_fresh_grads():
+    out = _mlp()
+    ex = out.simple_bind(ctx=mx.cpu(), grad_req='write', data=(4, 20))
+    ex2 = ex.reshape(data=(8, 20))
+    assert ex2.grad_dict['data'].shape == (8, 20)
+    assert ex.grad_dict['data'].shape == (4, 20)
+
+
+def test_none_param_json_round_trip():
+    z = sym.zeros((2, 3))
+    r = sym.load_json(z.tojson()).eval()
+    assert r[0].shape == (2, 3)
+
+
+def test_backward_key_survives_eval_forward():
+    x = sym.Variable('x')
+    d = sym.Dropout(x, p=0.5, name='drop')
+    ex = d.bind(ctx=mx.cpu(), args={'x': nd.ones((64, 64))}, grad_req='write')
+    out = ex.forward(is_train=True)[0].asnumpy()
+    ex.forward(is_train=False)          # validation pass must not disturb
+    ex.backward()
+    g = ex.grad_dict['x'].asnumpy()
+    np.testing.assert_array_equal(out != 0, g != 0)
+
+
+def test_indexed_symbol_reindex():
+    x = sym.Variable('x')
+    m = sym.BatchNorm(x, name='bn')[1]
+    assert m.list_outputs() == ['bn_output1']
+    assert m['bn_output1'].list_outputs() == ['bn_output1']
+    assert m[0].list_outputs() == ['bn_output1']
+
+
+def test_var_named_key_is_not_uint32():
+    s = sym.Variable('sort_key') * 1.0
+    _, out_t, _ = s.infer_type(sort_key=np.float32)
+    assert out_t == [np.float32]
+    _, out_t2, _ = s.infer_type()
+    assert out_t2 == [np.float32]
+
+
+def test_duplicate_var_names_rejected():
+    a = sym.Variable('x')
+    b = sym.Variable('x')
+    s = a * 1.0 + b * 1.0
+    with pytest.raises(mx.MXNetError):
+        s.bind(ctx=mx.cpu(), args={'x': nd.array([1.0])})
