@@ -55,7 +55,7 @@ def main():
     trainer = DataParallelTrainer(
         net, loss_fn, optimizer="sgd",
         optimizer_params={"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4},
-        mesh=mesh)
+        mesh=mesh, dtype=os.environ.get("BENCH_DTYPE", "bfloat16"))
 
     rng = np.random.RandomState(0)
     x = nd.array(rng.uniform(-1, 1, size=(BATCH, 3, IMAGE, IMAGE)).astype(np.float32))

@@ -45,6 +45,7 @@ from . import util
 from . import test_utils
 from . import image
 from . import recordio
+from . import contrib
 
 from .util import is_np_shape, is_np_array, set_np, reset_np
 

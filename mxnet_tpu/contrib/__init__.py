@@ -1,0 +1,7 @@
+"""Contrib namespace — AMP, quantization, ONNX-ish export, extras.
+
+Mirrors the capability surface of reference python/mxnet/contrib/ (AMP,
+quantization, tensorrt, onnx, text, …) with TPU-native mechanisms.
+"""
+from . import amp
+from . import quantization

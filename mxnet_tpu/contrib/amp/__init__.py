@@ -1,0 +1,13 @@
+"""Automatic Mixed Precision (reference python/mxnet/contrib/amp/amp.py).
+
+TPU-native AMP: the reference patches op namespaces to insert amp_cast nodes
+(contrib/amp/amp.py convert_symbol:354); on TPU we instead run the fused
+training step in bfloat16 with fp32 master weights (the MXU's native mode),
+so `init()` just records the target dtype which trainers consult, and the
+dynamic `LossScaler` is only engaged for float16 (bf16's fp32-sized exponent
+makes scaling unnecessary — a capability uplift over GPU fp16 AMP).
+"""
+from .amp import (init, init_trainer, scale_loss, unscale, convert_hybrid_block,
+                  convert_model, amp_cast, amp_multicast, is_enabled,
+                  target_dtype, list_lp16_ops, list_fp32_ops)
+from .loss_scaler import LossScaler

@@ -1,0 +1,152 @@
+"""AMP front-door (reference python/mxnet/contrib/amp/amp.py).
+
+API parity: init / init_trainer / scale_loss / unscale / convert_model /
+convert_hybrid_block. Mechanism is TPU-native (see package docstring).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...ndarray import NDArray
+from .loss_scaler import LossScaler
+
+_state = {"on": False, "dtype": None}
+
+# op families the reference forces to fp32 (contrib/amp/lists/symbol.py
+# FP32_FUNCS) — normalization/softmax/losses; on TPU these already compute
+# internally in f32 (ops/nn.py), so the lists are informational.
+_FP32_OPS = ["BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm", "L2Normalization",
+             "softmax", "log_softmax", "SoftmaxOutput", "softmax_cross_entropy",
+             "LinearRegressionOutput", "LogisticRegressionOutput", "MAERegressionOutput",
+             "mean", "norm", "CTCLoss", "exp", "log", "erfinv"]
+_LP16_OPS = ["Convolution", "Deconvolution", "FullyConnected", "RNN",
+             "_contrib_interleaved_matmul_selfatt_qk",
+             "_contrib_interleaved_matmul_selfatt_valatt",
+             "_contrib_interleaved_matmul_encdec_qk",
+             "_contrib_interleaved_matmul_encdec_valatt"]
+
+
+def list_lp16_ops(target_dtype="bfloat16") -> List[str]:
+    return list(_LP16_OPS)
+
+
+def list_fp32_ops(target_dtype="bfloat16") -> List[str]:
+    return list(_FP32_OPS)
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP globally (reference amp.init:104). After this, trainers
+    built without an explicit dtype run their fused step in target_dtype."""
+    dt = jnp.dtype(target_dtype)
+    if dt not in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
+        raise MXNetError("AMP target_dtype must be bfloat16 or float16")
+    _state["on"] = True
+    _state["dtype"] = str(dt)
+
+
+def is_enabled() -> bool:
+    return _state["on"]
+
+
+def target_dtype() -> Optional[str]:
+    return _state["dtype"] if _state["on"] else None
+
+
+def init_trainer(trainer):
+    """Attach a dynamic LossScaler to a gluon Trainer (amp.init_trainer:288).
+    For bfloat16 the scaler stays at 1.0 (scaling is a no-op by design)."""
+    if not _state["on"]:
+        raise MXNetError("call amp.init() before amp.init_trainer()")
+    scaler = LossScaler(init_scale=1.0 if _state["dtype"] == "bfloat16"
+                        else 2.0 ** 16)
+    trainer._amp_loss_scaler = scaler
+    trainer._amp_original_scale = getattr(trainer, "_scale", 1.0)
+    return trainer
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """with amp.scale_loss(loss, trainer) as l: l.backward()  (amp.py:214)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    if hasattr(trainer, "_scale"):
+        trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield type(loss)(l * scaler.loss_scale for l in loss)
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    """Divide accumulated grads by the current loss scale (amp.unscale:550)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None or scaler.loss_scale == 1.0:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req != "null" and p._grad is not None:
+            g = p._grad
+            g._set_data(g._data * inv)
+
+
+def amp_cast(x, dtype="bfloat16"):
+    """Insert-cast op (reference amp_cast registered in src/operator/tensor/
+    amp_cast.cc) — eager NDArray/raw cast that never upcasts fp32 params."""
+    raw = x._data if isinstance(x, NDArray) else x
+    out = raw.astype(jnp.dtype(dtype))
+    return NDArray(out) if isinstance(x, NDArray) else out
+
+
+def amp_multicast(*arrays, num_outputs=None):
+    """Cast a list to their widest floating dtype (amp_multicast.cc)."""
+    raws = [a._data if isinstance(a, NDArray) else a for a in arrays]
+    wide = jnp.result_type(*[r.dtype for r in raws])
+    outs = [r.astype(wide) for r in raws]
+    return [NDArray(o) if isinstance(a, NDArray) else o
+            for a, o in zip(arrays, outs)]
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", cast_optional_params=False):
+    """Cast a HybridBlock's parameters for low-precision inference
+    (reference amp.convert_hybrid_block:602). Normalization params stay f32
+    (their compute is f32 regardless; keeping them f32 preserves accuracy)."""
+    dt = jnp.dtype(target_dtype)
+    keep_f32 = ("gamma", "beta", "moving_mean", "moving_var",
+                "running_mean", "running_var")
+    for name, p in block.collect_params().items():
+        if p._data is None:
+            continue
+        if any(name.endswith(k) for k in keep_f32):
+            continue
+        raw = p._data._data
+        if jnp.issubdtype(raw.dtype, jnp.floating):
+            p._data._set_data(raw.astype(dt))
+            p.dtype = str(dt)
+    return block
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  target_dtype_ops=None, fp32_ops=None, conditional_fp32_ops=None,
+                  excluded_sym_names=None, cast_optional_params=False):
+    """Symbol-API variant (reference amp.convert_model:509): returns the same
+    symbol plus params cast to target_dtype (XLA re-fuses casts at jit time,
+    so no graph rewrite is needed — the cast IS the graph change)."""
+    dt = jnp.dtype(target_dtype)
+    excluded = set(excluded_sym_names or ())
+
+    def _cast(d):
+        out = {}
+        for k, v in d.items():
+            raw = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            if k not in excluded and jnp.issubdtype(raw.dtype, jnp.floating):
+                raw = raw.astype(dt)
+            out[k] = NDArray(raw)
+        return out
+    return sym, _cast(arg_params), _cast(aux_params)

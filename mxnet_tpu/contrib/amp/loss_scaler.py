@@ -1,0 +1,53 @@
+"""Dynamic loss scaler (reference python/mxnet/contrib/amp/loss_scaler.py).
+
+Doubles the scale every `scale_window` overflow-free steps, halves it on
+overflow and skips the update — identical policy to the reference; the
+overflow check is a jitted all-finite reduction over the grad list (the
+reference's multi_all_finite kernel, contrib/amp's LossScaler.has_overflow).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0, scale_window=2000,
+                 tolerance=0.0):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = float(scale_factor)
+        self._scale_window = int(scale_window)
+        self._unskipped = 0
+
+    @staticmethod
+    @jax.jit
+    def _all_finite(flats):
+        ok = jnp.bool_(True)
+        for f in flats:
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(f.astype(jnp.float32))))
+        return ok
+
+    def has_overflow(self, params_or_grads):
+        """True if any grad is inf/nan. Accepts NDArrays or raw arrays."""
+        flats = []
+        for g in params_or_grads:
+            raw = getattr(g, "_data", g)
+            if raw is None:
+                continue
+            raw = getattr(raw, "_data", raw)
+            if jnp.issubdtype(raw.dtype, jnp.floating):
+                flats.append(raw.reshape(-1))
+        if not flats:
+            return False
+        return not bool(self._all_finite(flats))
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0
+        return not overflow

@@ -1,0 +1,215 @@
+"""INT8 quantization path (reference src/operator/quantization/ +
+python/mxnet/contrib/quantization.py).
+
+TPU-native mechanism: symmetric int8 quantization with f32 scales; quantized
+matmul/conv run as int8×int8→int32 dots (the MXU's int8 mode) followed by a
+rescale — the analog of the reference's quantized_conv/quantized_fully_connected
+ops. Calibration mirrors the reference's minmax and KL-entropy modes
+(quantization.py _calibrate_quantized_sym:142).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ops.registry import register
+
+
+def _raw(x):
+    return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# Core quantize/dequantize/requantize ops (reference quantize.cc,
+# dequantize.cc, requantize.cc)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_quantize", multi_output=True)
+def quantize(data, min_range, max_range, *, out_type="int8"):
+    """Affine/symmetric quantize: f32 -> int8 with recorded range."""
+    if out_type not in ("int8", "uint8"):
+        raise MXNetError("out_type must be int8/uint8")
+    lo = jnp.minimum(min_range, 0.0)
+    hi = jnp.maximum(max_range, 0.0)
+    if out_type == "int8":
+        amax = jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+        scale = 127.0 / jnp.maximum(amax, 1e-30)
+        q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+        return q, -amax, amax
+    scale = 255.0 / jnp.maximum(hi - lo, 1e-30)
+    q = jnp.clip(jnp.round((data - lo) * scale), 0, 255).astype(jnp.uint8)
+    return q, lo, hi
+
+
+@register("_contrib_quantize_v2", multi_output=True)
+def quantize_v2(data, *, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    if min_calib_range is None or max_calib_range is None:
+        lo, hi = jnp.min(data), jnp.max(data)
+    else:
+        lo, hi = jnp.float32(min_calib_range), jnp.float32(max_calib_range)
+    return quantize(data, lo, hi, out_type=out_type)
+
+
+@register("_contrib_dequantize")
+def dequantize(data, min_range, max_range, *, out_type="float32"):
+    if data.dtype == jnp.int8:
+        amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+        return data.astype(jnp.float32) * (amax / 127.0)
+    scale = (max_range - min_range) / 255.0
+    return data.astype(jnp.float32) * scale + min_range
+
+
+@register("_contrib_requantize", multi_output=True)
+def requantize(data, min_range, max_range, *, out_type="int8",
+               min_calib_range=None, max_calib_range=None):
+    """int32 accumulator -> int8 with a new scale."""
+    real = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / (127.0 * 127.0))
+    if min_calib_range is not None:
+        lo, hi = jnp.float32(min_calib_range), jnp.float32(max_calib_range)
+    else:
+        lo, hi = jnp.min(real), jnp.max(real)
+    return quantize(real, lo, hi, out_type=out_type)
+
+
+# ---------------------------------------------------------------------------
+# Quantized kernels: int8 × int8 → int32 on the MXU
+# ---------------------------------------------------------------------------
+
+def quantized_matmul(x_q, w_q, x_scale, w_scale):
+    """int8 matmul with int32 accumulation, rescaled to f32."""
+    acc = lax.dot_general(
+        x_q, w_q, (((x_q.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) / (x_scale * w_scale)
+
+
+def quantized_conv2d(x_q, w_q, x_scale, w_scale, stride, padding):
+    dn = lax.conv_dimension_numbers(x_q.shape, w_q.shape, ("NCHW", "OIHW", "NCHW"))
+    acc = lax.conv_general_dilated(
+        x_q.astype(jnp.int8), w_q.astype(jnp.int8), window_strides=stride,
+        padding=padding, dimension_numbers=dn,
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) / (x_scale * w_scale)
+
+
+# ---------------------------------------------------------------------------
+# Calibration (reference quantization.py:142 _LayerOutputCollector /
+# _LayerOutputMinMaxCollector + KL divergence _get_optimal_threshold:293)
+# ---------------------------------------------------------------------------
+
+class LayerOutputMinMaxCollector:
+    def __init__(self):
+        self.min_max: Dict[str, Tuple[float, float]] = {}
+
+    def collect(self, name: str, arr):
+        raw = _np.asarray(_raw(arr))
+        lo, hi = float(raw.min()), float(raw.max())
+        if name in self.min_max:
+            plo, phi = self.min_max[name]
+            lo, hi = min(lo, plo), max(hi, phi)
+        self.min_max[name] = (lo, hi)
+
+
+def _get_optimal_threshold(hist, hist_edges, num_quantized_bins=255):
+    """KL-divergence calibration (reference _get_optimal_threshold:293)."""
+    num_bins = len(hist)
+    assert num_bins >= num_quantized_bins
+    zero_bin = num_bins // 2
+    thresholds = []
+    divergences = []
+    for i in range(num_quantized_bins // 2, zero_bin + 1, 2):
+        p_start, p_stop = zero_bin - i, zero_bin + i
+        sliced = hist[p_start:p_stop].astype(_np.float64)
+        p = sliced.copy()
+        p[0] += hist[:p_start].sum()
+        p[-1] += hist[p_stop:].sum()
+        # quantize p into num_quantized_bins, then expand back
+        factor = len(sliced) / num_quantized_bins
+        q = _np.zeros_like(p)
+        for j in range(num_quantized_bins):
+            lo = int(j * factor)
+            hi = int((j + 1) * factor) if j != num_quantized_bins - 1 else len(sliced)
+            seg = sliced[lo:hi]
+            nz = (seg != 0).sum()
+            if nz:
+                q[lo:hi] = _np.where(seg != 0, seg.sum() / nz, 0)
+        p /= max(p.sum(), 1e-12)
+        q /= max(q.sum(), 1e-12)
+        mask = p > 0
+        kl = float(_np.sum(p[mask] * _np.log(p[mask] / _np.maximum(q[mask], 1e-12))))
+        thresholds.append(float(hist_edges[p_stop]))
+        divergences.append(kl)
+    best = int(_np.argmin(divergences))
+    return thresholds[best]
+
+
+def calib_entropy(samples: _np.ndarray, num_bins=8001) -> Tuple[float, float]:
+    samples = _np.asarray(samples).ravel()
+    amax = float(_np.abs(samples).max()) or 1.0
+    hist, edges = _np.histogram(samples, bins=num_bins, range=(-amax, amax))
+    th = _get_optimal_threshold(hist, edges)
+    return -th, th
+
+
+# ---------------------------------------------------------------------------
+# Model-level driver (reference quantize_model:429)
+# ---------------------------------------------------------------------------
+
+class QuantizedDense:
+    """Int8 inference wrapper for a Dense layer's weight."""
+
+    def __init__(self, weight, bias=None, calib_range=None):
+        w = _np.asarray(_raw(weight), dtype=_np.float32)
+        self.w_amax = float(_np.abs(w).max()) or 1.0
+        self.w_scale = 127.0 / self.w_amax
+        self.w_q = jnp.asarray(_np.clip(_np.round(w * self.w_scale), -127, 127),
+                               dtype=jnp.int8)
+        self.bias = _raw(bias) if bias is not None else None
+        self.calib_range = calib_range
+
+    def __call__(self, x):
+        xr = _raw(x)
+        if self.calib_range is not None:
+            lo, hi = self.calib_range
+            amax = max(abs(lo), abs(hi)) or 1.0
+        else:
+            amax = float(jnp.max(jnp.abs(xr)))
+        x_scale = 127.0 / amax
+        x_q = jnp.clip(jnp.round(xr * x_scale), -127, 127).astype(jnp.int8)
+        out = quantized_matmul(x_q, self.w_q, x_scale, self.w_scale)
+        if self.bias is not None:
+            out = out + self.bias
+        return NDArray(out) if isinstance(x, NDArray) else out
+
+
+def quantize_model(sym=None, arg_params=None, aux_params=None, *,
+                   quantized_dtype="int8", calib_mode="naive", calib_data=None,
+                   num_calib_examples=None, excluded_sym_names=None, ctx=None,
+                   logger=None):
+    """Reference-shaped entry (quantization.py quantize_model:429): returns
+    (sym, arg_params, aux_params) with weights pre-quantized to int8 plus
+    per-tensor scales stored alongside (<name>_scale)."""
+    if quantized_dtype not in ("int8", "uint8", "auto"):
+        raise MXNetError("quantized_dtype must be int8/uint8/auto")
+    excluded = set(excluded_sym_names or ())
+    out_args = {}
+    for k, v in (arg_params or {}).items():
+        raw = _np.asarray(_raw(v))
+        if k in excluded or not _np.issubdtype(raw.dtype, _np.floating) \
+                or k.endswith(("_bias", "_beta", "_gamma")):
+            out_args[k] = NDArray(jnp.asarray(raw))
+            continue
+        amax = float(_np.abs(raw).max()) or 1.0
+        scale = 127.0 / amax
+        q = _np.clip(_np.round(raw * scale), -127, 127).astype(_np.int8)
+        out_args[k] = NDArray(jnp.asarray(q))
+        out_args[k + "_scale"] = NDArray(jnp.float32(scale))
+    return sym, out_args, dict(aux_params or {})

@@ -57,6 +57,17 @@ _CONV_DNUMS = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
                3: ("NCDHW", "OIDHW", "NCDHW")}
 
 
+def _match_conv_dtypes(data, weight):
+    """(data', weight', restore_dtype|None): fp16 → compute f32, round back;
+    mixed data/weight dtypes promote to the wider one, output keeps data's."""
+    if data.dtype == jnp.float16 or weight.dtype == jnp.float16:
+        return data.astype(jnp.float32), weight.astype(jnp.float32), data.dtype
+    if data.dtype != weight.dtype:
+        wide = jnp.result_type(data.dtype, weight.dtype)
+        return data.astype(wide), weight.astype(wide), data.dtype
+    return data, weight, None
+
+
 def _conv_tuples(kernel, stride, dilate, pad):
     nd = len(kernel)
     stride = tuple(stride) if stride else (1,) * nd
@@ -71,13 +82,17 @@ def convolution(data, weight, bias=None, *, kernel, num_filter, stride=None,
     """reference src/operator/nn/convolution.cc:399 — NCHW/OIHW semantics."""
     nd, stride, dilate, padding = _conv_tuples(kernel, stride, dilate, pad)
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DNUMS[nd])
+    # no preferred_element_type here: the MXU accumulates bf16 convs in f32
+    # natively, and an explicit f32 preference breaks the transpose rule
+    # (f32 cotangent vs bf16 weight) under grad-of-bf16. fp16 has no native
+    # MXU mode and a 65504 max, so compute it in f32 and round back.
+    data, weight, lo_dt = _match_conv_dtypes(data, weight)
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride, padding=padding,
         rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=num_group,
-        preferred_element_type=_pref(data))
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
+        feature_group_count=num_group)
+    if lo_dt is not None:
+        out = out.astype(lo_dt)
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
@@ -106,12 +121,13 @@ def deconvolution(data, weight, bias=None, *, kernel, num_filter, stride=None,
     else:
         w = jnp.swapaxes(w, 0, 1)
     dn = lax.conv_dimension_numbers(data.shape, w.shape, _CONV_DNUMS[nd])
+    data, w, lo_dt = _match_conv_dtypes(data, w)
     out = lax.conv_general_dilated(
         data, w, window_strides=(1,) * nd, padding=padding,
         lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=num_group, preferred_element_type=_pref(data))
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
+        feature_group_count=num_group)
+    if lo_dt is not None:
+        out = out.astype(lo_dt)
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out

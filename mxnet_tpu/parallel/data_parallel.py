@@ -201,6 +201,29 @@ class DataParallelTrainer:
                  optimizer_params=None, mesh: Optional[Mesh] = None,
                  batch_axis_name: str = "dp", dtype=None, data_spec=None):
         self.net = net
+        # Mixed precision: dtype="bfloat16" (or "float16") runs forward/backward
+        # in low precision with fp32 master weights + fp32 optimizer math —
+        # the TPU-native analog of reference AMP (python/mxnet/contrib/amp/).
+        self.compute_dtype = None
+        if dtype is None:
+            # amp.init() makes low-precision the session default
+            try:
+                from ..contrib.amp import amp as _amp
+                dtype = _amp.target_dtype()
+            except ImportError:
+                pass
+        if dtype is not None and jnp.dtype(dtype) != jnp.dtype(jnp.float32):
+            self.compute_dtype = jnp.dtype(dtype)
+            if self.compute_dtype not in (jnp.dtype(jnp.bfloat16),
+                                          jnp.dtype(jnp.float16)):
+                raise MXNetError(
+                    "dtype must be float32/bfloat16/float16, got %r" % dtype)
+        # fp16 needs dynamic loss scaling (grads under 2^-24 flush to zero);
+        # bf16/f32 don't — scaler stays None and the step skips that logic
+        self._scaler = None
+        if self.compute_dtype == jnp.dtype(jnp.float16):
+            from ..contrib.amp.loss_scaler import LossScaler
+            self._scaler = LossScaler()
         self.mesh = mesh if mesh is not None else current_mesh()
         self.batch_axis = batch_axis_name
         # input PartitionSpec; default = batch over the dp axis only. Pass
@@ -253,26 +276,54 @@ class DataParallelTrainer:
         x_sh = NamedSharding(mesh, P(batch_axis))
         rep = NamedSharding(mesh, P())
         p_sh = self._param_shardings
+        cdt = self.compute_dtype
+
+        def _low(a):
+            if cdt is not None and jnp.issubdtype(a.dtype, jnp.floating):
+                return a.astype(cdt)
+            return a
 
         # params/opt_state/x/y arrive pre-placed (device_put with NamedSharding);
         # XLA propagates shardings and inserts the dp all-reduce on grads.
+        scaled = self._scaler is not None
+
         @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def step(params, opt_state, key, x, y, lr, t):
+        def step(params, opt_state, key, x, y, lr, t, loss_scale):
             def lossf(ps):
-                out, aux = apply_fn(key, ps, x)
+                # casting inside the differentiated fn keeps fp32 master
+                # weights: astype's vjp casts the low-precision grads back
+                out, aux = apply_fn(key, [_low(p) for p in ps], _low(x))
                 pred = out if not isinstance(out, tuple) else out[0]
-                return loss_raw(pred, y), aux
-            (lossv, aux), grads = jax.value_and_grad(lossf, has_aux=True)(params)
+                lossv = loss_raw(pred, y)
+                return lossv * loss_scale, (lossv, aux)
+            (_, (lossv, aux)), grads = jax.value_and_grad(
+                lossf, has_aux=True)(params)
+            if scaled:
+                inv = 1.0 / loss_scale
+                grads = [g * inv if jnp.issubdtype(g.dtype, jnp.floating) else g
+                         for g in grads]
+                finite = jnp.bool_(True)
+                for i, g in enumerate(grads):
+                    if trainable[i] and jnp.issubdtype(g.dtype, jnp.floating):
+                        finite = jnp.logical_and(
+                            finite, jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+            else:
+                finite = jnp.bool_(True)
             new_params, new_state = [], []
             for i, (g, w, s) in enumerate(zip(grads, params, opt_state)):
                 if trainable[i]:
                     w2, s2 = update_fn(g, w, s, t, lr, jnp.float32(wds[i]))
-                    new_params.append(w2.astype(w.dtype))
+                    w2 = w2.astype(w.dtype)
+                    if scaled:  # skip the whole update on overflow
+                        w2 = jnp.where(finite, w2, w)
+                        s2 = jax.tree_util.tree_map(
+                            lambda new, old: jnp.where(finite, new, old), s2, s)
+                    new_params.append(w2)
                     new_state.append(s2)
                 else:
                     new_params.append(w)
                     new_state.append(s)
-            return new_params, new_state, lossv, aux
+            return new_params, new_state, lossv, finite, aux
         return step
 
     def step(self, x, y, batch_size=None):
@@ -294,9 +345,12 @@ class DataParallelTrainer:
         y_spec = self.data_spec if yr.ndim >= len(self.data_spec) \
             else P(*self.data_spec[:yr.ndim])
         yr = jax.device_put(yr, NamedSharding(self.mesh, y_spec))
-        self._params_raw, self._opt_state, lossv, aux = fn(
+        scale = jnp.float32(self._scaler.loss_scale if self._scaler else 1.0)
+        self._params_raw, self._opt_state, lossv, finite, aux = fn(
             self._params_raw, self._opt_state, key, xr, yr, lr,
-            jnp.float32(self._t))
+            jnp.float32(self._t), scale)
+        if self._scaler is not None:
+            self._scaler.update_scale(not bool(finite))
         return lossv
 
     def sync(self):

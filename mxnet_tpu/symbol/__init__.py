@@ -40,6 +40,19 @@ for _name, _op in sorted(_all_ops().items()):
         _seen.add(_name)
 
 
+def __getattr__(name):
+    # ops registered after this module imported (e.g. contrib extensions)
+    # resolve lazily from the live registry, keeping nd/sym in sync
+    try:
+        op = _get_op(name)
+    except MXNetError:
+        raise AttributeError(
+            f"module 'mxnet_tpu.symbol' has no attribute '{name}'") from None
+    fn = _make_symbol_function(op)
+    globals()[name] = fn
+    return fn
+
+
 def zeros(shape, dtype=None, **kwargs):
     return _apply_op(_get_op("_zeros"), shape=tuple(shape)
                      if isinstance(shape, (list, tuple)) else (shape,),

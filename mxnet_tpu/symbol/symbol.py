@@ -393,7 +393,7 @@ class Symbol:
             by_name = {}
             for i, (inp, oi) in enumerate(node.inputs):
                 cell = info.get(id(inp))
-                sh = cell[oi][0] if cell and cell[oi] else None
+                sh = cell[oi][0] if cell and oi < len(cell) and cell[oi] else None
                 nm = argnames[i] if i < len(argnames) else f"arg{i}"
                 by_name[nm] = sh
             if rule is not None:
@@ -413,7 +413,7 @@ class Symbol:
             structs = []
             for i, (inp, oi) in enumerate(node.inputs):
                 cell = info[id(inp)]
-                sh, dt = cell[oi]
+                sh, dt = cell[oi] if oi < len(cell) else (None, None)
                 if sh is None:
                     unknown = True
                     break
@@ -425,7 +425,7 @@ class Symbol:
                         f"'{node.name}' (op {node.op.name}); provide shapes "
                         f"for its variables")
                 info[id(node)] = [(None, _np.dtype(default_dtype()))] * \
-                    max(node.num_outputs or 1, 1)
+                    max(_num_outputs(node), 1)
                 continue
             params = _resolved_params(node)
             try:
@@ -478,6 +478,8 @@ class Symbol:
                               if v is not None},
                     "inputs": [[nid[id(i)], oi, 0] for i, oi in n.inputs],
                 }
+                if n.num_outputs is not None and n.num_outputs != 1:
+                    entry["num_outputs"] = n.num_outputs
                 if n.attrs:
                     entry["attrs"].update(n.attrs)
             nodes.append(entry)
@@ -636,14 +638,22 @@ def _op_arg_names(op: Op) -> List[str]:
     return [n.lstrip("*") for n, _ in _op_arg_spec(op)]
 
 
+_PARAM_NAMES_CACHE: Dict[str, set] = {}
+
+
 def _op_param_names(op: Op) -> set:
     import inspect
+    cached = _PARAM_NAMES_CACHE.get(op.name)
+    if cached is not None:
+        return cached
     try:
         sig = inspect.signature(op.fn)
-        return {p.name for p in sig.parameters.values()
-                if p.kind == p.KEYWORD_ONLY}
+        out = {p.name for p in sig.parameters.values()
+               if p.kind == p.KEYWORD_ONLY}
     except (TypeError, ValueError):
-        return set()
+        out = set()
+    _PARAM_NAMES_CACHE[op.name] = out
+    return out
 
 
 def _resolved_params(node: _Node, training: Optional[bool] = None) -> dict:
@@ -786,7 +796,10 @@ def load_json(json_str: str) -> Symbol:
                       if k in pnames}
             extra = {k: v for k, v in attrs.items() if k not in pnames}
             inputs = [(nodes[i], oi) for i, oi, *_ in entry["inputs"]]
-            nodes.append(_Node("op", entry["name"], op, params, inputs, extra))
+            node = _Node("op", entry["name"], op, params, inputs, extra)
+            if "num_outputs" in entry:
+                node.num_outputs = int(entry["num_outputs"])
+            nodes.append(node)
     heads = [(nodes[i], oi) for i, oi, *_ in g["heads"]]
     return Symbol(heads)
 

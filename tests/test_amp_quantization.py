@@ -1,0 +1,147 @@
+"""AMP (bf16 mixed precision) + INT8 quantization tests.
+
+Mirrors reference tests/python/gpu/test_contrib_amp.py and
+tests/python/quantization/test_quantization.py strategy: numeric closeness of
+low-precision vs f32 reference, loss-scaler state machine, calibration ranges.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.contrib import amp, quantization as quant
+from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+
+
+def _mesh1():
+    return make_mesh({"dp": 1}, devices=jax.devices()[:1])
+
+
+def test_bf16_trainer_step_and_master_weights():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1), gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"), gluon.nn.GlobalAvgPool2D(),
+            gluon.nn.Dense(10))
+    net.initialize()
+    net(nd.zeros((2, 3, 16, 16)))
+
+    def loss_fn(logits, labels):
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[:, None].astype(jnp.int32),
+                                   axis=-1)[:, 0]
+        return jnp.mean(logz - gold)
+
+    tr = DataParallelTrainer(net, loss_fn, optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.1},
+                             mesh=_mesh1(), dtype="bfloat16")
+    x = nd.array(np.random.RandomState(0).uniform(-1, 1, (4, 3, 16, 16)).astype(np.float32))
+    y = nd.array(np.zeros(4), dtype="int32")
+    losses = [float(tr.step(x, y)) for _ in range(5)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # optimizes the fixed batch
+    # master weights stay f32 on device
+    assert all(w.dtype == jnp.float32 for w in tr._params_raw
+               if jnp.issubdtype(w.dtype, jnp.floating))
+
+
+def test_amp_init_sets_trainer_default():
+    amp.amp._state["on"] = False
+    amp.init(target_dtype="bfloat16")
+    try:
+        net = gluon.nn.Dense(4)
+        net.initialize()
+        net(nd.zeros((2, 8)))
+        tr = DataParallelTrainer(net, lambda p, y: jnp.mean((p - y) ** 2),
+                                 mesh=_mesh1())
+        assert tr.compute_dtype == jnp.dtype(jnp.bfloat16)
+    finally:
+        amp.amp._state["on"] = False
+        amp.amp._state["dtype"] = None
+
+
+def test_loss_scaler_state_machine():
+    s = amp.LossScaler(init_scale=16.0, scale_factor=2.0, scale_window=2)
+    assert not s.has_overflow([nd.array(np.ones(4, np.float32))])
+    assert s.has_overflow([nd.array(np.array([1.0, np.inf], np.float32))])
+    s.update_scale(True)
+    assert s.loss_scale == 8.0
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 16.0
+
+
+def test_amp_scale_loss_and_cast():
+    amp.init("bfloat16")
+    try:
+        net = gluon.nn.Dense(4)
+        net.initialize()
+        net(nd.zeros((2, 8)))
+        tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+        amp.init_trainer(tr)
+        loss = nd.array(np.ones((2,), np.float32))
+        with amp.scale_loss(loss, tr) as scaled:
+            assert float(scaled.asnumpy()[0]) == 1.0  # bf16 scaler = 1.0
+        x = amp.amp_cast(nd.array(np.ones((2, 2), np.float32)), "bfloat16")
+        assert x.dtype == "bfloat16" or str(x.dtype) == "bfloat16"
+        outs = amp.amp_multicast(nd.array(np.ones(2, np.float16)),
+                                 nd.array(np.ones(2, np.float32)))
+        assert all(str(o.dtype) == "float32" for o in outs)
+    finally:
+        amp.amp._state["on"] = False
+        amp.amp._state["dtype"] = None
+
+
+def test_convert_hybrid_block_keeps_norm_f32():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8), gluon.nn.BatchNorm())
+    net.initialize()
+    net(nd.zeros((2, 4)))
+    amp.convert_hybrid_block(net, "bfloat16")
+    params = net.collect_params()
+    for name, p in params.items():
+        raw = p._data._data
+        if name.endswith(("gamma", "beta", "moving_mean", "moving_var")):
+            assert raw.dtype == jnp.float32
+        elif name.endswith("weight"):
+            assert raw.dtype == jnp.bfloat16
+
+
+def test_quantize_dequantize_roundtrip():
+    rs = np.random.RandomState(1)
+    x = rs.uniform(-3, 3, (64,)).astype(np.float32)
+    q, lo, hi = quant.quantize(jnp.asarray(x), jnp.float32(x.min()),
+                               jnp.float32(x.max()), out_type="int8")
+    assert q.dtype == jnp.int8
+    back = quant.dequantize(q, lo, hi)
+    np.testing.assert_allclose(np.asarray(back), x, atol=3.0 / 127 * 3 + 1e-3)
+
+
+def test_quantized_dense_close_to_f32():
+    rs = np.random.RandomState(2)
+    w = rs.uniform(-1, 1, (16, 32)).astype(np.float32)
+    x = rs.uniform(-1, 1, (8, 32)).astype(np.float32)
+    ref = x @ w.T
+    qd = quant.QuantizedDense(jnp.asarray(w))
+    out = np.asarray(qd(jnp.asarray(x)))
+    # int8 matmul should agree to ~1% of the dynamic range
+    assert np.abs(out - ref).max() < 0.05 * np.abs(ref).max() + 0.05
+
+
+def test_entropy_calibration_brackets_distribution():
+    rs = np.random.RandomState(3)
+    samples = rs.normal(0, 1, 20000).astype(np.float32)
+    lo, hi = quant.calib_entropy(samples)
+    assert 0 < hi <= float(np.abs(samples).max())
+    assert lo == -hi
+
+
+def test_quantize_model_params():
+    arg = {"fc_weight": nd.array(np.random.RandomState(4).uniform(-1, 1, (4, 8)).astype(np.float32)),
+           "fc_bias": nd.array(np.zeros(4, np.float32))}
+    _, qargs, _ = quant.quantize_model(None, arg, {})
+    assert str(qargs["fc_weight"].dtype) == "int8"
+    assert "fc_weight_scale" in qargs
+    assert str(qargs["fc_bias"].dtype) == "float32"
