@@ -19,8 +19,8 @@ import os
 
 BASELINE_IMG_S = 109.0  # reference resnet-50 train, 1 device, batch 32
 BATCH = int(os.environ.get("BENCH_BATCH", 32))
-WARMUP = int(os.environ.get("BENCH_WARMUP", 3))
-STEPS = int(os.environ.get("BENCH_STEPS", 20))
+WARMUP = int(os.environ.get("BENCH_WARMUP", 5))
+STEPS = int(os.environ.get("BENCH_STEPS", 60))
 IMAGE = int(os.environ.get("BENCH_IMAGE", 224))
 
 
@@ -61,13 +61,16 @@ def main():
     x = nd.array(rng.uniform(-1, 1, size=(BATCH, 3, IMAGE, IMAGE)).astype(np.float32))
     y = nd.array(rng.randint(0, 1000, size=(BATCH,)), dtype="int32")
 
+    # host-transfer sync (float()): on the tunneled TPU backend
+    # block_until_ready can return before execution finishes, which would
+    # time dispatch instead of compute
     for _ in range(WARMUP):
-        trainer.step(x, y).block_until_ready()
+        float(trainer.step(x, y))
 
     t0 = time.perf_counter()
     for _ in range(STEPS):
         lossv = trainer.step(x, y)
-    lossv.block_until_ready()
+    float(lossv)
     dt = time.perf_counter() - t0
 
     img_s = BATCH * STEPS / dt

@@ -42,11 +42,11 @@ class SelfAttention(HybridBlock):
         qkv = qkv.reshape((B, T, 3, H, d)).transpose((2, 0, 3, 1, 4))  # (3,B,H,T,d)
         q, k, v = qkv[0], qkv[1], qkv[2]
         if self._use_blockwise and mask is None:
-            from ..parallel.ring_attention import blockwise_attention
-            from ..ndarray import NDArray
-            out_raw = blockwise_attention(q._data, k._data, v._data,
-                                          block_size=min(512, T), causal=False)
-            out = NDArray(out_raw, x.ctx)
+            # registered-op form: dispatches to the Pallas kernel on TPU and
+            # records the VJP on the eager autograd tape (raw-array calls
+            # would silently detach attention from loss.backward())
+            from .. import ndarray as _nd
+            out = _nd._contrib_flash_attention(q, k, v, causal=False)
         else:
             scores = F.batch_dot(q.reshape((B * H, T, d)),
                                  k.reshape((B * H, T, d)), transpose_b=True)

@@ -18,6 +18,9 @@ from jax import lax
 
 from .registry import register
 
+_NEG = -1e30  # finite mask: -inf makes exp(-inf - -inf) = nan on fully
+              # masked (q-row, k-block) pairs under causal blocking
+
 
 def _block_attn(q, k, v, bias, scale):
     """One attention block in f32 LSE form. q:(B,H,Tq,D) k/v:(B,H,Tk,D)."""
@@ -56,7 +59,7 @@ def blockwise_attention(q, k, v, block_size: int = 512, causal: bool = False,
         mask = k_pos < Tk
         if causal:
             mask = jnp.logical_and(mask, q_pos >= k_pos)
-        bias = jnp.where(mask, 0.0, -jnp.inf)[None, None]
+        bias = jnp.where(mask, 0.0, _NEG)[None, None]
         num, den, m = _block_attn(qf, kblk.astype(jnp.float32), vblk, bias, scale)
         new_max = jnp.maximum(acc_max, m)
         corr_old = jnp.exp(acc_max - new_max)
@@ -66,15 +69,19 @@ def blockwise_attention(q, k, v, block_size: int = 512, causal: bool = False,
 
     acc = (jnp.zeros((B, H, T, D), jnp.float32),
            jnp.zeros((B, H, T, 1), jnp.float32),
-           jnp.full((B, H, T, 1), -jnp.inf, jnp.float32))
+           jnp.full((B, H, T, 1), _NEG, jnp.float32))
     (num, den, _), _ = lax.scan(body, acc, (jnp.arange(nblk), kb, vb))
     return (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
 
 
 @register("_contrib_flash_attention")
 def flash_attention_op(q, k, v, *, causal=False, block_size=512):
-    """Registered op form so the eager autograd tape records its VJP."""
-    return blockwise_attention(q, k, v, block_size=block_size, causal=causal)
+    """Registered op form so the eager autograd tape records its VJP.
+    Dispatches to the Pallas TPU kernel (ops/pallas/flash_attention.py)
+    when on TPU; the lax.scan blockwise path elsewhere."""
+    from .pallas.flash_attention import flash_attention as _pallas_flash
+    return _pallas_flash(q, k, v, causal=causal,
+                         block_q=min(block_size, 256), block_k=min(block_size, 256))
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
@@ -94,7 +101,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         bias = None
         if causal:
             k_pos = kv_rank * T + jnp.arange(T)[None, :]
-            bias = jnp.where(q_pos_base >= k_pos, 0.0, -jnp.inf)[None, None]
+            bias = jnp.where(q_pos_base >= k_pos, 0.0, _NEG)[None, None]
         num, den, m = _block_attn(qf, kb.astype(jnp.float32), vb, bias, scale)
         new_max = jnp.maximum(acc_max, m)
         corr_old = jnp.exp(acc_max - new_max)
@@ -108,7 +115,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
     acc = (jnp.zeros((B, H, T, D), jnp.float32),
            jnp.zeros((B, H, T, 1), jnp.float32),
-           jnp.full((B, H, T, 1), -jnp.inf, jnp.float32), k, v)
+           jnp.full((B, H, T, 1), _NEG, jnp.float32), k, v)
     (num, den, _, _, _), _ = lax.scan(body, acc, jnp.arange(n))
     return (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
 

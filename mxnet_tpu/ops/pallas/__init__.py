@@ -1,0 +1,10 @@
+"""Pallas TPU kernels — the hand-scheduled hot path.
+
+The reference keeps its hot ops in hand-written CUDA (cuDNN attention
+matmuls, src/operator/contrib/transformer.cc; fused optimizer kernels,
+src/operator/optimizer_op.cc). The TPU-native analogs live here as Pallas
+kernels: flash attention (fwd+bwd), fused multi-tensor optimizer updates.
+Everything degrades gracefully to pure-XLA fallbacks off-TPU.
+"""
+from .flash_attention import flash_attention, pallas_available
+from .fused_optimizer import fused_sgd_apply, fused_adam_apply

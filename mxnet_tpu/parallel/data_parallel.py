@@ -253,8 +253,11 @@ class DataParallelTrainer:
         self._param_shardings = [
             NamedSharding(self.mesh, p.sharding if p.sharding is not None else P())
             for p in self._plist]
-        self._params_raw = [jax.device_put(w, s) for w, s in
-                            zip(self._params_raw, self._param_shardings)]
+        # copy=True: the step jit donates these buffers, and without a copy
+        # donation would delete the gluon Parameters' own arrays (breaking any
+        # later use of the net or a second trainer on it)
+        self._params_raw = [jax.device_put(jnp.array(w, copy=True), s)
+                            for w, s in zip(self._params_raw, self._param_shardings)]
 
     # -- loss plumbing -------------------------------------------------------
     def _loss_raw(self, pred_raw, label_raw):
