@@ -46,6 +46,8 @@ from . import test_utils
 from . import image
 from . import recordio
 from . import contrib
+from . import numpy as np
+from . import numpy_extension as npx
 
 from .util import is_np_shape, is_np_array, set_np, reset_np
 

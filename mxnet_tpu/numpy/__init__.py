@@ -1,0 +1,433 @@
+"""``mx.np`` — the NumPy-semantics array API.
+
+Reference: python/mxnet/numpy/multiarray.py (294 defs over _npi_* C++ ops,
+SURVEY.md §2.2 numpy/ 70 files / 16.9 kLoC). TPU-native design: jax.numpy
+IS the NumPy-compatible compute layer, so every function here is a thin
+autograd-aware delegation to jnp — one `_apply` path that mirrors
+ndarray.invoke (jax.vjp + tape record) instead of 70 files of kernels. The
+`ndarray` class is a zero-slot subclass of the imperative NDArray, so
+mx.np arrays ride the same tape, context, and serialization machinery.
+"""
+from __future__ import annotations
+
+import builtins as _bi
+from typing import Any, Optional, Sequence
+
+import numpy as _onp
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray, _track
+
+__all__ = ["ndarray"]  # extended programmatically below
+
+
+class ndarray(NDArray):
+    """mx.np.ndarray (reference numpy/multiarray.py:77)."""
+    __slots__ = ()
+
+    def __repr__(self):
+        return f"array({self.asnumpy()!r})".replace("array(array", "array(")
+
+    # numpy-style methods delegate to module functions
+    def mean(self, axis=None, dtype=None, keepdims=False):
+        return mean(self, axis=axis, dtype=dtype, keepdims=keepdims)
+
+    def sum(self, axis=None, dtype=None, keepdims=False):
+        return sum(self, axis=axis, dtype=dtype, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return max(self, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return min(self, axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return prod(self, axis=axis, keepdims=keepdims)
+
+    def std(self, axis=None, ddof=0, keepdims=False):
+        return std(self, axis=axis, ddof=ddof, keepdims=keepdims)
+
+    def var(self, axis=None, ddof=0, keepdims=False):
+        return var(self, axis=axis, ddof=ddof, keepdims=keepdims)
+
+    def argmax(self, axis=None):
+        return argmax(self, axis=axis)
+
+    def argmin(self, axis=None):
+        return argmin(self, axis=axis)
+
+    def cumsum(self, axis=None):
+        return cumsum(self, axis=axis)
+
+    def flatten(self, order="C"):
+        return reshape(self, (-1,))
+
+    def item(self):
+        return self.asnumpy().item()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def copy(self):
+        # through _apply so the tape records (identity vjp)
+        return _apply(lambda x: jnp.array(x, copy=True), (self,), {})
+
+    def astype(self, dtype, copy=True):
+        dt = jnp.dtype(dtype)
+        if not copy and self._data.dtype == dt:
+            return self
+        return _apply(lambda x: x.astype(dt), (self,), {})
+
+    def reshape(self, *shape, order="C"):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return reshape(self, shape)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return transpose(self, axes if axes else None)
+
+    def squeeze(self, axis=None):
+        return squeeze(self, axis=axis)
+
+    def ravel(self):
+        return reshape(self, (-1,))
+
+    def clip(self, a_min=None, a_max=None):
+        return clip(self, a_min, a_max)
+
+    def round(self, decimals=0):
+        return around(self, decimals=decimals)
+
+    def dot(self, other):
+        return dot(self, other)
+
+    def as_nd_ndarray(self):
+        out = NDArray(self._data, self._ctx)
+        out._ag_node = self._ag_node
+        return out
+
+    def as_np_ndarray(self):
+        return self
+
+
+def _wrap(raw, ctx=None) -> ndarray:
+    out = ndarray(raw, ctx or current_context())
+    _track(out)
+    return out
+
+
+def _raw_in(x):
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (int, float, bool, complex)):
+        return x
+    return jnp.asarray(x)
+
+
+def _apply(jfn, args, kwargs):
+    """Autograd-aware delegation (mirrors ndarray.invoke): runs jfn on the
+    raw arrays; when the tape is recording and an input is attached, computes
+    via jax.vjp and records."""
+    from .. import autograd
+    # NDArrays may appear as positional args, inside a list/tuple arg
+    # (concatenate/stack take sequences), or as keyword args (indices=,
+    # condition=, …); flatten all three into the vjp inputs
+    entries = []  # (arg_pos | kw_name, elem_pos | None)
+    for i, a in enumerate(args):
+        if isinstance(a, NDArray):
+            entries.append((i, None))
+        elif isinstance(a, (list, tuple)):
+            for j, e in enumerate(a):
+                if isinstance(e, NDArray):
+                    entries.append((i, j))
+    for k, a in kwargs.items():
+        if isinstance(a, NDArray):
+            entries.append((k, None))
+
+    def _get(i, j):
+        src = kwargs[i] if isinstance(i, str) else args[i]
+        return src if j is None else src[j]
+
+    ins = [_get(i, j) for i, j in entries]
+    raws = [x._data for x in ins]
+    # NB: use builtins explicitly — this module shadows any/all/sum/min/max
+    need = (autograd.is_recording()
+            and _bi.any(x._ag_node is not None for x in ins))
+
+    def fn(*arrs):
+        # only NDArray positions are substituted; every other arg
+        # (None, shape tuples, scalars, python lists) passes through verbatim
+        full = [list(x) if isinstance(x, (list, tuple)) else x for x in args]
+        kw = dict(kwargs)
+        for (i, j), r in zip(entries, arrs):
+            if isinstance(i, str):
+                kw[i] = r
+            elif j is None:
+                full[i] = r
+            else:
+                full[i][j] = r
+        return jfn(*full, **kw)
+
+    if need:
+        try:
+            outs_raw, vjp_fn = jax.vjp(fn, *raws)
+        except TypeError:  # non-differentiable output (int/bool)
+            outs_raw, vjp_fn, need = fn(*raws), None, False
+    else:
+        outs_raw, vjp_fn = fn(*raws), None
+    was_tuple = isinstance(outs_raw, (tuple, list))
+    outs_t = tuple(outs_raw) if was_tuple else (outs_raw,)
+    if need and not _bi.any(jnp.issubdtype(o.dtype, jnp.inexact) for o in outs_t):
+        need = False  # integer outputs carry no gradient
+    ctx = ins[0]._ctx if ins else current_context()
+    outs = [_wrap(o, ctx) for o in outs_t]
+    if need:
+        autograd.record_op(vjp_fn, ins, outs, out_is_tuple=was_tuple)
+    if was_tuple:
+        return list(outs)
+    return outs[0]
+
+
+def _make_fn(jfn, name):
+    def wrapper(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        res = _apply(jfn, args, kwargs)
+        if out is not None:
+            out._set_data(res._data)
+            out._ag_node = res._ag_node
+            return out
+        return res
+    wrapper.__name__ = name
+    wrapper.__qualname__ = name
+    wrapper.__doc__ = (getattr(jfn, "__doc__", "") or "")[:400] + \
+        f"\n\n(mx.np.{name} — NumPy-semantics op, delegates to jax.numpy)"
+    return wrapper
+
+
+# Everything in this list delegates 1:1 to jax.numpy (same names/semantics).
+_DELEGATED = [
+    # math / ufuncs
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "mod", "remainder", "fmod", "power", "float_power", "negative", "positive",
+    "absolute", "abs", "fabs", "sign", "rint", "floor", "ceil", "trunc",
+    "sqrt", "cbrt", "square", "reciprocal", "exp", "expm1", "exp2", "log",
+    "log2", "log10", "log1p", "logaddexp", "logaddexp2", "sin", "cos", "tan",
+    "arcsin", "arccos", "arctan", "arctan2", "sinh", "cosh", "tanh", "arcsinh",
+    "arccosh", "arctanh", "hypot", "degrees", "radians", "deg2rad", "rad2deg",
+    "maximum", "minimum", "fmax", "fmin", "heaviside", "gcd", "lcm", "ldexp",
+    "around", "round", "clip", "nan_to_num", "real", "imag", "conj",  # noqa
+    "conjugate", "i0", "sinc", "interp", "unwrap", "ediff1d", "trapz",
+    "copysign", "frexp", "nextafter", "spacing",
+    # comparison / logic
+    "equal", "not_equal", "less", "less_equal", "greater", "greater_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not", "isnan",
+    "isinf", "isposinf", "isneginf", "isfinite", "isclose", "allclose",
+    "array_equal", "array_equiv", "signbit",
+    # bitwise
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "invert",
+    "left_shift", "right_shift",
+    # reductions
+    "sum", "prod", "mean", "std", "var", "min", "max", "amin", "amax", "ptp",
+    "median", "quantile", "percentile", "average", "nansum", "nanprod",
+    "nanmean", "nanstd", "nanvar", "nanmin", "nanmax", "nanmedian",
+    "nanquantile", "nanpercentile", "all", "any", "count_nonzero", "argmin",
+    "argmax", "nanargmin", "nanargmax", "cumsum", "cumprod", "nancumsum",
+    "nancumprod",
+    # shape manipulation
+    "reshape", "ravel", "transpose", "swapaxes", "moveaxis", "rollaxis",
+    "expand_dims", "squeeze", "concatenate", "stack", "vstack", "hstack",
+    "dstack", "column_stack", "row_stack", "split", "array_split", "vsplit",
+    "hsplit", "dsplit", "tile", "repeat", "flip", "fliplr", "flipud", "roll",
+    "rot90", "pad", "broadcast_to", "broadcast_arrays", "atleast_1d",
+    "atleast_2d", "atleast_3d", "flatnonzero", "resize", "append", "delete",
+    "insert", "trim_zeros",
+    # linear algebra / products
+    "dot", "vdot", "inner", "outer", "matmul", "tensordot", "einsum", "kron",
+    "trace", "cross", "diagonal",
+    # indexing / selection
+    "where", "take", "take_along_axis", "choose", "compress", "diag",
+    "diagflat", "tril", "triu", "extract", "select", "searchsorted", "nonzero",
+    "argwhere", "unravel_index", "ravel_multi_index", "indices", "ix_",
+    "diag_indices", "tril_indices", "triu_indices", "triu_indices_from",
+    "tril_indices_from", "diag_indices_from", "put_along_axis",
+    # sorting / sets
+    "sort", "argsort", "lexsort", "partition", "argpartition", "unique",
+    "intersect1d", "union1d", "setdiff1d", "setxor1d", "in1d", "isin",
+    "sort_complex", "msort" if hasattr(jnp, "msort") else "sort",
+    # statistics / histogram
+    "histogram", "histogram2d", "histogramdd", "histogram_bin_edges",
+    "bincount", "digitize", "corrcoef", "cov", "correlate", "convolve",
+    # polynomials / misc
+    "polyval", "polyfit", "polyadd", "polysub", "polymul", "polyder",
+    "polyint", "vander", "gradient", "diff", "sinc", "meshgrid",
+    "apply_along_axis", "tensordot", "float_power", "divmod",
+]
+
+_g = globals()
+for _name in dict.fromkeys(_DELEGATED):
+    _j = getattr(jnp, _name, None)
+    if _j is None:
+        continue
+    _g[_name] = _make_fn(_j, _name)
+    __all__.append(_name)
+
+
+# ---------------------------------------------------------------------------
+# creation functions (need ctx/dtype handling)
+# ---------------------------------------------------------------------------
+
+def array(obj, dtype=None, ctx=None):
+    if isinstance(obj, NDArray):
+        raw = obj._data
+    else:
+        raw = jnp.asarray(obj, dtype=jnp.dtype(dtype) if dtype else None)
+    if dtype is not None:
+        raw = raw.astype(jnp.dtype(dtype))
+    elif raw.dtype == jnp.float64:
+        raw = raw.astype(jnp.float32)
+    return _wrap(raw, ctx)
+
+
+def _creation(jfn, name):
+    def wrapper(*args, dtype=None, ctx=None, **kwargs):
+        if dtype is not None:
+            kwargs["dtype"] = jnp.dtype(dtype)
+        elif name not in ("arange", "eye", "identity"):
+            kwargs["dtype"] = jnp.float32
+        return _wrap(jfn(*args, **kwargs), ctx)
+    wrapper.__name__ = name
+    return wrapper
+
+
+zeros = _creation(jnp.zeros, "zeros")
+ones = _creation(jnp.ones, "ones")
+empty = _creation(jnp.empty, "empty")
+eye = _creation(jnp.eye, "eye")
+identity = _creation(jnp.identity, "identity")
+arange = _creation(jnp.arange, "arange")
+
+
+def full(shape, fill_value, dtype=None, ctx=None):
+    return _wrap(jnp.full(shape, fill_value,
+                          dtype=jnp.dtype(dtype) if dtype else jnp.float32), ctx)
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None):
+    out = jnp.linspace(start, stop, num, endpoint=endpoint, retstep=retstep,
+                       dtype=jnp.dtype(dtype) if dtype else jnp.float32, axis=axis)
+    if retstep:
+        return _wrap(out[0], ctx), float(out[1])
+    return _wrap(out, ctx)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None, ctx=None):
+    return _wrap(jnp.logspace(start, stop, num, endpoint=endpoint, base=base,
+                              dtype=jnp.dtype(dtype) if dtype else jnp.float32), ctx)
+
+
+def zeros_like(a, dtype=None):
+    return _wrap(jnp.zeros_like(_raw_in(a), dtype=dtype))
+
+
+def ones_like(a, dtype=None):
+    return _wrap(jnp.ones_like(_raw_in(a), dtype=dtype))
+
+
+def full_like(a, fill_value, dtype=None):
+    return _wrap(jnp.full_like(_raw_in(a), fill_value, dtype=dtype))
+
+
+def empty_like(a, dtype=None):
+    return _wrap(jnp.empty_like(_raw_in(a), dtype=dtype))
+
+
+def copy(a):
+    return _wrap(jnp.array(_raw_in(a), copy=True))
+
+
+def asarray(a, dtype=None):
+    return array(a, dtype=dtype)
+
+
+def ascontiguousarray(a, dtype=None):
+    return array(a, dtype=dtype)
+
+
+def may_share_memory(a, b):
+    return _raw_in(a) is _raw_in(b)
+
+
+def shares_memory(a, b):
+    return _raw_in(a) is _raw_in(b)
+
+
+def shape(a):
+    return tuple(_raw_in(a).shape)
+
+
+def ndim(a):
+    return _raw_in(a).ndim
+
+
+def size(a, axis=None):
+    r = _raw_in(a)
+    return int(r.shape[axis]) if axis is not None else int(r.size)
+
+
+def result_type(*args):
+    return jnp.result_type(*[_raw_in(a) if not isinstance(a, (str, type))
+                             else a for a in args])
+
+
+def can_cast(from_, to):
+    return jnp.can_cast(from_ if isinstance(from_, (str, type, jnp.dtype))
+                        else _raw_in(from_).dtype, to)
+
+
+def promote_types(t1, t2):
+    return jnp.promote_types(t1, t2)
+
+
+def expand_dims_(a, axis):
+    return _apply(jnp.expand_dims, (a,), {"axis": axis})
+
+
+# dtype aliases (reference numpy/__init__.py re-exports)
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+uint16 = _onp.uint16
+uint32 = _onp.uint32
+uint64 = _onp.uint64
+bool_ = _onp.bool_
+bfloat16 = jnp.bfloat16
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+dtype = _onp.dtype
+integer = _onp.integer
+floating = _onp.floating
+inexact = _onp.inexact
+number = _onp.number
+
+from . import random  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
+
+__all__ += ["array", "zeros", "ones", "empty", "full", "eye", "identity",
+            "arange", "linspace", "logspace", "zeros_like", "ones_like",
+            "full_like", "empty_like", "copy", "asarray", "shape", "ndim",
+            "size", "random", "linalg", "newaxis", "pi", "inf", "nan"]

@@ -1,0 +1,20 @@
+"""mx.np.linalg (reference python/mxnet/numpy/linalg.py + src/operator/numpy/
+linalg/). Delegates to jax.numpy.linalg with tape-aware wrapping."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _apply, _make_fn
+
+_DELEGATED = ["norm", "svd", "cholesky", "qr", "inv", "pinv", "det", "slogdet",
+              "solve", "lstsq", "eig", "eigh", "eigvals", "eigvalsh",
+              "matrix_rank", "matrix_power", "multi_dot", "tensorinv",
+              "tensorsolve", "cond"]
+
+_g = globals()
+for _name in _DELEGATED:
+    _j = getattr(jnp.linalg, _name, None)
+    if _j is not None:
+        _g[_name] = _make_fn(_j, _name)
+
+__all__ = [n for n in _DELEGATED if n in _g]

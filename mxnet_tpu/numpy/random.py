@@ -1,0 +1,157 @@
+"""mx.np.random (reference python/mxnet/numpy/random.py over _npi_ samplers).
+
+Counter-based: draws consume keys from the framework RNG stream
+(mxnet_tpu.random), the TPU-native replacement for the reference's
+per-device random_generator.h state.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import random as _rng
+from . import _wrap, _raw_in
+
+
+def _key():
+    # typed key (next_key): the supported jax.random form; next_key_raw is
+    # only for shipping key data across op/jit boundaries
+    return _rng.next_key()
+
+
+def _shape(size):
+    # None passes through: jax.random broadcasts to the params' shape, which
+    # matches NumPy's size=None semantics for array-valued parameters
+    if size is None:
+        return None
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def seed(s):
+    _rng.seed(s)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None):
+    low, high = _raw_in(low), _raw_in(high)
+    shp = _shape(size)
+    if shp is None:
+        shp = jnp.broadcast_shapes(jnp.shape(low), jnp.shape(high))
+    out = jax.random.uniform(_key(), shp,
+                             dtype=jnp.dtype(dtype) if dtype else jnp.float32,
+                             minval=low, maxval=high)
+    return _wrap(out, ctx)
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    loc, scale = _raw_in(loc), _raw_in(scale)
+    shp = _shape(size)
+    if shp is None:
+        shp = jnp.broadcast_shapes(jnp.shape(loc), jnp.shape(scale))
+    out = jax.random.normal(_key(), shp,
+                            dtype=jnp.dtype(dtype) if dtype else jnp.float32)
+    return _wrap(out * scale + loc, ctx)
+
+
+def randn(*size):
+    return normal(size=size or None)
+
+
+def rand(*size):
+    return uniform(size=size or None)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None):
+    if high is None:
+        low, high = 0, low
+    shp = _shape(size)
+    out = jax.random.randint(_key(), shp if shp is not None else (), _raw_in(low), _raw_in(high),
+                             dtype=jnp.dtype(dtype) if dtype else jnp.int32)
+    return _wrap(out, ctx)
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None):
+    a_raw = _raw_in(a) if not isinstance(a, int) else jnp.arange(a)
+    p_raw = _raw_in(p) if p is not None else None
+    out = jax.random.choice(_key(), a_raw, _shape(size), replace=replace,
+                            p=p_raw)
+    return _wrap(out, ctx)
+
+
+def permutation(x):
+    if isinstance(x, int):
+        return _wrap(jax.random.permutation(_key(), x))
+    return _wrap(jax.random.permutation(_key(), _raw_in(x)))
+
+
+def shuffle(x):
+    """In-place shuffle along axis 0 (reference _npi_shuffle)."""
+    x._set_data(jax.random.permutation(_key(), x._data))
+
+
+def exponential(scale=1.0, size=None, ctx=None):
+    shp = _shape(size)
+    out = jax.random.exponential(_key(), shp if shp is not None else ())
+    return _wrap(out * _raw_in(scale), ctx)
+
+
+def gamma(shape, scale=1.0, size=None, ctx=None):
+    out = jax.random.gamma(_key(), _raw_in(shape), _shape(size)) * _raw_in(scale)
+    return _wrap(out, ctx)
+
+
+def beta(a, b, size=None, ctx=None):
+    return _wrap(jax.random.beta(_key(), _raw_in(a), _raw_in(b), _shape(size)), ctx)
+
+
+def chisquare(df, size=None, ctx=None):
+    return _wrap(jax.random.chisquare(_key(), _raw_in(df), shape=_shape(size)), ctx)
+
+
+def multinomial(n, pvals, size=None):
+    pv = _raw_in(pvals)
+    shp = (_shape(size) or ()) + (pv.shape[-1],)
+    out = jax.random.multinomial(_key(), n, pv, shape=shp if size else None)
+    return _wrap(out)
+
+
+def multivariate_normal(mean, cov, size=None, ctx=None):
+    out = jax.random.multivariate_normal(_key(), _raw_in(mean), _raw_in(cov),
+                                         _shape(size) or None)
+    return _wrap(out, ctx)
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    shp = _shape(size)
+    out = jax.random.laplace(_key(), shp if shp is not None else (),
+                             dtype=jnp.dtype(dtype) if dtype else jnp.float32)
+    return _wrap(out * _raw_in(scale) + _raw_in(loc), ctx)
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, ctx=None):
+    return _wrap(jnp.exp(jax.random.normal(_key(), _shape(size) if _shape(size) is not None else ()) * _raw_in(sigma) + _raw_in(mean)), ctx)
+
+
+def logistic(loc=0.0, scale=1.0, size=None, ctx=None):
+    return _wrap(jax.random.logistic(_key(), _shape(size) if _shape(size) is not None else ()) * scale + loc, ctx)
+
+
+def pareto(a, size=None, ctx=None):
+    return _wrap(jax.random.pareto(_key(), _raw_in(a), shape=_shape(size)) - 1.0, ctx)
+
+
+def poisson(lam=1.0, size=None, ctx=None):
+    return _wrap(jax.random.poisson(_key(), _raw_in(lam), shape=_shape(size)), ctx)
+
+
+def weibull(a, size=None, ctx=None):
+    return _wrap(jax.random.weibull_min(_key(), 1.0, _raw_in(a), shape=_shape(size)), ctx)
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, ctx=None):
+    return _wrap(jax.random.gumbel(_key(), _shape(size) if _shape(size) is not None else ()) * scale + loc, ctx)
+
+
+def rayleigh(scale=1.0, size=None, ctx=None):
+    return _wrap(jax.random.rayleigh(_key(), shape=_shape(size)) * _raw_in(scale), ctx)

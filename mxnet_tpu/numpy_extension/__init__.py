@@ -1,0 +1,201 @@
+"""``mx.npx`` — NumPy-extension namespace (reference
+python/mxnet/numpy_extension/ + npx ops in src/operator/numpy/).
+
+Neural-net ops with NumPy-style arrays: thin re-dispatch to the registered
+op set, returning mx.np.ndarray so the two namespaces compose.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, invoke
+from ..ops.registry import get_op
+from ..util import set_np, reset_np, is_np_shape, is_np_array, use_np
+from ..numpy import ndarray as np_ndarray, _wrap, _apply
+
+
+def _npx_op(op_name, *arrays, **params):
+    ins = [a if isinstance(a, NDArray) else np_ndarray(jnp.asarray(a))
+           for a in arrays if a is not None]
+    out = invoke(get_op(op_name), ins, params)
+    if isinstance(out, list):
+        if len(out) == 1:
+            return _renp(out[0])
+        return [_renp(o) for o in out]
+    return _renp(out)
+
+
+def _renp(x: NDArray) -> np_ndarray:
+    out = np_ndarray(x._data, x._ctx)
+    out._ag_node = x._ag_node
+    return out
+
+
+def softmax(data, axis=-1, length=None, temperature=None):
+    return _npx_op("softmax", data, length, axis=axis, temperature=temperature,
+                   use_length=length is not None)
+
+
+def log_softmax(data, axis=-1):
+    return _npx_op("log_softmax", data, axis=axis)
+
+
+def relu(data):
+    return _npx_op("relu", data)
+
+
+def sigmoid(data):
+    return _npx_op("sigmoid", data)
+
+
+def gelu(data):
+    return _apply(jax.nn.gelu, (data,), {})
+
+
+def leaky_relu(data, slope=0.25):
+    return _npx_op("LeakyReLU", data, act_type="leaky", slope=slope)
+
+
+def activation(data, act_type="relu"):
+    return _npx_op("Activation", data, act_type=act_type)
+
+
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    return _npx_op("batch_dot", a, b, transpose_a=transpose_a,
+                   transpose_b=transpose_b)
+
+
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    return _npx_op("FullyConnected", data, weight, bias,
+                   num_hidden=num_hidden, no_bias=no_bias or bias is None,
+                   flatten=flatten)
+
+
+def convolution(data, weight, bias=None, **params):
+    return _npx_op("Convolution", data, weight, bias,
+                   no_bias=bias is None, **params)
+
+
+def pooling(data, **params):
+    return _npx_op("Pooling", data, **params)
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-3,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               output_mean_var=False, axis=1):
+    return _npx_op("BatchNorm", x, gamma, beta, running_mean, running_var,
+                   eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+                   use_global_stats=use_global_stats,
+                   output_mean_var=output_mean_var, axis=axis)
+
+
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    return _npx_op("LayerNorm", data, gamma, beta, axis=axis, eps=eps)
+
+
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False):
+    return _npx_op("Embedding", data, weight, input_dim=input_dim,
+                   output_dim=output_dim)
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+    return _npx_op("topk", data, axis=axis, k=k, ret_typ=ret_typ,
+                   is_ascend=is_ascend)
+
+
+def pick(data, index, axis=-1, mode="clip", keepdims=False):
+    return _npx_op("pick", data, index, axis=axis, mode=mode, keepdims=keepdims)
+
+
+def gather_nd(data, indices):
+    return _npx_op("gather_nd", data, indices)
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return _npx_op("one_hot", data, depth=depth, on_value=on_value,
+                   off_value=off_value, dtype=dtype)
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    return _npx_op("SequenceMask", data, sequence_length,
+                   use_sequence_length=use_sequence_length, value=value,
+                   axis=axis)
+
+
+def reshape_like(lhs, rhs):
+    return _npx_op("reshape_like", lhs, rhs)
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    r = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    n = r.size if axis is None else r.shape[axis]
+    n_base = -(-n // repeat) if repeat > 1 else n
+    out = jnp.arange(start, start + step * n_base, step, dtype=jnp.float32)
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)[:n]
+    return _wrap(out)
+
+
+def smooth_l1(data, scalar=1.0):
+    return _npx_op("smooth_l1", data, scalar=scalar)
+
+
+def erf(data):
+    return _apply(jax.scipy.special.erf, (data,), {})
+
+
+def erfinv(data):
+    return _apply(jax.scipy.special.erfinv, (data,), {})
+
+
+def gamma(data):
+    return _apply(lambda x: jnp.exp(jax.scipy.special.gammaln(x)), (data,), {})
+
+
+def gammaln(data):
+    return _apply(jax.scipy.special.gammaln, (data,), {})
+
+
+def seed(s):
+    from .. import random as _rng
+    _rng.seed(s)
+
+
+def waitall():
+    from ..ndarray import waitall as _w
+    _w()
+
+
+def cpu(i=0):
+    from ..context import cpu as _cpu
+    return _cpu(i)
+
+
+def gpu(i=0):
+    from ..context import gpu as _gpu
+    return _gpu(i)
+
+
+def num_gpus():
+    from ..context import num_gpus as _n
+    return _n()
+
+
+def current_device():
+    from ..context import current_context
+    return current_context()
+
+
+def load(fname):
+    from ..serialization import load_ndarrays
+    return load_ndarrays(fname)
+
+
+def save(fname, data):
+    from ..serialization import save_ndarrays
+    save_ndarrays(fname, data)

@@ -1,0 +1,234 @@
+"""mx.np / mx.npx tests — mirrors reference tests/python/unittest/
+test_numpy_op.py / test_numpy_ndarray.py strategy: parity against real numpy
+on values, plus autograd-through-np-ops checks."""
+import numpy as onp
+import pytest
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx, autograd
+
+
+def test_array_creation_and_dtype_default():
+    a = np.array([[1, 2], [3, 4]])
+    assert isinstance(a, np.ndarray)
+    assert a.shape == (2, 2)
+    z = np.zeros((3, 4))
+    assert str(z.dtype) == "float32"
+    o = np.ones((2,), dtype="int32")
+    assert str(o.dtype) == "int32"
+    ar = np.arange(5)
+    assert ar.tolist() == [0, 1, 2, 3, 4]
+    l = np.linspace(0, 1, 5)
+    onp.testing.assert_allclose(l.asnumpy(), onp.linspace(0, 1, 5), rtol=1e-6)
+
+
+def test_elementwise_matches_numpy():
+    rs = onp.random.RandomState(0)
+    x = rs.uniform(0.1, 2, (3, 4)).astype(onp.float32)
+    a = np.array(x)
+    for name in ["exp", "log", "sqrt", "square", "sin", "cos", "tanh",
+                 "floor", "ceil", "sign", "abs", "reciprocal", "log1p"]:
+        got = getattr(np, name)(a).asnumpy()
+        want = getattr(onp, name)(x)
+        onp.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-6), name
+
+
+def test_binary_broadcast_and_operators():
+    rs = onp.random.RandomState(1)
+    x = rs.uniform(-1, 1, (3, 1, 4)).astype(onp.float32)
+    y = rs.uniform(-1, 1, (1, 5, 4)).astype(onp.float32)
+    a, b = np.array(x), np.array(y)
+    onp.testing.assert_allclose(np.add(a, b).asnumpy(), x + y, rtol=1e-6)
+    onp.testing.assert_allclose(np.maximum(a, b).asnumpy(),
+                                onp.maximum(x, y), rtol=1e-6)
+    onp.testing.assert_allclose((a * b).asnumpy(), x * y, rtol=1e-6)
+    onp.testing.assert_allclose((a - 2.0).asnumpy(), x - 2.0, rtol=1e-6)
+
+
+def test_reductions_and_axis():
+    rs = onp.random.RandomState(2)
+    x = rs.uniform(-1, 1, (4, 5, 6)).astype(onp.float32)
+    a = np.array(x)
+    onp.testing.assert_allclose(np.sum(a, axis=1).asnumpy(), x.sum(axis=1),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(a.mean(axis=(0, 2)).asnumpy(),
+                                x.mean(axis=(0, 2)), rtol=1e-5)
+    onp.testing.assert_allclose(np.var(a).asnumpy(), x.var(), rtol=1e-4)
+    assert int(np.argmax(a).asnumpy()) == int(x.argmax())
+    onp.testing.assert_allclose(np.cumsum(a, axis=0).asnumpy(),
+                                x.cumsum(axis=0), rtol=1e-5)
+
+
+def test_manipulation():
+    rs = onp.random.RandomState(3)
+    x = rs.uniform(-1, 1, (2, 3, 4)).astype(onp.float32)
+    a = np.array(x)
+    assert np.reshape(a, (6, 4)).shape == (6, 4)
+    assert a.reshape(-1).shape == (24,)
+    assert np.transpose(a).shape == (4, 3, 2)
+    assert a.T.shape == (4, 3, 2)
+    assert np.expand_dims(a, 1).shape == (2, 1, 3, 4)
+    c = np.concatenate([a, a], axis=2)
+    assert c.shape == (2, 3, 8)
+    s = np.split(c, 2, axis=2)
+    assert len(s) == 2 and s[0].shape == (2, 3, 4)
+    onp.testing.assert_allclose(np.flip(a, 0).asnumpy(), x[::-1], rtol=1e-6)
+    st = np.stack([a, a])
+    assert st.shape == (2, 2, 3, 4)
+
+
+def test_matmul_einsum_dot():
+    rs = onp.random.RandomState(4)
+    x = rs.uniform(-1, 1, (3, 4)).astype(onp.float32)
+    y = rs.uniform(-1, 1, (4, 5)).astype(onp.float32)
+    a, b = np.array(x), np.array(y)
+    onp.testing.assert_allclose(np.matmul(a, b).asnumpy(), x @ y, rtol=1e-5)
+    onp.testing.assert_allclose(np.dot(a, b).asnumpy(), x @ y, rtol=1e-5)
+    onp.testing.assert_allclose(np.einsum("ij,jk->ik", a, b).asnumpy(),
+                                x @ y, rtol=1e-5)
+    onp.testing.assert_allclose(
+        np.tensordot(a, b, axes=1).asnumpy(), onp.tensordot(x, y, axes=1),
+        rtol=1e-5)
+
+
+def test_indexing_sorting():
+    rs = onp.random.RandomState(5)
+    x = rs.uniform(-1, 1, (6,)).astype(onp.float32)
+    a = np.array(x)
+    onp.testing.assert_allclose(np.sort(a).asnumpy(), onp.sort(x), rtol=1e-6)
+    assert np.argsort(a).asnumpy().tolist() == onp.argsort(x).tolist()
+    w = np.where(a > 0, a, np.zeros_like(a))
+    onp.testing.assert_allclose(w.asnumpy(), onp.where(x > 0, x, 0), rtol=1e-6)
+    idx = np.array([0, 2], dtype="int32")
+    onp.testing.assert_allclose(np.take(a, idx).asnumpy(), x[[0, 2]], rtol=1e-6)
+    u = np.unique(np.array([1, 1, 2, 3, 3]))
+    assert u.asnumpy().tolist() == [1, 2, 3]
+
+
+def test_linalg():
+    rs = onp.random.RandomState(6)
+    m = rs.uniform(-1, 1, (4, 4)).astype(onp.float32)
+    spd = m @ m.T + 4 * onp.eye(4, dtype=onp.float32)
+    a = np.array(spd)
+    onp.testing.assert_allclose(np.linalg.norm(a).asnumpy(),
+                                onp.linalg.norm(spd), rtol=1e-5)
+    inv = np.linalg.inv(a)
+    onp.testing.assert_allclose((np.matmul(a, inv)).asnumpy(), onp.eye(4),
+                                atol=1e-4)
+    L = np.linalg.cholesky(a)
+    onp.testing.assert_allclose(np.matmul(L, L.T).asnumpy(), spd, rtol=1e-4,
+                                atol=1e-4)
+
+
+def test_random():
+    np.random.seed(42)
+    u = np.random.uniform(0, 1, size=(1000,))
+    assert 0.0 <= float(u.min().asnumpy()) and float(u.max().asnumpy()) <= 1.0
+    n = np.random.normal(2.0, 0.5, size=(2000,))
+    assert abs(float(n.mean().asnumpy()) - 2.0) < 0.1
+    r = np.random.randint(0, 10, size=(100,))
+    assert str(r.dtype) == "int32" and int(r.max().asnumpy()) < 10
+    c = np.random.choice(5, size=(20,))
+    assert c.shape == (20,)
+
+
+def test_autograd_through_np_ops():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = np.sum(np.exp(x) * 2.0)
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * onp.exp(x.asnumpy()),
+                                rtol=1e-5)
+
+
+def test_autograd_through_np_matmul():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    b = np.array([[1.0, 0.0], [0.0, 1.0]])
+    a.attach_grad()
+    with autograd.record():
+        out = np.matmul(a, b).sum()
+    out.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), onp.ones((2, 2)), rtol=1e-6)
+
+
+def test_npx_ops():
+    x = np.array([[1.0, 2.0, 3.0]])
+    s = npx.softmax(x)
+    onp.testing.assert_allclose(s.asnumpy().sum(), 1.0, rtol=1e-5)
+    assert isinstance(s, np.ndarray)
+    r = npx.relu(np.array([-1.0, 2.0]))
+    assert r.asnumpy().tolist() == [0.0, 2.0]
+    t = npx.topk(np.array([[3.0, 1.0, 2.0]]), k=2)
+    assert t.asnumpy().astype(int).tolist() == [[0, 2]]
+    oh = npx.one_hot(np.array([0, 2], dtype="int32"), 3)
+    assert oh.asnumpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+    bd = npx.batch_dot(np.ones((2, 3, 4)), np.ones((2, 4, 5)))
+    assert bd.shape == (2, 3, 5)
+    onp.testing.assert_allclose(npx.erf(np.array([0.0])).asnumpy(), [0.0])
+
+
+def test_npx_set_np_roundtrip():
+    npx.set_np()
+    assert mx.is_np_array() and mx.is_np_shape()
+    npx.set_np(shape=False, array=False)
+    assert not mx.is_np_array() and not mx.is_np_shape()
+    # this build is numpy-semantics by default; reset_np restores that default
+    npx.reset_np()
+    assert mx.is_np_array() and mx.is_np_shape()
+
+
+def test_np_as_nd_roundtrip():
+    a = np.array([1.0, 2.0])
+    nd_view = a.as_nd_ndarray()
+    assert type(nd_view).__name__ == "NDArray"
+    back = np.array(nd_view)
+    assert isinstance(back, np.ndarray)
+    onp.testing.assert_allclose(back.asnumpy(), [1.0, 2.0])
+
+
+def test_kwarg_arrays_and_tape():
+    # review regression: NDArrays passed as keyword args must work + record
+    a = np.array([1.0, 2.0, 3.0])
+    idx = np.array([0, 2], dtype="int32")
+    onp.testing.assert_allclose(np.take(a, indices=idx).asnumpy(), [1.0, 3.0])
+    x = np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = np.sum(np.where(np.array([True]), x, np.zeros_like(x)))
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [1.0])
+
+
+def test_astype_copy_differentiable():
+    x = np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x.astype("float32") * 2.0 + x.copy()).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [3.0, 3.0])
+
+
+def test_random_array_params():
+    np.random.seed(0)
+    u = np.random.uniform(np.array([0.0, 10.0]), np.array([1.0, 11.0]))
+    assert u.shape == (2,)
+    vals = u.asnumpy()
+    assert 0 <= vals[0] <= 1 and 10 <= vals[1] <= 11
+    g = np.random.gamma(np.array([1.0, 2.0]))
+    assert g.shape == (2,)
+
+
+def test_npx_softmax_length_mask():
+    x = np.ones((2, 4))
+    s = npx.softmax(x, axis=-1, length=np.array([2, 2], dtype="int32"))
+    onp.testing.assert_allclose(s.asnumpy()[:, :2], 0.5 * onp.ones((2, 2)),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(s.asnumpy()[:, 2:], onp.zeros((2, 2)), atol=1e-6)
+
+
+def test_npx_arange_like_repeat():
+    x = np.zeros((6,))
+    out = npx.arange_like(x, repeat=2)
+    onp.testing.assert_allclose(out.asnumpy(), [0, 0, 1, 1, 2, 2])
