@@ -48,6 +48,12 @@ from . import recordio
 from . import contrib
 from . import numpy as np
 from . import numpy_extension as npx
+from . import module
+from . import model
+from . import callback
+from . import monitor
+from .model import FeedForward
+from .monitor import Monitor
 
 from .util import is_np_shape, is_np_array, set_np, reset_np
 

@@ -1,0 +1,155 @@
+"""model.py — FeedForward (the oldest API) + checkpoint helpers.
+
+Reference: python/mxnet/model.py (FeedForward, save_checkpoint:407,
+load_checkpoint:456). FeedForward delegates to Module internally, same as
+late reference versions effectively did.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """(reference model.py:407)"""
+    from .serialization import save_ndarrays
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    save_ndarrays("%s-%04d.params" % (prefix, epoch), save_dict)
+    logging.info("Saved checkpoint to \"%s-%04d.params\"", prefix, epoch)
+
+
+def load_params(fname):
+    from .serialization import load_ndarrays
+    loaded = load_ndarrays(fname)
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        tp, name = k.split(":", 1) if ":" in k else ("arg", k)
+        (arg_params if tp == "arg" else aux_params)[name] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """(reference model.py:456) -> (symbol, arg_params, aux_params)"""
+    from . import symbol as sym_mod
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    arg_params, aux_params = load_params("%s-%04d.params" % (prefix, epoch))
+    return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """(reference model.py:546) — kept for API parity; Module is the real
+    engine underneath."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from .initializer import Uniform
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.initializer = initializer or Uniform(0.01)
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = begin_epoch
+        self.numpy_batch_size = numpy_batch_size
+        self.kwargs = kwargs
+        self._module = None
+
+    def _as_iter(self, X, y=None, batch_size=None):
+        from .io import NDArrayIter
+        if hasattr(X, "provide_data"):
+            return X
+        return NDArrayIter(X, y, batch_size or self.numpy_batch_size)
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        from .module import Module
+        data = self._as_iter(X, y)
+        label_names = [n for n in self.symbol.list_arguments()
+                       if n.endswith("label")] or ["softmax_label"]
+        data_names = [d[0] if isinstance(d, (tuple, list)) else d.name
+                      for d in data.provide_data]
+        self._module = Module(self.symbol, data_names=data_names,
+                              label_names=label_names, context=self.ctx,
+                              logger=logger or logging)
+        # reference FeedForward forwards plain kwargs (learning_rate,
+        # momentum, wd, …) into optimizer creation
+        opt_params = dict(self.kwargs.get("optimizer_params",
+                                          (("learning_rate", 0.01),)))
+        for k, v in self.kwargs.items():
+            if k != "optimizer_params":
+                opt_params[k] = v
+        self._module.fit(data, eval_data=eval_data, eval_metric=eval_metric,
+                         epoch_end_callback=epoch_end_callback,
+                         batch_end_callback=batch_end_callback,
+                         kvstore=kvstore, optimizer=self.optimizer,
+                         optimizer_params=tuple(opt_params.items()),
+                         initializer=self.initializer,
+                         arg_params=self.arg_params,
+                         aux_params=self.aux_params,
+                         begin_epoch=self.begin_epoch,
+                         num_epoch=self.num_epoch,
+                         eval_end_callback=eval_end_callback,
+                         eval_batch_end_callback=eval_batch_end_callback,
+                         monitor=monitor)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        from .module import Module
+        data = self._as_iter(X)
+        if self._module is None:
+            data_names = [d[0] if isinstance(d, (tuple, list)) else d.name
+                          for d in data.provide_data]
+            self._module = Module(self.symbol, data_names=data_names,
+                                  label_names=[], context=self.ctx)
+            self._module.bind(data.provide_data, for_training=False)
+            self._module.init_params(arg_params=self.arg_params,
+                                     aux_params=self.aux_params)
+        out = self._module.predict(data, num_batch=num_batch, reset=reset)
+        return out.asnumpy() if isinstance(out, NDArray) else out
+
+    def score(self, X, eval_metric="acc", num_batch=None,
+              batch_end_callback=None, reset=True):
+        data = self._as_iter(X)
+        res = self._module.score(data, eval_metric, num_batch=num_batch,
+                                 batch_end_callback=batch_end_callback,
+                                 reset=reset)
+        return res[0][1] if res else None
+
+    def save(self, prefix, epoch=None, remove_amp_cast=True):
+        save_checkpoint(prefix, epoch if epoch is not None else
+                        (self.num_epoch or 0), self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None, **kwargs):
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger)
+        return model

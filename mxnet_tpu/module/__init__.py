@@ -1,0 +1,17 @@
+"""Legacy symbolic trainer API (reference python/mxnet/module/).
+
+`Module` binds a Symbol into a jit-compiled Executor and drives the classic
+fit/forward/backward/update loop (reference module/base_module.py:409 fit,
+module/module.py:40 Module). `BucketingModule` keeps one Executor per bucket
+key — on TPU each bucket is its own jit signature, which is exactly the
+reference's per-bucket executor sharing (bucketing_module.py:40).
+`SequentialModule` chains modules (sequential_module.py).
+"""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+from .python_module import PythonModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule", "SequentialModule",
+           "PythonModule"]

@@ -1,0 +1,152 @@
+"""BucketingModule (reference python/mxnet/module/bucketing_module.py:40).
+
+Variable-length sequence training: one Module per bucket key, parameters
+shared across buckets. On TPU each bucket is one jit signature — exactly the
+reference's executor-per-bucket sharing, with XLA compile caches standing in
+for shared memory pools.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen: Callable, default_bucket_key=None,
+                 logger=None, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger or logging)
+        assert default_bucket_key is not None
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets: Dict = {}
+        self._curr_module: Module = None
+        self._curr_bucket_key = None
+        self._grad_req = "write"
+        self._opt_args = None
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    def _gen_module(self, bucket_key):
+        sym, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(sym, data_names=data_names, label_names=label_names,
+                      logger=self.logger, context=self._context,
+                      fixed_param_names=self._fixed_param_names)
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self._grad_req = grad_req
+        module = self._gen_module(self._default_bucket_key)
+        module.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
+                    force_rebind=False, grad_req=grad_req)
+        self._buckets[self._default_bucket_key] = module
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+        self.for_training = for_training
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """(bucketing_module.py:404)"""
+        assert self.binded, "call bind before switching buckets"
+        if bucket_key == self._curr_bucket_key:
+            return  # common case: consecutive batches share a bucket
+        if bucket_key not in self._buckets:
+            module = self._gen_module(bucket_key)
+            module.bind(data_shapes, label_shapes, self.for_training,
+                        grad_req=self._grad_req)
+            if self.params_initialized:
+                # seed from the ACTIVE module — it holds the trained params
+                ap, xp = self._curr_module.get_params()
+                module.init_params(arg_params=ap, aux_params=xp,
+                                   allow_missing=True, force_init=True)
+                if self._opt_args is not None:
+                    self._init_module_optimizer(module)
+            self._buckets[bucket_key] = module
+        else:
+            module = self._buckets[bucket_key]
+            if self.params_initialized:
+                # pull latest shared params from the previously-active bucket
+                ap, xp = self._curr_module.get_params()
+                module.init_params(arg_params=ap, aux_params=xp,
+                                   allow_missing=True, force_init=True)
+        self._curr_module = module
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        assert self.binded
+        self._curr_module.init_params(initializer=initializer,
+                                      arg_params=arg_params,
+                                      aux_params=aux_params,
+                                      allow_missing=allow_missing,
+                                      force_init=force_init)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        from .. import optimizer as opt_mod
+        # ONE optimizer + updater shared across buckets: momentum/Adam state
+        # and update counts must not fork per jit signature
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._shared_optimizer = optimizer
+        else:
+            self._shared_optimizer = opt_mod.create(
+                optimizer, **dict(optimizer_params or ()))
+        self._shared_updater = opt_mod.get_updater(self._shared_optimizer)
+        self._opt_args = dict(kvstore=kvstore)
+        for mod in self._buckets.values():
+            self._init_module_optimizer(mod, force_init=force_init)
+        self.optimizer_initialized = True
+
+    def _init_module_optimizer(self, mod, force_init=False):
+        mod.init_optimizer(kvstore=self._opt_args.get("kvstore", "local"),
+                           optimizer=self._shared_optimizer,
+                           force_init=force_init)
+        mod._updater = self._shared_updater
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        bucket_key = getattr(data_batch, "bucket_key", self._default_bucket_key)
+        self.switch_bucket(bucket_key, data_batch.provide_data
+                           if hasattr(data_batch, "provide_data") else
+                           self._curr_module.data_shapes,
+                           getattr(data_batch, "provide_label", None))
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        if not self._curr_module.optimizer_initialized and self._opt_args:
+            self._init_module_optimizer(self._curr_module)
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs()
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        for mod in self._buckets.values():
+            mod.install_monitor(mon)

@@ -1,0 +1,236 @@
+"""Module (reference python/mxnet/module/module.py:40).
+
+One Symbol + one Executor (jit-compiled graph; the reference's
+DataParallelExecutorGroup multi-device slicing collapses into XLA sharding —
+use parallel.DataParallelTrainer for the multi-chip path).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from .. import optimizer as opt_mod
+from ..initializer import InitDesc
+from .base_module import BaseModule
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=None, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        import logging
+        super().__init__(logger=logger or logging)
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._context = context if isinstance(context, Context) else \
+            (context[0] if isinstance(context, (list, tuple)) and context
+             else current_context())
+        self._fixed_param_names = set(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._arg_params: Dict[str, NDArray] = {}
+        self._aux_params: Dict[str, NDArray] = {}
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._inputs_need_grad = False
+
+    # -- binding -------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        shapes = {}
+        for desc in data_shapes:
+            name, shape = (desc[0], desc[1]) if isinstance(desc, (tuple, list)) \
+                else (desc.name, desc.shape)
+            shapes[name] = tuple(shape)
+        if label_shapes:
+            for desc in label_shapes:
+                name, shape = (desc[0], desc[1]) if isinstance(desc, (tuple, list)) \
+                    else (desc.name, desc.shape)
+                shapes[name] = tuple(shape)
+        self._data_shapes, self._label_shapes = data_shapes, label_shapes
+        req = grad_req if for_training else "null"
+        if for_training:
+            # params get gradients; data/labels only if inputs_need_grad
+            req = {n: grad_req if (n in self._param_names
+                                   or (inputs_need_grad and n in self._data_names))
+                   else "null"
+                   for n in self._symbol.list_arguments()}
+        self._exec = self._symbol.simple_bind(ctx=self._context, grad_req=req,
+                                              **shapes)
+        self.binded = True
+        self.for_training = for_training
+        self._inputs_need_grad = inputs_need_grad
+        if shared_module is not None and shared_module.params_initialized:
+            ap, xp = shared_module.get_params()
+            self.set_params(ap, xp)
+
+    # -- parameters ----------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        assert self.binded, "call bind before init_params"
+        if self.params_initialized and not force_init:
+            return
+        if arg_params is None and getattr(self, "_preloaded_params", None):
+            # Module.load(): pull the checkpoint saved next to the symbol
+            from ..model import load_params as _load
+            arg_params, aux_params = _load(self._preloaded_params)
+        from ..initializer import Uniform
+        have_given = arg_params is not None
+        if initializer is None and not have_given:
+            initializer = Uniform(0.01)
+        arg_dict = dict(zip(self._symbol.list_arguments(),
+                            self._exec.arg_arrays))
+        aux_dict = dict(zip(self._aux_names, self._exec.aux_arrays))
+        for name in self._param_names:
+            arr = arg_dict[name]
+            if have_given and name in arg_params:
+                arr._set_data(arg_params[name]._data.astype(arr.dtype))
+            elif have_given and not allow_missing:
+                raise MXNetError(
+                    f"parameter '{name}' missing from given arg_params "
+                    "(pass allow_missing=True to initialize it instead)")
+            elif initializer is not None:
+                initializer(InitDesc(name), arr)
+            elif have_given:
+                pass  # allow_missing with no initializer: keep current value
+            else:
+                raise MXNetError(f"no initializer and no value for {name}")
+        for name in self._aux_names:
+            arr = aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr._set_data(aux_params[name]._data.astype(arr.dtype))
+        self._arg_params = {n: arg_dict[n] for n in self._param_names}
+        self._aux_params = dict(aux_dict)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.params_initialized
+        arg = {n: a.copy() if hasattr(a, "copy") else a
+               for n, a in self._arg_params.items()}
+        aux = {n: a.copy() if hasattr(a, "copy") else a
+               for n, a in self._aux_params.items()}
+        return arg, aux
+
+    # -- optimizer -----------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt_mod.create(optimizer,
+                                             **dict(optimizer_params or ()))
+        self._updater = opt_mod.get_updater(self._optimizer)
+        states_file = getattr(self, "_preloaded_states", None)
+        if states_file is not None:
+            with open(states_file, "rb") as f:
+                self._updater.set_states(f.read())
+        self.optimizer_initialized = True
+
+    # -- compute -------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label is not None and self._label_names:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        assert self.optimizer_initialized
+        grads = dict(zip(self._symbol.list_arguments(),
+                         self._exec.grad_arrays))
+        for i, name in enumerate(self._param_names):
+            if name in self._fixed_param_names:
+                continue
+            g = grads.get(name)
+            if g is None:
+                continue
+            w = self._arg_params[name]
+            self._updater(i, g, w)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self._inputs_need_grad
+        grads = dict(zip(self._symbol.list_arguments(),
+                         self._exec.grad_arrays))
+        return [grads[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, mon):
+        mon.install(self._exec)
+
+    # -- checkpoint (reference module.py save_checkpoint/load) ---------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._symbol.save("%s-symbol.json" % prefix)
+        param_name = "%s-%04d.params" % (prefix, epoch)
+        self.save_params(param_name)
+        if save_optimizer_states and self._updater is not None:
+            with open("%s-%04d.states" % (prefix, epoch), "wb") as f:
+                f.write(self._updater.get_states())
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from .. import symbol as sym_mod
+        symbol = sym_mod.load("%s-symbol.json" % prefix)
+        mod = Module(symbol, **kwargs)
+        # consumed by init_params / init_optimizer after bind
+        mod._preloaded_params = "%s-%04d.params" % (prefix, epoch)
+        if load_optimizer_states:
+            mod._preloaded_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        outs = self._exec.outputs
+        return list(zip(self.output_names, [o.shape for o in outs]))

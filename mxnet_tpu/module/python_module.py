@@ -1,0 +1,50 @@
+"""PythonModule (reference python/mxnet/module/python_module.py): a module
+whose compute is arbitrary Python — for loss layers/metrics that don't need
+parameters. Subclass and override forward/backward."""
+from __future__ import annotations
+
+import logging
+
+from .base_module import BaseModule
+
+
+class PythonModule(BaseModule):
+    def __init__(self, data_names, label_names, output_names, logger=None):
+        super().__init__(logger=logger or logging)
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._output_names = list(output_names)
+        self._outputs = None
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        self.binded = True
+        self.for_training = for_training
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        self.params_initialized = True
+
+    def get_params(self):
+        return {}, {}
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self.optimizer_initialized = True
+
+    def update(self):
+        pass
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._outputs
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        if self._outputs is not None:
+            eval_metric.update(labels, self._outputs)
+
+    def install_monitor(self, mon):
+        pass

@@ -1,0 +1,105 @@
+"""SequentialModule (reference python/mxnet/module/sequential_module.py):
+chains modules, each consuming the previous one's outputs."""
+from __future__ import annotations
+
+import logging
+
+from .base_module import BaseModule
+
+
+class SequentialModule(BaseModule):
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=None):
+        super().__init__(logger=logger or logging)
+        self._modules = []
+        self._metas = []
+        self._label_shapes = None
+
+    def add(self, module, **kwargs):
+        self._modules.append(module)
+        self._metas.append(kwargs)
+        return self
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        assert shared_module is None
+        self._label_shapes = label_shapes
+        cur_shapes = data_shapes
+        for i, (module, meta) in enumerate(zip(self._modules, self._metas)):
+            take_labels = meta.get(self.META_TAKE_LABELS, False)
+            need_grad = inputs_need_grad if i == 0 else True
+            module.bind(cur_shapes,
+                        label_shapes if take_labels else None,
+                        for_training=for_training,
+                        inputs_need_grad=need_grad,
+                        force_rebind=force_rebind, grad_req=grad_req)
+            outs = module.output_shapes
+            data_names = module.data_names if hasattr(module, "data_names") \
+                else ["data"]
+            cur_shapes = [(data_names[j] if j < len(data_names) else name, shape)
+                          for j, (name, shape) in enumerate(outs)]
+        self.binded = True
+        self.for_training = for_training
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        for module in self._modules:
+            module.init_params(initializer=initializer, arg_params=arg_params,
+                               aux_params=aux_params,
+                               allow_missing=True, force_init=force_init)
+        self.params_initialized = True
+
+    def get_params(self):
+        arg, aux = {}, {}
+        for module in self._modules:
+            a, x = module.get_params()
+            arg.update(a)
+            aux.update(x)
+        return arg, aux
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        for module in self._modules:
+            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                  optimizer_params=optimizer_params,
+                                  force_init=force_init)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        from ..io.io import DataBatch
+        batch = data_batch
+        for i, module in enumerate(self._modules):
+            module.forward(batch, is_train=is_train)
+            if i < len(self._modules) - 1:
+                batch = DataBatch(data=module.get_outputs(),
+                                  label=data_batch.label)
+
+    def backward(self, out_grads=None):
+        for i, module in reversed(list(enumerate(self._modules))):
+            module.backward(out_grads=out_grads)
+            if i > 0:
+                out_grads = module.get_input_grads()
+
+    def update(self):
+        for module in self._modules:
+            module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._modules[-1].get_outputs()
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        for module, meta in zip(self._modules, self._metas):
+            if meta.get(self.META_TAKE_LABELS, False):
+                module.update_metric(eval_metric, labels)
+                return
+        self._modules[-1].update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        for module in self._modules:
+            module.install_monitor(mon)

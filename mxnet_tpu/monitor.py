@@ -1,0 +1,76 @@
+"""Monitor (reference python/mxnet/monitor.py): installs a per-output
+callback on executors to dump activation/weight statistics every N batches —
+the debugging analog of executor monitor callbacks
+(SetMonitorCallback, reference src/executor/graph_executor.cc:187).
+"""
+from __future__ import annotations
+
+import logging
+import re
+from typing import Callable, List, Optional, Tuple
+
+from .ndarray import NDArray
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                """mean absolute value — the reference default |x|/size"""
+                import jax.numpy as jnp
+                return NDArray(jnp.mean(jnp.abs(x._data)))
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue: List[Tuple[int, str, NDArray]] = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe):
+        """Attach to an Executor (reference monitor.py:79 install_to_executor)."""
+        def stat_helper(name, arr):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(arr)))
+        exe.set_monitor_callback(stat_helper)
+        self.exes.append(exe)
+        return exe
+
+    def tic(self):
+        """Start collecting for this batch if due (reference monitor.py:87)."""
+        if self.step % self.interval == 0:
+            for exe in self.exes:
+                for arr in exe.arg_arrays:
+                    arr.wait_to_read()
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self) -> List[Tuple[int, str, str]]:
+        """Stop collecting; also dump weights (reference monitor.py:96)."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for name, array in zip(exe._symbol.list_arguments(),
+                                   exe.arg_arrays):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name, self.stat_func(array)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            s = " ".join(str(float(v.asnumpy().reshape(-1)[0]))
+                         if v.size == 1 else str(v.asnumpy()) for v in v_list)
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """(reference monitor.py:124)"""
+        for n, k, v in self.toc():
+            logging.info("Batch: %7d %30s %s", n, k, v)

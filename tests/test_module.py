@@ -1,0 +1,211 @@
+"""Legacy module API tests (reference tests/python/train/test_mlp.py,
+tests/python/unittest/test_module.py style): small real trainings with
+convergence asserts + bucketing + checkpoints + callbacks."""
+import glob
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.module import Module, BucketingModule, SequentialModule
+
+
+def _mlp_symbol(num_hidden=16, num_classes=2):
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=num_hidden)
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=num_classes)
+    return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"), name="softmax")
+
+
+def _toy_data(n=256, seed=0):
+    rs = onp.random.RandomState(seed)
+    x = rs.uniform(-1, 1, (n, 8)).astype(onp.float32)
+    y = (x.sum(axis=1) > 0).astype(onp.float32)
+    return x, y
+
+
+def test_module_fit_converges():
+    x, y = _toy_data()
+    train = NDArrayIter(x, y, batch_size=32, shuffle=True)
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train, num_epoch=10, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.05),))
+    score = mod.score(NDArrayIter(x, y, batch_size=32), "acc")
+    assert dict(score)["accuracy"] > 0.8
+
+
+def test_module_forward_backward_update():
+    x, y = _toy_data(64)
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    it = NDArrayIter(x, y, batch_size=16)
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    out0 = mod.get_outputs()[0].asnumpy()
+    assert out0.shape == (16, 2)
+    onp.testing.assert_allclose(out0.sum(axis=1), onp.ones(16), rtol=1e-4)
+    before = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+    mod.backward()
+    mod.update()
+    after = mod.get_params()[0]
+    changed = any(onp.abs(after[k].asnumpy() - before[k]).max() > 0
+                  for k in before)
+    assert changed
+
+
+def test_module_predict_and_params_roundtrip(tmp_path):
+    x, y = _toy_data(64)
+    it = NDArrayIter(x, y, batch_size=16)
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    pred = mod.predict(NDArrayIter(x, y, batch_size=16))
+    assert pred.shape == (64, 2)
+    fname = str(tmp_path / "weights.params")
+    mod.save_params(fname)
+    mod2 = Module(_mlp_symbol(), context=mx.cpu())
+    mod2.bind(it.provide_data, it.provide_label)
+    mod2.init_params()
+    mod2.load_params(fname)
+    pred2 = mod2.predict(NDArrayIter(x, y, batch_size=16))
+    onp.testing.assert_allclose(pred.asnumpy(), pred2.asnumpy(), rtol=1e-5)
+
+
+def test_module_save_checkpoint_and_load(tmp_path):
+    x, y = _toy_data(64)
+    it = NDArrayIter(x, y, batch_size=16)
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 3)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0003.params")
+    symbol, arg_params, aux_params = mx.model.load_checkpoint(prefix, 3)
+    assert set(arg_params) == {"fc1_weight", "fc1_bias", "fc2_weight",
+                               "fc2_bias"}
+
+
+def test_feedforward_fit_predict():
+    x, y = _toy_data(128, seed=1)
+    model = mx.FeedForward(_mlp_symbol(), ctx=mx.cpu(), num_epoch=10,
+                           optimizer="sgd", numpy_batch_size=32,
+                           optimizer_params=(("learning_rate", 0.05),))
+    model.fit(x, y)
+    pred = model.predict(x)
+    acc = ((pred.argmax(axis=1) == y).mean())
+    assert acc > 0.75
+
+
+def test_bucketing_module():
+    # two buckets = two sequence lengths of a shared-weight MLP
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data, name="fc_shared", num_hidden=2)
+        out = sym.SoftmaxOutput(fc, sym.Variable("softmax_label"),
+                                name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    from mxnet_tpu.io.io import DataBatch
+    mod = BucketingModule(sym_gen, default_bucket_key=8, context=mx.cpu())
+    mod.bind([("data", (4, 8))], [("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+
+    rs = onp.random.RandomState(0)
+
+    class BucketBatch(DataBatch):
+        def __init__(self, bucket_key, n_feat):
+            super().__init__(
+                data=[nd.array(rs.uniform(-1, 1, (4, n_feat)).astype("float32"))],
+                label=[nd.array(onp.zeros(4, "float32"))])
+            self.bucket_key = bucket_key
+            self.provide_data = [("data", (4, n_feat))]
+            self.provide_label = [("softmax_label", (4,))]
+
+    mod.forward(BucketBatch(8, 8), is_train=True)
+    mod.backward()
+    mod.update()
+    # same weights, different jit signature: params must be shared
+    p8 = mod.get_params()[0]["fc_shared_weight"].asnumpy()
+    # switching buckets with a different input width needs a new symbol; here
+    # bucket 8 only — verify a second bucket with SAME width shares params
+    mod.forward(BucketBatch(4, 8), is_train=True)
+    p4 = mod.get_params()[0]["fc_shared_weight"].asnumpy()
+    onp.testing.assert_allclose(p8, p4)
+
+
+def test_speedometer_and_checkpoint_callback(tmp_path):
+    x, y = _toy_data(64)
+    train = NDArrayIter(x, y, batch_size=16)
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    prefix = str(tmp_path / "cb")
+    mod.fit(train, num_epoch=2, optimizer="sgd",
+            batch_end_callback=mx.callback.Speedometer(16, frequent=2),
+            epoch_end_callback=mx.callback.do_checkpoint(prefix, period=1))
+    assert os.path.exists(prefix + "-0002.params")
+
+
+def test_monitor():
+    x, y = _toy_data(32)
+    it = NDArrayIter(x, y, batch_size=16)
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    mon = mx.Monitor(interval=1, pattern=".*")
+    mod.install_monitor(mon)
+    batch = next(iter(it))
+    mon.tic()
+    mod.forward(batch, is_train=True)
+    stats = mon.toc()
+    assert len(stats) > 0
+    names = [k for _, k, _ in stats]
+    assert any("fc1" in n or "softmax" in n or "weight" in n for n in names)
+
+
+def test_module_load_restores_checkpoint(tmp_path):
+    # review regression: Module.load must actually restore saved weights
+    x, y = _toy_data(64)
+    it = NDArrayIter(x, y, batch_size=16)
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 1)
+    pred = mod.predict(NDArrayIter(x, y, batch_size=16)).asnumpy()
+
+    mod2 = Module.load(prefix, 1, context=mx.cpu())
+    mod2.bind(it.provide_data, it.provide_label)
+    mod2.init_params()
+    pred2 = mod2.predict(NDArrayIter(x, y, batch_size=16)).asnumpy()
+    onp.testing.assert_allclose(pred, pred2, rtol=1e-5)
+
+
+def test_set_params_missing_raises():
+    x, y = _toy_data(32)
+    it = NDArrayIter(x, y, batch_size=16)
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    arg, aux = mod.get_params()
+    del arg["fc1_weight"]
+    with pytest.raises(Exception):
+        mod.set_params(arg, aux, allow_missing=False)
+    mod.set_params(arg, aux, allow_missing=True)  # ok
+
+
+def test_feedforward_plain_kwargs_reach_optimizer():
+    x, y = _toy_data(64)
+    model = mx.FeedForward(_mlp_symbol(), ctx=mx.cpu(), num_epoch=1,
+                           optimizer="sgd", numpy_batch_size=32,
+                           learning_rate=0.25)
+    model.fit(x, y)
+    assert abs(model._module._optimizer.learning_rate - 0.25) < 1e-9
