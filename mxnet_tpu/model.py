@@ -14,15 +14,24 @@ from . import ndarray as nd
 from .ndarray import NDArray
 
 
+def pack_params(arg_params, aux_params):
+    """The single definition of the checkpoint key format (arg:/aux:)."""
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    return save_dict
+
+
+def save_params_file(fname, arg_params, aux_params):
+    from .serialization import save_ndarrays
+    save_ndarrays(fname, pack_params(arg_params, aux_params))
+
+
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
     """(reference model.py:407)"""
-    from .serialization import save_ndarrays
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
-    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-    save_ndarrays("%s-%04d.params" % (prefix, epoch), save_dict)
+    save_params_file("%s-%04d.params" % (prefix, epoch), arg_params, aux_params)
     logging.info("Saved checkpoint to \"%s-%04d.params\"", prefix, epoch)
 
 

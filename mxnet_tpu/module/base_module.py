@@ -209,11 +209,9 @@ class BaseModule:
 
     # -- checkpointing (one key format, defined in model.py) -----------------
     def save_params(self, fname):
-        from ..serialization import save_ndarrays
+        from ..model import save_params_file
         arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-        save_ndarrays(fname, save_dict)
+        save_params_file(fname, arg_params, aux_params)
 
     def load_params(self, fname):
         from ..model import load_params as _load

@@ -77,6 +77,8 @@ class BucketingModule(BaseModule):
                                    allow_missing=True, force_init=True)
                 if self._opt_args is not None:
                     self._init_module_optimizer(module)
+            if getattr(self, "_monitor", None) is not None:
+                module.install_monitor(self._monitor)
             self._buckets[bucket_key] = module
         else:
             module = self._buckets[bucket_key]
@@ -126,11 +128,16 @@ class BucketingModule(BaseModule):
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
-        bucket_key = getattr(data_batch, "bucket_key", self._default_bucket_key)
-        self.switch_bucket(bucket_key, data_batch.provide_data
-                           if hasattr(data_batch, "provide_data") else
-                           self._curr_module.data_shapes,
-                           getattr(data_batch, "provide_label", None))
+        # DataBatch always HAS these attributes (default None) — test the
+        # values, not attribute presence
+        bucket_key = getattr(data_batch, "bucket_key", None)
+        if bucket_key is None:
+            bucket_key = self._default_bucket_key
+        shapes = getattr(data_batch, "provide_data", None) \
+            or self._curr_module.data_shapes
+        label_shapes = getattr(data_batch, "provide_label", None) \
+            or self._curr_module.label_shapes
+        self.switch_bucket(bucket_key, shapes, label_shapes)
         self._curr_module.forward(data_batch, is_train=is_train)
 
     def backward(self, out_grads=None):
@@ -148,5 +155,6 @@ class BucketingModule(BaseModule):
         self._curr_module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
+        self._monitor = mon  # also installed on buckets created later
         for mod in self._buckets.values():
             mod.install_monitor(mon)

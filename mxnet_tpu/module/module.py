@@ -72,6 +72,13 @@ class Module(BaseModule):
                    for n in self._symbol.list_arguments()}
         self._exec = self._symbol.simple_bind(ctx=self._context, grad_req=req,
                                               **shapes)
+        # cache the name->grad mapping once: list_arguments/grad_arrays are
+        # full-graph traversals, too slow for the per-batch update() loop
+        grads = dict(zip(self._symbol.list_arguments(),
+                         self._exec.grad_arrays))
+        self._param_grads = [(i, name, grads.get(name))
+                             for i, name in enumerate(self._param_names)]
+        self._data_grads = [grads.get(n) for n in self._data_names]
         self.binded = True
         self.for_training = for_training
         self._inputs_need_grad = inputs_need_grad
@@ -164,25 +171,17 @@ class Module(BaseModule):
 
     def update(self):
         assert self.optimizer_initialized
-        grads = dict(zip(self._symbol.list_arguments(),
-                         self._exec.grad_arrays))
-        for i, name in enumerate(self._param_names):
-            if name in self._fixed_param_names:
+        for i, name, g in self._param_grads:
+            if g is None or name in self._fixed_param_names:
                 continue
-            g = grads.get(name)
-            if g is None:
-                continue
-            w = self._arg_params[name]
-            self._updater(i, g, w)
+            self._updater(i, g, self._arg_params[name])
 
     def get_outputs(self, merge_multi_context=True):
         return self._exec.outputs
 
     def get_input_grads(self, merge_multi_context=True):
         assert self._inputs_need_grad
-        grads = dict(zip(self._symbol.list_arguments(),
-                         self._exec.grad_arrays))
-        return [grads[n] for n in self._data_names]
+        return list(self._data_grads)
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         eval_metric.update(labels, self.get_outputs())

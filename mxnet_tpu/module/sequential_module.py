@@ -39,10 +39,14 @@ class SequentialModule(BaseModule):
                         inputs_need_grad=need_grad,
                         force_rebind=force_rebind, grad_req=grad_req)
             outs = module.output_shapes
-            data_names = module.data_names if hasattr(module, "data_names") \
-                else ["data"]
-            cur_shapes = [(data_names[j] if j < len(data_names) else name, shape)
-                          for j, (name, shape) in enumerate(outs)]
+            # key the next module's input shapes by the NEXT module's own
+            # data names (its symbol's free variables), not this module's
+            if i + 1 < len(self._modules):
+                next_names = getattr(self._modules[i + 1], "data_names",
+                                     ["data"])
+                cur_shapes = [(next_names[j] if j < len(next_names) else name,
+                               shape)
+                              for j, (name, shape) in enumerate(outs)]
         self.binded = True
         self.for_training = for_training
 

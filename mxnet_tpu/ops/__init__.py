@@ -15,3 +15,4 @@ from . import linalg     # noqa: F401
 from . import contrib    # noqa: F401
 from . import attention  # noqa: F401
 from . import extra      # noqa: F401
+from . import detection  # noqa: F401

@@ -1,0 +1,436 @@
+"""Detection operators: multibox suite, NMS, ROI pooling/align, spatial
+transformer (reference src/operator/contrib/multibox_*.cc, nms in
+src/operator/tensor/ordering + box_nms in contrib, src/operator/roi_pooling.cc,
+src/operator/contrib/roi_align.cc, src/operator/spatial_transformer.cc,
+src/operator/bilinear_sampler.cc).
+
+TPU-first design (SURVEY.md §7 step 10): no data-dependent shapes anywhere —
+matching and NMS are fixed-shape lax.scan sweeps over dense IoU matrices with
+masking (the "sorted-iota masking" strategy), ROI ops are dense gathers over
+fixed sampling grids. Dynamic result counts are encoded as -1-filled rows,
+matching the reference's output convention.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+from .contrib import box_iou
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior (reference src/operator/contrib/multibox_prior.cc)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",),
+          differentiable=False)
+def multibox_prior(data, *, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor generation: (1, H*W*(m+n-1), 4) corner boxes, normalized."""
+    H, W = data.shape[2], data.shape[3]
+    sizes = tuple(sizes)
+    ratios = tuple(ratios)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")          # (H, W)
+    # anchor set: (size_i, ratio_1) for all i  +  (size_1, ratio_j) j>1
+    ws, hs = [], []
+    for s in sizes:
+        ws.append(s * _np.sqrt(ratios[0]))
+        hs.append(s / _np.sqrt(ratios[0]))
+    for r in ratios[1:]:
+        ws.append(sizes[0] * _np.sqrt(r))
+        hs.append(sizes[0] / _np.sqrt(r))
+    ws = jnp.asarray(ws, jnp.float32) / 2.0                  # (A,)
+    hs = jnp.asarray(hs, jnp.float32) / 2.0
+    cxg = cxg[..., None]                                     # (H, W, 1)
+    cyg = cyg[..., None]
+    boxes = jnp.stack([cxg - ws, cyg - hs, cxg + ws, cyg + hs], axis=-1)
+    boxes = boxes.reshape(1, -1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+# ---------------------------------------------------------------------------
+# Box encode/decode helpers (reference multibox_target/detection kernels)
+# ---------------------------------------------------------------------------
+
+def _corner_to_center(boxes):
+    x1, y1, x2, y2 = jnp.split(boxes, 4, axis=-1)
+    return ((x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1)
+
+
+def _encode_box(anchor, gt, variances):
+    ax, ay, aw, ah = _corner_to_center(anchor)
+    gx, gy, gw, gh = _corner_to_center(gt)
+    aw = jnp.maximum(aw, 1e-12)
+    ah = jnp.maximum(ah, 1e-12)
+    dx = (gx - ax) / aw / variances[0]
+    dy = (gy - ay) / ah / variances[1]
+    dw = jnp.log(jnp.maximum(gw / aw, 1e-12)) / variances[2]
+    dh = jnp.log(jnp.maximum(gh / ah, 1e-12)) / variances[3]
+    return jnp.concatenate([dx, dy, dw, dh], axis=-1)
+
+
+def _decode_box(anchor, delta, variances):
+    ax, ay, aw, ah = _corner_to_center(anchor)
+    dx, dy, dw, dh = jnp.split(delta, 4, axis=-1)
+    cx = dx * variances[0] * aw + ax
+    cy = dy * variances[1] * ah + ay
+    w = jnp.exp(dw * variances[2]) * aw
+    h = jnp.exp(dh * variances[3]) * ah
+    return jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                           axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget (reference src/operator/contrib/multibox_target.cc)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",),
+          multi_output=True, differentiable=False)
+def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to ground truth -> (box_target, box_mask, cls_target).
+
+    Matching = greedy bipartite (each gt claims its best free anchor) then
+    threshold matching, as a fixed-M lax.scan over the dense IoU matrix.
+    label: (B, M, 5) rows [cls, x1, y1, x2, y2], padded with -1.
+    """
+    variances = tuple(variances)
+    anchors = anchor.reshape(-1, 4)                           # (N, 4)
+    N = anchors.shape[0]
+    B, M = label.shape[0], label.shape[1]
+    num_cls = cls_pred.shape[1] - 1
+
+    def one_sample(lab, scores):
+        valid = lab[:, 0] >= 0                                # (M,)
+        iou = box_iou(anchors, lab[:, 1:5])                   # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+
+        # greedy bipartite: M rounds of global argmax with row/col masking
+        def bip(carry, _):
+            iou_m, match = carry
+            flat = jnp.argmax(iou_m)
+            i, j = flat // M, flat % M
+            good = iou_m[i, j] > 1e-12
+            match = jnp.where(good, match.at[i].set(j), match)
+            iou_m = jnp.where(good,
+                              iou_m.at[i, :].set(-1.0).at[:, j].set(-1.0),
+                              jnp.full_like(iou_m, -1.0))
+            return (iou_m, match), None
+
+        match0 = jnp.full((N,), -1, jnp.int32)
+        (_, match), _ = lax.scan(bip, (iou, match0), None, length=M)
+
+        # threshold matching for still-unmatched anchors
+        best_j = jnp.argmax(iou, axis=1).astype(jnp.int32)
+        best_v = jnp.max(iou, axis=1)
+        match = jnp.where((match < 0) & (best_v >= overlap_threshold),
+                          best_j, match)
+
+        matched = match >= 0
+        gt = lab[jnp.maximum(match, 0)]                        # (N, 5)
+        box_t = _encode_box(anchors, gt[:, 1:5], variances)
+        box_t = jnp.where(matched[:, None], box_t, 0.0)
+        box_m = jnp.where(matched[:, None],
+                          jnp.ones((N, 4), jnp.float32), 0.0)
+        cls_t = jnp.where(matched, gt[:, 0] + 1.0, 0.0)
+
+        if negative_mining_ratio > 0:
+            # hard negative mining by background confidence deficit
+            # scores: (num_cls+1, N) per-class logits/probs
+            bg = scores[0]
+            max_fg = jnp.max(scores[1:], axis=0)
+            neg_score = max_fg - bg                            # hardness
+            neg_cand = ~matched
+            k = jnp.maximum(
+                (jnp.sum(matched) * negative_mining_ratio).astype(jnp.int32),
+                int(minimum_negative_samples))
+            order = jnp.argsort(jnp.where(neg_cand, neg_score, -jnp.inf))[::-1]
+            rank = jnp.zeros((N,), jnp.int32).at[order].set(jnp.arange(N))
+            keep_neg = neg_cand & (rank < k)
+            cls_t = jnp.where(~matched & ~keep_neg,
+                              jnp.float32(ignore_label), cls_t)
+        return box_t.reshape(-1), box_m.reshape(-1), cls_t
+
+    box_t, box_m, cls_t = jax.vmap(one_sample)(label, cls_pred)
+    return box_t, box_m, cls_t
+
+
+# ---------------------------------------------------------------------------
+# box_nms (reference src/operator/contrib/bounding_box.cc box_nms)
+# ---------------------------------------------------------------------------
+
+def _nms_keep(boxes, scores, valid, overlap_thresh, force_suppress, ids):
+    """Sequential-suppression NMS on sorted boxes: fixed-shape lax.scan.
+    Returns keep mask over the SORTED order plus the sort order."""
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    v = valid[order]
+    cid = ids[order] if ids is not None else None
+    iou = box_iou(b, b)                                       # (N, N)
+    if not force_suppress and cid is not None:
+        same = cid[:, None] == cid[None, :]
+        iou = jnp.where(same, iou, 0.0)
+    N = b.shape[0]
+
+    def body(keep, i):
+        sup = jnp.any(keep & (jnp.arange(N) < i) & (iou[:, i] > overlap_thresh))
+        keep = keep.at[i].set(v[i] & ~sup)
+        return keep, None
+
+    keep0 = jnp.zeros((N,), bool)
+    keep, _ = lax.scan(body, keep0, jnp.arange(N))
+    return keep, order
+
+
+@register("_contrib_box_nms", aliases=("box_nms",), differentiable=False)
+def box_nms(data, *, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """data: (..., N, K) rows [.. id, score, x1, y1, x2, y2 ..]; suppressed
+    rows become -1 (reference convention)."""
+    shape = data.shape
+    flat = data.reshape(-1, shape[-2], shape[-1])
+
+    def one(batch):
+        scores = batch[:, score_index]
+        valid = scores > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid = valid & (batch[:, id_index] != background_id)
+        ids = batch[:, id_index] if id_index >= 0 else None
+        boxes = batch[:, coord_start:coord_start + 4]
+        if in_format == "center":
+            cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+            boxes = jnp.concatenate([cx - w / 2, cy - h / 2,
+                                     cx + w / 2, cy + h / 2], -1)
+        keep, order = _nms_keep(boxes, scores, valid, overlap_thresh,
+                                force_suppress, ids)
+        if topk > 0:
+            keep = keep & (jnp.cumsum(keep.astype(jnp.int32)) <= topk)
+        sorted_batch = batch[order]
+        out = jnp.where(keep[:, None], sorted_batch, -jnp.ones_like(sorted_batch))
+        return out
+
+    out = jax.vmap(one)(flat)
+    return out.reshape(shape)
+
+
+@register("_contrib_box_non_maximum_suppression", differentiable=False)
+def box_non_maximum_suppression(data, **kwargs):
+    return box_nms(data, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection (reference src/operator/contrib/multibox_detection.cc)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",),
+          differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, *, clip=True,
+                       threshold=0.01, background_id=0, nms_threshold=0.5,
+                       force_suppress=False, variances=(0.1, 0.1, 0.2, 0.2),
+                       nms_topk=-1):
+    """cls_prob (B, C, N), loc_pred (B, N*4), anchor (1, N, 4) ->
+    (B, N, 6) rows [cls_id, score, x1, y1, x2, y2], -1 = invalid."""
+    variances = tuple(variances)
+    B, C, N = cls_prob.shape
+    anchors = anchor.reshape(-1, 4)
+
+    def one(probs, deltas):
+        boxes = _decode_box(anchors, deltas.reshape(-1, 4), variances)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        fg = jnp.concatenate([probs[:background_id],
+                              probs[background_id + 1:]], axis=0) \
+            if 0 <= background_id < C else probs
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        valid = score > threshold
+        rows = jnp.concatenate([cls_id[:, None], score[:, None], boxes], -1)
+        keep, order = _nms_keep(boxes, jnp.where(valid, score, -1.0), valid,
+                                nms_threshold, force_suppress,
+                                None if force_suppress else cls_id)
+        if nms_topk > 0:
+            keep = keep & (jnp.cumsum(keep.astype(jnp.int32)) <= nms_topk)
+        rows = rows[order]
+        return jnp.where(keep[:, None], rows, -jnp.ones_like(rows))
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# ROI pooling / align (reference src/operator/roi_pooling.cc,
+# src/operator/contrib/roi_align.cc)
+# ---------------------------------------------------------------------------
+
+@register("ROIPooling")
+def roi_pooling(data, rois, *, pooled_size, spatial_scale):
+    """Max pooling over quantized ROI bins. rois (R, 5): [b, x1, y1, x2, y2]
+    in image coords. Fixed-shape: each bin is sampled on an S*S integer grid
+    (S=8) with out-of-bin points masked — exact for bins up to 8px."""
+    PH, PW = pooled_size
+    S = 8
+    Bc, C, H, W = data.shape
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_w = rw / PW
+        bin_h = rh / PH
+        img = data[b]                                         # (C, H, W)
+        py = jnp.arange(PH, dtype=jnp.float32)
+        px = jnp.arange(PW, dtype=jnp.float32)
+        ys = jnp.floor(y1 + py[:, None] * bin_h) + \
+            jnp.arange(S, dtype=jnp.float32)[None, :]          # (PH, S)
+        xs = jnp.floor(x1 + px[:, None] * bin_w) + \
+            jnp.arange(S, dtype=jnp.float32)[None, :]          # (PW, S)
+        y_end = jnp.ceil(y1 + (py + 1) * bin_h)
+        x_end = jnp.ceil(x1 + (px + 1) * bin_w)
+        ym = (ys < y_end[:, None]) & (ys < H) & (ys >= 0)
+        xm = (xs < x_end[:, None]) & (xs < W) & (xs >= 0)
+        yi = jnp.clip(ys, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xs, 0, W - 1).astype(jnp.int32)
+        # gather (C, PH, S, PW, S)
+        g = img[:, yi[:, :, None, None], xi[None, None, :, :]]
+        mask = (ym[:, :, None, None] & xm[None, None, :, :])
+        g = jnp.where(mask[None], g, -jnp.inf)
+        out = jnp.max(g, axis=(2, 4))                          # (C, PH, PW)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one)(rois)
+
+
+@register("_contrib_ROIAlign")
+def roi_align(data, rois, *, pooled_size, spatial_scale, sample_ratio=2,
+              position_sensitive=False, aligned=False):
+    """Average pooling with bilinear sampling (exact, differentiable)."""
+    PH, PW = pooled_size
+    S = max(int(sample_ratio), 1)
+    Bc, C, H, W = data.shape
+    off = 0.5 if aligned else 0.0
+
+    def bilinear(img, y, x):
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x)
+        y1, x1 = y0 + 1, x0 + 1
+        wy1 = y - y0
+        wx1 = x - x0
+        wy0, wx0 = 1 - wy1, 1 - wx1
+
+        def at(yy, xx):
+            inb = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            return jnp.where(inb[None], img[:, yi, xi], 0.0)
+
+        return (at(y0, x0) * (wy0 * wx0)[None] + at(y0, x1) * (wy0 * wx1)[None]
+                + at(y1, x0) * (wy1 * wx0)[None] + at(y1, x1) * (wy1 * wx1)[None])
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - off
+        y1 = roi[2] * spatial_scale - off
+        x2 = roi[3] * spatial_scale - off
+        y2 = roi[4] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        bin_w, bin_h = rw / PW, rh / PH
+        img = data[b]
+        py = jnp.arange(PH, dtype=jnp.float32)
+        px = jnp.arange(PW, dtype=jnp.float32)
+        sy = (jnp.arange(S, dtype=jnp.float32) + 0.5) / S
+        sx = (jnp.arange(S, dtype=jnp.float32) + 0.5) / S
+        ys = y1 + (py[:, None] + sy[None, :]) * bin_h          # (PH, S)
+        xs = x1 + (px[:, None] + sx[None, :]) * bin_w          # (PW, S)
+        yy = jnp.broadcast_to(ys[:, :, None, None], (PH, S, PW, S))
+        xx = jnp.broadcast_to(xs[None, None, :, :], (PH, S, PW, S))
+        vals = bilinear(img, yy.reshape(-1), xx.reshape(-1))   # (C, PH*S*PW*S)
+        vals = vals.reshape(C, PH, S, PW, S)
+        return jnp.mean(vals, axis=(2, 4))
+
+    return jax.vmap(one)(rois)
+
+
+# ---------------------------------------------------------------------------
+# BilinearSampler / GridGenerator / SpatialTransformer
+# (reference src/operator/bilinear_sampler.cc, grid_generator.cc,
+#  spatial_transformer.cc)
+# ---------------------------------------------------------------------------
+
+@register("BilinearSampler")
+def bilinear_sampler(data, grid, *, cudnn_off=False):
+    """data (B, C, H, W), grid (B, 2, Ho, Wo) in [-1, 1] (x, y)."""
+    B, C, H, W = data.shape
+    _, _, Ho, Wo = grid.shape
+    gx = (grid[:, 0] + 1) * (W - 1) / 2                        # (B, Ho, Wo)
+    gy = (grid[:, 1] + 1) * (H - 1) / 2
+
+    def one(img, y, x):
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x)
+        y1, x1 = y0 + 1, x0 + 1
+        wy1, wx1 = y - y0, x - x0
+        wy0, wx0 = 1 - wy1, 1 - wx1
+
+        def at(yy, xx):
+            inb = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            return jnp.where(inb[None], img[:, yi, xi], 0.0)
+
+        return (at(y0, x0) * (wy0 * wx0)[None] + at(y0, x1) * (wy0 * wx1)[None]
+                + at(y1, x0) * (wy1 * wx0)[None] + at(y1, x1) * (wy1 * wx1)[None])
+
+    return jax.vmap(one)(data, gy, gx)
+
+
+@register("GridGenerator")
+def grid_generator(data, *, transform_type="affine", target_shape=(0, 0)):
+    """affine: data (B, 6) -> grid (B, 2, H, W); warp: data is flow field."""
+    if transform_type == "affine":
+        H, W = target_shape
+        ys = jnp.linspace(-1.0, 1.0, H)
+        xs = jnp.linspace(-1.0, 1.0, W)
+        yg, xg = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(xg)
+        base = jnp.stack([xg, yg, ones], 0).reshape(3, -1)     # (3, H*W)
+        theta = data.reshape(-1, 2, 3)
+        out = jnp.einsum("bij,jk->bik", theta, base)           # (B, 2, H*W)
+        return out.reshape(-1, 2, H, W)
+    if transform_type == "warp":
+        B, _, H, W = data.shape
+        yg, xg = jnp.meshgrid(jnp.arange(H, dtype=jnp.float32),
+                              jnp.arange(W, dtype=jnp.float32), indexing="ij")
+        x = (xg[None] + data[:, 0]) * 2 / jnp.maximum(W - 1, 1) - 1
+        y = (yg[None] + data[:, 1]) * 2 / jnp.maximum(H - 1, 1) - 1
+        return jnp.stack([x, y], 1)
+    raise MXNetError(f"GridGenerator: unknown transform_type {transform_type}")
+
+
+@register("SpatialTransformer")
+def spatial_transformer(data, loc, *, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=False):
+    grid = grid_generator(loc, transform_type=transform_type,
+                          target_shape=tuple(target_shape))
+    return bilinear_sampler(data, grid)

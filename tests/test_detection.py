@@ -1,0 +1,133 @@
+"""Detection op tests — numpy brute-force references (mirrors reference
+tests/python/unittest/test_operator.py test_multibox_* and
+test_bounding_box style)."""
+import numpy as onp
+import pytest
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ops import detection as det
+
+
+def test_multibox_prior_shapes_and_values():
+    data = jnp.zeros((1, 3, 4, 4))
+    out = det.multibox_prior(data, sizes=(0.5, 0.25), ratios=(1.0, 2.0))
+    # m + n - 1 = 3 anchors per cell
+    assert out.shape == (1, 4 * 4 * 3, 4)
+    a = onp.asarray(out)[0]
+    # first cell center = (0.5/4, 0.5/4); first anchor size 0.5 ratio 1
+    cx, cy = 0.5 / 4, 0.5 / 4
+    onp.testing.assert_allclose(a[0], [cx - 0.25, cy - 0.25, cx + 0.25,
+                                       cy + 0.25], rtol=1e-5)
+    # widths of ratio-2 anchor: s*sqrt(2), height s/sqrt(2)
+    w = a[2, 2] - a[2, 0]
+    h = a[2, 3] - a[2, 1]
+    onp.testing.assert_allclose(w / h, 2.0, rtol=1e-5)
+
+
+def test_multibox_target_matches_easy_case():
+    # 2 anchors, 1 gt that overlaps the first anchor perfectly
+    anchors = jnp.asarray([[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0]]])
+    label = jnp.asarray([[[1.0, 0.0, 0.0, 0.5, 0.5]]])  # cls 1 == anchor 0
+    cls_pred = jnp.zeros((1, 3, 2))
+    box_t, box_m, cls_t = det.multibox_target(anchors, label, cls_pred)
+    assert cls_t.shape == (1, 2)
+    assert cls_t[0, 0] == 2.0  # gt class 1 -> target 2 (bg=0 offset)
+    assert cls_t[0, 1] == 0.0  # unmatched -> background
+    onp.testing.assert_allclose(onp.asarray(box_m)[0, :4], onp.ones(4))
+    onp.testing.assert_allclose(onp.asarray(box_t)[0, :4], onp.zeros(4),
+                                atol=1e-5)  # perfect match -> zero offsets
+
+
+def test_box_nms_suppresses_overlaps():
+    # rows: [id, score, x1, y1, x2, y2]
+    data = jnp.asarray([
+        [0.0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [0.0, 0.8, 0.05, 0.05, 1.0, 1.0],   # heavy overlap with row 0
+        [0.0, 0.7, 2.0, 2.0, 3.0, 3.0],     # disjoint
+        [1.0, 0.6, 0.0, 0.0, 1.0, 1.0],     # other class, overlap w/ row 0
+    ])
+    out = onp.asarray(det.box_nms(data, overlap_thresh=0.5, id_index=0))
+    kept_scores = sorted([r[1] for r in out if r[1] >= 0], reverse=True)
+    # row1 suppressed; row3 kept (different class, force_suppress=False)
+    assert kept_scores == [0.9, 0.7, 0.6]
+    out2 = onp.asarray(det.box_nms(data, overlap_thresh=0.5, id_index=0,
+                                   force_suppress=True))
+    kept2 = sorted([r[1] for r in out2 if r[1] >= 0], reverse=True)
+    assert kept2 == [0.9, 0.7]
+
+
+def test_multibox_detection_roundtrip():
+    anchors = jnp.asarray([[[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]]])
+    # class 1 strongly on anchor 0; background on anchor 1
+    cls_prob = jnp.asarray([[[0.1, 0.9],     # background prob per anchor
+                             [0.9, 0.1]]])   # class-1 prob per anchor
+    loc_pred = jnp.zeros((1, 8))             # zero deltas -> anchor boxes
+    out = onp.asarray(det.multibox_detection(cls_prob, loc_pred, anchors,
+                                             threshold=0.5))
+    assert out.shape == (1, 2, 6)
+    valid = out[0][out[0][:, 0] >= 0]
+    assert len(valid) == 1
+    onp.testing.assert_allclose(valid[0][2:], [0.1, 0.1, 0.4, 0.4], atol=1e-5)
+    assert valid[0][0] == 0.0  # class id 0 (first non-background class)
+
+
+def test_roi_pooling_exact_small():
+    # 1x1x4x4 feature map with known values
+    fm = jnp.arange(16, dtype=jnp.float32).reshape(1, 1, 4, 4)
+    rois = jnp.asarray([[0.0, 0.0, 0.0, 3.0, 3.0]])  # whole map
+    out = det.roi_pooling(fm, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    onp.testing.assert_allclose(onp.asarray(out)[0, 0],
+                                [[5.0, 7.0], [13.0, 15.0]])
+
+
+def test_roi_align_center_value():
+    fm = jnp.ones((1, 1, 4, 4), jnp.float32) * 3.0
+    rois = jnp.asarray([[0.0, 0.0, 0.0, 3.0, 3.0]])
+    out = det.roi_align(fm, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    onp.testing.assert_allclose(onp.asarray(out)[0, 0], 3 * onp.ones((2, 2)),
+                                rtol=1e-5)
+
+
+def test_roi_align_differentiable():
+    import jax
+    fm = jnp.arange(16, dtype=jnp.float32).reshape(1, 1, 4, 4)
+    rois = jnp.asarray([[0.0, 0.5, 0.5, 2.5, 2.5]])
+
+    def f(x):
+        return jnp.sum(det.roi_align(x, rois, pooled_size=(2, 2),
+                                     spatial_scale=1.0))
+    g = jax.grad(f)(fm)
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_bilinear_sampler_identity():
+    B, C, H, W = 1, 2, 5, 5
+    rs = onp.random.RandomState(0)
+    img = jnp.asarray(rs.uniform(-1, 1, (B, C, H, W)).astype(onp.float32))
+    ys = jnp.linspace(-1, 1, H)
+    xs = jnp.linspace(-1, 1, W)
+    yg, xg = jnp.meshgrid(ys, xs, indexing="ij")
+    grid = jnp.stack([xg, yg], 0)[None]
+    out = det.bilinear_sampler(img, grid)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(img), atol=1e-5)
+
+
+def test_spatial_transformer_identity():
+    rs = onp.random.RandomState(1)
+    img = jnp.asarray(rs.uniform(-1, 1, (1, 1, 6, 6)).astype(onp.float32))
+    theta = jnp.asarray([[1.0, 0.0, 0.0, 0.0, 1.0, 0.0]])
+    out = det.spatial_transformer(img, theta, target_shape=(6, 6))
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(img), atol=1e-5)
+
+
+def test_detection_ops_in_nd_namespace():
+    assert hasattr(mx.nd, "_contrib_MultiBoxPrior")
+    assert hasattr(mx.nd, "box_nms")
+    assert hasattr(mx.sym, "_contrib_MultiBoxDetection")
+    out = mx.nd.ROIPooling(
+        nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4)),
+        nd.array(onp.asarray([[0.0, 0.0, 0.0, 3.0, 3.0]], "float32")),
+        pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
