@@ -133,10 +133,14 @@ class BucketingModule(BaseModule):
         bucket_key = getattr(data_batch, "bucket_key", None)
         if bucket_key is None:
             bucket_key = self._default_bucket_key
-        shapes = getattr(data_batch, "provide_data", None) \
-            or self._curr_module.data_shapes
-        label_shapes = getattr(data_batch, "provide_label", None) \
-            or self._curr_module.label_shapes
+        shapes = getattr(data_batch, "provide_data", None)
+        if shapes is not None:
+            # the batch describes itself: take its label shapes verbatim —
+            # None means an unlabeled batch, NOT "reuse the current bucket's"
+            label_shapes = getattr(data_batch, "provide_label", None)
+        else:
+            shapes = self._curr_module.data_shapes
+            label_shapes = self._curr_module.label_shapes
         self.switch_bucket(bucket_key, shapes, label_shapes)
         self._curr_module.forward(data_batch, is_train=is_train)
 

@@ -1,0 +1,214 @@
+"""ctypes bindings for the native C++ runtime (src/native/).
+
+The reference framework's IO/runtime layers are C++ (SURVEY.md §2.1:
+src/io/ 6.6 kLoC, dmlc recordio); this package binds the TPU framework's
+C++ equivalents. The shared library is compiled on first use with g++ and
+cached next to the sources (no external deps, ~1 s); every consumer falls
+back to the pure-Python path when the toolchain is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as _np
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+_SRC = os.path.join(_REPO_ROOT, "src", "native", "recordio.cc")
+_LIB_PATH = os.path.join(_REPO_ROOT, "src", "native", "libmxtpu_io.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_error: Optional[str] = None
+
+
+def _build() -> Optional[str]:
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", _LIB_PATH]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return str(e)
+    if res.returncode != 0:
+        return res.stderr[-2000:]
+    return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native IO library; None if unavailable."""
+    global _lib, _build_error
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            return None
+        if not os.path.exists(_LIB_PATH) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)):
+            if not os.path.exists(_SRC):
+                _build_error = "source missing"
+                return None
+            err = _build()
+            if err is not None:
+                _build_error = err
+                return None
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.rio_index_build.restype = ctypes.c_int64
+        lib.rio_index_build.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                        ctypes.c_void_p]
+        lib.rio_reader_create.restype = ctypes.c_void_p
+        lib.rio_reader_create.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                          ctypes.c_int, ctypes.c_uint64]
+        lib.rio_reader_next.restype = ctypes.c_int64
+        lib.rio_reader_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_int64]
+        lib.rio_reader_peek_len.restype = ctypes.c_int64
+        lib.rio_reader_peek_len.argtypes = [ctypes.c_void_p]
+        lib.rio_reader_next_batch.restype = ctypes.c_int64
+        lib.rio_reader_next_batch.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                              ctypes.c_void_p, ctypes.c_int64,
+                                              ctypes.c_void_p]
+        lib.rio_reader_reset.argtypes = [ctypes.c_void_p]
+        lib.rio_reader_destroy.argtypes = [ctypes.c_void_p]
+        lib.rio_writer_create.restype = ctypes.c_void_p
+        lib.rio_writer_create.argtypes = [ctypes.c_char_p]
+        lib.rio_writer_write.restype = ctypes.c_int64
+        lib.rio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_int64]
+        lib.rio_writer_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def build_error() -> Optional[str]:
+    get_lib()
+    return _build_error
+
+
+def build_index(path: str) -> Tuple[_np.ndarray, _np.ndarray]:
+    """Scan a .rec file -> (offsets, lengths) int64 arrays."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError(f"native IO unavailable: {_build_error}")
+    n = lib.rio_index_build(path.encode(), None, None)
+    if n < 0:
+        raise IOError(f"cannot scan record file {path}")
+    offs = _np.zeros(n, _np.int64)
+    lens = _np.zeros(n, _np.int64)
+    if n:
+        lib.rio_index_build(path.encode(), offs.ctypes.data, lens.ctypes.data)
+    return offs, lens
+
+
+class NativeRecordReader:
+    """Background-prefetching record reader over a .rec file.
+
+    The C++ worker thread reads ahead into a bounded ring (capacity records)
+    so file IO overlaps Python-side decode and device work — the
+    PrefetcherIter design (reference src/io/iter_prefetcher.h:47) without a
+    GIL in the hot path. shuffle=True re-orders records each epoch.
+    """
+
+    def __init__(self, path: str, capacity: int = 256, shuffle: bool = False,
+                 seed: int = 0, max_record: int = 1 << 24):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(f"native IO unavailable: {_build_error}")
+        self._lib = lib
+        self._handle = lib.rio_reader_create(path.encode(), capacity,
+                                             1 if shuffle else 0, seed)
+        if not self._handle:
+            raise IOError(f"cannot open record file {path}")
+        self._buf = bytearray(max_record)
+        self._cbuf = (ctypes.c_char * max_record).from_buffer(self._buf)
+
+    def next(self) -> Optional[bytes]:
+        n = self._lib.rio_reader_next(self._handle, self._cbuf, len(self._buf))
+        if n == -1:
+            return None
+        if n == -2:
+            need = self._lib.rio_reader_peek_len(self._handle)
+            self._buf = bytearray(int(need))
+            self._cbuf = (ctypes.c_char * len(self._buf)).from_buffer(self._buf)
+            n = self._lib.rio_reader_next(self._handle, self._cbuf,
+                                          len(self._buf))
+            if n < 0:
+                return None
+        return bytes(self._buf[:n])
+
+    def next_batch(self, n: int) -> List[bytes]:
+        sizes = _np.zeros(n, _np.int64)
+        got = self._lib.rio_reader_next_batch(self._handle, n, self._cbuf,
+                                              len(self._buf), sizes.ctypes.data)
+        if got == -2:  # first queued record exceeds the buffer: regrow
+            need = self._lib.rio_reader_peek_len(self._handle)
+            self._buf = bytearray(int(need))
+            self._cbuf = (ctypes.c_char * len(self._buf)).from_buffer(self._buf)
+            got = self._lib.rio_reader_next_batch(self._handle, n, self._cbuf,
+                                                  len(self._buf),
+                                                  sizes.ctypes.data)
+        out, off = [], 0
+        for i in range(int(got)):
+            ln = int(sizes[i])
+            out.append(bytes(self._buf[off:off + ln]))
+            off += ln
+        return out
+
+    def reset(self):
+        self._lib.rio_reader_reset(self._handle)
+
+    def close(self):
+        if self._handle:
+            h, self._handle = self._handle, None
+            self._lib.rio_reader_destroy(h)
+
+    def __iter__(self):
+        while True:
+            rec = self.next()
+            if rec is None:
+                return
+            yield rec
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeRecordWriter:
+    def __init__(self, path: str):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(f"native IO unavailable: {_build_error}")
+        self._lib = lib
+        self._handle = lib.rio_writer_create(path.encode())
+        if not self._handle:
+            raise IOError(f"cannot open {path} for writing")
+
+    def write(self, buf: bytes) -> int:
+        """Returns the record's byte offset (for .idx files)."""
+        pos = self._lib.rio_writer_write(self._handle, buf, len(buf))
+        if pos < 0:
+            raise IOError("record write failed")
+        return int(pos)
+
+    def close(self):
+        if self._handle:
+            h, self._handle = self._handle, None
+            self._lib.rio_writer_destroy(h)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

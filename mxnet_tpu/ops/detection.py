@@ -155,7 +155,9 @@ def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
             bg = scores[0]
             max_fg = jnp.max(scores[1:], axis=0)
             neg_score = max_fg - bg                            # hardness
-            neg_cand = ~matched
+            # anchors whose best IoU exceeds negative_mining_thresh are too
+            # close to a gt to serve as negatives (reference marks ignore)
+            neg_cand = ~matched & (best_v < negative_mining_thresh)
             k = jnp.maximum(
                 (jnp.sum(matched) * negative_mining_ratio).astype(jnp.int32),
                 int(minimum_negative_samples))
@@ -222,6 +224,14 @@ def box_nms(data, *, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
         if topk > 0:
             keep = keep & (jnp.cumsum(keep.astype(jnp.int32)) <= topk)
         sorted_batch = batch[order]
+        if out_format != in_format:
+            coords = boxes[order]  # already corner format
+            if out_format == "center":
+                x1, y1, x2, y2 = jnp.split(coords, 4, axis=-1)
+                coords = jnp.concatenate(
+                    [(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], -1)
+            sorted_batch = lax.dynamic_update_slice_in_dim(
+                sorted_batch, coords, coord_start, axis=-1)
         out = jnp.where(keep[:, None], sorted_batch, -jnp.ones_like(sorted_batch))
         return out
 
@@ -320,31 +330,42 @@ def roi_pooling(data, rois, *, pooled_size, spatial_scale):
     return jax.vmap(one)(rois)
 
 
+def _bilinear_gather(img, y, x, H, W):
+    """Clamped bilinear interpolation of img (C, H, W) at flat coords y/x.
+    Shared by roi_align and bilinear_sampler."""
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1 = y - y0
+    wx1 = x - x0
+    wy0, wx0 = 1 - wy1, 1 - wx1
+
+    def at(yy, xx):
+        inb = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        return jnp.where(inb[None], img[:, yi, xi], 0.0)
+
+    return (at(y0, x0) * (wy0 * wx0)[None] + at(y0, x1) * (wy0 * wx1)[None]
+            + at(y1, x0) * (wy1 * wx0)[None] + at(y1, x1) * (wy1 * wx1)[None])
+
+
 @register("_contrib_ROIAlign")
 def roi_align(data, rois, *, pooled_size, spatial_scale, sample_ratio=2,
               position_sensitive=False, aligned=False):
-    """Average pooling with bilinear sampling (exact, differentiable)."""
+    """Average pooling with bilinear sampling (exact, differentiable).
+    position_sensitive=True: R-FCN pooling — input channels C = C_out*PH*PW,
+    output bin (ph, pw) reads its own channel group."""
     PH, PW = pooled_size
     S = max(int(sample_ratio), 1)
     Bc, C, H, W = data.shape
     off = 0.5 if aligned else 0.0
+    if position_sensitive and C % (PH * PW) != 0:
+        raise MXNetError("ROIAlign(position_sensitive): channels must be a "
+                         f"multiple of {PH}*{PW}")
 
     def bilinear(img, y, x):
-        y0 = jnp.floor(y)
-        x0 = jnp.floor(x)
-        y1, x1 = y0 + 1, x0 + 1
-        wy1 = y - y0
-        wx1 = x - x0
-        wy0, wx0 = 1 - wy1, 1 - wx1
-
-        def at(yy, xx):
-            inb = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
-            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
-            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
-            return jnp.where(inb[None], img[:, yi, xi], 0.0)
-
-        return (at(y0, x0) * (wy0 * wx0)[None] + at(y0, x1) * (wy0 * wx1)[None]
-                + at(y1, x0) * (wy1 * wx0)[None] + at(y1, x1) * (wy1 * wx1)[None])
+        return _bilinear_gather(img, y, x, H, W)
 
     def one(roi):
         b = roi[0].astype(jnp.int32)
@@ -366,7 +387,13 @@ def roi_align(data, rois, *, pooled_size, spatial_scale, sample_ratio=2,
         xx = jnp.broadcast_to(xs[None, None, :, :], (PH, S, PW, S))
         vals = bilinear(img, yy.reshape(-1), xx.reshape(-1))   # (C, PH*S*PW*S)
         vals = vals.reshape(C, PH, S, PW, S)
-        return jnp.mean(vals, axis=(2, 4))
+        pooled = jnp.mean(vals, axis=(2, 4))                   # (C, PH, PW)
+        if position_sensitive:
+            c_out = C // (PH * PW)
+            ps = pooled.reshape(c_out, PH, PW, PH, PW)
+            # output bin (ph, pw) reads channel group (ph, pw)
+            return jnp.einsum("cijij->cij", ps)
+        return pooled
 
     return jax.vmap(one)(rois)
 
@@ -386,20 +413,8 @@ def bilinear_sampler(data, grid, *, cudnn_off=False):
     gy = (grid[:, 1] + 1) * (H - 1) / 2
 
     def one(img, y, x):
-        y0 = jnp.floor(y)
-        x0 = jnp.floor(x)
-        y1, x1 = y0 + 1, x0 + 1
-        wy1, wx1 = y - y0, x - x0
-        wy0, wx0 = 1 - wy1, 1 - wx1
-
-        def at(yy, xx):
-            inb = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
-            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
-            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
-            return jnp.where(inb[None], img[:, yi, xi], 0.0)
-
-        return (at(y0, x0) * (wy0 * wx0)[None] + at(y0, x1) * (wy0 * wx1)[None]
-                + at(y1, x0) * (wy1 * wx0)[None] + at(y1, x1) * (wy1 * wx1)[None])
+        vals = _bilinear_gather(img, y.reshape(-1), x.reshape(-1), H, W)
+        return vals.reshape(C, Ho, Wo)
 
     return jax.vmap(one)(data, gy, gx)
 

@@ -4,8 +4,11 @@ Binary-compatible with the reference format:
   each record = [kMagic:u32][lrec:u32][data...pad to 4B]
   kMagic = 0xced7230a; upper 3 bits of lrec encode continue-flag for
   multi-part records; IRHeader packs (flag:u32, label:f32, id:u64, id2:u64).
-A C++ accelerated scanner lives in src/native (round >=2); this pure-python
-reader already streams at memory bandwidth for packed files via numpy.
+
+The C++ runtime lives in src/native/recordio.cc (threaded prefetch reader,
+index scanner, writer) and is bound in mxnet_tpu.native; NativeRecordReader/
+NativeRecordWriter below re-export it. This pure-python class remains the
+portable fallback and the random-access (tell/seek) surface.
 """
 from __future__ import annotations
 
@@ -125,6 +128,18 @@ class MXIndexedRecordIO(MXRecordIO):
                     key = self.key_type(parts[0])
                     self.idx[key] = int(parts[1])
                     self.keys.append(key)
+        elif not self.writable:
+            # no .idx file: rebuild via the native C++ scanner when possible
+            try:
+                from .native import available, build_index
+                if available():
+                    offs, _ = build_index(self.uri)
+                    for i, off in enumerate(offs):
+                        key = self.key_type(i)
+                        self.idx[key] = int(off)
+                        self.keys.append(key)
+            except Exception:
+                pass
 
     def close(self):
         if not self.is_open:
@@ -190,3 +205,13 @@ def pack_img(header, img, quality=95, img_fmt=".jpg"):
         return pack(header, buf.tobytes())
     except ImportError:
         raise MXNetError("pack_img requires cv2")
+
+
+# Native C++ fast path (src/native/recordio.cc via ctypes)
+try:
+    from .native import (NativeRecordReader, NativeRecordWriter,  # noqa: F401
+                         available as native_available,
+                         build_index as native_build_index)
+except Exception:  # pragma: no cover
+    def native_available():
+        return False

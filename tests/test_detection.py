@@ -131,3 +131,33 @@ def test_detection_ops_in_nd_namespace():
         nd.array(onp.asarray([[0.0, 0.0, 0.0, 3.0, 3.0]], "float32")),
         pooled_size=(2, 2), spatial_scale=1.0)
     assert out.shape == (1, 1, 2, 2)
+
+
+def test_box_nms_out_format_center():
+    data = jnp.asarray([[0.0, 0.9, 0.0, 0.0, 1.0, 1.0],
+                        [0.0, 0.7, 2.0, 2.0, 4.0, 3.0]])
+    out = onp.asarray(det.box_nms(data, overlap_thresh=0.5, id_index=0,
+                                  out_format="center"))
+    row = out[out[:, 1] == 0.7][0]
+    onp.testing.assert_allclose(row[2:], [3.0, 2.5, 2.0, 1.0], rtol=1e-5)
+
+
+def test_roi_align_position_sensitive():
+    import jax
+    PH = PW = 2
+    c_out = 3
+    fm = jnp.arange(1 * c_out * PH * PW * 4 * 4,
+                    dtype=jnp.float32).reshape(1, c_out * PH * PW, 4, 4)
+    rois = jnp.asarray([[0.0, 0.0, 0.0, 3.0, 3.0]])
+    out = det.roi_align(fm, rois, pooled_size=(PH, PW), spatial_scale=1.0,
+                        position_sensitive=True)
+    assert out.shape == (1, c_out, PH, PW)
+    # plain align for comparison: PS output bin (i,j) equals channel-group
+    # (i*PW+j)'s plain pooled bin (i,j)
+    plain = det.roi_align(fm, rois, pooled_size=(PH, PW), spatial_scale=1.0)
+    plain = onp.asarray(plain).reshape(c_out, PH, PW, PH, PW)
+    got = onp.asarray(out)[0]
+    for i in range(PH):
+        for j in range(PW):
+            onp.testing.assert_allclose(got[:, i, j], plain[:, i, j, i, j],
+                                        rtol=1e-5)

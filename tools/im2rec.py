@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Dataset -> RecordIO packer (reference tools/im2rec.py / tools/im2rec.cc).
+
+Reads a .lst file (TAB-separated: index, label..., relative-path), packs each
+file's bytes behind an IRHeader into a .rec (+ .idx) pair using the native
+C++ writer when available. Images are packed as-is (decode happens at load
+time); --resize/--quality re-encoding requires cv2, matching the reference's
+OpenCV dependency.
+
+Usage: python tools/im2rec.py prefix root [--pass-through]
+  expects prefix.lst; writes prefix.rec and prefix.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def read_list(path):
+    with open(path) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(float(parts[0]))
+            label = [float(x) for x in parts[1:-1]]
+            yield idx, label, parts[-1]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prefix", help="prefix of the .lst file")
+    ap.add_argument("root", help="root directory of the files")
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter edge (requires cv2)")
+    ap.add_argument("--quality", type=int, default=95)
+    args = ap.parse_args()
+
+    lst = args.prefix + ".lst"
+    rec_path = args.prefix + ".rec"
+    idx_path = args.prefix + ".idx"
+
+    use_native = recordio.native_available() and args.resize == 0
+    if use_native:
+        from mxnet_tpu.native import NativeRecordWriter
+        writer = NativeRecordWriter(rec_path)
+        idx_out = open(idx_path, "w")
+    else:
+        writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+        idx_out = None
+
+    count = 0
+    for idx, label, rel in read_list(lst):
+        fname = os.path.join(args.root, rel)
+        with open(fname, "rb") as f:
+            payload = f.read()
+        if args.resize:
+            import cv2
+            import numpy as np
+            img = cv2.imdecode(np.frombuffer(payload, np.uint8), 1)
+            h, w = img.shape[:2]
+            s = args.resize / min(h, w)
+            img = cv2.resize(img, (int(w * s), int(h * s)))
+            ok, buf = cv2.imencode(".jpg", img,
+                                   [cv2.IMWRITE_JPEG_QUALITY, args.quality])
+            payload = buf.tobytes()
+        header = recordio.IRHeader(0, label if len(label) > 1 else
+                                   (label[0] if label else 0.0), idx, 0)
+        packed = recordio.pack(header, payload)
+        if use_native:
+            pos = writer.write(packed)
+            idx_out.write(f"{idx}\t{pos}\n")
+        else:
+            writer.write_idx(idx, packed)
+        count += 1
+        if count % 1000 == 0:
+            print(f"packed {count} records")
+
+    if use_native:
+        writer.close()
+        idx_out.close()
+    else:
+        writer.close()
+    print(f"done: {count} records -> {rec_path}")
+
+
+if __name__ == "__main__":
+    main()
