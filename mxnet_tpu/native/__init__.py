@@ -60,7 +60,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
         lib.rio_index_build.restype = ctypes.c_int64
         lib.rio_index_build.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
-                                        ctypes.c_void_p]
+                                        ctypes.c_void_p, ctypes.c_int64]
         lib.rio_reader_create.restype = ctypes.c_void_p
         lib.rio_reader_create.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                           ctypes.c_int, ctypes.c_uint64]
@@ -99,13 +99,16 @@ def build_index(path: str) -> Tuple[_np.ndarray, _np.ndarray]:
     lib = get_lib()
     if lib is None:
         raise RuntimeError(f"native IO unavailable: {_build_error}")
-    n = lib.rio_index_build(path.encode(), None, None)
+    n = lib.rio_index_build(path.encode(), None, None, 0)
     if n < 0:
         raise IOError(f"cannot scan record file {path}")
     offs = _np.zeros(n, _np.int64)
     lens = _np.zeros(n, _np.int64)
     if n:
-        lib.rio_index_build(path.encode(), offs.ctypes.data, lens.ctypes.data)
+        # capacity-bounded: a concurrently growing file can't overflow
+        m = lib.rio_index_build(path.encode(), offs.ctypes.data,
+                                lens.ctypes.data, n)
+        offs, lens = offs[:m], lens[:m]
     return offs, lens
 
 
@@ -131,7 +134,12 @@ class NativeRecordReader:
         self._buf = bytearray(max_record)
         self._cbuf = (ctypes.c_char * max_record).from_buffer(self._buf)
 
+    def _check_open(self):
+        if not self._handle:
+            raise ValueError("reader is closed")
+
     def next(self) -> Optional[bytes]:
+        self._check_open()
         n = self._lib.rio_reader_next(self._handle, self._cbuf, len(self._buf))
         if n == -1:
             return None
@@ -146,6 +154,7 @@ class NativeRecordReader:
         return bytes(self._buf[:n])
 
     def next_batch(self, n: int) -> List[bytes]:
+        self._check_open()
         sizes = _np.zeros(n, _np.int64)
         got = self._lib.rio_reader_next_batch(self._handle, n, self._cbuf,
                                               len(self._buf), sizes.ctypes.data)
@@ -164,6 +173,7 @@ class NativeRecordReader:
         return out
 
     def reset(self):
+        self._check_open()
         self._lib.rio_reader_reset(self._handle)
 
     def close(self):
@@ -197,6 +207,10 @@ class NativeRecordWriter:
 
     def write(self, buf: bytes) -> int:
         """Returns the record's byte offset (for .idx files)."""
+        if not self._handle:
+            raise ValueError("writer is closed")
+        if len(buf) >= (1 << 29):
+            raise ValueError("record too large (>= 512 MB)")
         pos = self._lib.rio_writer_write(self._handle, buf, len(buf))
         if pos < 0:
             raise IOError("record write failed")

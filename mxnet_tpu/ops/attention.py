@@ -67,9 +67,12 @@ def blockwise_attention(q, k, v, block_size: int = 512, causal: bool = False,
         return (acc_num * corr_old + num * corr_new,
                 acc_den * corr_old + den * corr_new, new_max), None
 
-    acc = (jnp.zeros((B, H, T, D), jnp.float32),
-           jnp.zeros((B, H, T, 1), jnp.float32),
-           jnp.full((B, H, T, 1), _NEG, jnp.float32))
+    # init carry derived from qf (x0 terms are no-ops XLA folds away) so it
+    # carries the same device-varying type as the scanned k/v blocks when
+    # this runs inside shard_map (ulysses path)
+    zero_like_q = qf * 0.0
+    zero_col = zero_like_q[..., :1]
+    acc = (zero_like_q, zero_col, zero_col + _NEG)
     (num, den, _), _ = lax.scan(body, acc, (jnp.arange(nblk), kb, vb))
     return (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
 
@@ -113,9 +116,17 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         vb = lax.ppermute(vb, axis_name, perm)
         return (acc_num, acc_den, new_max, kb, vb), None
 
-    acc = (jnp.zeros((B, H, T, D), jnp.float32),
-           jnp.zeros((B, H, T, 1), jnp.float32),
-           jnp.full((B, H, T, 1), _NEG, jnp.float32), k, v)
+    # pvary: the scan carry must match the device-varying type of the
+    # ppermute'd k/v shards under shard_map's varying-axis checking
+    def _vary(x):
+        try:
+            return lax.pvary(x, (axis_name,))
+        except (AttributeError, TypeError):
+            return x
+
+    acc = (_vary(jnp.zeros((B, H, T, D), jnp.float32)),
+           _vary(jnp.zeros((B, H, T, 1), jnp.float32)),
+           _vary(jnp.full((B, H, T, 1), _NEG, jnp.float32)), k, v)
     (num, den, _, _, _), _ = lax.scan(body, acc, jnp.arange(n))
     return (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
 

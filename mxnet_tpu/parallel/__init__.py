@@ -19,9 +19,12 @@ from .ring_attention import ring_attention, blockwise_attention
 from .tensor_parallel import (column_parallel_spec, row_parallel_spec,
                               shard_params_megatron)
 from .pipeline import pipeline_spec
+from .moe import (moe_ffn, expert_parallel_moe, topk_gating,
+                  load_balancing_loss)
 
 __all__ = ["make_mesh", "local_mesh", "replicate", "shard_batch", "P",
            "current_mesh", "set_default_mesh", "DataParallelTrainer",
            "functional_optimizer", "ring_attention", "blockwise_attention",
            "column_parallel_spec", "row_parallel_spec", "shard_params_megatron",
-           "pipeline_spec"]
+           "pipeline_spec", "moe_ffn", "expert_parallel_moe", "topk_gating",
+           "load_balancing_loss"]

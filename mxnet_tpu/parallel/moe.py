@@ -1,0 +1,125 @@
+"""Mixture-of-Experts with expert parallelism (capability uplift: the
+reference has no EP/MoE at all — SURVEY.md §2.4).
+
+TPU-native design: capacity-based top-k gating builds fixed-shape dispatch/
+combine tensors (no dynamic shapes — dropped tokens are the standard
+capacity-overflow semantics), expert FFNs run as one batched einsum, and
+expert parallelism shards the expert dimension over an 'ep' mesh axis with
+two `lax.all_to_all` exchanges (token -> expert shard -> token), riding ICI.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def topk_gating(logits, top_k: int, capacity: int):
+    """Top-k capacity gating (Switch/GShard style).
+
+    logits: (N, E). Returns (dispatch (N, E, C) float 0/1, combine (N, E, C)).
+    Token n's k-th choice lands in expert e's slot c if fewer than C earlier
+    tokens chose e; overflow tokens are dropped (their combine weight is 0).
+    """
+    N, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    _, idx = lax.top_k(probs, top_k)                     # (N, K)
+
+    dispatch = jnp.zeros((N, E, capacity), logits.dtype)
+    combine = jnp.zeros((N, E, capacity), logits.dtype)
+    counts = jnp.zeros((E,), jnp.int32)
+    for k in range(top_k):
+        onehot = jax.nn.one_hot(idx[:, k], E, dtype=jnp.int32)   # (N, E)
+        pos_in_e = jnp.cumsum(onehot, axis=0) - onehot           # prior count
+        pos = jnp.sum(onehot * (pos_in_e + counts[None, :]), axis=1)  # (N,)
+        e_sel = idx[:, k]
+        fits = pos < capacity
+        slot = jax.nn.one_hot(jnp.where(fits, pos, capacity), capacity,
+                              dtype=logits.dtype)                # (N, C)
+        d_k = jax.nn.one_hot(e_sel, E, dtype=logits.dtype)[:, :, None] * \
+            slot[:, None, :]                                     # (N, E, C)
+        d_k = d_k * fits[:, None, None].astype(logits.dtype)
+        dispatch = dispatch + d_k
+        gate = jnp.take_along_axis(probs, e_sel[:, None], axis=1)[:, 0]
+        combine = combine + d_k * gate[:, None, None]
+        counts = counts + jnp.sum(onehot, axis=0)
+    return dispatch, combine
+
+
+def moe_ffn(x, gate_w, w1, w2, *, top_k: int = 2,
+            capacity_factor: float = 1.5, activation=jax.nn.relu,
+            normalize_gates: bool = True):
+    """Dense (single-shard) MoE FFN.
+
+    x (N, D); gate_w (D, E); w1 (E, D, H); w2 (E, H, D). Returns (N, D).
+    """
+    N, D = x.shape
+    E = gate_w.shape[1]
+    capacity = max(1, int(capacity_factor * N * top_k / E))
+    logits = x @ gate_w
+    dispatch, combine = topk_gating(logits, top_k, capacity)
+    if normalize_gates:
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
+    expert_in = jnp.einsum("nd,nec->ecd", x, dispatch)     # (E, C, D)
+    h = activation(jnp.einsum("ecd,edh->ech", expert_in, w1))
+    expert_out = jnp.einsum("ech,ehd->ecd", h, w2)         # (E, C, D)
+    return jnp.einsum("ecd,nec->nd", expert_out, combine)
+
+
+def expert_parallel_moe(x, gate_w, w1_local, w2_local, *, axis_name: str,
+                        top_k: int = 2, capacity_factor: float = 1.5,
+                        activation=jax.nn.relu, normalize_gates: bool = True):
+    """Expert-parallel MoE FFN — call inside shard_map over `axis_name`.
+
+    Tokens are sharded over the axis (x is the LOCAL (Nl, D) shard); experts
+    are sharded too (w1_local (El, D, H), El = E / axis_size). Dataflow:
+
+      gate locally over ALL E experts
+      -> all_to_all: each device collects the slots destined to ITS experts
+      -> batched expert FFN on local experts
+      -> all_to_all back -> combine locally
+
+    Same math as moe_ffn on the gathered arrays (up to capacity rounding).
+    """
+    n_dev = lax.axis_size(axis_name)
+    Nl, D = x.shape
+    El = w1_local.shape[0]
+    E = El * n_dev
+    capacity = max(1, int(capacity_factor * Nl * top_k / E))
+
+    logits = x @ gate_w                                     # (Nl, E)
+    dispatch, combine = topk_gating(logits, top_k, capacity)
+    if normalize_gates:
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
+    expert_in = jnp.einsum("nd,nec->ecd", x, dispatch)      # (E, C, D)
+    # regroup experts by owner device and exchange: after all_to_all, axis 0
+    # indexes the SOURCE device and axis 1 the local expert
+    expert_in = expert_in.reshape(n_dev, El, capacity, D)
+    expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                               concat_axis=0, tiled=False)
+    # (n_dev_src, El, C, D) -> (El, n_dev_src * C, D)
+    gathered = jnp.moveaxis(expert_in, 0, 1).reshape(El, n_dev * capacity, D)
+    h = activation(jnp.einsum("ecd,edh->ech", gathered, w1_local))
+    out = jnp.einsum("ech,ehd->ecd", h, w2_local)           # (El, n_dev*C, D)
+    # reverse exchange: send each source device its slots back
+    out = jnp.moveaxis(out.reshape(El, n_dev, capacity, D), 1, 0)
+    out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                         tiled=False)                       # (n_dev, El, C, D)
+    out = out.reshape(E, capacity, D)
+    return jnp.einsum("ecd,nec->nd", out, combine)
+
+
+def load_balancing_loss(logits, top_k: int = 2):
+    """Auxiliary load-balance loss (Switch Transformer eq. 4): encourages
+    uniform expert utilization. Returns a scalar >= 1/E."""
+    N, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    _, idx = lax.top_k(probs, top_k)
+    me = jnp.mean(probs, axis=0)                            # mean router prob
+    ce = jnp.mean(jax.nn.one_hot(idx[:, 0], E), axis=0)     # token fraction
+    return E * jnp.sum(me * ce)

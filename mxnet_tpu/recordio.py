@@ -129,17 +129,27 @@ class MXIndexedRecordIO(MXRecordIO):
                     self.idx[key] = int(parts[1])
                     self.keys.append(key)
         elif not self.writable:
-            # no .idx file: rebuild via the native C++ scanner when possible
-            try:
-                from .native import available, build_index
-                if available():
-                    offs, _ = build_index(self.uri)
-                    for i, off in enumerate(offs):
-                        key = self.key_type(i)
-                        self.idx[key] = int(off)
-                        self.keys.append(key)
-            except Exception:
-                pass
+            # No .idx file: rebuild by POSITION via the native C++ scanner
+            # (keys become 0..n-1 — original non-contiguous .lst keys cannot
+            # be recovered without the .idx). Cached so per-epoch reset()
+            # doesn't rescan the file.
+            cached = getattr(self, "_native_index_cache", None)
+            if cached is not None and cached[0] == self.uri:
+                offs = cached[1]
+            else:
+                offs = None
+                try:
+                    from .native import available, build_index
+                    if available():
+                        offs, _ = build_index(self.uri)
+                        self._native_index_cache = (self.uri, offs)
+                except Exception:
+                    offs = None
+            if offs is not None:
+                for i, off in enumerate(offs):
+                    key = self.key_type(i)
+                    self.idx[key] = int(off)
+                    self.keys.append(key)
 
     def close(self):
         if not self.is_open:

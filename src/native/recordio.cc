@@ -137,18 +137,21 @@ extern "C" {
 
 // --- index -----------------------------------------------------------------
 
-// Returns number of records, or -1 on error. Caller passes arrays of size
-// >= rio_index_count(path) (call with nullptrs first to get the count).
-int64_t rio_index_build(const char* path, int64_t* offsets, int64_t* lengths) {
+// Returns number of records, or -1 on error. Call with nullptrs to get the
+// count, then with arrays of capacity `cap`; the copy is bounded by cap so a
+// file that grew between the two calls cannot overflow the caller's buffers.
+int64_t rio_index_build(const char* path, int64_t* offsets, int64_t* lengths,
+                        int64_t cap) {
   Index idx;
   if (!scan_file(path, &idx)) return -1;
+  int64_t n = static_cast<int64_t>(idx.offsets.size());
   if (offsets && lengths) {
-    std::memcpy(offsets, idx.offsets.data(),
-                idx.offsets.size() * sizeof(int64_t));
-    std::memcpy(lengths, idx.lengths.data(),
-                idx.lengths.size() * sizeof(int64_t));
+    int64_t m = n < cap ? n : cap;
+    std::memcpy(offsets, idx.offsets.data(), m * sizeof(int64_t));
+    std::memcpy(lengths, idx.lengths.data(), m * sizeof(int64_t));
+    return m;
   }
-  return static_cast<int64_t>(idx.offsets.size());
+  return n;
 }
 
 // --- reader ----------------------------------------------------------------
@@ -269,6 +272,9 @@ void* rio_writer_create(const char* path) {
 // Returns the byte offset the record was written at, or -1 on error.
 int64_t rio_writer_write(void* handle, const char* buf, int64_t len) {
   auto* w = static_cast<Writer*>(handle);
+  // lengths at or above 2^29 would leak into the header's continue-flag
+  // bits and corrupt the stream
+  if (len < 0 || len >= (int64_t(1) << 29)) return -1;
   int64_t pos = std::ftell(w->f);
   uint32_t head[2] = {kMagic, static_cast<uint32_t>(len)};
   if (std::fwrite(head, sizeof(uint32_t), 2, w->f) != 2) return -1;

@@ -1,0 +1,224 @@
+"""Parallelism tests on the 8-virtual-device CPU mesh (SURVEY.md §4's
+N-process local pod pattern realized as N virtual devices): data parallel
+consistency vs single device, tensor-parallel sharding, ring/Ulysses
+sequence parallelism, expert-parallel MoE vs its dense reference, and the
+ulysses/pipeline helpers."""
+import numpy as onp
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.experimental.shard_map import shard_map
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.parallel import (make_mesh, P, DataParallelTrainer,
+                                ring_attention, blockwise_attention,
+                                shard_params_megatron, moe_ffn,
+                                expert_parallel_moe, topk_gating,
+                                load_balancing_loss)
+from mxnet_tpu.ops.attention import ulysses_attention
+
+
+def _devices(n):
+    d = jax.devices("cpu")
+    assert len(d) >= n, f"need {n} cpu devices"
+    return d[:n]
+
+
+def _loss_fn(logits, labels):
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None].astype(jnp.int32),
+                               axis=-1)[:, 0]
+    return jnp.mean(logz - gold)
+
+
+def _mlp():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32), gluon.nn.Activation("relu"),
+            gluon.nn.Dense(4))
+    net.initialize()
+    net(nd.zeros((2, 16)))
+    return net
+
+
+def test_dp8_matches_dp1():
+    """Same data, same init: 8-way data parallel must track 1-device."""
+    rs = onp.random.RandomState(0)
+    x = nd.array(rs.uniform(-1, 1, (16, 16)).astype(onp.float32))
+    y = nd.array(rs.randint(0, 4, (16,)), dtype="int32")
+
+    losses = {}
+    for ndev in (1, 8):
+        mx.random.seed(7)
+        net = _mlp()
+        mesh = make_mesh({"dp": ndev}, devices=_devices(ndev))
+        tr = DataParallelTrainer(net, _loss_fn, optimizer="sgd",
+                                 optimizer_params={"learning_rate": 0.1},
+                                 mesh=mesh)
+        losses[ndev] = [float(tr.step(x, y)) for _ in range(4)]
+    onp.testing.assert_allclose(losses[1], losses[8], rtol=1e-4, atol=1e-5)
+    assert losses[1][-1] < losses[1][0]
+
+
+def test_tensor_parallel_training_matches_replicated():
+    rs = onp.random.RandomState(1)
+    x = nd.array(rs.uniform(-1, 1, (8, 16)).astype(onp.float32))
+    y = nd.array(rs.randint(0, 4, (8,)), dtype="int32")
+
+    losses = {}
+    for mode in ("rep", "tp"):
+        mx.random.seed(11)
+        net = _mlp()
+        if mode == "tp":
+            from mxnet_tpu.parallel import column_parallel_spec, row_parallel_spec
+            mesh = make_mesh({"dp": 2, "tp": 4}, devices=_devices(8))
+            n = shard_params_megatron(net, axis="tp", rules={
+                r"0\.weight$": column_parallel_spec("tp"),
+                r"0\.bias$": P("tp"),
+                r"2\.weight$": row_parallel_spec("tp"),
+            })
+            assert n > 0
+        else:
+            mesh = make_mesh({"dp": 2}, devices=_devices(2))
+        tr = DataParallelTrainer(net, _loss_fn, optimizer="sgd",
+                                 optimizer_params={"learning_rate": 0.1},
+                                 mesh=mesh)
+        losses[mode] = [float(tr.step(x, y)) for _ in range(3)]
+    onp.testing.assert_allclose(losses["rep"], losses["tp"], rtol=1e-4,
+                                atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_blockwise(causal):
+    n = 4
+    mesh = make_mesh({"sp": n}, devices=_devices(n))
+    rs = onp.random.RandomState(2)
+    B, H, T, D = 2, 2, 64, 16
+    q = jnp.asarray(rs.normal(0, 1, (B, H, T, D)).astype(onp.float32))
+    k = jnp.asarray(rs.normal(0, 1, (B, H, T, D)).astype(onp.float32))
+    v = jnp.asarray(rs.normal(0, 1, (B, H, T, D)).astype(onp.float32))
+
+    ref = blockwise_attention(q, k, v, causal=causal)
+
+    ring = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="sp", causal=causal),
+        mesh=mesh, in_specs=(P(None, None, "sp", None),) * 3,
+        out_specs=P(None, None, "sp", None))
+    out = jax.jit(ring)(q, k, v)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_attention_matches_blockwise():
+    n = 2
+    mesh = make_mesh({"sp": n}, devices=_devices(n))
+    rs = onp.random.RandomState(3)
+    B, H, T, D = 2, 4, 32, 8
+    q = jnp.asarray(rs.normal(0, 1, (B, H, T, D)).astype(onp.float32))
+    k = jnp.asarray(rs.normal(0, 1, (B, H, T, D)).astype(onp.float32))
+    v = jnp.asarray(rs.normal(0, 1, (B, H, T, D)).astype(onp.float32))
+    ref = blockwise_attention(q, k, v)
+    uly = shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, axis_name="sp"),
+        mesh=mesh, in_specs=(P(None, None, "sp", None),) * 3,
+        out_specs=P(None, None, "sp", None))
+    out = jax.jit(uly)(q, k, v)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# MoE
+# ---------------------------------------------------------------------------
+
+def test_topk_gating_capacity_and_slots():
+    logits = jnp.asarray([[5.0, 0.0], [4.0, 0.0], [3.0, 0.0], [0.0, 5.0]])
+    dispatch, combine = topk_gating(logits, top_k=1, capacity=2)
+    d = onp.asarray(dispatch)
+    # tokens 0,1 fill expert 0 slots 0,1; token 2 overflows (dropped)
+    assert d[0, 0, 0] == 1 and d[1, 0, 1] == 1
+    assert d[2].sum() == 0
+    assert d[3, 1, 0] == 1
+    c = onp.asarray(combine)
+    assert c[0, 0, 0] > 0.9  # softmax prob of the chosen expert
+
+
+def test_moe_ffn_runs_and_differentiable():
+    rs = onp.random.RandomState(4)
+    N, D, E, Hh = 32, 8, 4, 16
+    x = jnp.asarray(rs.normal(0, 1, (N, D)).astype(onp.float32))
+    gw = jnp.asarray(rs.normal(0, 0.5, (D, E)).astype(onp.float32))
+    w1 = jnp.asarray(rs.normal(0, 0.5, (E, D, Hh)).astype(onp.float32))
+    w2 = jnp.asarray(rs.normal(0, 0.5, (E, Hh, D)).astype(onp.float32))
+    out = moe_ffn(x, gw, w1, w2, top_k=2, capacity_factor=4.0)
+    assert out.shape == (N, D)
+    g = jax.grad(lambda a, b, c, d: jnp.sum(moe_ffn(a, b, c, d, top_k=2,
+                                                    capacity_factor=4.0) ** 2),
+                 argnums=(0, 2, 3))(x, gw, w1, w2)
+    assert all(float(jnp.abs(t).sum()) > 0 for t in g)
+
+
+def test_expert_parallel_matches_dense():
+    n = 4
+    mesh = make_mesh({"ep": n}, devices=_devices(n))
+    rs = onp.random.RandomState(5)
+    N, D, E, Hh = 64, 8, 4, 16          # E == n -> 1 expert per device
+    x = jnp.asarray(rs.normal(0, 1, (N, D)).astype(onp.float32))
+    gw = jnp.asarray(rs.normal(0, 0.5, (D, E)).astype(onp.float32))
+    w1 = jnp.asarray(rs.normal(0, 0.5, (E, D, Hh)).astype(onp.float32))
+    w2 = jnp.asarray(rs.normal(0, 0.5, (E, Hh, D)).astype(onp.float32))
+
+    # dense reference computed per token shard (same local capacity math)
+    Nl = N // n
+    ref_parts = [moe_ffn(x[i * Nl:(i + 1) * Nl], gw, w1, w2, top_k=1,
+                         capacity_factor=float(E))  # capacity = Nl
+                 for i in range(n)]
+    ref = jnp.concatenate(ref_parts, axis=0)
+
+    ep = shard_map(
+        lambda x, gw, w1, w2: expert_parallel_moe(
+            x, gw, w1, w2, axis_name="ep", top_k=1,
+            capacity_factor=float(E)),
+        mesh=mesh,
+        in_specs=(P("ep", None), P(None, None), P("ep", None, None),
+                  P("ep", None, None)),
+        out_specs=P("ep", None))
+    out = jax.jit(ep)(x, gw, w1, w2)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-4, atol=2e-4)
+
+
+def test_load_balancing_loss_bounds():
+    rs = onp.random.RandomState(6)
+    logits = jnp.asarray(rs.normal(0, 1, (128, 8)).astype(onp.float32))
+    lb = float(load_balancing_loss(logits))
+    assert lb >= 0.9  # >= 1 at perfect balance, higher when skewed
+    skewed = jnp.zeros((128, 8)).at[:, 0].set(10.0)
+    assert float(load_balancing_loss(skewed)) > lb
+
+
+def test_dp_sp_combined_trainer_step():
+    """dp x sp mesh: batch AND sequence sharded in the fused step."""
+    from mxnet_tpu.models import bert_tiny
+    mesh = make_mesh({"dp": 2, "sp": 2}, devices=_devices(4))
+    net = bert_tiny(vocab_size=64)
+    net.initialize()
+
+    def loss_fn(logits, labels):
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return jnp.mean(logz - gold)
+
+    tr = DataParallelTrainer(net, loss_fn, optimizer="adam",
+                             optimizer_params={"learning_rate": 1e-3},
+                             mesh=mesh, data_spec=P("dp", "sp"))
+    rs = onp.random.RandomState(7)
+    x = nd.array(rs.randint(0, 64, (4, 32)), dtype="int32")
+    y = nd.array(rs.randint(0, 64, (4, 32)), dtype="int32")
+    l0 = float(tr.step(x, y))
+    l1 = float(tr.step(x, y))
+    assert onp.isfinite([l0, l1]).all()

@@ -7,8 +7,10 @@ C++ writer when available. Images are packed as-is (decode happens at load
 time); --resize/--quality re-encoding requires cv2, matching the reference's
 OpenCV dependency.
 
-Usage: python tools/im2rec.py prefix root [--pass-through]
-  expects prefix.lst; writes prefix.rec and prefix.idx
+Usage: python tools/im2rec.py prefix root [--resize N] [--quality Q]
+  expects prefix.lst; writes prefix.rec and prefix.idx.
+  Without --resize, files are packed byte-for-byte (--quality only applies
+  when --resize re-encodes through cv2).
 """
 from __future__ import annotations
 
