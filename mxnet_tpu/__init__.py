@@ -52,6 +52,7 @@ from . import module
 from . import model
 from . import callback
 from . import monitor
+from . import operator
 from .model import FeedForward
 from .monitor import Monitor
 

@@ -7,6 +7,7 @@ from . import rnn
 from . import loss
 from . import data
 from . import utils
+from . import contrib
 from ..import initializer as init  # mx.gluon.init alias parity
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",

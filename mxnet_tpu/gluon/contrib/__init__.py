@@ -1,0 +1,5 @@
+"""gluon.contrib (reference python/mxnet/gluon/contrib/): Estimator
+train-loop, extra nn blocks, rnn extras."""
+from . import estimator
+from . import nn
+from .estimator import Estimator

@@ -1,0 +1,133 @@
+"""Estimator (reference gluon/contrib/estimator/estimator.py): the
+batteries-included gluon fit loop — autograd record, loss, Trainer step,
+metric updates, event handlers."""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Union
+
+from ....base import MXNetError
+from .... import autograd
+from ....metric import EvalMetric, Loss as LossMetric, create as metric_create
+from ...loss import Loss as GluonLoss
+from ...trainer import Trainer
+from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                            BatchBegin, BatchEnd, StoppingHandler,
+                            MetricHandler, LoggingHandler, ValidationHandler)
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 initializer=None, trainer=None, context=None,
+                 val_loss=None):
+        self.net = net
+        if not isinstance(loss, GluonLoss):
+            raise MXNetError("loss must be a gluon Loss")
+        self.loss = loss
+        self.train_metrics = self._check_metrics(train_metrics)
+        self.val_metrics = self._check_metrics(val_metrics)
+        self.context = context
+        if initializer is not None:
+            self.net.initialize(initializer, ctx=context, force_reinit=True)
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "sgd", {"learning_rate": 0.001})
+        self.train_loss_metric = LossMetric(name="train_loss")
+        self.val_loss_metric = LossMetric(name="val_loss")
+        self.stop_training = False
+
+    @staticmethod
+    def _check_metrics(metrics):
+        if metrics is None:
+            return []
+        if isinstance(metrics, EvalMetric):
+            return [metrics]
+        return [m if isinstance(m, EvalMetric) else metric_create(m)
+                for m in metrics]
+
+    def evaluate_batch(self, batch):
+        data, label = batch[0], batch[1]
+        pred = self.net(data)
+        loss = self.loss(pred, label)
+        return data, label, pred, loss
+
+    def evaluate(self, val_data):
+        for m in self.val_metrics + [self.val_loss_metric]:
+            m.reset()
+        for batch in val_data:
+            _, label, pred, loss = self.evaluate_batch(batch)
+            for m in self.val_metrics:
+                m.update(label, pred)
+            self.val_loss_metric.update(0, loss)
+        return [(m.get()) for m in self.val_metrics + [self.val_loss_metric]]
+
+    def fit_batch(self, batch):
+        data, label = batch[0], batch[1]
+        with autograd.record():
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+        loss.backward()
+        bs = data.shape[0]
+        self.trainer.step(bs)
+        return data, label, pred, loss
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None):
+        if epochs is None and batches is None:
+            epochs = 1
+        handlers = self._prepare_handlers(val_data, epochs, batches,
+                                          event_handlers)
+
+        def call(event, **kw):
+            stop = False
+            for h in handlers:
+                if isinstance(h, _EVENT_BASE[event]):
+                    r = getattr(h, event)(self, **kw)
+                    stop = stop or bool(r)
+            return stop
+
+        self.stop_training = False
+        call("train_begin")
+        while not self.stop_training:
+            call("epoch_begin")
+            for batch in train_data:
+                call("batch_begin", batch=batch)
+                data, label, pred, loss = self.fit_batch(batch)
+                self.train_loss_metric.update(0, loss)
+                if call("batch_end", batch=batch, pred=pred, label=label,
+                        loss=loss):
+                    self.stop_training = True
+                    break
+            if call("epoch_end"):
+                self.stop_training = True
+            if hasattr(train_data, "reset"):
+                train_data.reset()
+        call("train_end")
+
+    def _prepare_handlers(self, val_data, epochs, batches, event_handlers):
+        handlers = list(event_handlers or [])
+        has_stopping = any(isinstance(h, StoppingHandler) for h in handlers)
+        if not has_stopping:
+            handlers.append(StoppingHandler(max_epoch=epochs,
+                                            max_batch=batches))
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(self.train_metrics))
+        if val_data is not None and \
+                not any(isinstance(h, ValidationHandler) for h in handlers):
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(
+                metrics=self.train_metrics + [self.train_loss_metric]))
+        # fire in priority order (ValidationHandler=-1000 runs BEFORE user
+        # handlers like EarlyStopping that read validation metrics)
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
+        return handlers
+
+
+_EVENT_BASE = {
+    "train_begin": TrainBegin,
+    "train_end": TrainEnd,
+    "epoch_begin": EpochBegin,
+    "epoch_end": EpochEnd,
+    "batch_begin": BatchBegin,
+    "batch_end": BatchEnd,
+}

@@ -1,0 +1,249 @@
+"""Estimator event handlers (reference
+gluon/contrib/estimator/event_handler.py)."""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after max_epoch/max_batch (reference StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+        return self.stop_training
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+        return self.stop_training
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Resets/updates train metrics (reference MetricHandler)."""
+
+    def __init__(self, train_metrics):
+        self.train_metrics = train_metrics or []
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.train_metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        for m in self.train_metrics:
+            from ....metric import Loss as LossMetric
+            if isinstance(m, LossMetric):
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Runs validation every epoch/N batches (reference ValidationHandler)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.priority = priority
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    """(reference LoggingHandler)"""
+
+    def __init__(self, log_interval="epoch", metrics=None, priority=float("inf")):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.priority = priority
+        self.processed_samples = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        logging.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        logging.info("Training finished in %.3fs", time.time() - self.train_start)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        msg = f"Epoch[{self.current_epoch}] finished in " \
+              f"{time.time() - self.epoch_start:.3f}s: "
+        for m in self.metrics:
+            name, value = m.get()
+            msg += f"{name}: {value:.4f} "
+        logging.info(msg)
+        self.current_epoch += 1
+        self.batch_index = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        if isinstance(self.log_interval, int) and \
+                self.batch_index % self.log_interval == 0:
+            msg = f"[Epoch {self.current_epoch}][Batch {self.batch_index}] "
+            for m in self.metrics:
+                name, value = m.get()
+                msg += f"{name}: {value:.4f} "
+            logging.info(msg)
+        self.batch_index += 1
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save params every epoch; track best by monitored metric
+    (reference CheckpointHandler)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.current_epoch = 0
+        self.current_batch = 0
+        self.best = None
+        self.mode = mode
+        os.makedirs(model_dir, exist_ok=True)
+
+    def _improved(self, value):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return value < self.best
+        if self.mode == "max":
+            return value > self.best
+        name = self.monitor.get()[0] if self.monitor else ""
+        lower_better = any(k in name.lower() for k in ("loss", "error", "mse",
+                                                       "mae", "perplexity"))
+        return value < self.best if lower_better else value > self.best
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            path = os.path.join(self.model_dir,
+                                f"{self.model_prefix}-epoch{self.current_epoch}.params")
+            estimator.net.save_parameters(path)
+            if self.save_best and self.monitor is not None:
+                value = self.monitor.get()[1]
+                if self._improved(value):
+                    self.best = value
+                    best_path = os.path.join(self.model_dir,
+                                             f"{self.model_prefix}-best.params")
+                    estimator.net.save_parameters(best_path)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            path = os.path.join(self.model_dir,
+                                f"{self.model_prefix}-batch{self.current_batch}.params")
+            estimator.net.save_parameters(path)
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop when the monitored metric stops improving
+    (reference EarlyStoppingHandler)."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.mode = mode
+        self.baseline = baseline
+        self.wait = 0
+        self.best = None
+        self.stop_training = False
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+
+    def _better(self, a, b):
+        name = self.monitor.get()[0]
+        lower_better = self.mode == "min" or (
+            self.mode == "auto" and any(k in name.lower() for k in
+                                        ("loss", "error", "mse", "mae")))
+        return (a < b - self.min_delta) if lower_better \
+            else (a > b + self.min_delta)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        value = self.monitor.get()[1]
+        if self.best is None or self._better(value, self.best):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stop_training = True
+                self.stopped_epoch = self.current_epoch
+        self.current_epoch += 1
+        return self.stop_training
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch:
+            logging.info("Early stopping at epoch %d", self.stopped_epoch)

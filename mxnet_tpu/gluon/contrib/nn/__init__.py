@@ -1,0 +1,5 @@
+"""Extra gluon blocks (reference gluon/contrib/nn/basic_layers.py):
+Concurrent, HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm,
+PixelShuffle."""
+from .basic_layers import (Concurrent, HybridConcurrent, Identity,
+                           SparseEmbedding, SyncBatchNorm, PixelShuffle2D)

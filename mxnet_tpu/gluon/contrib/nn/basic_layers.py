@@ -1,0 +1,99 @@
+"""Extra gluon blocks (reference gluon/contrib/nn/basic_layers.py)."""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Embedding, BatchNorm
+
+
+class Concurrent(Block):
+    """Run children on the same input, concatenate outputs
+    (reference contrib/nn/basic_layers.py Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__()
+        self.axis = axis
+        self._layers = []
+
+    def add(self, *blocks):
+        for b in blocks:
+            self._layers.append(b)
+            self.register_child(b)
+        return self
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        outs = [b(x) for b in self._layers]
+        return nd.concat(*outs, dim=self.axis)
+
+
+class HybridConcurrent(HybridBlock):
+    """(reference HybridConcurrent)"""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__()
+        self.axis = axis
+        self._layers = []
+
+    def add(self, *blocks):
+        for b in blocks:
+            self._layers.append(b)
+            self.register_child(b)
+        return self
+
+    def hybrid_forward(self, F, x):
+        outs = [b(x) for b in self._layers]
+        return F.concat(*outs, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """(reference Identity)"""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Embedding):
+    """Embedding with row-sparse gradient semantics (reference
+    SparseEmbedding). On TPU the gradient is dense (XLA scatter-add) but the
+    API — including sparse_grad attribute — is preserved; pair with
+    kvstore.row_sparse_pull for the sparse-update workflow."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32", **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype, **kwargs)
+        self.sparse_grad = True
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference contrib sync_batch_norm.cc).
+
+    Under pjit, batch statistics are computed over the GLOBAL batch
+    automatically (XLA all-reduces the mean/var reductions over the sharded
+    batch axis) — so plain BatchNorm IS sync BN in the fused step; this
+    subclass exists for API parity and for explicitly choosing the number
+    of synchronizing devices in eager mode (ignored on TPU)."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+        self.num_devices = num_devices
+
+
+class PixelShuffle2D(HybridBlock):
+    """Sub-pixel upsampling (reference contrib PixelShuffle2D): rearranges
+    (B, C*f1*f2, H, W) -> (B, C, H*f1, W*f2)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        if isinstance(factor, int):
+            factor = (factor, factor)
+        self._factors = tuple(int(f) for f in factor)
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        B, C, H, W = x.shape
+        c_out = C // (f1 * f2)
+        x = x.reshape((B, c_out, f1, f2, H, W))
+        x = x.transpose((0, 1, 4, 2, 5, 3))      # B, c, H, f1, W, f2
+        return x.reshape((B, c_out, H * f1, W * f2))

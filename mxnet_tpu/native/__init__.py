@@ -108,6 +108,8 @@ def build_index(path: str) -> Tuple[_np.ndarray, _np.ndarray]:
         # capacity-bounded: a concurrently growing file can't overflow
         m = lib.rio_index_build(path.encode(), offs.ctypes.data,
                                 lens.ctypes.data, n)
+        if m < 0:
+            raise IOError(f"record file {path} became unreadable mid-scan")
         offs, lens = offs[:m], lens[:m]
     return offs, lens
 

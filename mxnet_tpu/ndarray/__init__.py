@@ -80,6 +80,13 @@ def __getattr__(name: str):
         raise AttributeError(name)
     if name in _wrapper_cache:
         return _wrapper_cache[name]
+    if name == "contrib":
+        from . import contrib as _contrib
+        return _contrib
+    if name == "Custom":
+        from ..operator import custom as _custom
+        _wrapper_cache[name] = _custom
+        return _custom
     try:
         get_op(name)
     except Exception:

@@ -1,0 +1,32 @@
+"""mx.nd.contrib — contrib op namespace (reference
+python/mxnet/ndarray/contrib.py): compiled control flow (foreach,
+while_loop, cond) plus every `_contrib_*` registered op without the prefix.
+"""
+from __future__ import annotations
+
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
+from ..ops.registry import all_ops as _all_ops, get_op as _get_op
+from ..base import MXNetError
+
+
+def isfinite(data):
+    from . import NDArray
+    import jax.numpy as jnp
+    raw = data._data if isinstance(data, NDArray) else data
+    return NDArray(jnp.isfinite(raw).astype(jnp.float32))
+
+
+def __getattr__(name):
+    """`mx.nd.contrib.box_nms` -> registered op `_contrib_box_nms` (or the
+    bare name), wrapped for NDArray in/out via the nd namespace."""
+    from . import _make_wrapper
+    for cand in (f"_contrib_{name}", name):
+        try:
+            _get_op(cand)
+        except MXNetError:
+            continue
+        fn = _make_wrapper(cand)
+        globals()[name] = fn
+        return fn
+    raise AttributeError(f"module 'mxnet_tpu.ndarray.contrib' has no "
+                         f"attribute '{name}'")

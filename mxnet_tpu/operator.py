@@ -1,0 +1,146 @@
+"""Custom operator API (reference python/mxnet/operator.py +
+src/operator/custom/custom-inl.h:52-192).
+
+User subclasses CustomOp (forward/backward with self.assign) and
+CustomOpProp (shapes/types/create_operator), registers with
+@mx.operator.register("name"), then calls mx.nd.Custom(..., op_type="name").
+
+TPU-native notes: custom ops run EAGERLY on the host (the reference runs
+them on dedicated worker threads outside the engine for the same reason —
+arbitrary Python can't live inside the compiled graph). Their outputs
+re-enter the jax world as device arrays; autograd records a tape node whose
+backward invokes the op's `backward`. Inside a jit trace, Custom raises —
+wrap the call in `jax.pure_callback` manually if host execution inside a
+compiled function is really wanted.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from .base import MXNetError
+
+_CUSTOM_REGISTRY: Dict[str, type] = {}
+
+
+class CustomOp:
+    """(reference operator.py CustomOp)"""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """(reference CustomOp.assign)"""
+        from .ndarray import NDArray
+        if req in ("null",):
+            return
+        src_nd = src if isinstance(src, NDArray) else NDArray(src)
+        if req in ("write", "inplace", None):
+            dst._set_data(src_nd._data.astype(dst.dtype))
+        elif req == "add":
+            dst._set_data((dst._data + src_nd._data).astype(dst.dtype))
+        else:
+            raise MXNetError(f"unknown req {req}")
+
+
+class CustomOpProp:
+    """(reference operator.py CustomOpProp)"""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError()
+
+
+def register(reg_name: str):
+    """Decorator registering a CustomOpProp subclass (reference
+    operator.py register)."""
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return deco
+
+
+def get_custom_prop(op_type: str, **kwargs) -> CustomOpProp:
+    cls = _CUSTOM_REGISTRY.get(op_type)
+    if cls is None:
+        raise MXNetError(f"custom op '{op_type}' is not registered")
+    return cls(**kwargs)
+
+
+def custom(*inputs, op_type: str, **kwargs):
+    """mx.nd.Custom — eager execution + tape recording
+    (reference MXImperativeInvoke on the Custom op, custom-inl.h)."""
+    import jax
+    from .ndarray import NDArray
+    from .context import current_context
+    from . import autograd
+
+    if any(isinstance(getattr(x, "_data", None), jax.core.Tracer)
+           for x in inputs):
+        raise MXNetError(
+            "Custom ops run on the host and cannot be traced into a "
+            "compiled graph; call outside jit/hybridize or wrap with "
+            "jax.pure_callback")
+
+    prop = get_custom_prop(op_type, **kwargs)
+    in_nd = [x if isinstance(x, NDArray) else NDArray(x) for x in inputs]
+    in_shapes = [list(x.shape) for x in in_nd]
+    _, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+    in_types = [x.dtype for x in in_nd]
+    _, out_types, aux_types = prop.infer_type(in_types)
+
+    from .ndarray import zeros
+    out_nd = [zeros(tuple(s), dtype=str(_np.dtype(t)))
+              for s, t in zip(out_shapes, out_types)]
+    aux_nd = [zeros(tuple(s), dtype=str(_np.dtype(t)))
+              for s, t in zip(aux_shapes, aux_types)]
+
+    op = prop.create_operator(current_context(), in_shapes, in_types)
+    is_train = autograd.is_training() if hasattr(autograd, "is_training") \
+        else autograd.is_recording()
+    op.forward(is_train=is_train, req=["write"] * len(out_nd),
+               in_data=in_nd, out_data=out_nd, aux=aux_nd)
+
+    if autograd.is_recording() and any(x._ag_node is not None for x in in_nd):
+        fwd_in = list(in_nd)
+        fwd_out = list(out_nd)
+
+        def vjp_fn(cotangents):
+            if not isinstance(cotangents, (list, tuple)):
+                cotangents = (cotangents,)
+            out_grad = [NDArray(g) for g in cotangents]
+            in_grad = [zeros(x.shape, dtype=str(x.dtype)) for x in fwd_in]
+            op.backward(req=["write"] * len(in_grad), out_grad=out_grad,
+                        in_data=fwd_in, out_data=fwd_out, in_grad=in_grad,
+                        aux=aux_nd)
+            return tuple(g._data for g in in_grad)
+
+        autograd.record_op(vjp_fn, in_nd, out_nd,
+                           out_is_tuple=len(out_nd) > 1)
+    if len(out_nd) == 1:
+        return out_nd[0]
+    return out_nd

@@ -1,0 +1,185 @@
+"""Control-flow operators: foreach, while_loop, cond
+(reference src/operator/control_flow.cc: _foreach :1089, _while_loop :1150,
+_cond :1211 — subgraph ops; python API python/mxnet/ndarray/contrib.py).
+
+TPU-native: the reference builds subgraph ops executed node-by-node; here
+the user's Python body is traced ONCE into lax.scan / lax.while_loop /
+lax.cond — compiled control flow, differentiable through scan/cond (while
+follows jax's semantics: no reverse-mode through while_loop).
+
+Functions take NDArray in / NDArray out; inside the body the user works with
+NDArrays whose raw payloads are tracers (the same trick hybridize uses).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+
+
+def _to_raw(x):
+    from ..ndarray import NDArray
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_to_raw(e) for e in x)
+    return x
+
+
+def _to_nd(x):
+    from ..ndarray import NDArray
+    if isinstance(x, (list, tuple)):
+        return type(x)(_to_nd(e) for e in x)
+    if x is None or isinstance(x, NDArray):
+        return x
+    return NDArray(x)
+
+
+def _run_recorded(fn_raw, nd_inputs):
+    """Execute fn_raw(*raws); if the tape is recording and any input is
+    attached, go through jax.vjp and record (mirrors ndarray.invoke)."""
+    from ..ndarray import NDArray
+    from .. import autograd
+    raws = [x._data for x in nd_inputs]
+    need = autograd.is_recording() and any(
+        x._ag_node is not None for x in nd_inputs)
+    if need:
+        outs_raw, vjp_fn = jax.vjp(fn_raw, *raws)
+    else:
+        outs_raw, vjp_fn = fn_raw(*raws), None
+    leaves = jax.tree_util.tree_leaves(outs_raw)
+    struct = jax.tree_util.tree_structure(outs_raw)
+    outs_nd = [NDArray(r) for r in leaves]
+    if need:
+        autograd.record_op(vjp_fn, list(nd_inputs), outs_nd,
+                           out_is_tuple=len(leaves) > 1)
+    return jax.tree_util.tree_unflatten(struct, outs_nd)
+
+
+def _flatten_nd(tree):
+    from ..ndarray import NDArray
+    return [x for x in jax.tree_util.tree_leaves(
+        tree, is_leaf=lambda v: isinstance(v, NDArray))
+        if isinstance(x, NDArray)]
+
+
+def foreach(body: Callable, data, init_states):
+    """Scan `body` over the leading axis of `data`
+    (reference _foreach, python/mxnet/ndarray/contrib.py foreach).
+
+    body(slice, states) -> (out, new_states). Returns (stacked_outs, states).
+    data and init_states must be NDArrays (or lists of NDArrays).
+    """
+    from ..ndarray import NDArray
+    data_is_list = isinstance(data, (list, tuple))
+    states_is_list = isinstance(init_states, (list, tuple))
+    for v in (list(data) if data_is_list else [data]) + \
+            (list(init_states) if states_is_list else [init_states]):
+        if not isinstance(v, NDArray):
+            raise MXNetError("foreach: data/init_states must be NDArrays, "
+                             f"got {type(v).__name__}")
+    nd_inputs = _flatten_nd(data) + _flatten_nd(init_states)
+
+    def fn_raw(*raws):
+        n_data = len(_flatten_nd(data))
+        d_raws, s_raws = raws[:n_data], raws[n_data:]
+        xs = list(d_raws) if data_is_list else d_raws[0]
+        ss = list(s_raws) if states_is_list else (s_raws[0] if s_raws else [])
+
+        def step(carry, x):
+            x_nd = [_to_nd(e) for e in x] if data_is_list else _to_nd(x)
+            c_nd = [_to_nd(e) for e in carry] if states_is_list else _to_nd(carry)
+            out, new_states = body(x_nd, c_nd)
+            return _to_raw(new_states), _to_raw(out)
+
+        carry, ys = lax.scan(step, _to_raw(ss), _to_raw(xs))
+        return ys, carry
+
+    return _run_recorded(fn_raw, nd_inputs)
+
+
+def while_loop(cond: Callable, func: Callable, loop_vars,
+               max_iterations: int = None):
+    """Bounded while loop (reference _while_loop; the reference also demands
+    max_iterations — outputs are padded to that length).
+
+    cond(*loop_vars) -> bool scalar; func(*loop_vars) -> (step_output,
+    new_loop_vars). Returns (stacked_outputs, final_loop_vars).
+    """
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations (static bound "
+                         "for compiled control flow)")
+    loop_vars = list(loop_vars)
+    nd_inputs = _flatten_nd(loop_vars)
+
+    def fn_raw(*raws):
+        vars0 = list(raws)
+
+        def one(carry, _):
+            vs, active, count = carry
+            vs_nd = [_to_nd(v) for v in vs]
+            pred = cond(*vs_nd)
+            pred_raw = jnp.logical_and(
+                active, _to_raw(pred).astype(bool).reshape(()))
+
+            out, new_vs = func(*vs_nd)
+            out_raw = _to_raw(out)
+            new_raw = _to_raw(new_vs)
+            # only advance where the predicate held
+            vs_next = [jnp.where(pred_raw, n, v)
+                       for n, v in zip(jax.tree_util.tree_leaves(new_raw),
+                                       vs)]
+            out_leaves = [jnp.where(pred_raw, o, jnp.zeros_like(o))
+                          for o in jax.tree_util.tree_leaves(out_raw)]
+            count = count + pred_raw.astype(jnp.int32)
+            return (vs_next, pred_raw, count), out_leaves
+
+        init = (vars0, jnp.bool_(True), jnp.int32(0))
+        (final_vars, _, count), outs = lax.scan(one, init, None,
+                                                length=max_iterations)
+        return outs, final_vars, count
+
+    from ..ndarray import NDArray
+    res = _run_recorded(fn_raw, nd_inputs)
+    outs, final_vars, count = res
+    if isinstance(outs, (list, tuple)) and len(outs) == 1:
+        outs = outs[0]
+    return outs, final_vars
+
+
+def cond(pred, then_func: Callable, else_func: Callable, inputs=None):
+    """Functional if/else (reference _cond). pred: scalar NDArray/bool;
+    branches are zero-arg callables (or take `inputs`). Non-NDArray inputs
+    (python scalars, shapes) pass through to the branches unchanged."""
+    from ..ndarray import NDArray
+    inputs = list(inputs) if inputs is not None else []
+    nd_pos = [i for i, v in enumerate(inputs) if isinstance(v, NDArray)]
+    nd_inputs = ([pred] if isinstance(pred, NDArray) else []) + \
+        [inputs[i] for i in nd_pos]
+
+    def fn_raw(*raws):
+        if isinstance(pred, NDArray):
+            p_raw, rest = raws[0], raws[1:]
+        else:
+            p_raw, rest = jnp.bool_(bool(pred)), raws
+
+        def _args(ops):
+            full = list(inputs)
+            for i, o in zip(nd_pos, ops):
+                full[i] = _to_nd(o)
+            return full
+
+        def t_branch(ops):
+            return _to_raw(then_func(*_args(ops)) if inputs else then_func())
+
+        def f_branch(ops):
+            return _to_raw(else_func(*_args(ops)) if inputs else else_func())
+
+        return lax.cond(p_raw.astype(bool).reshape(()), t_branch, f_branch,
+                        list(rest))
+
+    return _run_recorded(fn_raw, nd_inputs)

@@ -50,7 +50,7 @@ for _name, _f in _UNARY.items():
 
 register("identity", aliases=("_copy", "stop_gradient_off"))(lambda x: x)
 register("BlockGrad", aliases=("stop_gradient",))(lax.stop_gradient)
-register("make_loss")(lambda x: x)
+register("make_loss", aliases=("MakeLoss",))(lambda x: x)
 register("zeros_like")(jnp.zeros_like)
 register("ones_like")(jnp.ones_like)
 register("shape_array", differentiable=False)(

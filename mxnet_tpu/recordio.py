@@ -85,6 +85,10 @@ class MXRecordIO:
     def write(self, buf: bytes):
         assert self.writable
         lrec = len(buf)
+        if lrec >= (1 << 29):
+            # would leak into the header's continue-flag bits; the read path
+            # masks with (1<<29)-1 and would silently mis-frame the stream
+            raise MXNetError("record too large (>= 512 MB)")
         self.handle.write(struct.pack("<II", _kMagic, lrec))
         self.handle.write(buf)
         pad = (4 - lrec % 4) % 4

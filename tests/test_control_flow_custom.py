@@ -1,0 +1,153 @@
+"""Control flow op + Custom op tests (reference
+tests/python/unittest/test_contrib_control_flow.py and test_operator.py
+test_custom_op)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.ndarray.contrib import foreach, while_loop, cond
+
+
+def test_foreach_cumsum():
+    data = nd.array(onp.arange(8, dtype="float32").reshape(8, 1))
+    init = nd.zeros((1,))
+
+    def body(x, state):
+        new = x + state
+        return new, new
+
+    outs, final = foreach(body, data, init)
+    want = onp.cumsum(onp.arange(8, dtype="float32"))
+    onp.testing.assert_allclose(outs.asnumpy()[:, 0], want)
+    onp.testing.assert_allclose(final.asnumpy(), [28.0])
+
+
+def test_foreach_differentiable():
+    data = nd.array(onp.ones((4, 2), "float32"))
+    data.attach_grad()
+    init = nd.zeros((2,))
+    with autograd.record():
+        outs, final = foreach(lambda x, s: (x * s + x, x * s + x), data, init)
+        loss = (final * final).sum()
+    loss.backward()
+    assert float(abs(data.grad.asnumpy()).sum()) > 0
+
+
+def test_foreach_multi_state():
+    data = nd.array(onp.arange(6, dtype="float32").reshape(6, 1))
+    s0, s1 = nd.zeros((1,)), nd.ones((1,))
+
+    def body(x, states):
+        a, b = states
+        return x + a + b, [a + x, b * 1.0]
+
+    outs, (fa, fb) = foreach(body, data, [s0, s1])
+    assert outs.shape == (6, 1)
+    onp.testing.assert_allclose(fa.asnumpy(), [15.0])
+
+
+def test_while_loop_counts():
+    def cond_fn(i, s):
+        return i < 5
+
+    def body_fn(i, s):
+        return (s + i), (i + 1, s + i)
+
+    outs, (i_fin, s_fin) = while_loop(
+        cond_fn, body_fn, [nd.array([0.0]), nd.array([0.0])],
+        max_iterations=10)
+    assert float(i_fin.asnumpy()[0]) == 5.0
+    assert float(s_fin.asnumpy()[0]) == 10.0  # 0+1+2+3+4
+    # padded outputs beyond the 5 active steps are zero
+    assert outs.shape[0] == 10
+    onp.testing.assert_allclose(outs.asnumpy()[5:], onp.zeros((5, 1)))
+
+
+def test_cond_branches():
+    x = nd.array([2.0])
+    out_t = cond(nd.array([1.0]), lambda a: a * 2.0, lambda a: a - 1.0, [x])
+    out_f = cond(nd.array([0.0]), lambda a: a * 2.0, lambda a: a - 1.0, [x])
+    onp.testing.assert_allclose(out_t.asnumpy(), [4.0])
+    onp.testing.assert_allclose(out_f.asnumpy(), [1.0])
+
+
+def test_cond_differentiable():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = cond(nd.array([1.0]), lambda a: a * a, lambda a: a, [x])
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+# ---------------------------------------------------------------------------
+# Custom op
+# ---------------------------------------------------------------------------
+
+@mx.operator.register("scale2")
+class Scale2Prop(mx.operator.CustomOpProp):
+    def __init__(self, factor="2.0"):
+        super().__init__(need_top_grad=True)
+        self.factor = float(factor)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        factor = self.factor
+
+        class Scale2(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] * factor)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                self.assign(in_grad[0], req[0], out_grad[0] * factor)
+
+        return Scale2()
+
+
+def test_custom_op_forward_backward():
+    x = nd.array(onp.asarray([1.0, 2.0, 3.0], "float32"))
+    out = nd.Custom(x, op_type="scale2")
+    onp.testing.assert_allclose(out.asnumpy(), [2.0, 4.0, 6.0])
+    # with kwarg
+    out3 = nd.Custom(x, op_type="scale2", factor="3.0")
+    onp.testing.assert_allclose(out3.asnumpy(), [3.0, 6.0, 9.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (nd.Custom(x, op_type="scale2") * 1.0).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0, 2.0, 2.0])
+
+
+def test_custom_op_unregistered_raises():
+    with pytest.raises(Exception):
+        nd.Custom(nd.zeros((2,)), op_type="definitely_missing")
+
+
+def test_contrib_namespace_resolves_prefixed_ops():
+    from mxnet_tpu.ndarray import contrib as ndc
+    out = ndc.box_iou(nd.array([[0.0, 0.0, 1.0, 1.0]]),
+                      nd.array([[0.0, 0.0, 1.0, 1.0]]))
+    onp.testing.assert_allclose(out.asnumpy(), [[1.0]])
+    assert hasattr(ndc, "quadratic")
+
+
+def test_cond_mixed_inputs():
+    # review regression: non-NDArray inputs pass through to the branches
+    x = nd.array([2.0])
+    out = cond(nd.array([1.0]), lambda a, k: a * k, lambda a, k: a - k,
+               [x, 3.0])
+    onp.testing.assert_allclose(out.asnumpy(), [6.0])
+
+
+def test_foreach_rejects_non_ndarray():
+    with pytest.raises(Exception):
+        foreach(lambda x, s: (x, s), [nd.zeros((2, 1)), 1.5], nd.zeros((1,)))

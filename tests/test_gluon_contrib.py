@@ -1,0 +1,140 @@
+"""gluon.contrib tests: Estimator fit loop + handlers + extra blocks
+(reference tests/python/unittest/test_gluon_estimator.py,
+test_gluon_contrib.py style)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.gluon.contrib import Estimator
+from mxnet_tpu.gluon.contrib.estimator import (CheckpointHandler,
+                                               EarlyStoppingHandler,
+                                               StoppingHandler)
+from mxnet_tpu.gluon.contrib.nn import (Concurrent, HybridConcurrent,
+                                        Identity, SparseEmbedding,
+                                        PixelShuffle2D)
+from mxnet_tpu.io import NDArrayIter
+
+
+def _toy():
+    rs = onp.random.RandomState(0)
+    x = rs.uniform(-1, 1, (128, 8)).astype(onp.float32)
+    y = (x.sum(axis=1) > 0).astype(onp.float32)
+    return x, y
+
+
+class _ListData:
+    """Minimal iterable of (data, label) NDArray batches."""
+
+    def __init__(self, x, y, bs):
+        self.batches = [(nd.array(x[i:i + bs]), nd.array(y[i:i + bs]))
+                        for i in range(0, len(x), bs)]
+
+    def __iter__(self):
+        return iter(self.batches)
+
+
+def _net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16), gluon.nn.Activation("relu"),
+            gluon.nn.Dense(2))
+    net.initialize()
+    net(nd.zeros((2, 8)))
+    return net
+
+
+def test_estimator_fit_converges():
+    x, y = _toy()
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=mx.metric.Accuracy(),
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 1.0}))
+    est.fit(_ListData(x, y, 32), epochs=10)
+    acc = est.train_metrics[0].get()[1]
+    assert acc > 0.8
+
+
+def test_estimator_validation_and_early_stopping():
+    x, y = _toy()
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=mx.metric.Accuracy(),
+                    val_metrics=mx.metric.Accuracy(),
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.05}))
+    stopper = EarlyStoppingHandler(monitor=est.val_loss_metric, patience=2)
+    est.fit(_ListData(x, y, 32), val_data=_ListData(x, y, 32), epochs=20,
+            event_handlers=[stopper])
+    # either trained all epochs or stopped early; both leave valid metrics
+    assert est.val_loss_metric.get()[1] > 0
+
+
+def test_estimator_checkpoint_handler(tmp_path):
+    x, y = _toy()
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.05}))
+    est.fit(_ListData(x, y, 32), epochs=2,
+            event_handlers=[CheckpointHandler(str(tmp_path), "m")])
+    assert os.path.exists(str(tmp_path / "m-epoch2.params"))
+
+
+def test_estimator_max_batches():
+    x, y = _toy()
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.05}))
+    est.fit(_ListData(x, y, 32), batches=3)
+    # StoppingHandler stopped at 3 batches
+    sh = [h for h in est._prepare_handlers(None, None, 3, [])
+          if isinstance(h, StoppingHandler)]
+    assert sh
+
+
+def test_concurrent_blocks():
+    blk = HybridConcurrent(axis=-1)
+    blk.add(gluon.nn.Dense(3), gluon.nn.Dense(5), Identity())
+    blk.initialize()
+    out = blk(nd.zeros((2, 4)))
+    assert out.shape == (2, 3 + 5 + 4)
+    b2 = Concurrent(axis=-1)
+    b2.add(gluon.nn.Dense(2), Identity())
+    b2.initialize()
+    assert b2(nd.zeros((2, 4))).shape == (2, 6)
+
+
+def test_sparse_embedding_and_pixelshuffle():
+    emb = SparseEmbedding(10, 4)
+    emb.initialize()
+    out = emb(nd.array(onp.asarray([1, 2], "int32")))
+    assert out.shape == (2, 4)
+    assert emb.sparse_grad
+
+    ps = PixelShuffle2D(2)
+    x = nd.array(onp.arange(16, dtype="float32").reshape(1, 4, 2, 2))
+    out = ps(x)
+    assert out.shape == (1, 1, 4, 4)
+    # block (0,0) of upsampled = channels (0..3) at pixel (0,0)
+    onp.testing.assert_allclose(out.asnumpy()[0, 0, :2, :2],
+                                [[0.0, 4.0], [8.0, 12.0]])
+
+
+def test_validation_runs_before_early_stopping():
+    # review regression: priority ordering — ValidationHandler(-1000) must
+    # fire before user handlers that read validation metrics
+    x, y = _toy()
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    val_metrics=mx.metric.Accuracy(),
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.5}))
+    stopper = EarlyStoppingHandler(monitor=est.val_loss_metric, patience=3)
+    est.fit(_ListData(x, y, 32), val_data=_ListData(x, y, 32), epochs=4,
+            event_handlers=[stopper])
+    # with priority sorting the stopper sees real (finite) val losses
+    assert onp.isfinite(stopper.best)
