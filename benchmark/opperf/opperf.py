@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Per-operator benchmark harness (reference benchmark/opperf/).
+
+Measures forward (and backward where differentiable) latency for registered
+operators over representative shapes, printing a table and one JSON line per
+op. Timing follows the platform rules: host-transfer sync (block_until_ready
+is unreliable through the TPU tunnel) and warmup runs to exclude compiles;
+each measurement chains `inner` iterations inside one jit to amortize the
+per-launch RTT.
+
+Usage:
+  python benchmark/opperf/opperf.py                 # default op set
+  python benchmark/opperf/opperf.py --ops exp,dot  # subset
+  python benchmark/opperf/opperf.py --json out.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def _default_profiles():
+    """op -> (arg shapes, params). Mirrors opperf's default shape sets."""
+    L = (1024, 1024)
+    return {
+        # elementwise / activation
+        "exp": ([L], {}),
+        "log": ([L], {}),
+        "sqrt": ([L], {}),
+        "relu": ([L], {}),
+        "sigmoid": ([L], {}),
+        "tanh": ([L], {}),
+        "softmax": ([L], {}),
+        # binary broadcast
+        "broadcast_add": ([L, L], {}),
+        "broadcast_mul": ([L, L], {}),
+        "elemwise_add": ([L, L], {}),
+        # reductions
+        "sum": ([L], {}),
+        "mean": ([L], {}),
+        "max": ([L], {}),
+        # linear algebra
+        "dot": ([(512, 512), (512, 512)], {}),
+        "batch_dot": ([(16, 256, 256), (16, 256, 256)], {}),
+        "FullyConnected": ([(128, 1024), (1024, 1024), (1024,)],
+                           {"num_hidden": 1024}),
+        "Convolution": ([(32, 64, 56, 56), (64, 64, 3, 3), (64,)],
+                        {"kernel": (3, 3), "num_filter": 64, "pad": (1, 1)}),
+        "Pooling": ([(32, 64, 56, 56)],
+                    {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"}),
+        "BatchNorm": ([(32, 64, 56, 56), (64,), (64,), (64,), (64,)], {}),
+        "LayerNorm": ([(64, 512, 768), (768,), (768,)], {}),
+        # data movement
+        "transpose": ([(512, 512)], {}),
+        "Reshape": ([L], {"shape": (512, 2048)}),
+        "Concat": ([(512, 512), (512, 512)], {"dim": 1, "num_args": 2}),
+        "take": ([(10000, 64), (4096,)], {}),
+        "one_hot": ([(4096,)], {"depth": 1000}),
+        # attention
+        "_contrib_flash_attention": ([(4, 8, 512, 64)] * 3, {}),
+    }
+
+
+def _make_inputs(op_name, shapes):
+    import jax.numpy as jnp
+    rs = np.random.RandomState(0)
+    arrs = []
+    for i, s in enumerate(shapes):
+        if op_name in ("take",) and i == 1:
+            arrs.append(jnp.asarray(
+                rs.randint(0, shapes[0][0], size=s), dtype=jnp.int32))
+        elif op_name == "one_hot":
+            arrs.append(jnp.asarray(rs.randint(0, 1000, size=s),
+                                    dtype=jnp.int32))
+        else:
+            arrs.append(jnp.asarray(rs.uniform(-1, 1, s).astype(np.float32)))
+    return arrs
+
+
+def bench_op(op_name, shapes, params, warmup=2, runs=5, inner=10):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import get_op
+
+    op = get_op(op_name)
+    raw = _make_inputs(op_name, shapes)
+
+    def chained(*args):
+        out = None
+        acc = jnp.float32(0)
+        for _ in range(inner):
+            out = op.unbound(params)(*args)
+            first = out[0] if isinstance(out, tuple) else out
+            acc = acc + first.astype(jnp.float32).sum()
+        return acc
+
+    fwd = jax.jit(chained)
+
+    def sync(r):
+        # host transfer (block_until_ready is unreliable on the tunnel);
+        # grads are arrays, forward is a scalar — sum handles both
+        return float(jnp.asarray(r).astype(jnp.float32).sum())
+
+    def timeit(f, *a):
+        for _ in range(warmup):
+            sync(f(*a))
+        ts = []
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            sync(f(*a))
+            ts.append((time.perf_counter() - t0) / inner)
+        return min(ts) * 1e3  # ms
+
+    fwd_ms = timeit(fwd, *raw)
+    bwd_ms = None
+    if op.differentiable:
+        try:
+            gradfn = jax.jit(jax.grad(lambda *a: chained(*a)))
+            bwd_ms = timeit(gradfn, *raw)
+        except Exception:
+            bwd_ms = None
+    return fwd_ms, bwd_ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", type=str, default=None,
+                    help="comma-separated subset")
+    ap.add_argument("--json", type=str, default=None)
+    ap.add_argument("--runs", type=int, default=5)
+    ap.add_argument("--inner", type=int, default=10)
+    args = ap.parse_args()
+
+    profiles = _default_profiles()
+    if args.ops:
+        sel = args.ops.split(",")
+        profiles = {k: v for k, v in profiles.items() if k in sel}
+
+    results = []
+    print(f"{'operator':<28} {'fwd (ms)':>10} {'fwd+bwd (ms)':>13}")
+    print("-" * 53)
+    for name, (shapes, params) in profiles.items():
+        try:
+            fwd, bwd = bench_op(name, shapes, params, runs=args.runs,
+                                inner=args.inner)
+        except Exception as e:  # noqa: BLE001
+            print(f"{name:<28} failed: {str(e)[:40]}")
+            continue
+        bwd_s = f"{bwd:13.3f}" if bwd is not None else f"{'n/a':>13}"
+        print(f"{name:<28} {fwd:10.3f} {bwd_s}")
+        results.append({"op": name, "fwd_ms": round(fwd, 4),
+                        "bwd_ms": round(bwd, 4) if bwd else None,
+                        "shapes": [list(s) for s in shapes]})
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+        print(f"wrote {args.json}")
+
+
+if __name__ == "__main__":
+    main()

@@ -63,5 +63,15 @@ __version__ = "1.0.0.dev0"
 init = gluon.init  # alias: mx.init.Xavier() etc.
 
 
+def __getattr__(name):
+    if name == "checkpoint":
+        # lazy: orbax costs ~2.6 s to import; only checkpoint users pay it
+        import importlib
+        mod = importlib.import_module(".checkpoint", __name__)
+        globals()["checkpoint"] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute '{name}'")
+
+
 def waitall():
     ndarray.waitall()

@@ -17,6 +17,10 @@ __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
 
 def __getattr__(name):
     if name == "model_zoo":
-        from . import model_zoo as mz
+        # importlib, not `from . import`: the latter re-enters this
+        # __getattr__ mid-import and recurses
+        import importlib
+        mz = importlib.import_module(".model_zoo", __name__)
+        globals()["model_zoo"] = mz
         return mz
     raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute '{name}'")

@@ -51,3 +51,17 @@ def next_key():
 def next_key_raw():
     """Raw uint32 key data (for feeding key arrays through op boundaries)."""
     return jax.random.key_data(next_key())
+
+
+def get_state_raw():
+    """Raw uint32 key data of the global stream (for checkpointing)."""
+    with _lock:
+        return jax.random.key_data(_key)
+
+
+def set_state_raw(raw):
+    """Restore the global stream from get_state_raw() output."""
+    global _key
+    with _lock:
+        _key = jax.random.wrap_key_data(jnp.asarray(raw, jnp.uint32),
+                                        impl="threefry2x32")
