@@ -63,14 +63,17 @@ def main():
 
     # host-transfer sync (float()): on the tunneled TPU backend
     # block_until_ready can return before execution finishes, which would
-    # time dispatch instead of compute
+    # time dispatch instead of compute. run_steps puts the whole measured
+    # loop in ONE compiled computation (on-device lax.scan training loop),
+    # so per-step host dispatch/tunnel RTT is excluded — same methodology
+    # as the reference's synthetic benchmark_score.py.
     for _ in range(WARMUP):
         float(trainer.step(x, y))
+    float(trainer.run_steps(x, y, STEPS)[-1])  # compile the scan step
 
     t0 = time.perf_counter()
-    for _ in range(STEPS):
-        lossv = trainer.step(x, y)
-    float(lossv)
+    losses = trainer.run_steps(x, y, STEPS)
+    float(losses[-1])
     dt = time.perf_counter() - t0
 
     img_s = BATCH * STEPS / dt

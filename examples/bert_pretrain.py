@@ -68,7 +68,8 @@ def main():
     # MLM-style target: predict the token itself on synthetic data
     y = nd.array(tokens, dtype="int32")
 
-    float(trainer.step(x, y))  # compile
+    loss = trainer.step(x, y)  # compile
+    float(loss)
     tic = time.time()
     for step in range(args.steps):
         loss = trainer.step(x, y)

@@ -53,6 +53,7 @@ from . import model
 from . import callback
 from . import monitor
 from . import operator
+from . import visualization
 from .model import FeedForward
 from .monitor import Monitor
 

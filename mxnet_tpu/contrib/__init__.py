@@ -5,3 +5,6 @@ quantization, tensorrt, onnx, text, …) with TPU-native mechanisms.
 """
 from . import amp
 from . import quantization
+from . import text
+from . import tensorboard
+from . import onnx

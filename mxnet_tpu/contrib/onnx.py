@@ -1,0 +1,160 @@
+"""ONNX interop (reference python/mxnet/contrib/onnx/).
+
+The `onnx` package is not part of this environment, so export/import are
+gated: when onnx IS installed, export_model serializes a Symbol graph to an
+ONNX ModelProto covering the common layer ops; without it, both entry points
+raise with a pointer to the portable alternative (HybridBlock.export /
+Symbol JSON + params — loadable by any mxnet_tpu build).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError
+
+try:
+    import onnx as _onnx
+    from onnx import helper as _oh, TensorProto as _TP
+    _HAS_ONNX = True
+except ImportError:
+    _HAS_ONNX = False
+
+
+_OP_MAP = {
+    # mxnet op -> (onnx op, attr translator)
+    "FullyConnected": "Gemm",
+    "Convolution": "Conv",
+    "Activation": None,  # dispatched on act_type
+    "relu": "Relu",
+    "sigmoid": "Sigmoid",
+    "tanh": "Tanh",
+    "softmax": "Softmax",
+    "Pooling": None,     # Max/AveragePool
+    "BatchNorm": "BatchNormalization",
+    "Flatten": "Flatten",
+    "Reshape": "Reshape",
+    "Concat": "Concat",
+    "elemwise_add": "Add",
+    "broadcast_add": "Add",
+    "elemwise_mul": "Mul",
+    "broadcast_mul": "Mul",
+    "Dropout": "Dropout",
+    "LayerNorm": "LayerNormalization",
+    "Embedding": "Gather",
+    "transpose": "Transpose",
+}
+
+
+def _require_onnx():
+    if not _HAS_ONNX:
+        raise MXNetError(
+            "the 'onnx' package is not installed in this environment; for a "
+            "portable serialized model use HybridBlock.export() (symbol JSON "
+            "+ params) or model.save_checkpoint()")
+
+
+def export_model(sym, params, input_shape: List[Tuple[int, ...]],
+                 input_type=_np.float32, onnx_file_path: str = "model.onnx",
+                 verbose: bool = False):
+    """Export a Symbol + params to ONNX (reference
+    contrib/onnx/mx2onnx/export_model.py). Requires the onnx package."""
+    _require_onnx()
+    from .. import symbol as sym_mod
+    if isinstance(sym, str):
+        sym = sym_mod.load(sym)
+    if isinstance(params, str):
+        from ..model import load_params
+        arg, aux = load_params(params)
+        params = {**arg, **aux}
+
+    nodes, initializers, value_infos = [], [], []
+    topo = sym._topo()
+    names = {}
+    dtype_map = {_np.float32: _TP.FLOAT, _np.float64: _TP.DOUBLE,
+                 _np.int32: _TP.INT32, _np.int64: _TP.INT64}
+    elem = dtype_map.get(_np.dtype(input_type).type, _TP.FLOAT)
+    inputs = []
+    input_idx = 0
+    for node in topo:
+        if node.kind == "var":
+            names[id(node)] = node.name
+            if node.name in params:
+                arr = params[node.name]
+                np_arr = arr.asnumpy() if hasattr(arr, "asnumpy") else \
+                    _np.asarray(arr)
+                initializers.append(_oh.make_tensor(
+                    node.name, dtype_map.get(np_arr.dtype.type, _TP.FLOAT),
+                    np_arr.shape, np_arr.flatten().tolist()))
+            else:
+                shape = input_shape[input_idx] \
+                    if input_idx < len(input_shape) else None
+                input_idx += 1
+                inputs.append(_oh.make_tensor_value_info(
+                    node.name, elem, list(shape) if shape else None))
+            continue
+        op_name = node.op.name
+        onnx_op = _OP_MAP.get(op_name)
+        if op_name == "Activation":
+            onnx_op = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+                       "softrelu": "Softplus"}.get(
+                           node.params.get("act_type", "relu"), "Relu")
+        elif op_name == "Pooling":
+            onnx_op = "MaxPool" if node.params.get("pool_type", "max") == "max" \
+                else "AveragePool"
+        if onnx_op is None:
+            raise MXNetError(f"ONNX export: unsupported op {op_name}")
+        out_name = node.name
+        names[id(node)] = out_name
+        in_names = [names[id(i)] for i, _ in node.inputs]
+        attrs = _attrs_for(op_name, node.params)
+        nodes.append(_oh.make_node(onnx_op, in_names, [out_name],
+                                   name=node.name, **attrs))
+    out_infos = [_oh.make_tensor_value_info(names[id(n)], elem, None)
+                 for n, _ in sym._heads]
+    graph = _oh.make_graph(nodes, "mxnet_tpu_model", inputs, out_infos,
+                           initializer=initializers)
+    model = _oh.make_model(graph, producer_name="mxnet_tpu")
+    _onnx.save(model, onnx_file_path)
+    return onnx_file_path
+
+
+def _attrs_for(op_name: str, p: Dict) -> Dict:
+    if op_name == "Convolution":
+        k = tuple(p.get("kernel", ()))
+        out = {"kernel_shape": list(k)}
+        if p.get("stride"):
+            out["strides"] = list(p["stride"])
+        if p.get("pad"):
+            out["pads"] = list(p["pad"]) * 2
+        if p.get("num_group", 1) != 1:
+            out["group"] = int(p["num_group"])
+        return out
+    if op_name == "Pooling":
+        out = {"kernel_shape": list(p.get("kernel", (1, 1)))}
+        if p.get("stride"):
+            out["strides"] = list(p["stride"])
+        if p.get("pad"):
+            out["pads"] = list(p["pad"]) * 2
+        return out
+    if op_name == "Concat":
+        return {"axis": int(p.get("dim", 1))}
+    if op_name == "softmax":
+        return {"axis": int(p.get("axis", -1))}
+    if op_name == "BatchNorm":
+        return {"epsilon": float(p.get("eps", 1e-3)),
+                "momentum": float(p.get("momentum", 0.9))}
+    if op_name == "transpose":
+        return {"perm": list(p.get("axes", ()))} if p.get("axes") else {}
+    if op_name == "FullyConnected":
+        return {"transB": 1}
+    return {}
+
+
+def import_model(model_file: str):
+    """ONNX -> (sym, arg_params, aux_params) (reference
+    contrib/onnx/onnx2mx/import_model.py). Requires the onnx package."""
+    _require_onnx()
+    raise MXNetError("ONNX import is not implemented yet; export the source "
+                     "model with HybridBlock.export-compatible tooling")

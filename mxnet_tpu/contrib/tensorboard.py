@@ -1,0 +1,111 @@
+"""TensorBoard logging (reference python/mxnet/contrib/tensorboard.py
+LogMetricsCallback). Writes TensorBoard-compatible scalar event files
+directly (tfevents protobuf framing with CRC32C) — no tensorboard package
+required to WRITE; any TensorBoard install can read the logs.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import time
+from typing import Optional
+
+
+def _masked_crc32c(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xa282ead8 & 0xFFFFFFFF
+
+
+_CRC_TABLE = []
+
+
+def _crc32c(data: bytes) -> int:
+    global _CRC_TABLE
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            _CRC_TABLE.append(c)
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            out += bytes([b])
+            return out
+
+
+def _field(num: int, wire: int) -> bytes:
+    return _varint((num << 3) | wire)
+
+
+def _scalar_event(tag: str, value: float, step: int, wall: float) -> bytes:
+    """Hand-rolled Event{wall_time, step, summary{value{tag, simple_value}}}
+    protobuf (schema: tensorboard event.proto / summary.proto)."""
+    tag_b = tag.encode()
+    sv = _field(1, 2) + _varint(len(tag_b)) + tag_b \
+        + _field(2, 5) + struct.pack("<f", float(value))
+    summary = _field(1, 2) + _varint(len(sv)) + sv
+    ev = _field(1, 1) + struct.pack("<d", wall) \
+        + _field(2, 0) + _varint(step) \
+        + _field(5, 2) + _varint(len(summary)) + summary
+    return ev
+
+
+class SummaryWriter:
+    """Minimal event-file writer (scalar support)."""
+
+    def __init__(self, logdir: str):
+        os.makedirs(logdir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.mxnet_tpu"
+        self._f = open(os.path.join(logdir, fname), "wb")
+        self._write_event(self._version_event())
+
+    def _version_event(self) -> bytes:
+        v = b"brain.Event:2"
+        return _field(1, 1) + struct.pack("<d", time.time()) \
+            + _field(3, 2) + _varint(len(v)) + v
+
+    def _write_event(self, payload: bytes):
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc32c(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc32c(payload)))
+        self._f.flush()
+
+    def add_scalar(self, tag: str, value: float, global_step: int = 0):
+        self._write_event(_scalar_event(tag, value, global_step, time.time()))
+
+    def close(self):
+        self._f.close()
+
+
+class LogMetricsCallback:
+    """Batch-end callback streaming metric values to TensorBoard
+    (reference contrib/tensorboard.py LogMetricsCallback)."""
+
+    def __init__(self, logging_dir: str, prefix: Optional[str] = None):
+        self.prefix = prefix
+        self._writer = SummaryWriter(logging_dir)
+        self._step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self._step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self._writer.add_scalar(name, value, self._step)

@@ -1,0 +1,147 @@
+"""Text utilities (reference python/mxnet/contrib/text/): vocabulary and
+token embeddings backed by dense device tables."""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array, zeros
+
+
+class Vocabulary:
+    """Token vocabulary (reference contrib/text/vocab.py Vocabulary)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        self.unknown_token = unknown_token
+        reserved_tokens = list(reserved_tokens or [])
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for tok, freq in pairs:
+                if freq < min_freq or tok in self._token_to_idx:
+                    continue
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def idx_to_token(self) -> List[str]:
+        return self._idx_to_token
+
+    @property
+    def token_to_idx(self) -> Dict[str, int]:
+        return self._token_to_idx
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, 0) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self):
+                raise MXNetError(f"index {i} out of vocabulary range")
+        out = [self._idx_to_token[i] for i in idxs]
+        return out[0] if single else out
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """(reference contrib/text/utils.py)"""
+    source_str = source_str.lower() if to_lower else source_str
+    tokens = [t for seq in source_str.split(seq_delim)
+              for t in seq.split(token_delim) if t]
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(tokens)
+    return counter
+
+
+class TokenEmbedding:
+    """Pretrained token embedding table (reference
+    contrib/text/embedding.py _TokenEmbedding). Loads from a text file of
+    `token v1 v2 ...` lines; unknown tokens get init_unknown_vec."""
+
+    def __init__(self, vocabulary: Optional[Vocabulary] = None,
+                 vec_len: int = 0):
+        self._vocab = vocabulary
+        self._vec_len = vec_len
+        self._idx_to_vec: Optional[NDArray] = None
+
+    @classmethod
+    def from_file(cls, file_path, elem_delim=" ",
+                  vocabulary: Optional[Vocabulary] = None,
+                  init_unknown_vec=None):
+        vecs: Dict[str, _np.ndarray] = {}
+        vec_len = 0
+        with open(file_path) as f:
+            for lineno, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                if lineno == 0 and len(parts) == 2 and \
+                        parts[0].isdigit() and parts[1].isdigit():
+                    continue  # fastText-style "<count> <dim>" header
+                tok = parts[0]
+                try:
+                    v = _np.asarray([float(x) for x in parts[1:]], _np.float32)
+                except ValueError:
+                    continue
+                if vec_len == 0:
+                    vec_len = len(v)
+                elif len(v) != vec_len:
+                    continue  # truncated/inconsistent row
+                vecs[tok] = v
+        if vocabulary is None:
+            counter = collections.Counter({t: 1 for t in vecs})
+            vocabulary = Vocabulary(counter)
+        emb = cls(vocabulary, vec_len)
+        table = _np.zeros((len(vocabulary), vec_len), _np.float32)
+        if init_unknown_vec is not None:
+            table[0] = init_unknown_vec(vec_len)
+        for i, tok in enumerate(vocabulary.idx_to_token):
+            if tok in vecs:
+                table[i] = vecs[tok]
+        emb._idx_to_vec = array(table)
+        return emb
+
+    @property
+    def vec_len(self) -> int:
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self) -> NDArray:
+        return self._idx_to_vec
+
+    @property
+    def vocabulary(self) -> Vocabulary:
+        return self._vocab
+
+    def get_vecs_by_tokens(self, tokens):
+        single = isinstance(tokens, str)
+        idxs = self._vocab.to_indices([tokens] if single else tokens)
+        out = NDArray(self._idx_to_vec._data[_np.asarray(idxs)])
+        return NDArray(out._data[0]) if single else out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        idxs = self._vocab.to_indices(
+            [tokens] if isinstance(tokens, str) else tokens)
+        raw = self._idx_to_vec._data
+        nv = new_vectors._data if isinstance(new_vectors, NDArray) \
+            else _np.asarray(new_vectors)
+        raw = raw.at[_np.asarray(idxs)].set(nv)
+        self._idx_to_vec._set_data(raw)

@@ -13,6 +13,7 @@ import functools
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
+from jax import lax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -290,7 +291,6 @@ class DataParallelTrainer:
         # XLA propagates shardings and inserts the dp all-reduce on grads.
         scaled = self._scaler is not None
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step(params, opt_state, key, x, y, lr, t, loss_scale):
             def lossf(ps):
                 # casting inside the differentiated fn keeps fp32 master
@@ -329,6 +329,84 @@ class DataParallelTrainer:
             return new_params, new_state, lossv, finite, aux
         return step
 
+    def _get_step(self, sig):
+        fn = self._step_jit.get(sig)
+        if fn is None:
+            fn = jax.jit(self._build_step(None, None), donate_argnums=(0, 1))
+            self._step_jit[sig] = fn
+        return fn
+
+    def _get_multi(self, sig, n, stacked):
+        key = (sig, "multi", n)
+        fn = self._step_jit.get(key)
+        if fn is None:
+            body = self._build_step(None, None)
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def multi(params, opt_state, key_raw, x, y, lr, t0, loss_scale):
+                kk = jax.random.wrap_key_data(key_raw.astype(jnp.uint32),
+                                              impl="threefry2x32")
+
+                def sbody(carry, i):
+                    params, opt_state, t = carry
+                    ki = jax.random.key_data(jax.random.fold_in(kk, i))
+                    # per-step batch when x is stacked (n, B, ...), else reuse
+                    xi = x[i] if stacked else x
+                    yi = y[i] if stacked else y
+                    p2, s2, lossv, finite, aux = body(
+                        params, opt_state, ki, xi, yi, lr[i], t, loss_scale)
+                    return (p2, s2, t + 1.0), (lossv, finite)
+
+                (p, s, _), (losses, finites) = lax.scan(
+                    sbody, (params, opt_state, t0), jnp.arange(n))
+                return p, s, losses, jnp.all(finites)
+            fn = multi
+            self._step_jit[key] = fn
+        return fn
+
+    def run_steps(self, x, y, n, stacked=False):
+        """Run `n` fused steps in ONE compiled computation (lax.scan over
+        the step body) — the on-device training loop. Removes per-step host
+        dispatch entirely; use with device-resident batches.
+
+        stacked=False (default): x/y are one batch reused every step
+        (benchmark mode). stacked=True: x/y carry a leading per-step axis
+        (n, B, ...). The learning-rate schedule is honored per step (the
+        scheduler is evaluated host-side for each of the n steps and the
+        resulting lr array is scanned); the fp16 loss scale, however, is
+        constant within one call — split into shorter calls if dynamic
+        scaling needs to react faster. Returns the per-step loss array."""
+        xr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        yr = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+        self.optimizer.rescale_grad = 1.0
+        if stacked and (xr.shape[0] != n or yr.shape[0] != n):
+            raise MXNetError(
+                f"run_steps(stacked=True): leading dim must be n={n}, got "
+                f"{xr.shape[0]}/{yr.shape[0]}")
+        sig = (xr.shape, str(xr.dtype), yr.shape, str(yr.dtype), stacked)
+        fn = self._get_multi(sig, n, stacked)
+        # per-step lr from the scheduler (host-evaluated, scanned on device)
+        lrs = []
+        for i in range(n):
+            self.optimizer.num_update = self._t + 1 + i
+            lrs.append(float(self.optimizer.learning_rate))
+        lr = jnp.asarray(lrs, jnp.float32)
+        key = _rng.next_key_raw()
+        spec = self.data_spec
+        if stacked:
+            spec = P(None, *self.data_spec)
+        xr = jax.device_put(xr, NamedSharding(self.mesh, P(*spec[:xr.ndim])))
+        yr = jax.device_put(yr, NamedSharding(self.mesh, P(*spec[:yr.ndim])))
+        scale = jnp.float32(self._scaler.loss_scale if self._scaler else 1.0)
+        self._params_raw, self._opt_state, losses, finite = fn(
+            self._params_raw, self._opt_state, key, xr, yr, lr,
+            jnp.float32(self._t + 1), scale)
+        self._t += n
+        self.optimizer.num_update = self._t
+        if self._scaler is not None:
+            self._scaler.update_scale(not bool(finite))
+        return losses
+
     def step(self, x, y, batch_size=None):
         """Run one fused training step; x/y are NDArrays (global batch)."""
         xr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
@@ -336,10 +414,7 @@ class DataParallelTrainer:
         bs = batch_size or xr.shape[0]
         self.optimizer.rescale_grad = 1.0
         sig = (xr.shape, str(xr.dtype), yr.shape, str(yr.dtype))
-        fn = self._step_jit.get(sig)
-        if fn is None:
-            fn = self._build_step(None, None)
-            self._step_jit[sig] = fn
+        fn = self._get_step(sig)
         self._t += 1
         self.optimizer.num_update = self._t
         lr = jnp.float32(self.optimizer.learning_rate)

@@ -176,3 +176,36 @@ def resume(profile_process="worker"):
 
 if env.get("MXNET_PROFILER_AUTOSTART"):
     set_state("run")
+
+
+# ---------------------------------------------------------------------------
+# XLA/xplane bridge (replaces the reference's VTune/NVTX bridges,
+# src/profiler/vtune.cc / nvtx.cc): the device-side profile comes from the
+# XLA profiler; host-side scopes above feed the chrome-trace dump.
+# ---------------------------------------------------------------------------
+
+_xla_trace_dir = None
+
+
+def start_xla_trace(logdir: str):
+    """Start an XLA profiler trace (xplane; view in TensorBoard/XProf)."""
+    global _xla_trace_dir
+    import jax
+    jax.profiler.start_trace(logdir)
+    _xla_trace_dir = logdir
+    return logdir
+
+
+def stop_xla_trace():
+    global _xla_trace_dir
+    import jax
+    jax.profiler.stop_trace()
+    d, _xla_trace_dir = _xla_trace_dir, None
+    return d
+
+
+def annotate(name: str):
+    """Device-visible trace annotation (jax.profiler.TraceAnnotation):
+    regions show up inside the xplane timeline."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,130 @@
+"""Network visualization (reference python/mxnet/visualization.py):
+print_summary (layer table with shapes/params) and plot_network (graphviz
+when available, text tree otherwise)."""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as _np
+
+from .base import MXNetError
+
+
+def print_summary(symbol, shape: Optional[Dict] = None, line_length=120,
+                  positions=(0.44, 0.64, 0.74, 1.0)):
+    """Layer-by-layer summary (reference visualization.py:print_summary)."""
+    shape_dict = {}
+    if shape is not None:
+        arg_shapes, out_shapes, _ = symbol.infer_shape_partial(**shape)
+        arg_names = symbol.list_arguments()
+        shape_dict = dict(zip(arg_names, arg_shapes or []))
+    topo = symbol._topo()
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(vals):
+        line = ""
+        for v, pos in zip(vals, positions):
+            line = (line + str(v))[:pos - 1].ljust(pos)
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields)
+    print("=" * line_length)
+    total_params = 0
+    # infer every node's output shape in one pass when input shapes given
+    node_shapes = {}
+    if shape is not None:
+        import jax
+        import numpy as np
+        from .symbol.symbol import _resolved_params
+        info = {}
+        for node in topo:
+            if node.kind == "var":
+                s = shape_dict.get(node.name)
+                info[id(node)] = [s]
+                continue
+            try:
+                import jax.numpy as jnp
+                structs = []
+                ok = True
+                for inp, oi in node.inputs:
+                    cell = info.get(id(inp), [None])
+                    s = cell[oi] if oi < len(cell) else None
+                    if s is None:
+                        ok = False
+                        break
+                    structs.append(jax.ShapeDtypeStruct(tuple(s), jnp.float32))
+                if not ok:
+                    info[id(node)] = [None]
+                    continue
+                out = jax.eval_shape(node.op.unbound(_resolved_params(node)),
+                                     *structs)
+                outs = out if isinstance(out, tuple) else (out,)
+                info[id(node)] = [tuple(o.shape) for o in outs]
+            except Exception:
+                info[id(node)] = [None]
+        node_shapes = info
+
+    for node in topo:
+        if node.kind == "var":
+            continue
+        out_shape = (node_shapes.get(id(node), [None]) or [None])[0]
+        n_params = 0
+        prevs = []
+        for inp, _ in node.inputs:
+            if inp.kind == "var" and inp.name not in shape_dict:
+                pass
+            if inp.kind == "var":
+                s = shape_dict.get(inp.name)
+                if s is not None and not inp.name.endswith(("data", "label")):
+                    n_params += int(_np.prod(s))
+            else:
+                prevs.append(inp.name)
+        total_params += n_params
+        print_row([f"{node.name} ({node.op.name})",
+                   out_shape if out_shape else "",
+                   n_params, ", ".join(prevs)])
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz digraph when graphviz is installed; otherwise returns a
+    text rendering of the DAG (reference visualization.py:plot_network)."""
+    topo = symbol._topo()
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        lines = []
+        for node in topo:
+            if node.kind == "var":
+                if not hide_weights or not node.name.endswith(
+                        ("weight", "bias", "gamma", "beta", "moving_mean",
+                         "moving_var")):
+                    lines.append(f"[var] {node.name}")
+                continue
+            ins = ", ".join(i.name for i, _ in node.inputs
+                            if not (hide_weights and i.kind == "var"
+                                    and i.name != "data"))
+            lines.append(f"[{node.op.name}] {node.name} <- {ins}")
+        return "\n".join(lines)
+
+    dot = Digraph(name=title, format=save_format)
+    for node in topo:
+        if node.kind == "var":
+            if hide_weights and node.name.endswith(
+                    ("weight", "bias", "gamma", "beta", "moving_mean",
+                     "moving_var")):
+                continue
+            dot.node(node.name, node.name, shape="oval")
+        else:
+            dot.node(node.name, f"{node.name}\n{node.op.name}", shape="box")
+            for inp, _ in node.inputs:
+                if hide_weights and inp.kind == "var" and inp.name != "data":
+                    continue
+                dot.edge(inp.name, node.name)
+    return dot

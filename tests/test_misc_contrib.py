@@ -1,0 +1,90 @@
+"""contrib.text / tensorboard / visualization / profiler-bridge tests."""
+import os
+import struct
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.contrib import text as ctext
+from mxnet_tpu.contrib.tensorboard import SummaryWriter, LogMetricsCallback
+
+
+def test_vocabulary_and_counting():
+    c = ctext.count_tokens_from_str("a b b c\na c c c")
+    vocab = ctext.Vocabulary(c, min_freq=2)
+    assert len(vocab) >= 3            # <unk> + frequent tokens
+    assert vocab.to_indices("zzz") == 0  # unknown -> 0
+    idx = vocab.to_indices(["c", "b"])
+    assert vocab.to_tokens(idx) == ["c", "b"]
+
+
+def test_token_embedding_from_file(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = ctext.TokenEmbedding.from_file(str(p))
+    assert emb.vec_len == 3
+    v = emb.get_vecs_by_tokens("hello")
+    onp.testing.assert_allclose(v.asnumpy(), [1.0, 2.0, 3.0])
+    vs = emb.get_vecs_by_tokens(["world", "hello"])
+    assert vs.shape == (2, 3)
+    emb.update_token_vectors("hello", nd.array(onp.asarray([9.0, 9.0, 9.0],
+                                                           "float32")))
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9.0, 9.0, 9.0])
+
+
+def test_tensorboard_event_file(tmp_path):
+    w = SummaryWriter(str(tmp_path))
+    w.add_scalar("loss", 0.5, 1)
+    w.add_scalar("loss", 0.25, 2)
+    w.close()
+    files = [f for f in os.listdir(tmp_path) if "tfevents" in f]
+    assert len(files) == 1
+    raw = (tmp_path / files[0]).read_bytes()
+    # valid tfevents framing: u64 length + crc + payload + crc, repeated
+    off, events = 0, 0
+    while off < len(raw):
+        (ln,) = struct.unpack_from("<Q", raw, off)
+        off += 8 + 4 + ln + 4
+        events += 1
+    assert off == len(raw) and events == 3  # version header + 2 scalars
+    assert b"loss" in raw
+
+
+def test_log_metrics_callback(tmp_path):
+    acc = mx.metric.Accuracy()
+    acc.update(nd.array(onp.asarray([1.0])), nd.array(onp.asarray([[0.1, 0.9]])))
+    cb = LogMetricsCallback(str(tmp_path))
+
+    class P:
+        eval_metric = acc
+        nbatch = 1
+        epoch = 0
+    cb(P())
+    assert any("tfevents" in f for f in os.listdir(tmp_path))
+
+
+def test_print_summary_and_plot():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc1", num_hidden=8)
+    net = sym.Activation(net, act_type="relu", name="act1")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=2)
+    total = mx.visualization.print_summary(net, shape={"data": (4, 16)})
+    assert total > 0
+    txt = mx.visualization.plot_network(net)
+    # graphviz likely absent: text rendering mentions layers either way
+    assert "fc1" in str(txt)
+
+
+def test_onnx_gated():
+    from mxnet_tpu.contrib import onnx as conx
+    if not conx._HAS_ONNX:
+        with pytest.raises(Exception):
+            conx.export_model(None, None, [(1, 3, 4, 4)])
+
+
+def test_profiler_annotate_runs():
+    with mx.profiler.annotate("test-region"):
+        _ = nd.zeros((2, 2)) + 1

@@ -222,3 +222,33 @@ def test_dp_sp_combined_trainer_step():
     l0 = float(tr.step(x, y))
     l1 = float(tr.step(x, y))
     assert onp.isfinite([l0, l1]).all()
+
+
+def test_run_steps_matches_single_steps():
+    """On-device scan training loop == n sequential fused steps."""
+    mesh = make_mesh({"dp": 1}, devices=_devices(1))
+    rs = onp.random.RandomState(9)
+    x = nd.array(rs.uniform(-1, 1, (8, 16)).astype(onp.float32))
+    y = nd.array(rs.randint(0, 4, (8,)), dtype="int32")
+
+    mx.random.seed(21)
+    net1 = _mlp()
+    tr1 = DataParallelTrainer(net1, _loss_fn, optimizer="adam",
+                              optimizer_params={"learning_rate": 1e-2},
+                              mesh=mesh)
+    singles = [float(tr1.step(x, y)) for _ in range(4)]
+
+    mx.random.seed(21)
+    net2 = _mlp()
+    tr2 = DataParallelTrainer(net2, _loss_fn, optimizer="adam",
+                              optimizer_params={"learning_rate": 1e-2},
+                              mesh=mesh)
+    multi = tr2.run_steps(x, y, 4)
+    onp.testing.assert_allclose(singles, onp.asarray(multi), rtol=1e-4,
+                                atol=1e-5)
+    assert tr2._t == 4
+    # stacked per-step batches also run
+    xs = nd.array(rs.uniform(-1, 1, (2, 8, 16)).astype(onp.float32))
+    ys = nd.array(rs.randint(0, 4, (2, 8)), dtype="int32")
+    out = tr2.run_steps(xs, ys, 2, stacked=True)
+    assert out.shape == (2,) and onp.isfinite(onp.asarray(out)).all()
