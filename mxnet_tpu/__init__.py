@@ -54,6 +54,7 @@ from . import callback
 from . import monitor
 from . import operator
 from . import visualization
+from . import rtc
 from .model import FeedForward
 from .monitor import Monitor
 

@@ -8,3 +8,4 @@ from . import quantization
 from . import text
 from . import tensorboard
 from . import onnx
+from . import svrg_optimization

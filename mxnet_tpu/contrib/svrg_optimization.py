@@ -1,0 +1,145 @@
+"""SVRG optimization (reference python/mxnet/contrib/svrg_optimization/):
+Stochastic Variance Reduced Gradient — maintains a snapshot of the weights
+and the full-dataset gradient at that snapshot; each step uses
+g_i(w) - g_i(w_snap) + g_full(w_snap).
+
+TPU-native form: a functional SVRGState usable with any gluon net, plus an
+SVRGModule mirroring the reference module API (fit refreshes the snapshot
+every `update_freq` epochs).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from .. import autograd
+from ..ndarray import NDArray, zeros_like
+from ..module.module import Module
+
+
+class SVRGState:
+    """Snapshot weights + full gradient at the snapshot."""
+
+    def __init__(self, params: Dict[str, NDArray]):
+        self._params = params
+        self.snapshot: Dict[str, NDArray] = {}
+        self.full_grad: Dict[str, NDArray] = {}
+
+    def take_snapshot(self, data_iter, forward_loss, num_batches=None):
+        """Record w_snap and mu = (1/N) sum_i grad_i(w_snap)."""
+        self.snapshot = {k: NDArray(v._data) for k, v in self._params.items()}
+        acc = {k: zeros_like(v) for k, v in self._params.items()}
+        n = 0
+        for batch in data_iter:
+            if num_batches is not None and n >= num_batches:
+                break
+            with autograd.record():
+                loss = forward_loss(batch)
+            loss.backward()
+            for k, v in self._params.items():
+                g = v.grad() if callable(getattr(v, "grad", None)) else v._grad
+                if g is not None:
+                    acc[k]._set_data(acc[k]._data + g._data)
+            n += 1
+        if n == 0:
+            raise MXNetError("take_snapshot: empty data iterator")
+        self.full_grad = {k: NDArray(a._data / n) for k, a in acc.items()}
+        return n
+
+    def corrected_grad(self, key: str, grad_now: NDArray,
+                       grad_at_snap: NDArray) -> NDArray:
+        """g_i(w) - g_i(w_snap) + mu."""
+        mu = self.full_grad[key]
+        return NDArray(grad_now._data - grad_at_snap._data + mu._data)
+
+
+class SVRGModule(Module):
+    """Reference-shaped module (svrg_module.py SVRGModule): update applies
+    variance-reduced gradients; fit refreshes the full-gradient snapshot
+    every `update_freq` epochs."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, **kwargs)
+        self.update_freq = int(update_freq)
+        self._snapshot: Dict[str, NDArray] = {}
+        self._mu: Dict[str, NDArray] = {}
+
+    def update_full_grads(self, train_data):
+        """Compute mu over the whole iterator at the current weights
+        (reference SVRGModule.update_full_grads)."""
+        self._snapshot = {k: NDArray(v._data)
+                          for k, v in self._arg_params.items()}
+        acc = {k: zeros_like(v) for k, v in self._arg_params.items()}
+        train_data.reset()
+        n = 0
+        for batch in train_data:
+            self.forward(batch, is_train=True)
+            self.backward()
+            for i, name, g in self._param_grads:
+                if g is not None:
+                    acc[name]._set_data(acc[name]._data + g._data)
+            n += 1
+        if n == 0:
+            raise MXNetError("update_full_grads: empty data iterator")
+        for k in acc:
+            self._mu[k] = NDArray(acc[k]._data / n)
+        train_data.reset()
+        return n
+
+    def update_svrg(self):
+        """One variance-reduced update: re-evaluates the current batch's
+        gradient at the snapshot weights, then applies
+        g(w) - g(w_snap) + mu through the optimizer."""
+        if not self._mu:
+            raise MXNetError("call update_full_grads first")
+        grads_now = {name: NDArray(g._data)
+                     for _, name, g in self._param_grads if g is not None}
+        # swap snapshot weights in, recompute grads on the same batch;
+        # save the current-weight outputs so update_metric (which fit calls
+        # AFTER update) still scores the real forward pass
+        saved_outputs = self._exec.outputs
+        current = {k: NDArray(v._data) for k, v in self._arg_params.items()}
+        for k, v in self._arg_params.items():
+            v._set_data(self._snapshot[k]._data)
+        Module.forward(self, self._last_batch, is_train=True)
+        self.backward()
+        grads_snap = {name: NDArray(g._data)
+                      for _, name, g in self._param_grads if g is not None}
+        for k, v in self._arg_params.items():
+            v._set_data(current[k]._data)
+        self._exec.outputs = saved_outputs
+        # install corrected grads and run the plain optimizer update
+        for _, name, g in self._param_grads:
+            if g is not None:
+                g._set_data(grads_now[name]._data
+                            - grads_snap[name]._data
+                            + self._mu[name]._data)
+        super().update()
+
+    def forward(self, data_batch, is_train=None):
+        self._last_batch = data_batch
+        super().forward(data_batch, is_train=is_train)
+
+    def update(self):
+        if self._mu:
+            self.update_svrg()
+        else:
+            super().update()
+
+    def fit(self, train_data, *args, begin_epoch=0, num_epoch=None, **kwargs):
+        """Epoch loop with periodic full-gradient refresh (reference
+        svrg_module.py fit)."""
+        assert num_epoch is not None, "please specify number of epochs"
+        for epoch in range(begin_epoch, num_epoch):
+            if epoch % self.update_freq == 0:
+                if not self.binded:
+                    # bind/init via one plain-fit epoch first, then snapshot
+                    super().fit(train_data, *args, begin_epoch=epoch,
+                                num_epoch=epoch + 1, **kwargs)
+                    self.update_full_grads(train_data)
+                    continue
+                self.update_full_grads(train_data)
+            super().fit(train_data, *args, begin_epoch=epoch,
+                        num_epoch=epoch + 1, **kwargs)

@@ -91,7 +91,10 @@ class KVStore:
             if self._updater is not None:
                 self._updater(k, merged, self._store[k])
             else:
-                self._store[k]._set_data(self._store[k]._data + merged._data)
+                # reference default: the aggregated push value REPLACES the
+                # stored value (kv.push(3, ones*8); kv.pull(3) -> 8)
+                self._store[k]._set_data(
+                    merged._data.astype(self._store[k].dtype))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)
@@ -113,6 +116,10 @@ class KVStore:
                 self._updater(k, merged, self._store[k])
                 src = self._store[k]
             else:
+                # push-then-pull: persist the merged value like push does
+                if k in self._store:
+                    self._store[k]._set_data(
+                        merged._data.astype(self._store[k].dtype))
                 src = merged
             if out is not None:
                 o = out[idx] if isinstance(out, (list, tuple)) and isinstance(key, (list, tuple)) else out
@@ -130,9 +137,21 @@ class KVStore:
             olist = o if isinstance(o, (list, tuple)) else [o]
             for t in olist:
                 idx = r._data.astype(jnp.int32)
-                full = jnp.zeros(src.shape, src.dtype).at[idx].set(
-                    jnp.take(src._data, idx, axis=0))
-                t._set_data(full.astype(t.dtype))
+                rows = jnp.take(src._data, idx, axis=0)
+                if t.shape == src.shape:
+                    # row_sparse form first: full-shape out gets the rows in
+                    # place, others zero (takes precedence when the request
+                    # size coincides with the table size)
+                    full = jnp.zeros(src.shape, src.dtype).at[idx].set(rows)
+                    t._set_data(full.astype(t.dtype))
+                elif t.shape == rows.shape:
+                    # gathered form: out holds exactly the requested rows
+                    t._set_data(rows.astype(t.dtype))
+                else:
+                    raise MXNetError(
+                        f"row_sparse_pull: out shape {t.shape} matches "
+                        f"neither the table {src.shape} nor the gathered "
+                        f"rows {rows.shape}")
 
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)

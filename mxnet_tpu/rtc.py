@@ -1,0 +1,117 @@
+"""Runtime kernel compilation (reference include/mxnet/rtc.h:39 CudaModule /
+python/mxnet/rtc.py over NVRTC).
+
+TPU analog: NVRTC-compiled CUDA strings become runtime-compiled Pallas
+kernels. PallasModule accepts either a Python kernel function (refs in,
+writes out) or a SOURCE STRING of Python/Pallas code compiled at runtime —
+the direct counterpart of mx.rtc.CudaModule(source).get_kernel(...).launch:
+
+    src = '''
+    def axpy(x_ref, y_ref, o_ref):
+        o_ref[...] = 2.0 * x_ref[...] + y_ref[...]
+    '''
+    mod = mx.rtc.PallasModule(src)
+    kern = mod.get_kernel("axpy", out_shapes=[((64, 64), "float32")])
+    (z,) = kern.launch([x, y])
+
+Off-TPU, kernels run through the Pallas interpreter (same code path tests
+use); grid/block geometry maps to the Pallas grid.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+
+class PallasKernel:
+    """A launchable kernel (reference rtc.py CudaKernel)."""
+
+    def __init__(self, fn: Callable, name: str, out_shapes, grid=None,
+                 interpret: Optional[bool] = None):
+        self._fn = fn
+        self.name = name
+        self._out_shapes = out_shapes
+        self._grid = grid
+        self._interpret = interpret
+
+    def launch(self, args: Sequence, ctx=None, grid=None,
+               interpret: Optional[bool] = None):
+        """Run the kernel. args: NDArrays/arrays; returns tuple of NDArrays
+        (reference launch(args, ctx, grid_dims, block_dims) — block dims are
+        a CUDA notion; the Pallas grid subsumes both)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        raws = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                for a in args]
+        interp = interpret if interpret is not None else self._interpret
+        if interp is None:
+            from .ops.pallas.flash_attention import _on_tpu
+            interp = not (raws and _on_tpu(raws[0]))
+        out_shape = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(dt))
+                     for s, dt in self._out_shapes]
+        grid = grid or self._grid
+        kw = {"grid": grid} if grid is not None else {}
+        call = pl.pallas_call(
+            self._fn,
+            out_shape=out_shape if len(out_shape) > 1 else out_shape[0],
+            interpret=bool(interp),
+            **kw,
+        )
+        outs = call(*raws)
+        outs = outs if isinstance(outs, (list, tuple)) else (outs,)
+        return tuple(NDArray(o) for o in outs)
+
+
+class PallasModule:
+    """Runtime-compiled kernel module (reference rtc.py CudaModule).
+
+    source: a Python source string defining one or more Pallas kernel
+    functions, or a single callable. exports lists the kernel names
+    (defaults to every top-level function in the source).
+    """
+
+    def __init__(self, source: Union[str, Callable], options=(), exports=()):
+        self._kernels: dict = {}
+        if callable(source):
+            self._kernels[source.__name__] = source
+        else:
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+            import textwrap
+            namespace = {"jax": jax, "jnp": jnp, "pl": pl, "pltpu": pltpu,
+                         "np": _np}
+            code = textwrap.dedent(source)
+            exec(compile(code, "<mx.rtc.PallasModule>", "exec"), namespace)
+            import types
+            for name, obj in list(namespace.items()):
+                if isinstance(obj, types.FunctionType) and \
+                        obj.__code__.co_filename == "<mx.rtc.PallasModule>":
+                    self._kernels[name] = obj
+        if exports:
+            missing = [e for e in exports if e not in self._kernels]
+            if missing:
+                raise MXNetError(f"exports not found in source: {missing}")
+
+    def get_kernel(self, name: str, signature: str = "", *, out_shapes,
+                   grid=None, interpret: Optional[bool] = None) -> PallasKernel:
+        """signature is accepted for API parity and ignored (Pallas kernels
+        are shape-polymorphic until launch)."""
+        if name not in self._kernels:
+            raise MXNetError(
+                f"kernel '{name}' not found; available: "
+                f"{sorted(self._kernels)}")
+        return PallasKernel(self._kernels[name], name, out_shapes, grid,
+                            interpret)
+
+
+# Reference-name alias: mx.rtc.CudaModule(source) keeps old call sites alive
+CudaModule = PallasModule

@@ -1,0 +1,128 @@
+"""KVStore facade tests (reference tests/nightly/dist_sync_kvstore.py and
+tests/python/unittest/test_kvstore.py): push/pull math, multi-value
+aggregation, updater-on-store, row_sparse pull, optimizer state round-trip."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+@pytest.mark.parametrize("kv_type", ["local", "device", "tpu"])
+def test_init_push_pull(kv_type):
+    kv = mx.kv.create(kv_type)
+    kv.init(3, nd.ones((2, 3)))
+    out = nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.ones((2, 3)))
+    kv.push(3, nd.ones((2, 3)) * 4)
+    kv.pull(3, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 4 * onp.ones((2, 3)))
+
+
+def test_push_aggregates_list():
+    """Pushing a list of values (one per device) sums them (reference
+    dist_sync_kvstore.py check_default_keys)."""
+    kv = mx.kv.create("local")
+    kv.init("w", nd.zeros((4,)))
+    kv.push("w", [nd.ones((4,)), nd.ones((4,)) * 2, nd.ones((4,)) * 3])
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 6 * onp.ones(4))
+
+
+def test_updater_on_store():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.ones((3,)))
+
+    def sgd_like(key, grad, weight):
+        weight._set_data((weight - 0.1 * grad)._data)
+
+    kv.set_updater(sgd_like)
+    kv.push("w", nd.ones((3,)))
+    out = nd.zeros((3,))
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 0.9 * onp.ones(3), rtol=1e-6)
+
+
+def test_pushpull_fused():
+    kv = mx.kv.create("tpu")
+    kv.init(0, nd.zeros((5,)))
+    out = nd.zeros((5,))
+    kv.pushpull(0, nd.ones((5,)) * 2, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 2 * onp.ones(5))
+
+
+def test_multiple_keys_and_str_keys():
+    kv = mx.kv.create("local")
+    keys = ["a", "b", "c"]
+    for i, k in enumerate(keys):
+        kv.init(k, nd.ones((2,)) * i)
+    outs = [nd.zeros((2,)) for _ in keys]
+    for k, o in zip(keys, outs):
+        kv.pull(k, out=o)
+    for i, o in enumerate(outs):
+        onp.testing.assert_allclose(o.asnumpy(), i * onp.ones(2))
+
+
+def test_row_sparse_pull():
+    kv = mx.kv.create("local")
+    table = onp.arange(12, dtype="float32").reshape(4, 3)
+    kv.init("emb", nd.array(table))
+    out = nd.zeros((2, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array(onp.asarray([1, 3]),
+                                                        dtype="int32"))
+    onp.testing.assert_allclose(out.asnumpy(), table[[1, 3]])
+
+
+def test_optimizer_states_roundtrip(tmp_path):
+    kv = mx.kv.create("local")
+    kv.init("w", nd.ones((3,)))
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    kv.set_optimizer(opt)
+    kv.push("w", nd.ones((3,)))          # momentum state materializes
+    fname = str(tmp_path / "kv.states")
+    kv.save_optimizer_states(fname)
+    kv2 = mx.kv.create("local")
+    kv2.init("w", nd.ones((3,)))
+    kv2.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1,
+                                          momentum=0.9))
+    kv2.load_optimizer_states(fname)
+    # align weights too (state file carries optimizer state, not weights)
+    cur = nd.zeros((3,))
+    kv.pull("w", out=cur)
+    kv2._store["w"]._set_data(cur._data)
+    # same state + same weight -> same update trajectory
+    kv.push("w", nd.ones((3,)))
+    kv2.push("w", nd.ones((3,)))
+    o1, o2 = nd.zeros((3,)), nd.zeros((3,))
+    kv.pull("w", out=o1)
+    kv2.pull("w", out=o2)
+    onp.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(), rtol=1e-6)
+
+
+def test_rank_and_barrier():
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == 0 and kv.num_workers >= 1
+    kv.barrier()  # no-op single process, must not raise
+    assert kv.get_num_dead_node() == 0
+    assert "dist" in kv.type
+
+
+def test_pushpull_persists_and_row_sparse_full_form():
+    # review regressions
+    kv = mx.kv.create("local")
+    kv.init(0, nd.zeros((5,)))
+    out = nd.zeros((5,))
+    kv.pushpull(0, nd.ones((5,)) * 2, out=out)
+    after = nd.zeros((5,))
+    kv.pull(0, out=after)
+    onp.testing.assert_allclose(after.asnumpy(), 2 * onp.ones(5))
+
+    table = onp.arange(6, dtype="float32").reshape(2, 3)
+    kv.init("t", nd.array(table))
+    full = nd.zeros((2, 3))
+    kv.row_sparse_pull("t", out=full,
+                       row_ids=nd.array(onp.asarray([1, 0]), dtype="int32"))
+    # full-form takes precedence: rows stay at their own indices
+    onp.testing.assert_allclose(full.asnumpy(), table)

@@ -1,0 +1,75 @@
+"""mx.rtc PallasModule + contrib SVRG tests."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io import NDArrayIter
+
+
+def test_rtc_source_kernel():
+    src = """
+def axpy(x_ref, y_ref, o_ref):
+    o_ref[...] = 2.0 * x_ref[...] + y_ref[...]
+"""
+    mod = mx.rtc.PallasModule(src)
+    kern = mod.get_kernel("axpy", out_shapes=[((8, 8), "float32")])
+    x = nd.array(onp.ones((8, 8), "float32"))
+    y = nd.array(onp.full((8, 8), 3.0, "float32"))
+    (z,) = kern.launch([x, y], interpret=True)
+    onp.testing.assert_allclose(z.asnumpy(), 5.0 * onp.ones((8, 8)))
+
+
+def test_rtc_callable_and_missing_kernel():
+    def scale3(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 3.0
+
+    mod = mx.rtc.CudaModule(scale3)  # reference-name alias
+    k = mod.get_kernel("scale3", out_shapes=[((4,), "float32")])
+    (out,) = k.launch([nd.array(onp.ones(4, "float32"))], interpret=True)
+    onp.testing.assert_allclose(out.asnumpy(), 3 * onp.ones(4))
+    with pytest.raises(Exception):
+        mod.get_kernel("nope", out_shapes=[((1,), "float32")])
+
+
+def _mlp_sym():
+    d = sym.Variable("data")
+    fc = sym.FullyConnected(d, name="fc1", num_hidden=8)
+    a = sym.Activation(fc, act_type="relu")
+    fc2 = sym.FullyConnected(a, name="fc2", num_hidden=2)
+    return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"), name="softmax")
+
+
+def test_svrg_module_trains():
+    from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+    rs = onp.random.RandomState(0)
+    x = rs.uniform(-1, 1, (128, 8)).astype("float32")
+    y = (x.sum(axis=1) > 0).astype("float32")
+    it = NDArrayIter(x, y, batch_size=32)
+    mod = SVRGModule(_mlp_sym(), context=mx.cpu(), update_freq=1)
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.05),))
+    for epoch in range(4):
+        mod.update_full_grads(it)
+        it.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+    score = mod.score(NDArrayIter(x, y, batch_size=32), "acc")
+    assert dict(score)["accuracy"] > 0.8
+
+
+def test_svrg_fit_refreshes_snapshot():
+    # review regression: fit() must engage SVRG via update_freq
+    from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+    rs = onp.random.RandomState(1)
+    x = rs.uniform(-1, 1, (64, 8)).astype("float32")
+    y = (x.sum(axis=1) > 0).astype("float32")
+    it = NDArrayIter(x, y, batch_size=16)
+    mod = SVRGModule(_mlp_sym(), context=mx.cpu(), update_freq=2)
+    mod.fit(it, num_epoch=4, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.05),))
+    assert mod._mu  # snapshot was taken by fit itself
