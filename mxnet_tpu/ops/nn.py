@@ -81,12 +81,14 @@ def convolution(data, weight, bias=None, *, kernel, num_filter, stride=None,
                 dilate=None, pad=None, num_group=1, no_bias=False, layout=None):
     """reference src/operator/nn/convolution.cc:399 — NCHW/OIHW semantics."""
     nd, stride, dilate, padding = _conv_tuples(kernel, stride, dilate, pad)
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DNUMS[nd])
     # no preferred_element_type here: the MXU accumulates bf16 convs in f32
     # natively, and an explicit f32 preference breaks the transpose rule
     # (f32 cotangent vs bf16 weight) under grad-of-bf16. fp16 has no native
     # MXU mode and a 65504 max, so compute it in f32 and round back.
     data, weight, lo_dt = _match_conv_dtypes(data, weight)
+    # XLA's TPU layout assignment already picks channels-last internally; an
+    # explicit NHWC transpose sandwich was measured neutral at model level
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DNUMS[nd])
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride, padding=padding,
         rhs_dilation=dilate, dimension_numbers=dn,

@@ -1,0 +1,89 @@
+"""Exception propagation + np-shape scopes + image pipeline tests
+(reference tests/python/unittest/test_exc_handling.py — async engine errors
+re-thrown at WaitToRead — and test_numpy_gluon np-shape scope tests)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+
+
+def test_invalid_op_raises_at_dispatch():
+    # shape errors surface immediately (jax raises at trace/dispatch — the
+    # analog of the engine's async exception path re-thrown at wait_to_read)
+    a = nd.zeros((2, 3))
+    b = nd.zeros((4, 5))
+    with pytest.raises(Exception):
+        out = nd.dot(a, b)
+        out.wait_to_read()
+
+
+def test_nan_propagates_not_raises():
+    # numeric issues are values, not exceptions (same as reference)
+    x = nd.array(onp.asarray([1.0, 0.0], "float32"))
+    y = x / x
+    assert onp.isnan(y.asnumpy()[1])
+
+
+def test_unknown_operator_message():
+    with pytest.raises(MXNetError, match="not registered"):
+        from mxnet_tpu.ops.registry import get_op
+        get_op("this_op_does_not_exist")
+
+
+def test_naive_engine_mode_sync(monkeypatch):
+    # MXNET_ENGINE_TYPE=Naive forces synchronous execution (deterministic
+    # debugging, reference engine.cc:40)
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "Naive")
+    out = nd.exp(nd.ones((4,)))
+    onp.testing.assert_allclose(out.asnumpy(), onp.e * onp.ones(4), rtol=1e-5)
+
+
+def test_np_shape_scopes():
+    from mxnet_tpu.util import np_shape, is_np_shape, set_np_shape
+    prev = is_np_shape()
+    with np_shape(False):
+        assert not is_np_shape()
+        with np_shape(True):
+            assert is_np_shape()
+        assert not is_np_shape()
+    assert is_np_shape() == prev
+
+
+def test_use_np_decorator():
+    @mx.util.use_np
+    def f():
+        return mx.is_np_shape()
+    assert f() is True
+
+
+def test_zero_size_arrays_np_semantics():
+    # numpy-shape mode: zero-size and 0-d arrays are first-class
+    z = nd.zeros((0, 4))
+    assert z.shape == (0, 4) and z.size == 0
+    s = nd.array(3.5)
+    assert s.shape == () and float(s.asnumpy()) == 3.5
+
+
+def test_image_pipeline_numpy_path():
+    from mxnet_tpu import image
+    rs = onp.random.RandomState(0)
+    img = nd.array(rs.uniform(0, 255, (40, 60, 3)).astype(onp.float32))
+    small = image.imresize(img, 30, 20)
+    assert small.shape == (20, 30, 3)
+    short = image.resize_short(img, 20)
+    assert min(short.shape[:2]) == 20
+    crop, _ = image.center_crop(img, (16, 16))
+    assert crop.shape == (16, 16, 3)
+    norm = image.color_normalize(img, mean=nd.array(onp.asarray([1.0, 2.0, 3.0],
+                                                                "float32")))
+    assert norm.shape == img.shape
+
+
+def test_check_numeric_gradient_harness():
+    # the reference's central numeric-vs-autograd gradient checker
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    rs = onp.random.RandomState(1)
+    x = nd.array(rs.uniform(0.5, 1.5, (3, 4)).astype(onp.float32))
+    check_numeric_gradient(lambda a: (a * a).sum(), [x])
