@@ -55,6 +55,9 @@ from . import monitor
 from . import operator
 from . import visualization
 from . import rtc
+from . import name
+from . import attribute
+from .attribute import AttrScope
 from .model import FeedForward
 from .monitor import Monitor
 

@@ -1,0 +1,50 @@
+"""Attribute scoping (reference python/mxnet/attribute.py AttrScope):
+attaches string attrs to every symbol created inside the scope —
+
+    with mx.AttrScope(group="stage2"):
+        fc = mx.sym.FullyConnected(...)
+    fc.attr("group")  # "stage2"
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+_state = threading.local()
+
+
+def _stack():
+    if not hasattr(_state, "stack"):
+        _state.stack = [AttrScope()]
+    return _state.stack
+
+
+def current():
+    return _stack()[-1]
+
+
+class AttrScope:
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise MXNetError("AttrScope values must be strings")
+        self._attr = dict(kwargs)
+
+    def get(self, attr=None):
+        """Merge scope attrs with explicitly-passed attrs (explicit wins)."""
+        if not self._attr:
+            return dict(attr) if attr else {}
+        out = dict(self._attr)
+        if attr:
+            out.update(attr)
+        return out
+
+    def __enter__(self):
+        merged = AttrScope()
+        merged._attr = {**current()._attr, **self._attr}
+        _stack().append(merged)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()

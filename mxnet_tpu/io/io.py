@@ -379,15 +379,35 @@ class ImageRecordIter(DataIter):
                  label_width=1, shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
                  preprocess_threads=4, prefetch_buffer=4, ctx=None,
-                 synthetic=False, synthetic_size=256, **kwargs):
+                 synthetic=False, synthetic_size=256, seed=0, **kwargs):
         super().__init__(batch_size)
         self.data_shape = tuple(data_shape)
         self._ctx = ctx or current_context()
-        if path_imgrec and os.path.exists(path_imgrec) and not synthetic:
-            from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
-            self._rec = MXRecordIO(path_imgrec, "r")
-            raise MXNetError("RecordIO image decoding lands with the gluon "
-                             "vision pipeline; use synthetic=True or gluon.data")
+        self._mean = _np.asarray([mean_r, mean_g, mean_b],
+                                 "float32").reshape(3, 1, 1)
+        self._std = _np.asarray([std_r or 1, std_g or 1, std_b or 1],
+                                "float32").reshape(3, 1, 1)
+        self._rand_crop = rand_crop
+        self._rand_mirror = rand_mirror
+        self._label_width = label_width
+        self._rng = _np.random.RandomState(seed)
+        self._inner = None
+        self._reader = None
+        self._cached = None
+        if path_imgrec and not synthetic:
+            if not os.path.exists(path_imgrec):
+                raise MXNetError(f"record file not found: {path_imgrec}")
+            # native C++ prefetch reader; payloads may be encoded images
+            # (decoded via cv2 when available) or raw arrays whose byte size
+            # matches data_shape (uint8 or float32), the cv2-free path
+            from ..recordio import NativeRecordReader, native_available
+            if native_available():
+                self._reader = NativeRecordReader(path_imgrec, shuffle=shuffle,
+                                                  seed=seed)
+            else:
+                self._reader = _PyRecordStream(path_imgrec, shuffle=shuffle,
+                                               seed=seed)
+            return
         # synthetic benchmark mode (reference example/image-classification
         # README 'benchmark with synthetic data')
         rng = _np.random.RandomState(0)
@@ -396,19 +416,166 @@ class ImageRecordIter(DataIter):
         self._inner = NDArrayIter(self._data, self._label, batch_size,
                                   shuffle=shuffle, ctx=self._ctx)
 
+    def _decode(self, payload: bytes) -> _np.ndarray:
+        c, h, w = self.data_shape
+        n_u8 = c * h * w
+        if len(payload) == n_u8:
+            img = _np.frombuffer(payload, _np.uint8).reshape(self.data_shape)
+            return img.astype(_np.float32)
+        if len(payload) == n_u8 * 4:
+            return _np.frombuffer(payload, _np.float32).reshape(
+                self.data_shape).copy()
+        from .. import image as _img
+        try:
+            hwc = _img.imdecode(_np.frombuffer(payload, _np.uint8))
+        except Exception as e:
+            raise MXNetError(
+                "record payload is neither a raw CHW uint8/float32 buffer "
+                f"matching data_shape {self.data_shape} nor decodable as a "
+                f"compressed image ({e})")
+        if self._rand_crop:
+            # resize the short side, then _augment random-crops to (h, w)
+            hwc = _img.resize_short(hwc, max(h, w) + max(h, w) // 8)
+        else:
+            hwc = _img.imresize(hwc, w, h)
+        arr = hwc.asnumpy() if hasattr(hwc, "asnumpy") else _np.asarray(hwc)
+        return _np.moveaxis(arr.astype(_np.float32), -1, 0)
+
+    def _augment(self, img: _np.ndarray) -> _np.ndarray:
+        c, h, w = self.data_shape
+        if img.shape[1:] != (h, w):
+            # crop to target: random position with rand_crop, center otherwise
+            ih, iw = img.shape[1], img.shape[2]
+            if self._rand_crop:
+                y0 = self._rng.randint(0, max(ih - h, 0) + 1)
+                x0 = self._rng.randint(0, max(iw - w, 0) + 1)
+            else:
+                y0, x0 = max(ih - h, 0) // 2, max(iw - w, 0) // 2
+            img = img[:, y0:y0 + h, x0:x0 + w]
+        if self._rand_mirror and self._rng.rand() < 0.5:
+            img = img[:, :, ::-1]
+        img = (img - self._mean) / self._std
+        return _np.ascontiguousarray(img)
+
+    def _next_record_batch(self):
+        from ..recordio import unpack
+        xs, ys = [], []
+        while len(xs) < self.batch_size:
+            rec = self._reader.next()
+            if rec is None:
+                break
+            header, payload = unpack(rec)
+            lab = header.label
+            lab = float(lab) if _np.isscalar(lab) else _np.asarray(
+                lab, "float32")[:self._label_width]
+            xs.append(self._augment(self._decode(payload)))
+            ys.append(lab)
+        if not xs:
+            return None
+        pad = self.batch_size - len(xs)
+        if pad:
+            xs += [xs[-1]] * pad
+            ys += [ys[-1]] * pad
+        from ..ndarray import array
+        data = array(_np.stack(xs))
+        label = array(_np.asarray(ys, "float32"))
+        return DataBatch(data=[data], label=[label], pad=pad)
+
     @property
     def provide_data(self):
-        return self._inner.provide_data
+        if self._inner is not None:
+            return self._inner.provide_data
+        return [("data", (self.batch_size,) + self.data_shape)]
 
     @property
     def provide_label(self):
-        return self._inner.provide_label
+        if self._inner is not None:
+            return self._inner.provide_label
+        shp = (self.batch_size,) if self._label_width == 1 \
+            else (self.batch_size, self._label_width)
+        return [("softmax_label", shp)]
 
     def reset(self):
-        self._inner.reset()
+        if self._inner is not None:
+            self._inner.reset()
+        else:
+            self._reader.reset()
 
     def next(self):
-        return self._inner.next()
+        if self._inner is not None:
+            return self._inner.next()
+        if self._cached is not None:
+            batch, self._cached = self._cached, None
+            return batch
+        batch = self._next_record_batch()
+        if batch is None:
+            raise StopIteration
+        return batch
 
     def iter_next(self):
-        return self._inner.iter_next()
+        if self._inner is not None:
+            return self._inner.iter_next()
+        if self._cached is not None:
+            return True
+        self._cached = self._next_record_batch()
+        return self._cached is not None
+
+    def getdata(self):
+        if self._inner is not None:
+            return self._inner.getdata()
+        return self._cached.data
+
+    def getlabel(self):
+        if self._inner is not None:
+            return self._inner.getlabel()
+        return self._cached.label
+
+    def getpad(self):
+        if self._inner is not None:
+            return self._inner.getpad()
+        return self._cached.pad if self._cached is not None else 0
+
+
+class _PyRecordStream:
+    """Pure-python fallback with the NativeRecordReader surface; shuffle is
+    an offset permutation re-drawn each epoch."""
+
+    def __init__(self, path, shuffle=False, seed=0):
+        from ..recordio import MXRecordIO
+        self._rec = MXRecordIO(path, "r")
+        self._shuffle = shuffle
+        self._rng = _np.random.RandomState(seed)
+        self._offsets = None
+        self._order = []
+        self._cursor = 0
+        if shuffle:
+            self._scan_offsets()
+            self._reshuffle()
+
+    def _scan_offsets(self):
+        offs = []
+        while True:
+            pos = self._rec.tell()
+            if self._rec.read() is None:
+                break
+            offs.append(pos)
+        self._offsets = offs
+        self._rec.reset()
+
+    def _reshuffle(self):
+        self._order = self._rng.permutation(len(self._offsets)).tolist()
+        self._cursor = 0
+
+    def next(self):
+        if not self._shuffle:
+            return self._rec.read()
+        if self._cursor >= len(self._order):
+            return None
+        self._rec.seek(self._offsets[self._order[self._cursor]])
+        self._cursor += 1
+        return self._rec.read()
+
+    def reset(self):
+        self._rec.reset()
+        if self._shuffle:
+            self._reshuffle()

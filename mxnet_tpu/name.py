@@ -1,0 +1,53 @@
+"""Name management (reference python/mxnet/name.py): NameManager auto-names
+symbols; Prefix prepends a scope prefix. Thread-local stack, used as
+
+    with mx.name.Prefix("stage1_"):
+        fc = mx.sym.FullyConnected(data, num_hidden=10)   # stage1_fullyconnected0
+"""
+from __future__ import annotations
+
+import threading
+
+_state = threading.local()
+
+
+def _stack():
+    if not hasattr(_state, "stack"):
+        _state.stack = [NameManager()]
+    return _state.stack
+
+
+def current():
+    return _stack()[-1]
+
+
+class NameManager:
+    """Auto-naming by per-hint counters (reference name.py NameManager)."""
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name is not None:
+            return name
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return f"{hint}{idx}"
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+
+
+class Prefix(NameManager):
+    """Prepends `prefix` to every auto name (reference name.py Prefix)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)

@@ -670,7 +670,8 @@ def _resolved_params(node: _Node, training: Optional[bool] = None) -> dict:
 def Variable(name: str, attr: Optional[dict] = None, shape=None, dtype=None,
              lr_mult=None, wd_mult=None, init=None, stype=None, **kwargs) -> Symbol:
     """Create a symbolic variable (reference symbol.py var())."""
-    attrs = {str(k): str(v) for k, v in (attr or {}).items()}
+    from ..attribute import current as _attr_scope
+    attrs = {str(k): str(v) for k, v in _attr_scope().get(attr or {}).items()}
     if shape is not None:
         attrs["__shape__"] = str(tuple(shape))
     if dtype is not None:
@@ -705,7 +706,14 @@ def _apply_op(op: Op, *args, name: Optional[str] = None,
     """Create an op node; auto-create variables for absent learnable inputs
     (the reference does this in the generated symbol functions)."""
     spec = _op_arg_spec(op)
-    node_name = name or _SymNameManager.fresh(op.name.lower().lstrip("_"))
+    # NameManager/Prefix scope (reference python/mxnet/name.py); falls back
+    # to the process-global counters when no user scope is active (the
+    # bottom-of-stack default manager would restart numbering per thread)
+    from ..name import _stack as _name_stack
+    if name is None and len(_name_stack()) > 1:
+        node_name = _name_stack()[-1].get(None, op.name.lower().lstrip("_"))
+    else:
+        node_name = name or _SymNameManager.fresh(op.name.lower().lstrip("_"))
     aux_names = _AUX_ARGS.get(op.name, ())
 
     # collect positional symbol inputs; varargs ops swallow all positionals
@@ -758,7 +766,8 @@ def _apply_op(op: Op, *args, name: Optional[str] = None,
         raise MXNetError(f"op {op.name}: too many positional inputs")
 
     params.update({k: _coerce_param(v) for k, v in kwargs.items()})
-    attrs = {str(k): str(v) for k, v in (attr or {}).items()}
+    from ..attribute import current as _attr_scope
+    attrs = {str(k): str(v) for k, v in _attr_scope().get(attr or {}).items()}
     node = _Node("op", node_name, op, params, inputs, attrs)
     return Symbol([(node, 0)])
 

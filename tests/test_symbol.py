@@ -331,3 +331,28 @@ def test_duplicate_var_names_rejected():
     s = a * 1.0 + b * 1.0
     with pytest.raises(mx.MXNetError):
         s.bind(ctx=mx.cpu(), args={'x': nd.array([1.0])})
+
+
+def test_name_prefix_and_attr_scope():
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    with mx.name.Prefix("stage1_"):
+        fc = sym.FullyConnected(sym.Variable("data"), num_hidden=4)
+    assert fc._heads[0][0].name.startswith("stage1_")
+    with mx.AttrScope(group="g2", lr_mult="0.1"):
+        fc2 = sym.FullyConnected(sym.Variable("d2"), num_hidden=4)
+    node = fc2._heads[0][0]
+    assert node.attrs.get("group") == "g2"
+    # explicit attr wins over scope
+    with mx.AttrScope(group="outer"):
+        fc3 = sym.FullyConnected(sym.Variable("d3"), num_hidden=4,
+                                 attr={"group": "inner"})
+    assert fc3._heads[0][0].attrs.get("group") == "inner"
+
+
+def test_attrscope_applies_to_variables():
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    with mx.AttrScope(lr_mult="0.1"):
+        w = sym.Variable("w_scoped")
+    assert w._heads[0][0].attrs.get("lr_mult") == "0.1"
