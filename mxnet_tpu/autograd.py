@@ -6,6 +6,9 @@ mechanism is TPU-native (SURVEY.md §7): instead of a C++ tape of nnvm nodes
 (src/imperative/imperative.cc AGInfo/RecordOp) we keep a Python tape whose
 entries hold the *compiled transpose* produced by `jax.vjp` at record time —
 forward runs once, backward replays XLA-compiled VJPs in reverse order.
+`grad(create_graph=True)` records the backward walk itself (re-deriving each
+op's VJP from its pure forward at the recorded primals), giving arbitrary-
+order derivatives for registered-op graphs.
 """
 from __future__ import annotations
 
@@ -42,14 +45,23 @@ class Node:
 
 
 class TapeEntry:
-    __slots__ = ("vjp_fn", "in_nodes", "out_nodes", "out_is_tuple", "out_avals")
+    __slots__ = ("vjp_fn", "in_nodes", "out_nodes", "out_is_tuple", "out_avals",
+                 "refn", "in_arrays", "in_raws")
 
-    def __init__(self, vjp_fn, in_nodes, out_nodes, out_is_tuple, out_avals):
+    def __init__(self, vjp_fn, in_nodes, out_nodes, out_is_tuple, out_avals,
+                 refn=None, in_arrays=None, in_raws=None):
         self.vjp_fn = vjp_fn
         self.in_nodes = in_nodes    # list[Node|None] aligned with op inputs
         self.out_nodes = out_nodes  # list[Node] aligned with op outputs
         self.out_is_tuple = out_is_tuple
         self.out_avals = out_avals  # [(shape, dtype)] for zero-fill
+        # create_graph support: the re-differentiable pure forward fn plus
+        # the primal NDArrays/raw values it was recorded with (the vjp_fn
+        # closure hides its primal dependence, so higher-order grads need
+        # to re-derive the backward from `refn` at the recorded primals)
+        self.refn = refn
+        self.in_arrays = in_arrays
+        self.in_raws = in_raws
 
 
 # ---------------------------------------------------------------------------
@@ -130,8 +142,10 @@ def _participates(arr) -> bool:
     return getattr(arr, "_ag_node", None) is not None
 
 
-def record_op(vjp_fn, inputs, outputs, out_is_tuple: bool):
-    """Called by the NDArray dispatch layer after a recorded forward."""
+def record_op(vjp_fn, inputs, outputs, out_is_tuple: bool, refn=None):
+    """Called by the NDArray dispatch layer after a recorded forward.
+    `refn`, when given, is the pure raw-array forward used to re-derive the
+    backward under create_graph (higher-order autograd)."""
     in_nodes = [getattr(x, "_ag_node", None) for x in inputs]
     out_nodes = []
     for o in outputs:
@@ -139,7 +153,13 @@ def record_op(vjp_fn, inputs, outputs, out_is_tuple: bool):
         o._ag_node = n
         out_nodes.append(n)
     avals = [(tuple(o.shape), o.dtype) for o in outputs]
-    _STATE.tape.append(TapeEntry(vjp_fn, in_nodes, out_nodes, out_is_tuple, avals))
+    # snapshot the primal RAW values (not the NDArray wrappers — Node keeps
+    # weakrefs by design, and in-place mutation between forward and a
+    # create_graph backward must not poison the re-derived VJP)
+    in_raws = [getattr(x, "_data", x) for x in inputs] if refn is not None \
+        else None
+    _STATE.tape.append(TapeEntry(vjp_fn, in_nodes, out_nodes, out_is_tuple,
+                                 avals, refn, None, in_raws))
 
 
 def _zeros_like_raw(arr):
@@ -224,19 +244,22 @@ def _run_backward(heads, head_grads, retain_graph) -> Dict[Node, Any]:
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
     """Return grads w.r.t. variables instead of accumulating (reference
-    autograd.py:273). create_graph (higher-order) lands with the jaxpr-level
-    tape in a later round."""
+    autograd.py:273). create_graph=True records the backward pass itself on
+    the tape, enabling higher-order gradients."""
     from .ndarray import NDArray, _wrap_like
-    if create_graph:
-        raise MXNetError("create_graph=True not yet supported on the TPU tape")
     single = isinstance(variables, NDArray)
     if single:
         variables = [variables]
     if isinstance(heads, NDArray):
         heads = [heads]
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
     if retain_graph is None:
         retain_graph = create_graph
-    grads = _run_backward(heads, head_grads, retain_graph)
+    if create_graph:
+        grads = _run_backward_create_graph(heads, head_grads)
+    else:
+        grads = _run_backward(heads, head_grads, retain_graph)
     outs = []
     for v in variables:
         node = getattr(v, "_ag_node", None)
@@ -244,8 +267,102 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         if g is None:
             raise MXNetError("one of the variables does not receive gradient "
                              "(not on any path from heads)")
-        outs.append(_wrap_like(g, v))
+        if create_graph:
+            # keep the tape linkage but place on v's context
+            w = _wrap_like(g._data, v)
+            w._ag_node = g._ag_node
+            outs.append(w)
+        else:
+            outs.append(_wrap_like(g, v))
     return outs[0] if single else outs
+
+
+def _run_backward_create_graph(heads, head_grads) -> Dict[Node, Any]:
+    """Backward walk whose every step is itself recorded: each tape entry's
+    backward is re-derived from its `refn` at the recorded primals via
+    jax.vjp, executed through the recording path, so the returned grads are
+    differentiable w.r.t. the original inputs (d²y/dx²)."""
+    from .ndarray import NDArray
+
+    grad_map: Dict[int, NDArray] = {}
+    node_by_id: Dict[int, Node] = {}
+    tape = list(_STATE.tape)  # snapshot: the walk appends new entries
+
+    prev = set_recording(True)
+    try:
+        def add_grad(node, g_nd):
+            if node is None or g_nd is None:
+                return
+            nid = id(node)
+            node_by_id[nid] = node
+            if nid in grad_map:
+                grad_map[nid] = grad_map[nid] + g_nd  # recorded add
+            else:
+                grad_map[nid] = g_nd
+
+        for i, h in enumerate(heads):
+            node = getattr(h, "_ag_node", None)
+            if node is None:
+                raise MXNetError("head array is not part of the recorded "
+                                 "graph")
+            if head_grads is None or head_grads[i] is None:
+                add_grad(node, NDArray(jnp.ones(h.shape, h.dtype)))
+            else:
+                hg = head_grads[i]
+                add_grad(node, hg if isinstance(hg, NDArray)
+                         else NDArray(jnp.asarray(hg)))
+
+        for entry in reversed(tape):
+            outs_g = []
+            any_out = False
+            for n, (shp, dt) in zip(entry.out_nodes, entry.out_avals):
+                g = grad_map.get(id(n))
+                if g is not None:
+                    any_out = True
+                    outs_g.append(g)
+                else:
+                    outs_g.append(NDArray(jnp.zeros(shp, dt)))
+            if not any_out:
+                continue
+            if entry.refn is None:
+                raise MXNetError(
+                    "create_graph=True: an op on the path has no "
+                    "re-differentiable form (hybridized-block forwards, "
+                    "custom autograd.Function, Custom ops); run the net "
+                    "un-hybridized / restructure with registered ops")
+            refn = entry.refn
+            n_in = len(entry.in_raws)
+            out_is_tuple = entry.out_is_tuple
+
+            def bwd(*args, _refn=refn, _n=n_in, _tup=out_is_tuple):
+                primals, cots = args[:_n], args[_n:]
+                _, vjp = jax.vjp(_refn, *primals)
+                return vjp(tuple(cots) if _tup else cots[0])
+
+            # primal wrappers over the RECORDED raws, re-attached to the
+            # original nodes so the new entries link into the graph
+            primal_nds = []
+            for raw, node in zip(entry.in_raws, entry.in_nodes):
+                p = NDArray(raw)
+                p._ag_node = node
+                primal_nds.append(p)
+            all_in = primal_nds + list(outs_g)
+            raws = [x._data for x in all_in]
+            in_gs_raw, vjp2 = jax.vjp(bwd, *raws)
+            # int inputs yield float0 cotangents — wrap as zeros so the
+            # NDArray layer never sees them (their nodes are None anyway)
+            g_nds = [NDArray(jnp.zeros(r.shape, jnp.float32))
+                     if r.dtype == jax.dtypes.float0 else NDArray(r)
+                     for r in in_gs_raw]
+            # bwd returns a tuple even for one input, so the recorded
+            # entry's cotangent is always tuple-structured
+            record_op(vjp2, all_in, g_nds, out_is_tuple=True, refn=bwd)
+            for node, g_nd in zip(entry.in_nodes, g_nds):
+                if node is not None:
+                    add_grad(node, g_nd)
+    finally:
+        set_recording(prev)
+    return {node_by_id[nid]: g for nid, g in grad_map.items()}
 
 
 def get_symbol(x):

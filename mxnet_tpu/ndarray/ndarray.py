@@ -303,7 +303,8 @@ class NDArray:
             def vjp_fn(cot, _key=key, _shape=self.shape, _dtype=self.dtype):
                 z = jnp.zeros(_shape, _dtype)
                 return (z.at[_key].add(cot),)
-            autograd.record_op(vjp_fn, [self], [out], out_is_tuple=False)
+            autograd.record_op(vjp_fn, [self], [out], out_is_tuple=False,
+                               refn=lambda a, _k=key: a[_k])
         _track(out)
         return out
 
@@ -492,7 +493,8 @@ def invoke(op: Union[str, Op], inputs: Sequence[NDArray], params: Dict[str, Any]
     for o in outs:
         _track(o)
     if need_grad:
-        autograd.record_op(vjp_fn, list(inputs), outs, out_is_tuple=was_tuple)
+        autograd.record_op(vjp_fn, list(inputs), outs, out_is_tuple=was_tuple,
+                           refn=op.unbound(params))
     if out is not None:
         targets = [out] if isinstance(out, NDArray) else list(out)
         for t, o in zip(targets, outs):

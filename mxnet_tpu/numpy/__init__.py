@@ -187,7 +187,7 @@ def _apply(jfn, args, kwargs):
     ctx = ins[0]._ctx if ins else current_context()
     outs = [_wrap(o, ctx) for o in outs_t]
     if need:
-        autograd.record_op(vjp_fn, ins, outs, out_is_tuple=was_tuple)
+        autograd.record_op(vjp_fn, ins, outs, out_is_tuple=was_tuple, refn=fn)
     if was_tuple:
         return list(outs)
     return outs[0]

@@ -158,7 +158,8 @@ def quadratic(x, *, a=0.0, b=0.0, c=0.0):
 
 
 # ---------------------------------------------------------------------------
-# Detection building blocks (SSD path; full multibox suite in round >=2)
+# Detection building blocks shared with ops/detection.py (full multibox
+# suite lives there)
 # ---------------------------------------------------------------------------
 
 @register("_contrib_box_iou", differentiable=False)

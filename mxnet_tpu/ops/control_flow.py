@@ -56,7 +56,7 @@ def _run_recorded(fn_raw, nd_inputs):
     outs_nd = [NDArray(r) for r in leaves]
     if need:
         autograd.record_op(vjp_fn, list(nd_inputs), outs_nd,
-                           out_is_tuple=len(leaves) > 1)
+                           out_is_tuple=len(leaves) > 1, refn=fn_raw)
     return jax.tree_util.tree_unflatten(struct, outs_nd)
 
 

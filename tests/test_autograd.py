@@ -147,3 +147,88 @@ def test_exception_propagation():
     with pytest.raises(Exception):
         y = nd.Reshape(x, shape=(7, 7))  # impossible reshape
         y.wait_to_read()
+
+
+def test_grad_create_graph_second_derivative():
+    """Higher-order autograd (reference autograd.grad create_graph=True)."""
+    import numpy as onp
+    x = nd.array(onp.asarray([1.0, 2.0, 3.0], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        gx = autograd.grad(y, x, create_graph=True)     # 3x^2
+        z = (gx * gx).sum()                             # sum 9x^4
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                36 * onp.asarray([1.0, 8.0, 27.0]), rtol=1e-4)
+
+
+def test_grad_create_graph_through_np_and_exp():
+    import numpy as onp
+    from mxnet_tpu import np as mnp
+    x = mnp.array([0.5, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mnp.exp(x)                                  # e^x
+        g = autograd.grad(y, x, create_graph=True)      # e^x
+    g.backward()
+    # d/dx e^x = e^x again
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.exp([0.5, 1.0]),
+                                rtol=1e-5)
+
+
+def test_grad_create_graph_rejects_custom_function():
+    import numpy as onp
+
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return dy * 2.0 * x
+
+    x = nd.array(onp.asarray([2.0], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = Square()(x)
+        with pytest.raises(Exception, match="re-differentiable"):
+            autograd.grad(y, x, create_graph=True)
+
+
+def test_create_graph_immune_to_inplace_mutation():
+    # review regression: snapshot primals, not live _data
+    import numpy as onp
+    x = nd.array(onp.asarray([1.0, 2.0, 3.0], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        x += 100.0  # in-place mutation after forward
+        gx = autograd.grad(y, x, create_graph=True)
+    onp.testing.assert_allclose(gx.asnumpy(), 3 * onp.asarray([1.0, 4.0, 9.0]),
+                                rtol=1e-5)
+
+
+def test_grad_single_head_grads_ndarray():
+    import numpy as onp
+    x = nd.array(onp.asarray([1.0, 2.0], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    g = autograd.grad(y, x, head_grads=nd.array(onp.asarray([1.0, 1.0],
+                                                            "float32")))
+    onp.testing.assert_allclose(g.asnumpy(), [2.0, 4.0], rtol=1e-6)
+
+
+def test_create_graph_through_slicing():
+    import numpy as onp
+    x = nd.array(onp.asarray([1.0, 2.0, 3.0, 4.0], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = (x[1:3] ** 2).sum()       # x1^2 + x2^2
+        g = autograd.grad(y, x, create_graph=True)
+        z = (g * g).sum()             # 4x1^2 + 4x2^2 -> dz/dx = 8x on 1:3
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [0.0, 16.0, 24.0, 0.0],
+                                rtol=1e-5)
