@@ -85,5 +85,21 @@ def main():
     }))
 
 
+def _main_with_retry(retries=2):
+    # the tunneled TPU backend occasionally drops a request mid-compile;
+    # a fresh attempt reuses the compile cache and succeeds quickly
+    for attempt in range(retries + 1):
+        try:
+            return main()
+        except Exception:
+            if attempt == retries:
+                raise
+            import traceback
+            traceback.print_exc()
+            print(f"# bench attempt {attempt + 1} failed; retrying",
+                  file=sys.stderr)
+            time.sleep(5)
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(_main_with_retry())

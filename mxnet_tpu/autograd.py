@@ -46,10 +46,10 @@ class Node:
 
 class TapeEntry:
     __slots__ = ("vjp_fn", "in_nodes", "out_nodes", "out_is_tuple", "out_avals",
-                 "refn", "in_arrays", "in_raws")
+                 "refn", "in_raws")
 
     def __init__(self, vjp_fn, in_nodes, out_nodes, out_is_tuple, out_avals,
-                 refn=None, in_arrays=None, in_raws=None):
+                 refn=None, in_raws=None):
         self.vjp_fn = vjp_fn
         self.in_nodes = in_nodes    # list[Node|None] aligned with op inputs
         self.out_nodes = out_nodes  # list[Node] aligned with op outputs
@@ -60,7 +60,6 @@ class TapeEntry:
         # closure hides its primal dependence, so higher-order grads need
         # to re-derive the backward from `refn` at the recorded primals)
         self.refn = refn
-        self.in_arrays = in_arrays
         self.in_raws = in_raws
 
 
@@ -159,7 +158,7 @@ def record_op(vjp_fn, inputs, outputs, out_is_tuple: bool, refn=None):
     in_raws = [getattr(x, "_data", x) for x in inputs] if refn is not None \
         else None
     _STATE.tape.append(TapeEntry(vjp_fn, in_nodes, out_nodes, out_is_tuple,
-                                 avals, refn, None, in_raws))
+                                 avals, refn=refn, in_raws=in_raws))
 
 
 def _zeros_like_raw(arr):
