@@ -30,6 +30,21 @@ def ones_like(a):
     return invoke("ones_like", [a], {})
 
 
+def cast_storage(arr, stype):
+    """Dense <-> sparse storage conversion (reference
+    src/operator/tensor/cast_storage.cc). Sparse is dense-backed here, so
+    this wraps/unwraps the CSR/RowSparse NDArray classes without copying."""
+    if stype in ("default", None):
+        if type(arr) is not NDArray:
+            return NDArray(arr._data, arr._ctx)
+        return arr
+    from .sparse import CSRNDArray, RowSparseNDArray
+    cls = {"csr": CSRNDArray, "row_sparse": RowSparseNDArray}[stype]
+    if isinstance(arr, cls):
+        return arr
+    return cls._from_dense(arr) if hasattr(cls, "_from_dense") else cls(arr._data, arr._ctx)
+
+
 def save(fname, data):
     from ..serialization import save_ndarrays
     save_ndarrays(fname, data)
@@ -80,9 +95,11 @@ def __getattr__(name: str):
         raise AttributeError(name)
     if name in _wrapper_cache:
         return _wrapper_cache[name]
-    if name == "contrib":
-        from . import contrib as _contrib
-        return _contrib
+    if name in ("contrib", "image"):
+        # importlib, not `from . import`: the latter's hasattr() probe
+        # re-enters this __getattr__ before the submodule import starts.
+        import importlib
+        return importlib.import_module(__name__ + "." + name)
     if name == "Custom":
         from ..operator import custom as _custom
         _wrapper_cache[name] = _custom

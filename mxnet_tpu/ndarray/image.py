@@ -1,0 +1,21 @@
+"""mx.nd.image — image op namespace (reference python/mxnet/ndarray/image.py):
+`nd.image.to_tensor/normalize/crop/resize/flip_*` over the `_image_*`
+registered ops."""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ops.registry import get_op as _get_op
+
+
+def __getattr__(name):
+    from . import _make_wrapper
+    for cand in (f"_image_{name}", name):
+        try:
+            _get_op(cand)
+        except MXNetError:
+            continue
+        fn = _make_wrapper(cand)
+        globals()[name] = fn
+        return fn
+    raise AttributeError(
+        f"module 'mxnet_tpu.ndarray.image' has no attribute '{name}'")

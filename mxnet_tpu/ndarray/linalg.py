@@ -62,3 +62,7 @@ def det(A):
 
 def slogdet(A):
     return invoke("linalg_slogdet", [A], {})
+
+
+def syevd(A):
+    return invoke("linalg_syevd", [A], {})

@@ -488,6 +488,19 @@ def invoke(op: Union[str, Op], inputs: Sequence[NDArray], params: Dict[str, Any]
         outs_raw = (outs_raw,)
     if env.get("MXNET_ENGINE_TYPE") == "Naive":
         jax.block_until_ready(outs_raw)
+    spec = op.state_inputs
+    if spec is not None:
+        # optimizer-style ops: updated states are trailing outputs written
+        # back into their input arrays (the reference mutates them in place)
+        pairs = spec(raw, params) if callable(spec) else spec
+        state_out = set()
+        for in_idx, out_idx in pairs:
+            inputs[in_idx]._set_data(outs_raw[out_idx])
+            state_out.add(out_idx)
+        outs_raw = tuple(o for i, o in enumerate(outs_raw)
+                         if i not in state_out)
+        if len(outs_raw) == 1:
+            was_tuple = False
     ctx = inputs[0]._ctx if inputs else current_context()
     outs = [NDArray(o, ctx) for o in outs_raw]
     for o in outs:

@@ -266,7 +266,16 @@ _DELEGATED = [
     "polyval", "polyfit", "polyadd", "polysub", "polymul", "polyder",
     "polyint", "vander", "gradient", "diff", "sinc", "meshgrid",
     "apply_along_axis", "tensordot", "float_power", "divmod",
+    # window functions (reference _npi_blackman/_npi_hamming/_npi_hanning)
+    "blackman", "hamming", "hanning", "bartlett", "kaiser",
 ]
+
+
+def trapz(y, x=None, dx=1.0, axis=-1):
+    """Trapezoidal integration (jnp renamed it trapezoid)."""
+    fn = getattr(jnp, "trapezoid", None) or jnp.trapz
+    return _apply(fn, (y,) if x is None else (y, x),
+                  {"dx": dx, "axis": axis} if x is None else {"axis": axis})
 
 _g = globals()
 for _name in dict.fromkeys(_DELEGATED):

@@ -131,6 +131,79 @@ def reshape_like(lhs, rhs):
     return _npx_op("reshape_like", lhs, rhs)
 
 
+def _npx_reshape_infer(src, target):
+    """_npx_reshape's own special-code table (reference
+    src/operator/numpy/np_matrix_op.cc NumpyXReshapeInferShape): -1 infer,
+    -2 copy one src dim, -3 skip a size-1 src dim, -4 copy ALL remaining
+    src dims, -5 merge two src dims, -6 split one src dim in two (next two
+    target entries, one may be -1). NOTE: different from legacy Reshape."""
+    if all(d >= 0 for d in target):
+        return tuple(target)
+    out = []
+    unknown = -1
+    known_prod = 1
+    si = 0
+    i = 0
+    while i < len(target):
+        d = target[i]
+        if d == -1:
+            if unknown >= 0:
+                raise ValueError("npx.reshape: only one dim can be inferred")
+            unknown = len(out)
+            out.append(-1)
+            si += 1
+        elif d == -2:
+            out.append(src[si]); known_prod *= src[si]; si += 1
+        elif d == -3:
+            if src[si] != 1:
+                raise ValueError("npx.reshape: -3 requires a size-1 dim")
+            si += 1
+        elif d == -4:
+            while si < len(src):
+                out.append(src[si]); known_prod *= src[si]; si += 1
+        elif d == -5:
+            m = src[si] * src[si + 1]
+            out.append(m); known_prod *= m; si += 2
+        elif d == -6:
+            d0 = src[si]; si += 1
+            d1, d2 = target[i + 1], target[i + 2]
+            i += 2
+            if d1 == -1 and d2 == -1:
+                raise ValueError("npx.reshape: split dims cannot both be -1")
+            if d1 == -1:
+                d1 = d0 // d2
+            elif d2 == -1:
+                d2 = d0 // d1
+            if d1 * d2 != d0:
+                raise ValueError("npx.reshape: invalid -6 split")
+            out.extend([d1, d2]); known_prod *= d0
+        elif d > 0:
+            out.append(d); known_prod *= d; si += 1
+        else:
+            raise ValueError(f"npx.reshape: invalid dim {d}")
+        i += 1
+    total = 1
+    for s in src:
+        total *= s
+    if unknown >= 0:
+        out[unknown] = total // known_prod
+    return tuple(out)
+
+
+def reshape(a, newshape, reverse=False, order="C"):
+    """`npx.reshape` (reference _npx_reshape, np_matrix_op.cc:198) with its
+    special codes; reverse=True matches dims right-to-left."""
+    if isinstance(newshape, int):
+        newshape = (newshape,)
+    src = tuple(a.shape)
+    tgt = tuple(int(d) for d in newshape)
+    if reverse:
+        shape = tuple(reversed(_npx_reshape_infer(src[::-1], tgt[::-1])))
+    else:
+        shape = _npx_reshape_infer(src, tgt)
+    return _npx_op("Reshape", a, shape=shape)
+
+
 def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
     r = data._data if isinstance(data, NDArray) else jnp.asarray(data)
     n = r.size if axis is None else r.shape[axis]

@@ -16,3 +16,11 @@ from . import contrib    # noqa: F401
 from . import attention  # noqa: F401
 from . import extra      # noqa: F401
 from . import detection  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import misc       # noqa: F401
+from . import random_pdf  # noqa: F401
+from . import contrib_misc  # noqa: F401
+from . import legacy     # noqa: F401
+from . import quantized  # noqa: F401
+from . import detection_extra  # noqa: F401
+from . import dgl_ops    # noqa: F401

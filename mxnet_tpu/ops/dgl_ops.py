@@ -1,0 +1,198 @@
+"""DGL graph-sampling operators (reference src/operator/contrib/dgl_graph.cc:
+_contrib_dgl_csr_neighbor_uniform_sample, _contrib_dgl_csr_neighbor_non_uniform_sample,
+_contrib_dgl_subgraph, _contrib_dgl_graph_compact).
+
+These are host-side data-preparation ops in the reference as well (CPU-only
+FComputeEx over CSR). Here graphs are dense-backed adjacency matrices whose
+non-zero entries are edge-ids (see ndarray/sparse.py); the sampling runs as a
+numpy routine behind jax.pure_callback with static padded output shapes
+(max_num_vertices), which keeps the op usable inside jitted input pipelines.
+
+Output layout per reference docs: for k seed arrays the op returns
+[vertices×k, subgraph×k, (probability×k,) layer×k]; each `vertices` array has
+length max_num_vertices+1 with the actual count in the last slot, padded
+with -1.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .registry import register
+
+_SAMPLE_SEED = [12345]
+
+
+def _neighbor_sample_host(adj, seeds, probability, num_hops, num_neighbor,
+                          max_num_vertices):
+    rng = _np.random.RandomState(_SAMPLE_SEED[0])
+    _SAMPLE_SEED[0] = (_SAMPLE_SEED[0] * 1103515245 + 12345) % (1 << 31)
+    V = adj.shape[0]
+    M = int(max_num_vertices)
+    seeds = [int(s) for s in _np.asarray(seeds).ravel() if s >= 0]
+    visited = {}
+    layer_of = {}
+    for s in seeds:
+        if s not in visited and len(visited) < M:
+            visited[s] = True
+            layer_of[s] = 0
+    frontier = list(visited)
+    kept_edges = []          # (src, dst)
+    for hop in range(1, int(num_hops) + 1):
+        nxt = []
+        for u in frontier:
+            nbrs = _np.nonzero(adj[u])[0]
+            if len(nbrs) == 0:
+                continue
+            if len(nbrs) > num_neighbor:
+                if probability is not None:
+                    p = probability[nbrs].astype(_np.float64)
+                    p = p / p.sum()
+                    nbrs = rng.choice(nbrs, size=num_neighbor, replace=False,
+                                      p=p)
+                else:
+                    nbrs = rng.choice(nbrs, size=num_neighbor, replace=False)
+            for v in nbrs:
+                kept_edges.append((u, int(v)))
+                if int(v) not in visited and len(visited) < M:
+                    visited[int(v)] = True
+                    layer_of[int(v)] = hop
+                    nxt.append(int(v))
+        frontier = nxt
+    verts = sorted(visited)
+    n = len(verts)
+    out_v = _np.full(M + 1, -1, _np.int32)
+    out_v[:n] = verts
+    out_v[M] = n
+    sub = _np.zeros((M, V), adj.dtype)
+    vset = set(verts)
+    for u, v in kept_edges:
+        if u in vset and v in vset:
+            sub[verts.index(u), v] = adj[u, v]
+    out_layer = _np.full(M, -1, _np.int32)
+    for i, u in enumerate(verts):
+        out_layer[i] = layer_of[u]
+    out_prob = _np.zeros(M, _np.float32)
+    if probability is not None:
+        for i, u in enumerate(verts):
+            out_prob[i] = probability[u]
+    return out_v, sub, out_prob, out_layer
+
+
+def _mk_sample(csr, seed_arrays, probability, num_hops, num_neighbor,
+               max_num_vertices):
+    M = int(max_num_vertices)
+    V = csr.shape[1]
+    outs_v, outs_g, outs_p, outs_l = [], [], [], []
+    for seed in seed_arrays:
+        shapes = (jax.ShapeDtypeStruct((M + 1,), jnp.int32),
+                  jax.ShapeDtypeStruct((M, V), csr.dtype),
+                  jax.ShapeDtypeStruct((M,), jnp.float32),
+                  jax.ShapeDtypeStruct((M,), jnp.int32))
+        # io_callback, NOT pure_callback: the sampler advances host RNG
+        # state, and XLA may CSE/deduplicate "pure" callbacks with identical
+        # operands — two independent draws would silently become one
+        from jax.experimental import io_callback
+        if probability is None:
+            v, g, p, l = io_callback(
+                lambda a, s: _neighbor_sample_host(
+                    _np.asarray(a), _np.asarray(s), None, num_hops,
+                    num_neighbor, M), shapes, csr, seed, ordered=True)
+        else:
+            v, g, p, l = io_callback(
+                lambda a, s, pr: _neighbor_sample_host(
+                    _np.asarray(a), _np.asarray(s), _np.asarray(pr),
+                    num_hops, num_neighbor, M), shapes, csr, seed,
+                probability, ordered=True)
+        outs_v.append(v); outs_g.append(g); outs_p.append(p); outs_l.append(l)
+    return outs_v, outs_g, outs_p, outs_l
+
+
+@register("_contrib_dgl_csr_neighbor_uniform_sample", differentiable=False,
+          multi_output=True)
+def dgl_csr_neighbor_uniform_sample(csr_matrix, *seeds, num_args,
+                                    num_hops=1, num_neighbor=2,
+                                    max_num_vertices=100):
+    v, g, _, l = _mk_sample(csr_matrix, seeds, None, num_hops, num_neighbor,
+                            max_num_vertices)
+    return tuple(v) + tuple(g) + tuple(l)
+
+
+@register("_contrib_dgl_csr_neighbor_non_uniform_sample",
+          differentiable=False, multi_output=True)
+def dgl_csr_neighbor_non_uniform_sample(csr_matrix, probability, *seeds,
+                                        num_args, num_hops=1, num_neighbor=2,
+                                        max_num_vertices=100):
+    v, g, p, l = _mk_sample(csr_matrix, seeds, probability, num_hops,
+                            num_neighbor, max_num_vertices)
+    return tuple(v) + tuple(g) + tuple(p) + tuple(l)
+
+
+@register("_contrib_dgl_subgraph", differentiable=False, multi_output=True)
+def dgl_subgraph(graph, *varrays, num_args, return_mapping=False):
+    """Induced subgraph per vertex array: out values are NEW edge ids
+    (1-based, row-major order); with return_mapping also CSR-shaped arrays
+    holding the PARENT edge ids (reference dgl_graph.cc:1116)."""
+    subs, maps = [], []
+    for vid in varrays:
+        def _host(adj, v):
+            a = _np.asarray(adj)
+            vv = _np.asarray(v).astype(_np.int64).ravel()
+            n = len(vv)
+            sub = _np.zeros((n, n), a.dtype)
+            mapping = _np.zeros((n, n), a.dtype)
+            eid = 1
+            for i, u in enumerate(vv):
+                for j, w in enumerate(vv):
+                    if a[u, w] != 0:
+                        sub[i, j] = eid
+                        mapping[i, j] = a[u, w]
+                        eid += 1
+            return sub, mapping
+
+        n = vid.shape[0]
+        shapes = (jax.ShapeDtypeStruct((n, n), graph.dtype),
+                  jax.ShapeDtypeStruct((n, n), graph.dtype))
+        s, m = jax.pure_callback(_host, shapes, graph, vid)
+        subs.append(s)
+        maps.append(m)
+    return tuple(subs) + (tuple(maps) if return_mapping else ())
+
+
+@register("_contrib_dgl_graph_compact", differentiable=False,
+          multi_output=True)
+def dgl_graph_compact(*args, num_args, graph_sizes, return_mapping=False):
+    """Strip the -1/empty padding left by the neighbor samplers: graph i is
+    cropped to its first graph_sizes[i] sampled vertices, with columns
+    re-indexed into the compacted vertex order (reference dgl_graph.cc:1552)."""
+    if isinstance(graph_sizes, (int, float)):
+        graph_sizes = (int(graph_sizes),)
+    k = len(graph_sizes)
+    graphs = args[:k]
+    vertices = args[k:2 * k]
+    outs, maps = [], []
+    for g, v, size in zip(graphs, vertices, graph_sizes):
+        size = int(size)
+
+        def _host(adj, vid, _n=size):
+            a = _np.asarray(adj)
+            vv = _np.asarray(vid).astype(_np.int64)[:_n]
+            out = _np.zeros((_n, _n), a.dtype)
+            mapping = _np.zeros((_n, _n), a.dtype)
+            col_of = {int(p): i for i, p in enumerate(vv)}
+            eid = 1
+            for i in range(_n):
+                for pcol, val in enumerate(a[i]):
+                    if val != 0 and pcol in col_of:
+                        out[i, col_of[pcol]] = val
+                        mapping[i, col_of[pcol]] = eid
+                        eid += 1
+            return out, mapping
+
+        shapes = (jax.ShapeDtypeStruct((size, size), g.dtype),
+                  jax.ShapeDtypeStruct((size, size), g.dtype))
+        o, m = jax.pure_callback(_host, shapes, g, v)
+        outs.append(o)
+        maps.append(m)
+    return tuple(outs) + (tuple(maps) if return_mapping else ())

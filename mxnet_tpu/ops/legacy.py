@@ -1,0 +1,211 @@
+"""Legacy v1 / misc operators kept for API parity (reference
+src/operator/batch_norm_v1.cc, convolution_v1.cc, pooling_v1.cc, crop.cc,
+svm_output.cc, identity_attach_KL_sparse_reg.cc, cross_device_copy.cc,
+native_op.cc, correlation.cc).
+
+These are the oldest MXNET_REGISTER_OP_PROPERTY ops; each wraps the modern
+lowering (or a small custom vjp) rather than reproducing v1 quirks that only
+existed because of missing cuDNN features.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register, get_op
+
+
+def _register_v1_aliases():
+    bn = get_op("BatchNorm")
+
+    def batch_norm_v1(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
+                      momentum=0.9, fix_gamma=True, use_global_stats=False,
+                      output_mean_var=False, training=True):
+        """v1 BN is channel-axis-1 only (reference batch_norm_v1.cc)."""
+        return bn.fn(data, gamma, beta, moving_mean, moving_var, eps=eps,
+                     momentum=momentum, fix_gamma=fix_gamma,
+                     use_global_stats=use_global_stats,
+                     output_mean_var=output_mean_var, axis=1,
+                     training=training)
+
+    register("BatchNorm_v1", multi_output=bn.multi_output)(batch_norm_v1)
+
+    conv = get_op("Convolution")
+
+    def convolution_v1(data, weight, bias=None, *, kernel, stride=None,
+                       dilate=None, pad=None, num_filter=0, num_group=1,
+                       workspace=1024, no_bias=False, cudnn_tune=None,
+                       cudnn_off=False, layout=None):
+        return conv.fn(data, weight, bias, kernel=kernel, stride=stride,
+                       dilate=dilate, pad=pad, num_filter=num_filter,
+                       num_group=num_group, no_bias=no_bias, layout=layout)
+
+    register("Convolution_v1")(convolution_v1)
+
+    pool = get_op("Pooling")
+
+    def pooling_v1(data, *, kernel=(), pool_type="max", global_pool=False,
+                   pooling_convention="valid", stride=None, pad=None):
+        return pool.fn(data, kernel=kernel, pool_type=pool_type,
+                       global_pool=global_pool,
+                       pooling_convention=pooling_convention, stride=stride,
+                       pad=pad)
+
+    register("Pooling_v1")(pooling_v1)
+
+
+_register_v1_aliases()
+
+
+@register("Crop")
+def crop(*inputs, offset=(0, 0), h_w=(0, 0), center_crop=False, num_args=None):
+    """Spatial crop of NCHW data to h_w (or to the size of a second
+    `crop_like` input). Reference src/operator/crop.cc."""
+    data = inputs[0]
+    if len(inputs) > 1:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = int(offset[0]), int(offset[1])
+    return lax.slice(data, (0, 0, y0, x0),
+                     (data.shape[0], data.shape[1], y0 + th, x0 + tw))
+
+
+# ---------------------------------------------------------------------------
+# SVMOutput: identity forward, hinge-loss gradient (reference svm_output.cc)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_output(data, label, margin, reg_coef, use_linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, reg_coef, use_linear):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, reg_coef, use_linear, res, g):
+    data, label = res
+    lab = label.astype(jnp.int32)
+    x_l = jnp.take_along_axis(data, lab[:, None], axis=1)
+    viol = margin - x_l + data                  # (N, C); at true class = margin
+    mask = jnp.arange(data.shape[1])[None, :] != lab[:, None]
+    if use_linear:
+        gj = reg_coef * ((viol > 0) & mask).astype(data.dtype)
+    else:
+        gj = 2.0 * reg_coef * jnp.maximum(viol, 0) * mask.astype(data.dtype)
+    gl = -jnp.sum(gj, axis=1, keepdims=True)
+    grad = jnp.where(mask, gj, 0) + (~mask) * gl
+    return (grad * jnp.ones_like(g), jnp.zeros_like(label))
+
+
+_svm_output.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register("SVMOutput")
+def svm_output(data, label, *, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    return _svm_output(data, label, float(margin),
+                       float(regularization_coefficient), bool(use_linear))
+
+
+# ---------------------------------------------------------------------------
+# IdentityAttachKLSparseReg (reference identity_attach_KL_sparse_reg.cc):
+# identity forward; backward adds the KL-divergence sparsity penalty gradient
+# computed from the batch mean activation (the reference additionally smooths
+# rho_hat with a moving average — here the batch estimate is used directly).
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _kl_sparse_reg(data, sparseness_target, penalty):
+    return data
+
+
+def _klsr_fwd(data, sparseness_target, penalty):
+    return data, data
+
+
+def _klsr_bwd(sparseness_target, penalty, data, g):
+    rho = sparseness_target
+    rho_hat = jnp.clip(jnp.mean(data, axis=0, keepdims=True), 1e-6, 1 - 1e-6)
+    kl_grad = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+    return (g + kl_grad * jnp.ones_like(data) / data.shape[0],)
+
+
+_kl_sparse_reg.defvjp(_klsr_fwd, _klsr_bwd)
+
+
+@register("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, *, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    return _kl_sparse_reg(data, float(sparseness_target), float(penalty))
+
+
+@register("_CrossDeviceCopy", aliases=("CrossDeviceCopy",))
+def cross_device_copy(data):
+    """Explicit cross-device copy node (reference cross_device_copy.cc).
+    Device movement is handled by jax.device_put at the NDArray layer, so the
+    op itself is identity."""
+    return data
+
+
+@register("_Native", differentiable=False)
+def native_op(*args, **kwargs):
+    raise MXNetError(
+        "_Native wraps in-process C callbacks from the legacy plugin ABI; "
+        "use mxnet_tpu.operator.CustomOp for custom Python operators instead.")
+
+
+# ---------------------------------------------------------------------------
+# Correlation (FlowNet-style, reference src/operator/correlation.cc)
+# ---------------------------------------------------------------------------
+
+@register("Correlation")
+def correlation(data1, data2, *, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """Correlation of two NCHW feature maps over a displacement grid.
+
+    out[:, d, y, x] = mean over channels and the kernel window of
+    data1[.., y, x] * data2[.., y+dy, x+dx] (or |a - b| when is_multiply
+    is False), for each displacement (dy, dx) on the stride2 grid within
+    max_displacement. All shifts are static -> one fused XLA computation;
+    the kernel window average is an avg_pool over the product map.
+    """
+    pad = int(pad_size)
+    md = int(max_displacement)
+    k = int(kernel_size)
+    s1, s2 = int(stride1), int(stride2)
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    H, W = p1.shape[2], p1.shape[3]
+    # reference border_size_ = max_displacement + (kernel_size-1)/2: outputs
+    # exist only where every displaced kernel window is fully in bounds
+    br = md + (k - 1) // 2
+    grid = range(-md, md + 1, s2)
+    outs = []
+    for dy in grid:
+        for dx in grid:
+            sh = jnp.roll(p2, shift=(-dy, -dx), axis=(2, 3))
+            prod = p1 * sh if is_multiply else jnp.abs(p1 - sh)
+            cm = jnp.mean(prod, axis=1)                    # (N, H, W)
+            if k > 1:
+                cm = lax.reduce_window(
+                    cm, 0.0, lax.add, (1, k, k), (1, 1, 1), "SAME") / (k * k)
+            # zero out displacements that read across the (rolled) boundary
+            ys = jnp.arange(H)[:, None]
+            xs = jnp.arange(W)[None, :]
+            valid = ((ys + dy >= 0) & (ys + dy < H)
+                     & (xs + dx >= 0) & (xs + dx < W))
+            outs.append(jnp.where(valid[None], cm, 0.0))
+    out = jnp.stack(outs, axis=1)                          # (N, D*D, H, W)
+    # reference output positions are border + i*stride1 in PADDED coords
+    # (correlation.cc): trim the kernel border first, THEN stride
+    return out[:, :, br:H - br:s1, br:W - br:s1]

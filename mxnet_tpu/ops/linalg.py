@@ -124,3 +124,11 @@ def linalg_maketrian(x, *, offset=0, lower=True):
         _np.triu(_np.ones((n, n), bool), k=offset)
     rows, cols = _np.where(m)
     return out.at[..., rows, cols].set(x)
+
+
+@register("linalg_syevd", aliases=("_linalg_syevd",), multi_output=True)
+def linalg_syevd(A):
+    """Symmetric eigendecomposition U, L with A = U^T diag(L) U (rows of U
+    are eigenvectors — reference src/operator/tensor/la_op.cc _linalg_syevd)."""
+    L, V = jnp.linalg.eigh(A)
+    return jnp.swapaxes(V, -1, -2), L

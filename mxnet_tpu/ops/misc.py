@@ -1,0 +1,192 @@
+"""Miscellaneous tensor ops closing the long tail of the reference registry:
+add_n, batch_take, im2col/col2im, slice assignment, sparse_retain, AMP
+multicast, image ops (reference src/operator/tensor/elemwise_sum.cc,
+indexing_op.cc, im2col.cc, matrix_op.cc _slice_assign, amp_cast.cc,
+image/image_random.cc, image/resize.cc)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("add_n", aliases=("ElementWiseSum",))
+def add_n(*args, num_args=None):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    """Per-row element pick: out[i] = a[i, indices[i]]
+    (reference src/operator/tensor/indexing_op.cc batch_take)."""
+    idx = jnp.clip(indices.astype(jnp.int32), 0, a.shape[1] - 1)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+def _conv_tuple(v, n):
+    if v is None:
+        return (1,) * n if n else ()
+    t = tuple(int(x) for x in v) if hasattr(v, "__len__") else (int(v),)
+    return t
+
+
+@register("im2col")
+def im2col(data, *, kernel, stride=None, dilate=None, pad=None):
+    """Sliding-window patch extraction, NCHW -> (N, C*prod(kernel), L)
+    (reference src/operator/nn/im2col.h). Lowered to XLA's native
+    conv_general_dilated_patches, which the TPU backend turns into
+    MXU-friendly strided loads."""
+    n = len(kernel)
+    kernel = _conv_tuple(kernel, n)
+    stride = _conv_tuple(stride, n) if stride else (1,) * n
+    dilate = _conv_tuple(dilate, n) if dilate else (1,) * n
+    pad = _conv_tuple(pad, n) if pad else (0,) * n
+    patches = lax.conv_general_dilated_patches(
+        data, filter_shape=kernel, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate)
+    # patches: (N, C*prod(kernel), *out_spatial) with channel-major order
+    N = data.shape[0]
+    return patches.reshape(N, patches.shape[1], -1)
+
+
+@register("col2im")
+def col2im(data, *, output_size, kernel, stride=None, dilate=None, pad=None):
+    """Adjoint of im2col: scatter-add patches back into the image
+    (reference src/operator/nn/im2col.h col2im). Implemented as the exact
+    vjp of the im2col lowering, so the two stay inverse-consistent."""
+    C = data.shape[1] // int(functools.reduce(lambda a, b: a * b, kernel))
+    out_shape = (data.shape[0], C) + tuple(int(s) for s in output_size)
+    f = functools.partial(im2col, kernel=kernel, stride=stride,
+                          dilate=dilate, pad=pad)
+    _, vjp = jax.vjp(f, jnp.zeros(out_shape, data.dtype))
+    return vjp(data)[0]
+
+
+def _slices(shape, begin, end, step):
+    step = step or (None,) * len(begin)
+    out = []
+    for i, (b, e) in enumerate(zip(begin, end)):
+        s = step[i] if i < len(step) and step[i] not in (None, 0) else 1
+        out.append(slice(b, e, s))
+    return tuple(out)
+
+
+@register("_slice_assign", aliases=("slice_assign",))
+def slice_assign(lhs, rhs, *, begin, end, step=None):
+    return lhs.at[_slices(lhs.shape, begin, end, step)].set(rhs)
+
+
+@register("_slice_assign_scalar", aliases=("slice_assign_scalar",))
+def slice_assign_scalar(lhs, *, scalar, begin, end, step=None):
+    return lhs.at[_slices(lhs.shape, begin, end, step)].set(scalar)
+
+
+@register("_sparse_retain", aliases=("sparse_retain",))
+def sparse_retain(data, indices):
+    """Keep only the given rows of a row_sparse array (dense-backed: all
+    other rows become zero). Reference src/operator/tensor/sparse_retain.cc."""
+    keep = jnp.zeros((data.shape[0],), jnp.bool_).at[
+        indices.astype(jnp.int32)].set(True)
+    return jnp.where(keep.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)
+
+
+@register("_rnn_param_concat", aliases=("rnn_param_concat",))
+def rnn_param_concat(*args, dim=0, num_args=None):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("_identity_with_attr_like_rhs", differentiable=True)
+def identity_with_attr_like_rhs(lhs, rhs):
+    return lhs
+
+
+@register("_zeros_without_dtype", differentiable=False)
+def zeros_without_dtype(*, shape=None, ctx=None, dtype=None):
+    return jnp.zeros(tuple(shape or ()), jnp.float32)
+
+
+@register("amp_multicast", multi_output=True)
+def amp_multicast(*data, num_outputs=None, cast_narrow=False):
+    """Cast all inputs to a common dtype — the widest by default, the
+    narrowest with cast_narrow (reference src/operator/tensor/amp_cast.cc)."""
+    widths = [jnp.dtype(d.dtype).itemsize for d in data]
+    target = data[widths.index(min(widths) if cast_narrow else max(widths))].dtype
+    return tuple(d.astype(target) for d in data)
+
+
+# ---------------------------------------------------------------------------
+# Image ops (reference src/operator/image/): exposed under nd.image.*
+# ---------------------------------------------------------------------------
+
+def _chan_param(v, c):
+    arr = jnp.asarray(v, jnp.float32).reshape(-1)
+    if arr.shape[0] == 1 and c != 1:
+        arr = jnp.broadcast_to(arr, (c,))
+    return arr
+
+
+@register("_image_to_tensor", aliases=("image_to_tensor",))
+def image_to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]; batched NHWC -> NCHW."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize", aliases=("image_normalize",))
+def image_normalize(data, *, mean=0.0, std=1.0):
+    c = data.shape[0] if data.ndim == 3 else data.shape[1]
+    m = _chan_param(mean, c)
+    s = _chan_param(std, c)
+    shape = (c, 1, 1) if data.ndim == 3 else (1, c, 1, 1)
+    return (data - m.reshape(shape)) / s.reshape(shape)
+
+
+@register("_image_crop", aliases=("image_crop",))
+def image_crop(data, *, x, y, width, height):
+    """HWC (or NHWC) spatial crop (reference src/operator/image/crop.cc)."""
+    if data.ndim == 3:
+        return lax.dynamic_slice(
+            data, (y, x, 0), (height, width, data.shape[2]))
+    return lax.dynamic_slice(
+        data, (0, y, x, 0), (data.shape[0], height, width, data.shape[3]))
+
+
+@register("_image_resize", aliases=("image_resize",))
+def image_resize(data, *, size, keep_ratio=False, interp=1):
+    method = "nearest" if interp == 0 else "linear"
+    if isinstance(size, int):
+        if keep_ratio:
+            # scale the SHORT edge to `size` (reference image/resize.cc)
+            H, W = (data.shape[0], data.shape[1]) if data.ndim == 3 else \
+                   (data.shape[1], data.shape[2])
+            if H < W:
+                size = (max(1, round(W * size / H)), size)   # (w, h)
+            else:
+                size = (size, max(1, round(H * size / W)))
+        else:
+            size = (size, size)
+    h, w = int(size[1]), int(size[0])
+    if data.ndim == 3:
+        return jax.image.resize(data, (h, w, data.shape[2]), method).astype(
+            data.dtype)
+    return jax.image.resize(
+        data, (data.shape[0], h, w, data.shape[3]), method).astype(data.dtype)
+
+
+@register("_image_flip_left_right", aliases=("image_flip_left_right",))
+def image_flip_left_right(data):
+    return jnp.flip(data, axis=-2)
+
+
+@register("_image_flip_top_bottom", aliases=("image_flip_top_bottom",))
+def image_flip_top_bottom(data):
+    return jnp.flip(data, axis=-3)

@@ -1,0 +1,490 @@
+"""Optimizer update operators (reference src/operator/optimizer_op.cc,
+src/operator/contrib/adamw.cc, src/operator/contrib/optimizer_op.cc).
+
+The reference exposes every optimizer update rule as an NDArray-level op
+(`nd.sgd_update`, `nd.adam_update`, fused `multi_sgd_*`, mixed-precision
+`mp_*`, `preloaded_multi_*`, LAMB phases, ...) that the Python `Optimizer`
+classes and the KVStore server call.  Here each is ONE pure jax function
+returning ``(primary outputs..., updated states...)``; the nd wrapper writes
+updated states back into the state input arrays (the functional analog of the
+reference's in-place state mutation) and returns only the primary outputs.
+
+TPU notes: mixed-precision variants keep a float32 master copy alongside a
+bf16/fp16 weight — the master update happens in f32 on the VPU and the cast
+back to the low-precision weight is fused by XLA into the same kernel.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _rescaled(g, rescale_grad, clip_gradient):
+    """Works with both static python hyperparams (registered-op path: the
+    clip test resolves at trace time) and traced scalars (the Optimizer-class
+    kernels jit these same functions with lr/wd/clip as runtime args so a
+    learning-rate change never retraces)."""
+    g = g * rescale_grad
+    if clip_gradient is None:
+        return g
+    if isinstance(clip_gradient, (int, float)):
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        return g
+    return jnp.where(clip_gradient > 0,
+                     jnp.clip(g, -clip_gradient, clip_gradient), g)
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# SGD family
+# ---------------------------------------------------------------------------
+
+@register("sgd_update", differentiable=False)
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+               lazy_update=True):
+    g = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    return weight - lr * g
+
+
+@register("sgd_mom_update", differentiable=False, multi_output=True,
+          state_inputs=((2, 1),))
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    mom2 = momentum * mom - lr * g
+    return weight + mom2, mom2
+
+
+@register("mp_sgd_update", differentiable=False, multi_output=True,
+          state_inputs=((2, 1),))
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    g = _rescaled(_f32(grad), rescale_grad, clip_gradient) + wd * weight32
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", differentiable=False, multi_output=True,
+          state_inputs=((2, 1), (3, 2)))
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _rescaled(_f32(grad), rescale_grad, clip_gradient) + wd * weight32
+    mom2 = momentum * mom - lr * g
+    w32 = weight32 + mom2
+    return w32.astype(weight.dtype), mom2, w32
+
+
+@register("nag_mom_update", differentiable=False, multi_output=True,
+          state_inputs=((2, 1),))
+def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    mom2 = momentum * mom + g
+    return weight - lr * (g + momentum * mom2), mom2
+
+
+@register("mp_nag_mom_update", differentiable=False, multi_output=True,
+          state_inputs=((2, 1), (3, 2)))
+def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescaled(_f32(grad), rescale_grad, clip_gradient) + wd * weight32
+    mom2 = momentum * mom + g
+    w32 = weight32 - lr * (g + momentum * mom2)
+    return w32.astype(weight.dtype), mom2, w32
+
+
+@register("signsgd_update", differentiable=False)
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    return (1 - lr * wd) * weight - lr * jnp.sign(g)
+
+
+@register("signum_update", differentiable=False, multi_output=True,
+          state_inputs=((2, 1),))
+def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    mom2 = momentum * mom - (1 - momentum) * (g + wd * weight)
+    return (1 - lr * wd_lh) * weight + lr * jnp.sign(mom2), mom2
+
+
+# ---------------------------------------------------------------------------
+# Adam family (adamw takes rescale_grad as a TENSOR input for loss scaling,
+# reference src/operator/contrib/adamw.cc)
+# ---------------------------------------------------------------------------
+
+@register("adam_update", differentiable=False, multi_output=True,
+          state_inputs=((2, 1), (3, 2)))
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    m2 = beta1 * mean + (1 - beta1) * g
+    v2 = beta2 * var + (1 - beta2) * g * g
+    return weight - lr * m2 / (jnp.sqrt(v2) + epsilon), m2, v2
+
+
+def _adamw_core(w32, g, mean, var, rescale_tensor, lr, eta, beta1, beta2,
+                epsilon, wd, clip_gradient):
+    g = _f32(g) * rescale_tensor
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m2 = beta1 * mean + (1 - beta1) * g
+    v2 = beta2 * var + (1 - beta2) * g * g
+    w2 = w32 - eta * (lr * m2 / (jnp.sqrt(v2) + epsilon) + wd * w32)
+    return w2, m2, v2
+
+
+@register("_adamw_update", aliases=("adamw_update",), differentiable=False,
+          multi_output=True, state_inputs=((2, 1), (3, 2)))
+def adamw_update(weight, grad, mean, var, rescale_grad, lr, eta, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, wd=0.0, clip_gradient=-1.0):
+    w2, m2, v2 = _adamw_core(weight, grad, mean, var, rescale_grad, lr, eta,
+                             beta1, beta2, epsilon, wd, clip_gradient)
+    return w2.astype(weight.dtype), m2, v2
+
+
+@register("_mp_adamw_update", aliases=("mp_adamw_update",),
+          differentiable=False, multi_output=True,
+          state_inputs=((2, 1), (3, 2), (4, 3)))
+def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad, lr, eta,
+                    beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                    clip_gradient=-1.0):
+    w32, m2, v2 = _adamw_core(weight32, grad, mean, var, rescale_grad, lr, eta,
+                              beta1, beta2, epsilon, wd, clip_gradient)
+    return w32.astype(weight.dtype), m2, v2, w32
+
+
+# ---------------------------------------------------------------------------
+# FTRL / FTML / RMSProp / AdaGrad variants
+# ---------------------------------------------------------------------------
+
+@register("ftrl_update", differentiable=False, multi_output=True,
+          state_inputs=((2, 1), (3, 2)))
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    n2 = n + g * g
+    sigma = (jnp.sqrt(n2) - jnp.sqrt(n)) / lr
+    z2 = z + g - sigma * weight
+    w2 = jnp.where(
+        jnp.abs(z2) > lamda1,
+        -(z2 - jnp.sign(z2) * lamda1) / ((beta + jnp.sqrt(n2)) / lr + wd),
+        0.0).astype(weight.dtype)
+    return w2, z2, n2
+
+
+@register("ftml_update", differentiable=False, multi_output=True,
+          state_inputs=((2, 1), (3, 2), (4, 3)))
+def ftml_update(weight, grad, d, v, z, lr, t, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    g = _rescaled(grad, rescale_grad, clip_grad) + wd * weight
+    v2 = beta2 * v + (1 - beta2) * g * g
+    d2 = (1 - beta1 ** t) / lr * (jnp.sqrt(v2 / (1 - beta2 ** t)) + epsilon)
+    sigma = d2 - beta1 * d
+    z2 = beta1 * z + (1 - beta1) * g - sigma * weight
+    return -z2 / d2, d2, v2, z2
+
+
+@register("rmsprop_update", differentiable=False, multi_output=True,
+          state_inputs=((2, 1),))
+def rmsprop_update(weight, grad, n, lr, rho=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    n2 = rho * n + (1 - rho) * g * g
+    w2 = weight - lr * g / jnp.sqrt(n2 + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w2 = jnp.clip(w2, -clip_weights, clip_weights)
+    return w2, n2
+
+
+@register("rmspropalex_update", differentiable=False, multi_output=True,
+          state_inputs=((2, 1), (3, 2), (4, 3)))
+def rmspropalex_update(weight, grad, n, g, delta, lr, rho=0.95, momentum=0.9,
+                       epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    gr = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    n2 = rho * n + (1 - rho) * gr * gr
+    gavg2 = rho * g + (1 - rho) * gr
+    delta2 = momentum * delta - lr * gr / jnp.sqrt(n2 - gavg2 * gavg2 + epsilon)
+    w2 = weight + delta2
+    if clip_weights is not None and clip_weights > 0:
+        w2 = jnp.clip(w2, -clip_weights, clip_weights)
+    return w2, n2, gavg2, delta2
+
+
+@register("_sparse_adagrad_update", aliases=("sparse_adagrad_update",),
+          differentiable=False, multi_output=True, state_inputs=((2, 1),))
+def sparse_adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0):
+    """Row-sparse AdaGrad (reference src/operator/optimizer_op.cc
+    _sparse_adagrad_update) — dense-backed here: rows with all-zero gradient
+    are left untouched, matching the lazy row_sparse semantics."""
+    g = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    axes = tuple(range(1, grad.ndim))
+    live = jnp.any(grad != 0, axis=axes, keepdims=True) if axes else (grad != 0)
+    h2 = jnp.where(live, history + g * g, history)
+    w2 = jnp.where(live, weight - lr * g / (jnp.sqrt(h2) + epsilon), weight)
+    return w2, h2
+
+
+@register("_contrib_group_adagrad_update", aliases=("group_adagrad_update",),
+          differentiable=False, multi_output=True, state_inputs=((2, 1),))
+def group_adagrad_update(weight, grad, history, lr, epsilon=1e-5,
+                         rescale_grad=1.0, clip_gradient=-1.0):
+    """Per-row (group) AdaGrad (reference src/operator/contrib/optimizer_op.cc):
+    the accumulator holds one value per row — mean of squared gradients over
+    the trailing axes."""
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    axes = tuple(range(1, grad.ndim))
+    h2 = history + (jnp.mean(g * g, axis=axes) if axes else g * g)
+    scale = h2.reshape(h2.shape + (1,) * (grad.ndim - 1)) if axes else h2
+    return weight - lr * g / (jnp.sqrt(scale) + epsilon), h2
+
+
+# ---------------------------------------------------------------------------
+# LAMB phases (reference src/operator/optimizer_op.cc lamb_update_phase1/2)
+# ---------------------------------------------------------------------------
+
+@register("lamb_update_phase1", differentiable=False, multi_output=True,
+          state_inputs=((2, 1), (3, 2)))
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescaled(grad, rescale_grad, clip_gradient)
+    m2 = beta1 * mean + (1 - beta1) * g
+    v2 = beta2 * var + (1 - beta2) * g * g
+    if bias_correction:
+        mhat = m2 / (1 - beta1 ** t)
+        vhat = v2 / (1 - beta2 ** t)
+    else:
+        mhat, vhat = m2, v2
+    return mhat / (jnp.sqrt(vhat) + epsilon) + wd * weight, m2, v2
+
+
+def _lamb_phase2(weight32, g, r1, r2, lr, lower_bound, upper_bound):
+    if lower_bound is not None and lower_bound > 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+    return weight32 - lr * ratio * g
+
+
+@register("lamb_update_phase2", differentiable=False)
+def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
+                       upper_bound=-1.0):
+    return _lamb_phase2(weight, g, r1, r2, lr, lower_bound, upper_bound)
+
+
+@register("mp_lamb_update_phase1", differentiable=False, multi_output=True,
+          state_inputs=((2, 1), (3, 2)))
+def mp_lamb_update_phase1(weight, grad, mean, var, weight32, beta1=0.9,
+                          beta2=0.999, epsilon=1e-6, t=1, bias_correction=True,
+                          wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescaled(_f32(grad), rescale_grad, clip_gradient)
+    m2 = beta1 * mean + (1 - beta1) * g
+    v2 = beta2 * var + (1 - beta2) * g * g
+    if bias_correction:
+        mhat = m2 / (1 - beta1 ** t)
+        vhat = v2 / (1 - beta2 ** t)
+    else:
+        mhat, vhat = m2, v2
+    return mhat / (jnp.sqrt(vhat) + epsilon) + wd * weight32, m2, v2
+
+
+@register("mp_lamb_update_phase2", differentiable=False, multi_output=True,
+          state_inputs=((4, 1),))
+def mp_lamb_update_phase2(weight, g, r1, r2, weight32, lr, lower_bound=-1.0,
+                          upper_bound=-1.0):
+    w32 = _lamb_phase2(weight32, g, r1, r2, lr, lower_bound, upper_bound)
+    return w32.astype(weight.dtype), w32
+
+
+# ---------------------------------------------------------------------------
+# Fused multi-tensor ops (reference multi_sgd_update et al. + multi_lars)
+# ---------------------------------------------------------------------------
+
+def _per_weight(params, i, default):
+    if params is None:
+        return default
+    return params[i]
+
+
+@register("multi_sum_sq", differentiable=False)
+def multi_sum_sq(*arrays, num_arrays):
+    """Sum of squares of each input, stacked into one (num_arrays,) vector
+    (feeds multi_lars)."""
+    return jnp.stack([jnp.sum(jnp.square(_f32(a))) for a in arrays])
+
+
+@register("multi_lars", differentiable=False)
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta, eps,
+               rescale_grad=1.0):
+    wn = jnp.sqrt(weights_sum_sq)
+    gn = jnp.sqrt(grads_sum_sq) * rescale_grad
+    trust = jnp.where((wn > 0) & (gn > 0),
+                      eta * wn / (gn + wds * wn + eps), 1.0)
+    return lrs * trust
+
+
+def _multi_sgd(arrays, stride, lrs, wds, momentum, rescale_grad,
+               clip_gradient, num_weights, mp):
+    new_w, new_state = [], []
+    for i in range(num_weights):
+        chunk = arrays[i * stride:(i + 1) * stride]
+        w, g = chunk[0], chunk[1]
+        master = chunk[-1] if mp else w
+        mom = chunk[2] if stride - mp == 3 else None
+        g = _rescaled(_f32(g) if mp else g, rescale_grad, clip_gradient)
+        g = g + wds[i] * master
+        if mom is not None:
+            mom2 = momentum * mom - lrs[i] * g
+            w2 = master + mom2
+            new_state.append(mom2)
+        else:
+            w2 = master - lrs[i] * g
+        new_w.append(w2.astype(w.dtype))
+        if mp:
+            new_state.append(w2)
+    return tuple(new_w) + tuple(new_state)
+
+
+def _multi_state_spec(stride, has_mom, mp):
+    """state_inputs callable: maps mom/weight32 inputs to outputs."""
+    def spec(inputs, params):
+        n = params["num_weights"]
+        pairs = []
+        out = n
+        for i in range(n):
+            if has_mom:
+                pairs.append((i * stride + 2, out)); out += 1
+            if mp:
+                pairs.append((i * stride + stride - 1, out)); out += 1
+        return pairs
+    return spec
+
+
+@register("multi_sgd_update", differentiable=False, multi_output=True)
+def multi_sgd_update(*arrays, lrs, wds, num_weights, rescale_grad=1.0,
+                     clip_gradient=-1.0):
+    return _multi_sgd(arrays, 2, lrs, wds, 0.0, rescale_grad, clip_gradient,
+                      num_weights, mp=False)
+
+
+@register("multi_sgd_mom_update", differentiable=False, multi_output=True,
+          state_inputs=_multi_state_spec(3, True, False))
+def multi_sgd_mom_update(*arrays, lrs, wds, num_weights, momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0):
+    return _multi_sgd(arrays, 3, lrs, wds, momentum, rescale_grad,
+                      clip_gradient, num_weights, mp=False)
+
+
+@register("multi_mp_sgd_update", differentiable=False, multi_output=True,
+          state_inputs=_multi_state_spec(3, False, True))
+def multi_mp_sgd_update(*arrays, lrs, wds, num_weights, rescale_grad=1.0,
+                        clip_gradient=-1.0):
+    return _multi_sgd(arrays, 3, lrs, wds, 0.0, rescale_grad, clip_gradient,
+                      num_weights, mp=True)
+
+
+@register("multi_mp_sgd_mom_update", differentiable=False, multi_output=True,
+          state_inputs=_multi_state_spec(4, True, True))
+def multi_mp_sgd_mom_update(*arrays, lrs, wds, num_weights, momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0):
+    return _multi_sgd(arrays, 4, lrs, wds, momentum, rescale_grad,
+                      clip_gradient, num_weights, mp=True)
+
+
+def _preloaded(arrays, stride, has_mom, mp, momentum, rescale_grad,
+               clip_gradient, num_weights):
+    lrs_t, wds_t = arrays[-2], arrays[-1]
+    lrs = [lrs_t[i] for i in range(num_weights)]
+    wds = [wds_t[i] for i in range(num_weights)]
+    return _multi_sgd(arrays[:-2], stride, lrs, wds, momentum, rescale_grad,
+                      clip_gradient, num_weights, mp=mp)
+
+
+@register("preloaded_multi_sgd_update", differentiable=False,
+          multi_output=True)
+def preloaded_multi_sgd_update(*arrays, num_weights, rescale_grad=1.0,
+                               clip_gradient=-1.0):
+    return _preloaded(arrays, 2, False, False, 0.0, rescale_grad,
+                      clip_gradient, num_weights)
+
+
+@register("preloaded_multi_sgd_mom_update", differentiable=False,
+          multi_output=True, state_inputs=_multi_state_spec(3, True, False))
+def preloaded_multi_sgd_mom_update(*arrays, num_weights, momentum=0.0,
+                                   rescale_grad=1.0, clip_gradient=-1.0):
+    return _preloaded(arrays, 3, True, False, momentum, rescale_grad,
+                      clip_gradient, num_weights)
+
+
+@register("preloaded_multi_mp_sgd_update", differentiable=False,
+          multi_output=True, state_inputs=_multi_state_spec(3, False, True))
+def preloaded_multi_mp_sgd_update(*arrays, num_weights, rescale_grad=1.0,
+                                  clip_gradient=-1.0):
+    return _preloaded(arrays, 3, False, True, 0.0, rescale_grad,
+                      clip_gradient, num_weights)
+
+
+@register("preloaded_multi_mp_sgd_mom_update", differentiable=False,
+          multi_output=True, state_inputs=_multi_state_spec(4, True, True))
+def preloaded_multi_mp_sgd_mom_update(*arrays, num_weights, momentum=0.0,
+                                      rescale_grad=1.0, clip_gradient=-1.0):
+    return _preloaded(arrays, 4, True, True, momentum, rescale_grad,
+                      clip_gradient, num_weights)
+
+
+def _multi_adamw_spec(stride, mp):
+    def spec(inputs, params):
+        n = params["num_weights"]
+        pairs = []
+        out = n
+        for i in range(n):
+            pairs.append((i * stride + 2, out)); out += 1
+            pairs.append((i * stride + 3, out)); out += 1
+            if mp:
+                pairs.append((i * stride + 4, out)); out += 1
+        return pairs
+    return spec
+
+
+def _multi_adamw(arrays, stride, mp, lrs, etas, wds, beta1, beta2, epsilon,
+                 clip_gradient, num_weights):
+    rescale = arrays[-1]
+    new_w, new_state = [], []
+    for i in range(num_weights):
+        chunk = arrays[i * stride:(i + 1) * stride]
+        w, g, m, v = chunk[0], chunk[1], chunk[2], chunk[3]
+        master = chunk[4] if mp else w
+        w2, m2, v2 = _adamw_core(master, g, m, v, rescale, lrs[i], etas[i],
+                                 beta1, beta2, epsilon, wds[i], clip_gradient)
+        new_w.append(w2.astype(w.dtype))
+        new_state.extend([m2, v2] + ([w2] if mp else []))
+    return tuple(new_w) + tuple(new_state)
+
+
+@register("_multi_adamw_update", aliases=("multi_adamw_update",),
+          differentiable=False, multi_output=True,
+          state_inputs=_multi_adamw_spec(4, False))
+def multi_adamw_update(*arrays, lrs, etas, wds, num_weights, beta1=0.9,
+                       beta2=0.999, epsilon=1e-8, clip_gradient=-1.0):
+    return _multi_adamw(arrays, 4, False, lrs, etas, wds, beta1, beta2,
+                        epsilon, clip_gradient, num_weights)
+
+
+@register("_multi_mp_adamw_update", aliases=("multi_mp_adamw_update",),
+          differentiable=False, multi_output=True,
+          state_inputs=_multi_adamw_spec(5, True))
+def multi_mp_adamw_update(*arrays, lrs, etas, wds, num_weights, beta1=0.9,
+                          beta2=0.999, epsilon=1e-8, clip_gradient=-1.0):
+    return _multi_adamw(arrays, 5, True, lrs, etas, wds, beta1, beta2,
+                        epsilon, clip_gradient, num_weights)

@@ -1,0 +1,223 @@
+"""INT8 quantized operators (reference src/operator/quantization/
+quantized_{conv,fully_connected,pooling,activation,batch_norm,concat,
+elemwise_add,elemwise_mul,flatten,embedding}.cc, calibrate.cc).
+
+TPU-first: int8×int8 matmuls/convs accumulate in int32 on the MXU
+(``preferred_element_type=jnp.int32``), exactly the path the reference takes
+through cuDNN/MKL-DNN int8 kernels. Range bookkeeping follows
+quantization_utils.h: for an int8×int8→int32 product,
+``max_out = (range_a/127)·(range_b/127)·(2^31-1)``, ``min_out = -max_out``.
+
+Input orders mirror the reference FListInputNames (data..., then min/max
+scalars); outputs are (out, min_output, max_output).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as _np
+
+from .registry import register, get_op
+
+_INT8_RANGE = 127.0
+_INT32_RANGE = float(0x7FFFFFFF)
+
+
+def _max_abs(lo, hi):
+    return jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+
+
+def _mul_range(min_a, max_a, min_b, max_b):
+    scale = (_max_abs(min_a, max_a) / _INT8_RANGE) * (
+        _max_abs(min_b, max_b) / _INT8_RANGE)
+    max_c = scale * _INT32_RANGE
+    return -max_c, max_c
+
+
+def _scalar(x):
+    return x.reshape(()).astype(jnp.float32)
+
+
+@register("_contrib_quantized_conv", differentiable=False, multi_output=True)
+def quantized_conv(data, weight, *args, kernel, num_filter, stride=None,
+                   dilate=None, pad=None, num_group=1, no_bias=True,
+                   layout="NCHW", **ignored):
+    """int8 NCHW convolution -> int32 (reference quantized_conv.cc)."""
+    if no_bias:
+        bias = None
+        min_d, max_d, min_w, max_w = args[:4]
+        min_b = max_b = None
+    else:
+        bias, min_d, max_d, min_w, max_w, min_b, max_b = args[:7]
+    n = len(kernel)
+    stride = tuple(stride) if stride else (1,) * n
+    dilate = tuple(dilate) if dilate else (1,) * n
+    pad = tuple(pad) if pad else (0,) * n
+    out = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8), stride,
+        [(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    min_o, max_o = _mul_range(_scalar(min_d), _scalar(max_d),
+                              _scalar(min_w), _scalar(max_w))
+    if bias is not None:
+        # rescale the int8 bias into the int32 accumulator's scale
+        scale_out = max_o / _INT32_RANGE
+        scale_b = _max_abs(_scalar(min_b), _scalar(max_b)) / _INT8_RANGE
+        b32 = jnp.round(bias.astype(jnp.float32) * scale_b / scale_out)
+        out = out + b32.astype(jnp.int32).reshape(1, -1, *([1] * (out.ndim - 2)))
+    return out, min_o, max_o
+
+
+@register("_contrib_quantized_fully_connected", differentiable=False,
+          multi_output=True)
+def quantized_fully_connected(data, weight, *args, num_hidden, no_bias=True,
+                              flatten=True, **ignored):
+    """int8 dense -> int32 (reference quantized_fully_connected.cc)."""
+    if no_bias:
+        bias = None
+        min_d, max_d, min_w, max_w = args[:4]
+        min_b = max_b = None
+    else:
+        bias, min_d, max_d, min_w, max_w, min_b, max_b = args[:7]
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    out = lax.dot_general(
+        x.astype(jnp.int8), weight.astype(jnp.int8),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    min_o, max_o = _mul_range(_scalar(min_d), _scalar(max_d),
+                              _scalar(min_w), _scalar(max_w))
+    if bias is not None:
+        scale_out = max_o / _INT32_RANGE
+        scale_b = _max_abs(_scalar(min_b), _scalar(max_b)) / _INT8_RANGE
+        b32 = jnp.round(bias.astype(jnp.float32) * scale_b / scale_out)
+        out = out + b32.astype(jnp.int32)
+    return out, min_o, max_o
+
+
+@register("_contrib_quantized_pooling", differentiable=False,
+          multi_output=True)
+def quantized_pooling(data, min_data, max_data, *, kernel=(), pool_type="max",
+                      global_pool=False, pooling_convention="valid",
+                      stride=None, pad=None, **ignored):
+    """int8 pooling, range passthrough (reference quantized_pooling.cc)."""
+    pool = get_op("Pooling")
+    out = pool.fn(data.astype(jnp.float32), kernel=kernel,
+                  pool_type=pool_type, global_pool=global_pool,
+                  pooling_convention=pooling_convention, stride=stride,
+                  pad=pad)
+    if pool_type == "avg":
+        out = jnp.round(out)
+    return (out.astype(data.dtype), _scalar(min_data), _scalar(max_data))
+
+
+@register("_contrib_quantized_act", differentiable=False, multi_output=True)
+def quantized_act(data, min_data, max_data, *, act_type="relu"):
+    """int8 ReLU (reference quantized_activation.cc — relu only there too)."""
+    if act_type != "relu":
+        raise ValueError("quantized_act supports act_type='relu' only")
+    out = jnp.maximum(data, 0)
+    return out, _scalar(min_data), _scalar(max_data)
+
+
+@register("_contrib_quantized_flatten", differentiable=False,
+          multi_output=True)
+def quantized_flatten(data, min_data, max_data):
+    return (data.reshape(data.shape[0], -1), _scalar(min_data),
+            _scalar(max_data))
+
+
+@register("_contrib_quantized_embedding", differentiable=False,
+          multi_output=True)
+def quantized_embedding(data, weight, min_weight, max_weight, *, input_dim,
+                        output_dim, **ignored):
+    """int8 table lookup, weight range passthrough
+    (reference quantized_indexing_op.cc)."""
+    idx = jnp.clip(data.astype(jnp.int32), 0, input_dim - 1)
+    return weight[idx], _scalar(min_weight), _scalar(max_weight)
+
+
+@register("_contrib_quantized_concat", differentiable=False,
+          multi_output=True)
+def quantized_concat(*inputs, num_args, dim=1):
+    """Concat int8 inputs after rescaling each into the widest range
+    (reference quantized_concat.cc; inputs = data×n then (min,max)×n)."""
+    data = inputs[:num_args]
+    ranges = inputs[num_args:]
+    mins = [_scalar(ranges[2 * i]) for i in range(num_args)]
+    maxs = [_scalar(ranges[2 * i + 1]) for i in range(num_args)]
+    out_range = functools.reduce(jnp.maximum,
+                                 [_max_abs(lo, hi) for lo, hi in zip(mins, maxs)])
+    rescaled = []
+    for d, lo, hi in zip(data, mins, maxs):
+        scale = _max_abs(lo, hi) / out_range
+        rescaled.append(jnp.round(d.astype(jnp.float32) * scale).astype(d.dtype))
+    return (jnp.concatenate(rescaled, axis=dim), -out_range, out_range)
+
+
+@register("_contrib_quantized_elemwise_add", differentiable=False,
+          multi_output=True)
+def quantized_elemwise_add(lhs, rhs, min_lhs, max_lhs, min_rhs, max_rhs):
+    """int8 + int8 -> int32 (reference quantized_elemwise_add.cc): both sides
+    are rescaled into the output's int32 grid before adding."""
+    r_l = _max_abs(_scalar(min_lhs), _scalar(max_lhs))
+    r_r = _max_abs(_scalar(min_rhs), _scalar(max_rhs))
+    max_o = r_l + r_r
+    scale_o = max_o / _INT32_RANGE
+    l32 = jnp.round(lhs.astype(jnp.float32) * (r_l / _INT8_RANGE) / scale_o)
+    r32 = jnp.round(rhs.astype(jnp.float32) * (r_r / _INT8_RANGE) / scale_o)
+    return (l32 + r32).astype(jnp.int32), -max_o, max_o
+
+
+@register("_contrib_quantized_elemwise_mul", differentiable=False,
+          multi_output=True)
+def quantized_elemwise_mul(lhs, rhs, min_lhs, max_lhs, min_rhs, max_rhs):
+    out = lhs.astype(jnp.int32) * rhs.astype(jnp.int32)
+    min_o, max_o = _mul_range(_scalar(min_lhs), _scalar(max_lhs),
+                              _scalar(min_rhs), _scalar(max_rhs))
+    return out, min_o, max_o
+
+
+@register("_contrib_quantized_batch_norm", differentiable=False,
+          multi_output=True)
+def quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                         min_data, max_data, *, eps=1e-3,
+                         min_calib_range=None, max_calib_range=None,
+                         **ignored):
+    """int8 inference BN (reference quantized_batch_norm.cc): dequantize,
+    normalize with the frozen statistics, requantize into the calibrated
+    output range."""
+    scale_in = _max_abs(_scalar(min_data), _scalar(max_data)) / _INT8_RANGE
+    x = data.astype(jnp.float32) * scale_in
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    inv = gamma / jnp.sqrt(moving_var + eps)
+    y = (x - moving_mean.reshape(shape)) * inv.reshape(shape) + \
+        beta.reshape(shape)
+    out_range = jnp.float32(max(abs(float(min_calib_range)),
+                                abs(float(max_calib_range)))) \
+        if min_calib_range is not None else jnp.max(jnp.abs(y))
+    q = jnp.clip(jnp.round(y / out_range * _INT8_RANGE), -127, 127)
+    return q.astype(jnp.int8), -out_range, out_range
+
+
+@register("_contrib_calibrate_entropy", differentiable=False,
+          multi_output=True)
+def calibrate_entropy(hist, hist_edges, *, num_quantized_bins=255):
+    """KL-divergence calibration over a collected histogram (reference
+    src/operator/quantization/calibrate.cc). The optimal-threshold search is
+    a host-side numpy routine behind jax.pure_callback (it runs once per
+    layer at calibration time — not a hot path)."""
+    def _host(h, e):
+        from ..contrib.quantization import _get_optimal_threshold
+        h = _np.asarray(h, dtype=_np.float64)   # callback may hand jax arrays
+        e = _np.asarray(e)
+        th = _get_optimal_threshold(h, e,
+                                    num_quantized_bins=num_quantized_bins)
+        return (_np.float32(-th), _np.float32(th))
+
+    min_s = jax.ShapeDtypeStruct((), jnp.float32)
+    return jax.pure_callback(_host, (min_s, min_s), hist, hist_edges)

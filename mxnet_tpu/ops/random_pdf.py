@@ -1,0 +1,92 @@
+"""Probability-density ops (reference src/operator/random/pdf_op.cc:297-316:
+_random_pdf_{uniform,normal,gamma,exponential,poisson,negative_binomial,
+generalized_negative_binomial,dirichlet}).
+
+Semantics follow the reference: for the scalar distributions the parameter
+arrays describe a batch of distributions and the sample's trailing dimension
+holds draws from each — ``sample.shape = params.shape + (m,)`` (params
+broadcast over the trailing axis). Dirichlet consumes the trailing event axis.
+Each op takes ``is_log`` to return log-density. All are differentiable in both
+sample and parameters via jax.vjp.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.special import gammaln
+
+from .registry import register
+
+_HALF_LOG_2PI = 0.9189385332046727
+
+
+def _expand(p, sample):
+    return p.reshape(p.shape + (1,) * (sample.ndim - p.ndim))
+
+
+def _ret(logp, is_log):
+    return logp if is_log else jnp.exp(logp)
+
+
+@register("_random_pdf_uniform", aliases=("random_pdf_uniform",))
+def pdf_uniform(sample, low, high, *, is_log=False):
+    logp = -jnp.log(_expand(high, sample) - _expand(low, sample))
+    logp = jnp.broadcast_to(logp, sample.shape)
+    return _ret(logp, is_log)
+
+
+@register("_random_pdf_normal", aliases=("random_pdf_normal",))
+def pdf_normal(sample, mu, sigma, *, is_log=False):
+    mu, sigma = _expand(mu, sample), _expand(sigma, sample)
+    z = (sample - mu) / sigma
+    return _ret(-0.5 * z * z - jnp.log(sigma) - _HALF_LOG_2PI, is_log)
+
+
+@register("_random_pdf_gamma", aliases=("random_pdf_gamma",))
+def pdf_gamma(sample, alpha, beta, *, is_log=False):
+    alpha, beta = _expand(alpha, sample), _expand(beta, sample)
+    logp = (alpha * jnp.log(beta) + (alpha - 1) * jnp.log(sample)
+            - beta * sample - gammaln(alpha))
+    return _ret(logp, is_log)
+
+
+@register("_random_pdf_exponential", aliases=("random_pdf_exponential",))
+def pdf_exponential(sample, lam, *, is_log=False):
+    lam = _expand(lam, sample)
+    return _ret(jnp.log(lam) - lam * sample, is_log)
+
+
+@register("_random_pdf_poisson", aliases=("random_pdf_poisson",))
+def pdf_poisson(sample, lam, *, is_log=False):
+    lam = _expand(lam, sample)
+    return _ret(sample * jnp.log(lam) - lam - gammaln(sample + 1), is_log)
+
+
+@register("_random_pdf_negative_binomial",
+          aliases=("random_pdf_negative_binomial",))
+def pdf_negative_binomial(sample, k, p, *, is_log=False):
+    k, p = _expand(k, sample), _expand(p, sample)
+    logp = (gammaln(sample + k) - gammaln(sample + 1) - gammaln(k)
+            + k * jnp.log(p) + sample * jnp.log1p(-p))
+    return _ret(logp, is_log)
+
+
+@register("_random_pdf_generalized_negative_binomial",
+          aliases=("random_pdf_generalized_negative_binomial",))
+def pdf_generalized_negative_binomial(sample, mu, alpha, *, is_log=False):
+    mu, alpha = _expand(mu, sample), _expand(alpha, sample)
+    r = 1.0 / alpha
+    logp = (gammaln(sample + r) - gammaln(sample + 1) - gammaln(r)
+            + r * jnp.log(r / (r + mu)) + sample * jnp.log(mu / (r + mu)))
+    return _ret(logp, is_log)
+
+
+@register("_random_pdf_dirichlet", aliases=("random_pdf_dirichlet",))
+def pdf_dirichlet(sample, alpha, *, is_log=False):
+    """sample (..., m, k) with alpha (..., k): alpha broadcasts over the
+    draws axis m (same convention as the scalar distributions)."""
+    if alpha.ndim == sample.ndim - 1:
+        alpha = alpha[..., None, :]
+    logp = (jnp.sum((alpha - 1) * jnp.log(sample), axis=-1)
+            + gammaln(jnp.sum(alpha, axis=-1))
+            - jnp.sum(gammaln(alpha), axis=-1))
+    return _ret(logp, is_log)

@@ -45,16 +45,22 @@ class Op:
     """A registered operator: one pure jax function + metadata."""
 
     __slots__ = ("name", "fn", "differentiable", "aliases", "doc", "_jit_cache",
-                 "nondiff_argnums", "multi_output")
+                 "nondiff_argnums", "multi_output", "state_inputs")
 
     def __init__(self, name: str, fn: Callable, differentiable: bool = True,
-                 aliases: Tuple[str, ...] = (), doc: str = "", multi_output: bool = False):
+                 aliases: Tuple[str, ...] = (), doc: str = "", multi_output: bool = False,
+                 state_inputs=None):
         self.name = name
         self.fn = fn
         self.differentiable = differentiable
         self.aliases = aliases
         self.doc = doc or (fn.__doc__ or "")
         self.multi_output = multi_output
+        # optimizer-style in-place state semantics: ((input_idx, output_idx),
+        # ...) or callable (raw_inputs, params) -> same. The nd invoke path
+        # writes output[out_idx] back into input[in_idx] and strips it from
+        # the returned outputs (reference ops mutate state NDArrays in place).
+        self.state_inputs = state_inputs
         self._jit_cache: Dict[Any, Callable] = {}
 
     def bound(self, params: Dict[str, Any]) -> Callable:
@@ -89,11 +95,11 @@ class Op:
 
 
 def register(name: str, aliases: Tuple[str, ...] = (), differentiable: bool = True,
-             multi_output: bool = False):
+             multi_output: bool = False, state_inputs=None):
     """Decorator: register a pure jax function as an operator."""
     def deco(fn: Callable) -> Callable:
         op = Op(name, fn, differentiable=differentiable, aliases=tuple(aliases),
-                multi_output=multi_output)
+                multi_output=multi_output, state_inputs=state_inputs)
         _OP_REGISTRY[name] = op
         for a in aliases:
             _OP_REGISTRY[a] = op

@@ -9,6 +9,7 @@ master weight is the f32 state and the bf16 copy is refreshed per step.
 """
 from __future__ import annotations
 
+import functools
 import math
 import pickle
 from typing import Any, Dict, Optional
@@ -214,41 +215,39 @@ def get_updater(optimizer: Optimizer) -> Updater:
 # Jitted update kernels
 # ---------------------------------------------------------------------------
 
+# The update-rule math lives ONCE in ops/optimizer_ops.py (the registered
+# nd.*_update ops — same wiring as the reference, whose Optimizer classes call
+# the ops). These kernels jit those functions with hyperparams as traced
+# scalars so per-step lr changes never retrace.
+from ..ops import optimizer_ops as _oo
+
+
 @jax.jit
 def _k_sgd(w, g, lr, wd, rescale, clip):
-    g = g * rescale
-    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
-    g = g + wd * w
-    return w - lr * g
+    return _oo.sgd_update(w, g, lr, wd=wd, rescale_grad=rescale,
+                          clip_gradient=clip)
 
 
 @jax.jit
 def _k_sgd_mom(w, g, mom, lr, wd, rescale, clip, momentum):
-    g = g * rescale
-    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
-    g = g + wd * w
-    mom2 = momentum * mom - lr * g
-    return w + mom2, mom2
+    return _oo.sgd_mom_update(w, g, mom, lr, momentum=momentum, wd=wd,
+                              rescale_grad=rescale, clip_gradient=clip)
 
 
 @jax.jit
 def _k_nag(w, g, mom, lr, wd, rescale, clip, momentum):
-    g = g * rescale
-    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
-    g = g + wd * w
-    mom2 = momentum * mom + g
-    return w - lr * (g + momentum * mom2), mom2
+    return _oo.nag_mom_update(w, g, mom, lr, momentum=momentum, wd=wd,
+                              rescale_grad=rescale, clip_gradient=clip)
 
 
 @jax.jit
 def _k_adam(w, g, m, v, lr, wd, rescale, clip, beta1, beta2, eps, coef1, coef2):
-    g = g * rescale
-    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
-    g = g + wd * w
-    m2 = beta1 * m + (1 - beta1) * g
-    v2 = beta2 * v + (1 - beta2) * g * g
+    # bias correction folded into lr, exactly how the reference class drives
+    # the (correction-free) adam_update op
     lr_t = lr * jnp.sqrt(coef2) / coef1
-    return w - lr_t * m2 / (jnp.sqrt(v2) + eps), m2, v2
+    return _oo.adam_update(w, g, m, v, lr_t, beta1=beta1, beta2=beta2,
+                           epsilon=eps, wd=wd, rescale_grad=rescale,
+                           clip_gradient=clip)
 
 
 @jax.jit
@@ -264,22 +263,15 @@ def _k_adamw(w, g, m, v, lr, eta, wd, rescale, clip, beta1, beta2, eps, coef1, c
 
 @jax.jit
 def _k_rmsprop(w, g, n, lr, wd, rescale, clip, rho, eps):
-    g = g * rescale
-    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
-    g = g + wd * w
-    n2 = rho * n + (1 - rho) * g * g
-    return w - lr * g / jnp.sqrt(n2 + eps), n2
+    return _oo.rmsprop_update(w, g, n, lr, rho=rho, epsilon=eps, wd=wd,
+                              rescale_grad=rescale, clip_gradient=clip)
 
 
 @jax.jit
 def _k_rmsprop_alex(w, g, n, gavg, delta, lr, wd, rescale, clip, rho, momentum, eps):
-    g = g * rescale
-    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
-    g = g + wd * w
-    n2 = rho * n + (1 - rho) * g * g
-    gavg2 = rho * gavg + (1 - rho) * g
-    delta2 = momentum * delta - lr * g / jnp.sqrt(n2 - gavg2 * gavg2 + eps)
-    return w + delta2, n2, gavg2, delta2
+    return _oo.rmspropalex_update(w, g, n, gavg, delta, lr, rho=rho,
+                                  momentum=momentum, epsilon=eps, wd=wd,
+                                  rescale_grad=rescale, clip_gradient=clip)
 
 
 @jax.jit
@@ -304,16 +296,8 @@ def _k_adadelta(w, g, acc_g, acc_d, wd, rescale, clip, rho, eps):
 
 @jax.jit
 def _k_ftrl(w, g, z, n, lr, wd, rescale, clip, lamda1, beta):
-    g = g * rescale
-    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
-    n2 = n + g * g
-    sigma = (jnp.sqrt(n2) - jnp.sqrt(n)) / lr
-    z2 = z + g - sigma * w
-    w2 = jnp.where(
-        jnp.abs(z2) > lamda1,
-        -(z2 - jnp.sign(z2) * lamda1) / ((beta + jnp.sqrt(n2)) / lr + wd),
-        0.0).astype(w.dtype)
-    return w2, z2, n2
+    return _oo.ftrl_update(w, g, z, n, lr, lamda1=lamda1, beta=beta, wd=wd,
+                           rescale_grad=rescale, clip_gradient=clip)
 
 
 @jax.jit
@@ -343,22 +327,16 @@ def _k_nadam(w, g, m, v, lr, wd, rescale, clip, beta1, beta2, eps, mschedule, mn
 
 @jax.jit
 def _k_signum(w, g, mom, lr, wd, rescale, clip, momentum, wd_lh):
-    g = g * rescale
-    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
-    mom2 = momentum * mom - (1 - momentum) * (g + wd * w)
-    return (1 - lr * wd_lh) * w + lr * jnp.sign(mom2), mom2
+    return _oo.signum_update(w, g, mom, lr, momentum=momentum, wd=wd,
+                             rescale_grad=rescale, clip_gradient=clip,
+                             wd_lh=wd_lh)
 
 
 @jax.jit
 def _k_ftml(w, g, d, v, z, lr, wd, rescale, clip, beta1, beta2, eps, t):
-    g = g * rescale
-    g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
-    g = g + wd * w
-    v2 = beta2 * v + (1 - beta2) * g * g
-    d2 = (1 - beta1 ** t) / lr * (jnp.sqrt(v2 / (1 - beta2 ** t)) + eps)
-    sigma = d2 - beta1 * d
-    z2 = beta1 * z + (1 - beta1) * g - sigma * w
-    return -z2 / d2, d2, v2, z2
+    return _oo.ftml_update(w, g, d, v, z, lr, t, beta1=beta1, beta2=beta2,
+                           epsilon=eps, wd=wd, rescale_grad=rescale,
+                           clip_grad=clip)
 
 
 @jax.jit

@@ -1,0 +1,22 @@
+"""mx.sym.contrib — symbolic contrib op namespace (reference
+python/mxnet/symbol/contrib.py): every `_contrib_*` registered op without the
+prefix, composed symbolically like the rest of `mx.sym`.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ops.registry import get_op as _get_op
+
+
+def __getattr__(name):
+    from . import _make_symbol_function
+    for cand in (f"_contrib_{name}", name):
+        try:
+            op = _get_op(cand)
+        except MXNetError:
+            continue
+        fn = _make_symbol_function(op)
+        globals()[name] = fn
+        return fn
+    raise AttributeError(
+        f"module 'mxnet_tpu.symbol.contrib' has no attribute '{name}'")

@@ -1,0 +1,154 @@
+"""Contrib batch 2: fft/ifft, count_sketch, hawkesll, index ops, box
+encode/decode, bipartite matching, graph ops (reference
+src/operator/contrib/{fft,count_sketch,hawkes_ll,index_copy,index_array,
+bounding_box,dgl_graph}.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 8).astype(np.float32)
+    f = nd.contrib.fft(nd.array(x))
+    assert f.shape == (3, 16)
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(_np(f)[:, 0::2], ref.real, atol=1e-4)
+    np.testing.assert_allclose(_np(f)[:, 1::2], ref.imag, atol=1e-4)
+    # reference/cuFFT semantics: ifft(fft(x)) == x * d
+    back = nd.contrib.ifft(f)
+    np.testing.assert_allclose(_np(back), x * 8, atol=1e-3)
+
+
+def test_count_sketch():
+    x = np.array([[1.0, 2.0, 3.0, 4.0]], np.float32)
+    h = np.array([0, 1, 0, 1], np.float32)
+    s = np.array([1, -1, 1, 1], np.float32)
+    out = nd.contrib.count_sketch(nd.array(x), nd.array(h), nd.array(s),
+                                  out_dim=2)
+    np.testing.assert_allclose(_np(out), [[1 + 3, -2 + 4]])
+
+
+def _hawkes_ref(lda, alpha, beta, state, lags, marks, vl, max_time):
+    """Direct numpy transcription of the documented math."""
+    N, T = lags.shape
+    K = lda.shape[1]
+    ll = np.zeros(N)
+    st = state.copy().astype(np.float64)
+    last = np.zeros((N, K))
+    for i in range(N):
+        t = 0.0
+        for j in range(int(vl[i])):
+            ci = int(marks[i, j])
+            t += lags[i, j]
+            d = t - last[i, ci]
+            ed = np.exp(-beta[ci] * d)
+            lam = lda[i, ci] + alpha[ci] * beta[ci] * st[i, ci] * ed
+            comp = lda[i, ci] * d + alpha[ci] * st[i, ci] * (1 - ed)
+            ll[i] += np.log(lam) - comp
+            st[i, ci] = 1 + st[i, ci] * ed
+            last[i, ci] = t
+        for k in range(K):
+            d = max_time[i] - last[i, k]
+            ed = np.exp(-beta[k] * d)
+            ll[i] -= lda[i, k] * d + alpha[k] * st[i, k] * (1 - ed)
+            st[i, k] *= ed
+    return ll, st
+
+
+def test_hawkesll_matches_reference_math():
+    rng = np.random.RandomState(1)
+    N, T, K = 3, 5, 2
+    lda = rng.uniform(0.5, 1.5, (N, K)).astype(np.float32)
+    alpha = rng.uniform(0.1, 0.5, K).astype(np.float32)
+    beta = rng.uniform(0.5, 2.0, K).astype(np.float32)
+    state = rng.uniform(0, 1, (N, K)).astype(np.float32)
+    lags = rng.uniform(0.1, 1.0, (N, T)).astype(np.float32)
+    marks = rng.randint(0, K, (N, T)).astype(np.int32)
+    vl = np.array([5, 3, 0], np.float32)
+    max_time = np.full(N, 10.0, np.float32)
+
+    out, st = nd.contrib.hawkesll(
+        nd.array(lda), nd.array(alpha), nd.array(beta), nd.array(state),
+        nd.array(lags), nd.array(marks, dtype="int32"), nd.array(vl),
+        nd.array(max_time))
+    ll_ref, st_ref = _hawkes_ref(lda, alpha, beta, state, lags, marks, vl,
+                                 max_time)
+    np.testing.assert_allclose(_np(out), ll_ref, rtol=1e-4)
+    np.testing.assert_allclose(_np(st), st_ref, rtol=1e-4)
+
+
+def test_index_copy_and_index_array():
+    old = nd.zeros((5, 2))
+    new = nd.ones((2, 2))
+    idx = nd.array(np.array([1, 3]), dtype="int32")
+    out = nd.contrib.index_copy(old, idx, new)
+    assert _np(out)[1].tolist() == [1, 1] and _np(out)[0].tolist() == [0, 0]
+
+    data = nd.zeros((2, 3))
+    ia = nd.contrib.index_array(data)
+    assert ia.shape == (2, 3, 2)
+    assert _np(ia)[1, 2].tolist() == [1, 2]
+    ia1 = nd.contrib.index_array(data, axes=(1,))
+    assert _np(ia1)[0, 2].tolist() == [2]
+
+
+def test_edge_id_getnnz_adjacency():
+    adj = np.zeros((4, 4), np.float32)
+    adj[0, 1] = 1
+    adj[2, 3] = 5
+    a = nd.array(adj)
+    out = nd.contrib.edge_id(a, nd.array(np.array([0, 1])),
+                             nd.array(np.array([1, 0])))
+    assert _np(out).tolist() == [1.0, -1.0]
+    assert int(_np(nd.contrib.getnnz(a))) == 2
+    b = nd.contrib.dgl_adjacency(a)
+    assert _np(b)[2, 3] == 1.0
+
+
+def test_box_encode_decode_roundtrip():
+    anchors = np.array([[[0.1, 0.1, 0.3, 0.3], [0.5, 0.5, 0.9, 0.9]]],
+                       np.float32)
+    refs = np.array([[[0.12, 0.12, 0.34, 0.3]]], np.float32)
+    samples = np.array([[1.0, -1.0]], np.float32)
+    matches = np.array([[0, 0]], np.float32)
+    means = nd.array(np.zeros(4, np.float32))
+    stds = nd.array(np.ones(4, np.float32))
+    targets, masks = nd.contrib.box_encode(
+        nd.array(samples), nd.array(matches), nd.array(anchors),
+        nd.array(refs), means, stds)
+    assert targets.shape == (1, 2, 4)
+    assert np.all(_np(masks)[0, 1] == 0)
+    # decoding the encoded offsets with the same anchors recovers the ref box
+    dec = nd.contrib.box_decode(targets, nd.array(anchors))
+    np.testing.assert_allclose(_np(dec)[0, 0], refs[0, 0], atol=1e-5)
+
+
+def test_bipartite_matching():
+    scores = np.array([[[0.9, 0.1], [0.8, 0.7]]], np.float32)
+    rm, cm = nd.contrib.bipartite_matching(nd.array(scores), threshold=0.5)
+    # greedy: (0,0)=0.9 first, then (1,0) taken -> (1,1)=0.7
+    assert _np(rm)[0].tolist() == [0.0, 1.0]
+    assert _np(cm)[0].tolist() == [0.0, 1.0]
+    rm2, _ = nd.contrib.bipartite_matching(nd.array(scores), threshold=0.95)
+    assert _np(rm2)[0].tolist() == [-1.0, -1.0]
+
+
+def test_sparse_embedding_and_sync_bn_aliases():
+    w = nd.array(np.arange(10, dtype=np.float32).reshape(5, 2))
+    idx = nd.array(np.array([1, 4], np.float32))
+    out = nd.contrib.SparseEmbedding(idx, w, input_dim=5, output_dim=2)
+    np.testing.assert_allclose(_np(out), [[2, 3], [8, 9]])
+
+    x = nd.array(np.random.RandomState(0).randn(4, 3, 2, 2).astype(np.float32))
+    gamma, beta = nd.ones((3,)), nd.zeros((3,))
+    mm, mv = nd.zeros((3,)), nd.ones((3,))
+    outs = nd.contrib.SyncBatchNorm(x, gamma, beta, mm, mv, ndev=1)
+    out = outs[0] if isinstance(outs, list) else outs
+    assert out.shape == x.shape

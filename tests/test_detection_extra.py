@@ -1,0 +1,145 @@
+"""Detection batch 2: PSROIPooling, DeformablePSROIPooling,
+DeformableConvolution, Proposal/MultiProposal, RROIAlign (reference
+src/operator/contrib/{psroi_pooling,deformable_psroi_pooling,
+deformable_convolution,proposal,multi_proposal,rroi_align}.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+def test_psroi_pooling_constant_map():
+    # constant per-channel-group map: each output bin must read its own group
+    P, D = 2, 3
+    C = D * P * P
+    data = np.zeros((1, C, 8, 8), np.float32)
+    for c in range(C):
+        data[0, c] = c
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                  spatial_scale=1.0, output_dim=D,
+                                  pooled_size=P)
+    assert out.shape == (1, D, P, P)
+    # channel layout (dim, gy, gx): bin (y, x) of dim d reads channel d*P*P + y*P + x
+    for d in range(D):
+        for y in range(P):
+            for x in range(P):
+                assert _np(out)[0, d, y, x] == pytest.approx(
+                    d * P * P + y * P + x)
+
+
+def test_deformable_psroi_pooling_no_trans_matches_psroi():
+    rng = np.random.RandomState(0)
+    P, D = 2, 2
+    data = rng.randn(1, D * P * P, 8, 8).astype(np.float32)
+    rois = np.array([[0, 1, 1, 6, 6]], np.float32)
+    base = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                   spatial_scale=1.0, output_dim=D,
+                                   pooled_size=P)
+    out, cnt = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), no_trans=True, spatial_scale=1.0,
+        output_dim=D, group_size=P, pooled_size=P, sample_per_part=2)
+    np.testing.assert_allclose(_np(out), _np(base), rtol=1e-5)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    offset = np.zeros((2, 2 * 3 * 3, 6, 6), np.float32)
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(offset), nd.array(w), kernel=(3, 3),
+        num_filter=4, no_bias=True)
+    ref = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(3, 3),
+                         num_filter=4, no_bias=True)
+    np.testing.assert_allclose(_np(out), _np(ref), rtol=1e-3, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts():
+    # a +1 x-offset on every tap equals convolving the shifted image
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 1, 7, 7).astype(np.float32)
+    w = rng.randn(1, 1, 3, 3).astype(np.float32)
+    offset = np.zeros((1, 2 * 9, 5, 5), np.float32)
+    offset[:, 1::2] = 1.0  # (y, x) pairs: x-component
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(offset), nd.array(w), kernel=(3, 3),
+        num_filter=1, no_bias=True)
+    x_shift = np.zeros_like(x)
+    x_shift[..., :-1] = x[..., 1:]
+    ref = nd.Convolution(nd.array(x_shift), nd.array(w), None, kernel=(3, 3),
+                         num_filter=1, no_bias=True)
+    # interior agrees exactly; the right edge reads zeros in both versions
+    np.testing.assert_allclose(_np(out)[..., :, :-1], _np(ref)[..., :, :-1],
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_deformable_conv_grad_flows():
+    rng = np.random.RandomState(3)
+    x = nd.array(rng.randn(1, 2, 6, 6).astype(np.float32))
+    off = nd.array(np.full((1, 2 * 9, 4, 4), 0.25, np.float32))
+    w = nd.array(rng.randn(2, 2, 3, 3).astype(np.float32))
+    x.attach_grad(); off.attach_grad(); w.attach_grad()
+    with autograd.record():
+        out = nd.contrib.DeformableConvolution(
+            x, off, w, kernel=(3, 3), num_filter=2, no_bias=True)
+        loss = out.sum()
+    loss.backward()
+    assert float(abs(_np(w.grad)).sum()) > 0
+    assert float(abs(_np(off.grad)).sum()) > 0
+
+
+def test_proposal_shapes_and_validity():
+    rng = np.random.RandomState(4)
+    B, A, FH, FW = 1, 3, 4, 4
+    cls_prob = rng.uniform(0, 1, (B, 2 * A, FH, FW)).astype(np.float32)
+    bbox_pred = (rng.randn(B, 4 * A, FH, FW) * 0.1).astype(np.float32)
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    rois = nd.contrib.Proposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        rpn_pre_nms_top_n=12, rpn_post_nms_top_n=6, threshold=0.7,
+        rpn_min_size=4, scales=(8,), ratios=(0.5, 1, 2), feature_stride=16)
+    rois = rois[0] if isinstance(rois, list) else rois
+    assert rois.shape == (6, 5)
+    r = _np(rois)
+    assert np.all(r[:, 0] == 0)
+    assert np.all(r[:, 1] >= 0) and np.all(r[:, 3] <= 63)
+    assert np.all(r[:, 3] >= r[:, 1]) and np.all(r[:, 4] >= r[:, 2])
+
+
+def test_multi_proposal_batched():
+    rng = np.random.RandomState(5)
+    B, A, FH, FW = 2, 1, 3, 3  # A must equal len(scales)*len(ratios)
+    cls_prob = rng.uniform(0, 1, (B, 2 * A, FH, FW)).astype(np.float32)
+    bbox_pred = (rng.randn(B, 4 * A, FH, FW) * 0.1).astype(np.float32)
+    im_info = np.tile(np.array([[48, 48, 1.0]], np.float32), (B, 1))
+    rois, scores = nd.contrib.MultiProposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        rpn_pre_nms_top_n=10, rpn_post_nms_top_n=4, rpn_min_size=2,
+        scales=(4,), ratios=(1,), feature_stride=16, output_score=True)
+    assert rois.shape == (8, 5) and scores.shape == (8, 1)
+    assert _np(rois)[:4, 0].tolist() == [0, 0, 0, 0]
+    assert _np(rois)[4:, 0].tolist() == [1, 1, 1, 1]
+
+
+def test_rroi_align_zero_angle_matches_axis_aligned():
+    rng = np.random.RandomState(6)
+    data = rng.randn(1, 2, 10, 10).astype(np.float32)
+    # rotated roi with angle 0, center (5,5), w=h=6
+    rois_r = np.array([[0, 5, 5, 6, 6, 0]], np.float32)
+    out = nd.contrib.RROIAlign(nd.array(data), nd.array(rois_r),
+                               pooled_size=(3, 3), spatial_scale=1.0,
+                               sampling_ratio=2)
+    assert out.shape == (1, 2, 3, 3)
+    # 180-degree rotation flips the pooled grid
+    rois_f = np.array([[0, 5, 5, 6, 6, 180]], np.float32)
+    out_f = nd.contrib.RROIAlign(nd.array(data), nd.array(rois_f),
+                                 pooled_size=(3, 3), spatial_scale=1.0,
+                                 sampling_ratio=2)
+    np.testing.assert_allclose(_np(out_f), _np(out)[:, :, ::-1, ::-1],
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,93 @@
+"""DGL graph sampling ops (reference src/operator/contrib/dgl_graph.cc),
+mirroring the in-source doc examples on the dense-backed adjacency."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+def _k5_adjacency():
+    # the doc example: complete digraph on 5 vertices, edge ids 1..20
+    a = np.zeros((5, 5), np.float32)
+    eid = 1
+    for i in range(5):
+        for j in range(5):
+            if i != j:
+                a[i, j] = eid
+                eid += 1
+    return a
+
+
+def test_uniform_sample_all_neighbors():
+    a = _k5_adjacency()
+    seed = nd.array(np.array([0], np.float32))
+    outs = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        nd.array(a), seed, num_args=2, num_hops=1, num_neighbor=4,
+        max_num_vertices=5)
+    verts, sub, layer = outs
+    v = _np(verts)
+    assert v[-1] == 5                      # 1 seed + 4 sampled neighbors
+    assert sorted(v[:5].tolist()) == [0, 1, 2, 3, 4]
+    s = _np(sub)
+    # seed row keeps its 4 outgoing edges with parent edge ids
+    np.testing.assert_allclose(s[0], a[0])
+    l = _np(layer)
+    assert l[0] == 0 and set(l[1:5].tolist()) == {1}
+
+
+def test_uniform_sample_respects_max_vertices():
+    a = _k5_adjacency()
+    seed = nd.array(np.array([0], np.float32))
+    outs = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        nd.array(a), seed, num_args=2, num_hops=1, num_neighbor=2,
+        max_num_vertices=3)
+    v = _np(outs[0])
+    assert v[-1] == 3
+    assert (_np(outs[2]) >= -1).all()
+
+
+def test_non_uniform_sample_prefers_high_probability():
+    a = _k5_adjacency()
+    prob = nd.array(np.array([0.0, 0.0, 1.0, 1.0, 0.0], np.float32))
+    seed = nd.array(np.array([0], np.float32))
+    outs = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        nd.array(a), prob, seed, num_args=3, num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    verts, sub, p, layer = outs
+    v = _np(verts)
+    assert v[-1] == 3
+    assert {2, 3} <= set(v[:3].tolist())
+
+
+def test_dgl_subgraph_and_mapping():
+    a = _k5_adjacency()
+    vid = nd.array(np.array([0, 2, 4], np.float32))
+    sub, mapping = nd.contrib.dgl_subgraph(
+        nd.array(a), vid, num_args=2, return_mapping=True)
+    s, m = _np(sub), _np(mapping)
+    assert s.shape == (3, 3)
+    # all 6 directed edges among {0,2,4} exist; new ids are 1..6 row-major
+    assert s[0, 1] == 1 and s[0, 2] == 2 and s[1, 0] == 3
+    # mapping carries the parent edge ids
+    assert m[0, 1] == a[0, 2] and m[2, 0] == a[4, 0]
+
+
+def test_graph_compact():
+    a = _k5_adjacency()
+    seed = nd.array(np.array([0], np.float32))
+    outs = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        nd.array(a), seed, num_args=2, num_hops=1, num_neighbor=4,
+        max_num_vertices=6)
+    verts, sub = outs[0], outs[1]
+    n = int(_np(verts)[-1])
+    compact = nd.contrib.dgl_graph_compact(
+        sub, verts, num_args=2, graph_sizes=(n,), return_mapping=False)
+    compact = compact[0] if isinstance(compact, list) else compact
+    c = _np(compact)
+    assert c.shape == (n, n)
+    # row 0 = seed's edges, now indexed by compacted columns
+    assert (c[0] != 0).sum() == 4

@@ -1,0 +1,118 @@
+"""Legacy v1 ops (reference src/operator/batch_norm_v1.cc, crop.cc,
+svm_output.cc, correlation.cc, identity_attach_KL_sparse_reg.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+def test_v1_aliases_match_modern():
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(2, 3, 8, 8).astype(np.float32))
+    w = nd.array(rng.randn(4, 3, 3, 3).astype(np.float32))
+    b = nd.zeros((4,))
+    v1 = nd.Convolution_v1(x, w, b, kernel=(3, 3), num_filter=4)
+    mod = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4)
+    np.testing.assert_allclose(_np(v1), _np(mod), rtol=1e-5)
+
+    p1 = nd.Pooling_v1(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    pm = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    np.testing.assert_allclose(_np(p1), _np(pm))
+
+
+def test_crop_offset_and_like():
+    x = nd.array(np.arange(2 * 1 * 4 * 4, dtype=np.float32).reshape(2, 1, 4, 4))
+    out = nd.Crop(x, offset=(1, 2), h_w=(2, 2))
+    np.testing.assert_allclose(_np(out)[0, 0], _np(x)[0, 0, 1:3, 2:4])
+    like = nd.zeros((1, 1, 2, 2))
+    out2 = nd.Crop(x, like, center_crop=True)
+    np.testing.assert_allclose(_np(out2)[0, 0], _np(x)[0, 0, 1:3, 1:3])
+
+
+def test_svm_output_gradient():
+    data = nd.array(np.array([[2.0, 1.0, 0.0]], np.float32))
+    label = nd.array(np.array([0.0], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(data, label, margin=1.0, use_linear=True)
+    out.backward()
+    # x_l=2; violations: x_1=1 > 2-1? not strict (1 > 1 false); x_2=0 > 1? no
+    assert _np(data.grad)[0].tolist() == [0, 0, 0]
+
+    data2 = nd.array(np.array([[1.0, 0.9, -2.0]], np.float32))
+    data2.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(data2, label, margin=1.0, use_linear=True)
+    out.backward()
+    # class1 violates (0.9 > 1-1=0): +1; class2 (-2 > 0)? no
+    assert _np(data2.grad)[0].tolist() == [-1, 1, 0]
+
+
+def test_svm_l2_gradient():
+    data = nd.array(np.array([[1.0, 0.5]], np.float32))
+    label = nd.array(np.array([0.0], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(data, label, margin=1.0)
+    out.backward()
+    # L2: g_1 = 2*(margin - (1-0.5)) = 1.0; g_0 = -1.0
+    np.testing.assert_allclose(_np(data.grad)[0], [-1.0, 1.0], rtol=1e-5)
+
+
+def test_correlation_self_identity_displacement():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 4, 5, 5).astype(np.float32)
+    out = nd.Correlation(nd.array(x), nd.array(x), kernel_size=1,
+                         max_displacement=1)
+    # border = md + (k-1)//2 = 1 -> 3x3 output (reference correlation.cc)
+    assert out.shape == (1, 9, 3, 3)
+    # center displacement (dy=dx=0) is channel 4: mean over C of x*x
+    np.testing.assert_allclose(_np(out)[0, 4],
+                               np.mean(x[0] * x[0], axis=0)[1:4, 1:4],
+                               rtol=1e-5)
+
+
+def test_kl_sparse_reg_backward():
+    data = nd.array(np.full((4, 2), 0.5, np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = nd.IdentityAttachKLSparseReg(data, sparseness_target=0.5,
+                                           penalty=1.0)
+    out.backward()
+    # rho_hat == rho -> KL grad = -1 + 1 = 0
+    np.testing.assert_allclose(_np(data.grad), np.ones((4, 2)), atol=1e-5)
+
+
+def test_cross_device_copy_and_native():
+    x = nd.ones((2,))
+    y = nd.invoke("_CrossDeviceCopy", [x], {})
+    np.testing.assert_allclose(_np(y), [1, 1])
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        nd.invoke("_Native", [x], {})
+
+
+def test_correlation_stride1_samples_reference_grid():
+    # brute-force reference: out[d, i, j] at padded position (br + i*s1)
+    rng = np.random.RandomState(7)
+    x1 = rng.randn(1, 2, 8, 8).astype(np.float32)
+    x2 = rng.randn(1, 2, 8, 8).astype(np.float32)
+    k, md, s1 = 3, 1, 2
+    out = nd.Correlation(nd.array(x1), nd.array(x2), kernel_size=k,
+                         max_displacement=md, stride1=s1)
+    br = md + (k - 1) // 2
+    H = 8
+    # displacement (0,0) channel index = 4 (3x3 grid)
+    a, b = x1[0], x2[0]
+    prod = (a * b).mean(axis=0)
+    # kernel box filter (SAME) then sample rows/cols br, br+s1, ...
+    import scipy.ndimage as ndi
+    box = ndi.uniform_filter(prod, size=k, mode="constant")
+    rows = list(range(br, H - br, s1))
+    ref = box[np.ix_(rows, rows)]
+    np.testing.assert_allclose(_np(out)[0, 4], ref, rtol=1e-4, atol=1e-5)

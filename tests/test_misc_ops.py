@@ -1,0 +1,205 @@
+"""Misc tensor/image/pdf ops (reference src/operator/tensor/elemwise_sum.cc,
+indexing_op.cc, im2col.cc, matrix_op.cc, amp_cast.cc, image/, random/pdf_op.cc)."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+def test_add_n():
+    xs = [nd.array(np.full((2, 3), i, np.float32)) for i in range(4)]
+    np.testing.assert_allclose(_np(nd.add_n(*xs)), np.full((2, 3), 6.0))
+
+
+def test_batch_take():
+    a = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array(np.array([0, 2, 1, 0]), dtype="int32")
+    np.testing.assert_allclose(_np(nd.batch_take(a, idx)), [0, 5, 7, 9])
+
+
+def test_im2col_col2im_roundtrip_adjoint():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    cols = nd.im2col(nd.array(x), kernel=(3, 3), stride=(1, 1), pad=(1, 1))
+    assert cols.shape == (2, 27, 64)
+    # col2im(im2col(x)) multiplies each pixel by its patch-coverage count;
+    # for an all-ones input interior pixels are covered 9 times
+    ones = nd.ones((1, 1, 5, 5))
+    c = nd.im2col(ones, kernel=(3, 3), stride=(1, 1), pad=(1, 1))
+    back = nd.col2im(c, output_size=(5, 5), kernel=(3, 3), stride=(1, 1),
+                     pad=(1, 1))
+    assert _np(back)[0, 0, 2, 2] == pytest.approx(9.0)
+    assert _np(back)[0, 0, 0, 0] == pytest.approx(4.0)
+
+
+def test_slice_assign():
+    x = nd.zeros((4, 4))
+    y = nd.ones((2, 2))
+    out = nd.slice_assign(x, y, begin=(1, 1), end=(3, 3))
+    ref = np.zeros((4, 4), np.float32)
+    ref[1:3, 1:3] = 1
+    np.testing.assert_allclose(_np(out), ref)
+    out2 = nd.slice_assign_scalar(x, scalar=5.0, begin=(0, 0), end=(1, 4))
+    assert _np(out2)[0].tolist() == [5, 5, 5, 5]
+
+
+def test_sparse_retain():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3) + 1)
+    idx = nd.array(np.array([0, 2]), dtype="int64")
+    out = nd.sparse_retain(data, idx)
+    assert np.all(_np(out)[1] == 0) and np.all(_np(out)[3] == 0)
+    np.testing.assert_allclose(_np(out)[0], _np(data)[0])
+
+
+def test_amp_multicast():
+    a = nd.array(np.ones(3, np.float16))
+    b = nd.array(np.ones(3, np.float32))
+    outs = nd.amp_multicast(a, b, num_outputs=2)
+    assert all(o.dtype == np.float32 for o in outs)
+    outs = nd.amp_multicast(a, b, num_outputs=2, cast_narrow=True)
+    assert all(o.dtype == np.float16 for o in outs)
+
+
+def test_cast_storage_roundtrip():
+    x = np.zeros((4, 3), np.float32)
+    x[1] = [1, 2, 3]
+    rsp = nd.cast_storage(nd.array(x), "row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert _np(rsp.indices).tolist() == [1]
+    dense = nd.cast_storage(rsp, "default")
+    assert type(dense).__name__ == "NDArray"
+    np.testing.assert_allclose(_np(dense), x)
+
+
+def test_image_namespace():
+    img = nd.array(np.arange(4 * 5 * 3, dtype=np.uint8).reshape(4, 5, 3))
+    t = nd.image.to_tensor(img)
+    assert t.shape == (3, 4, 5) and t.dtype == np.float32
+    norm = nd.image.normalize(t, mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))
+    assert norm.shape == (3, 4, 5)
+    crop = nd.image.crop(img, x=1, y=0, width=3, height=2)
+    assert crop.shape == (2, 3, 3)
+    rs = nd.image.resize(img, size=(10, 8))
+    assert rs.shape == (8, 10, 3)
+    flipped = nd.image.flip_left_right(img)
+    np.testing.assert_array_equal(_np(flipped), _np(img)[:, ::-1])
+
+
+def test_rnn_param_concat():
+    a, b = nd.ones((3,)), nd.zeros((2,))
+    out = nd.rnn_param_concat(a, b, dim=0)
+    assert out.shape == (5,)
+
+
+def test_pdf_normal_vs_scipy():
+    rng = np.random.RandomState(1)
+    mu = rng.randn(3).astype(np.float32)
+    sigma = rng.uniform(0.5, 2, 3).astype(np.float32)
+    x = rng.randn(3, 5).astype(np.float32)
+    out = nd.random_pdf_normal(nd.array(x), nd.array(mu), nd.array(sigma))
+    ref = st.norm.pdf(x, mu[:, None], sigma[:, None])
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-4)
+
+
+def test_pdf_gamma_poisson_dirichlet():
+    rng = np.random.RandomState(2)
+    alpha = rng.uniform(1, 3, 2).astype(np.float32)
+    beta = rng.uniform(0.5, 2, 2).astype(np.float32)
+    x = rng.uniform(0.1, 3, (2, 4)).astype(np.float32)
+    out = nd.random_pdf_gamma(nd.array(x), nd.array(alpha), nd.array(beta),
+                              is_log=True)
+    ref = st.gamma.logpdf(x, alpha[:, None], scale=1 / beta[:, None])
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-4)
+
+    lam = np.array([2.0, 5.0], np.float32)
+    k = np.array([[0, 1, 2, 3], [1, 2, 3, 4]], np.float32)
+    out = nd.random_pdf_poisson(nd.array(k), nd.array(lam))
+    ref = st.poisson.pmf(k, lam[:, None])
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-4)
+
+    a = np.array([[1.0, 2.0, 3.0]], np.float32)
+    s = np.array([[0.2, 0.3, 0.5]], np.float32)
+    out = nd.random_pdf_dirichlet(nd.array(s), nd.array(a), is_log=True)
+    ref = st.dirichlet.logpdf(s[0], a[0])
+    np.testing.assert_allclose(_np(out), [ref], rtol=1e-4)
+
+
+def test_pdf_uniform_exponential_nb():
+    low = np.array([0.0], np.float32)
+    high = np.array([2.0], np.float32)
+    x = np.array([[0.5, 1.5]], np.float32)
+    out = nd.random_pdf_uniform(nd.array(x), nd.array(low), nd.array(high))
+    np.testing.assert_allclose(_np(out), [[0.5, 0.5]], rtol=1e-6)
+
+    lam = np.array([1.5], np.float32)
+    out = nd.random_pdf_exponential(nd.array(x), nd.array(lam))
+    np.testing.assert_allclose(_np(out), st.expon.pdf(x, scale=1 / 1.5),
+                               rtol=1e-5)
+
+    k = np.array([3.0], np.float32)
+    p = np.array([0.4], np.float32)
+    cnt = np.array([[0.0, 2.0]], np.float32)
+    out = nd.random_pdf_negative_binomial(nd.array(cnt), nd.array(k),
+                                          nd.array(p))
+    ref = st.nbinom.pmf(cnt, 3, 0.4)
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-5)
+
+
+def test_pdf_grad_flows():
+    from mxnet_tpu import autograd
+    mu = nd.array(np.zeros(1, np.float32))
+    sigma = nd.array(np.ones(1, np.float32))
+    x = nd.array(np.array([[0.3]], np.float32))
+    mu.attach_grad()
+    with autograd.record():
+        p = nd.random_pdf_normal(x, mu, sigma, is_log=True)
+    p.backward()
+    # d/dmu logpdf = (x - mu)/sigma^2 = 0.3
+    np.testing.assert_allclose(_np(mu.grad), [0.3], rtol=1e-5)
+
+
+def test_np_windows_and_trapz():
+    np.testing.assert_allclose(_np(mx.np.hanning(5)), np.hanning(5),
+                               atol=1e-6)
+    np.testing.assert_allclose(_np(mx.np.blackman(6)), np.blackman(6),
+                               atol=1e-6)
+    np.testing.assert_allclose(_np(mx.np.hamming(4)), np.hamming(4),
+                               atol=1e-6)
+    y = mx.np.array([1.0, 2.0, 3.0])
+    assert float(mx.np.trapz(y)) == pytest.approx(4.0)
+
+
+def test_npx_reshape():
+    # _npx_reshape codes (np_matrix_op.cc): -2 copy dim, -4 copy rest,
+    # -5 merge two, -6 split, -3 skip size-1
+    x = mx.np.arange(24).reshape(2, 3, 4)
+    assert mx.npx.reshape(x, (-1, 4)).shape == (6, 4)
+    assert mx.npx.reshape(x, (-2, -5)).shape == (2, 12)
+    assert mx.npx.reshape(x, (-2, -1)).shape == (2, 12)
+    assert mx.npx.reshape(x, (-4,)).shape == (2, 3, 4)
+    assert mx.npx.reshape(x, (-6, 1, 2, -4)).shape == (1, 2, 3, 4)
+    y = mx.np.arange(6).reshape(1, 6)
+    assert mx.npx.reshape(y, (-3, -1)).shape == (6,)
+    # reverse matches right-to-left
+    z = mx.np.arange(24).reshape(2, 3, 4)
+    assert mx.npx.reshape(z, (-5, -2), reverse=True).shape == (6, 4)
+
+
+def test_pdf_dirichlet_batched_draws():
+    # alpha (batch, k) with sample (batch, draws, k) — the draws axis
+    # broadcasts (regression: cross-batch mixing)
+    a = np.array([[1.0, 2.0, 3.0], [2.0, 2.0, 2.0]], np.float32)
+    s = np.array([[[0.2, 0.3, 0.5], [0.1, 0.4, 0.5]],
+                  [[0.3, 0.3, 0.4], [0.5, 0.2, 0.3]]], np.float32)
+    out = nd.random_pdf_dirichlet(nd.array(s), nd.array(a), is_log=True)
+    assert out.shape == (2, 2)
+    for b in range(2):
+        for d in range(2):
+            ref = st.dirichlet.logpdf(s[b, d], a[b])
+            assert float(_np(out)[b, d]) == pytest.approx(ref, rel=1e-4)

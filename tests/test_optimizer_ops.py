@@ -1,0 +1,260 @@
+"""Optimizer update operators (reference src/operator/optimizer_op.cc,
+tests/python/unittest/test_optimizer.py style: compare the fused op against
+a straightforward numpy reference implementation, and check the in-place
+state-mutation semantics)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+def test_sgd_update_matches_reference_math():
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 3).astype(np.float32)
+    g = rng.randn(4, 3).astype(np.float32)
+    out = nd.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.01,
+                        rescale_grad=0.5, clip_gradient=1.0)
+    gref = np.clip(g * 0.5, -1, 1) + 0.01 * w
+    np.testing.assert_allclose(_np(out), w - 0.1 * gref, rtol=1e-6)
+
+
+def test_sgd_mom_update_mutates_state_in_place():
+    rng = np.random.RandomState(1)
+    w = nd.array(rng.randn(5).astype(np.float32))
+    g = nd.array(rng.randn(5).astype(np.float32))
+    mom = nd.zeros((5,))
+    w0, g0 = _np(w), _np(g)
+    out = nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, out=w)
+    assert out is w
+    np.testing.assert_allclose(_np(mom), -0.1 * g0, rtol=1e-6)
+    np.testing.assert_allclose(_np(w), w0 - 0.1 * g0, rtol=1e-6)
+    # second step exercises the momentum accumulation
+    nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, out=w)
+    np.testing.assert_allclose(_np(mom), 0.9 * (-0.1 * g0) - 0.1 * g0,
+                               rtol=1e-5)
+
+
+def test_adam_update():
+    rng = np.random.RandomState(2)
+    w = nd.array(rng.randn(6).astype(np.float32))
+    g = nd.array(rng.randn(6).astype(np.float32))
+    m, v = nd.zeros((6,)), nd.zeros((6,))
+    w0, g0 = _np(w), _np(g)
+    nd.adam_update(w, g, m, v, lr=0.01, beta1=0.9, beta2=0.999,
+                   epsilon=1e-8, out=w)
+    m_ref = 0.1 * g0
+    v_ref = 0.001 * g0 * g0
+    np.testing.assert_allclose(_np(m), m_ref, rtol=1e-5)
+    np.testing.assert_allclose(_np(v), v_ref, rtol=1e-5)
+    np.testing.assert_allclose(
+        _np(w), w0 - 0.01 * m_ref / (np.sqrt(v_ref) + 1e-8), rtol=1e-5)
+
+
+def test_mp_sgd_keeps_f32_master():
+    rng = np.random.RandomState(3)
+    w32_np = rng.randn(8).astype(np.float32)
+    w = nd.array(w32_np).astype("float16")
+    w32 = nd.array(w32_np)
+    g = nd.array(rng.randn(8).astype(np.float16))
+    out = nd.mp_sgd_update(w, g, w32, lr=0.1, out=w)
+    assert out.dtype == np.float16
+    ref = w32_np - 0.1 * _np(g).astype(np.float32)
+    np.testing.assert_allclose(_np(w32), ref, rtol=1e-6)
+    np.testing.assert_allclose(_np(w), ref.astype(np.float16), rtol=1e-3)
+
+
+def test_nag_matches_optimizer_class():
+    # the op and the NAG Optimizer class must implement the same rule
+    rng = np.random.RandomState(4)
+    w_np = rng.randn(7).astype(np.float32)
+    g_np = rng.randn(7).astype(np.float32)
+
+    opt = mx.optimizer.create("nag", learning_rate=0.1, momentum=0.9, wd=0.0,
+                              rescale_grad=1.0)
+    w_cls = nd.array(w_np)
+    state = opt.create_state(0, w_cls)
+    opt.update(0, w_cls, nd.array(g_np), state)
+
+    w_op = nd.array(w_np)
+    mom = nd.zeros((7,))
+    nd.nag_mom_update(w_op, nd.array(g_np), mom, lr=0.1, momentum=0.9,
+                      out=w_op)
+    np.testing.assert_allclose(_np(w_op), _np(w_cls), rtol=1e-5)
+
+
+@pytest.mark.parametrize("name,op_call", [
+    ("sgd", lambda w, g, st: nd.sgd_mom_update(
+        w, g, st, lr=0.1, momentum=0.9, wd=0.01, out=w)),
+    ("adam", lambda w, g, st: nd.adam_update(
+        w, g, st[0], st[1], lr=0.1 * (np.sqrt(1 - 0.999) / (1 - 0.9)),
+        beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.01, out=w)),
+])
+def test_update_op_matches_optimizer_class(name, op_call):
+    """Guard against the op-level and Optimizer-class update rules diverging
+    (the rule lives in both places; the reference wires its classes THROUGH
+    these ops). Adam: the class folds bias correction into lr."""
+    rng = np.random.RandomState(42)
+    w_np = rng.randn(6).astype(np.float32)
+    g_np = rng.randn(6).astype(np.float32)
+
+    opt = mx.optimizer.create(name, learning_rate=0.1, wd=0.01,
+                              **({"momentum": 0.9} if name == "sgd" else {}))
+    w_cls = nd.array(w_np)
+    state = opt.create_state(0, w_cls)
+    opt.update(0, w_cls, nd.array(g_np), state)
+
+    w_op = nd.array(w_np)
+    if name == "sgd":
+        st = nd.zeros((6,))
+    else:
+        st = (nd.zeros((6,)), nd.zeros((6,)))
+    op_call(w_op, nd.array(g_np), st)
+    np.testing.assert_allclose(_np(w_op), _np(w_cls), rtol=1e-5, atol=1e-6)
+
+
+def test_ftrl_ftml_rmsprop_signum_run():
+    rng = np.random.RandomState(5)
+    shape = (3, 4)
+    w = lambda: nd.array(rng.randn(*shape).astype(np.float32))
+    g = nd.array(rng.randn(*shape).astype(np.float32))
+    z, n = nd.zeros(shape), nd.zeros(shape)
+    out = nd.ftrl_update(w(), g, z, n, lr=0.1)
+    assert out.shape == shape and np.isfinite(_np(out)).all()
+    d, v, zz = nd.zeros(shape), nd.zeros(shape), nd.zeros(shape)
+    out = nd.ftml_update(w(), g, d, v, zz, lr=0.1, t=1)
+    assert np.isfinite(_np(out)).all()
+    nn_ = nd.zeros(shape)
+    out = nd.rmsprop_update(w(), g, nn_, lr=0.01)
+    assert np.isfinite(_np(out)).all()
+    gavg, delta = nd.zeros(shape), nd.zeros(shape)
+    out = nd.rmspropalex_update(w(), g, nn_, gavg, delta, lr=0.01)
+    assert np.isfinite(_np(out)).all()
+    mom = nd.zeros(shape)
+    out = nd.signum_update(w(), g, mom, lr=0.01, momentum=0.9)
+    assert set(np.round(np.unique(np.abs(np.sign(_np(mom))))).tolist()) <= {0.0, 1.0}
+
+
+def test_signsgd_update():
+    w = nd.array(np.ones(4, np.float32))
+    g = nd.array(np.array([0.5, -2.0, 0.0, 3.0], np.float32))
+    out = nd.signsgd_update(w, g, lr=0.1)
+    np.testing.assert_allclose(_np(out), 1.0 - 0.1 * np.sign(_np(g)),
+                               rtol=1e-6)
+
+
+def test_adamw_update_rescale_tensor():
+    rng = np.random.RandomState(6)
+    w = nd.array(rng.randn(5).astype(np.float32))
+    g = nd.array(rng.randn(5).astype(np.float32))
+    m, v = nd.zeros((5,)), nd.zeros((5,))
+    w0, g0 = _np(w), _np(g)
+    rescale = nd.array(np.array([0.5], np.float32))
+    nd.adamw_update(w, g, m, v, rescale, lr=0.01, eta=1.0, wd=0.1, out=w)
+    gs = g0 * 0.5
+    m_ref, v_ref = 0.1 * gs, 0.001 * gs * gs
+    ref = w0 - (0.01 * m_ref / (np.sqrt(v_ref) + 1e-8) + 0.1 * w0)
+    np.testing.assert_allclose(_np(w), ref, rtol=1e-5)
+
+
+def test_lamb_phases():
+    rng = np.random.RandomState(7)
+    w = nd.array(rng.randn(6).astype(np.float32))
+    g = nd.array(rng.randn(6).astype(np.float32))
+    m, v = nd.zeros((6,)), nd.zeros((6,))
+    gnew = nd.lamb_update_phase1(w, g, m, v, beta1=0.9, beta2=0.999,
+                                 epsilon=1e-6, t=1, wd=0.01)
+    assert np.isfinite(_np(gnew)).all()
+    assert abs(_np(m)).sum() > 0 and abs(_np(v)).sum() > 0
+    r1 = nd.array(np.array(np.linalg.norm(_np(w)), np.float32))
+    r2 = nd.array(np.array(np.linalg.norm(_np(gnew)), np.float32))
+    w0 = _np(w)
+    out = nd.lamb_update_phase2(w, gnew, r1, r2, lr=0.001)
+    ratio = _np(r1) / _np(r2)
+    np.testing.assert_allclose(_np(out), w0 - 0.001 * ratio * _np(gnew),
+                               rtol=1e-5)
+
+
+def test_multi_sum_sq_and_lars():
+    a = nd.array(np.array([1.0, 2.0], np.float32))
+    b = nd.array(np.array([[3.0], [4.0]], np.float32))
+    ss = nd.multi_sum_sq(a, b, num_arrays=2)
+    np.testing.assert_allclose(_np(ss), [5.0, 25.0], rtol=1e-6)
+    lrs = nd.array(np.array([0.1, 0.1], np.float32))
+    wds = nd.array(np.array([0.0, 0.0], np.float32))
+    new = nd.multi_lars(lrs, ss, ss, wds, eta=0.001, eps=1e-8)
+    np.testing.assert_allclose(_np(new), 0.1 * 0.001 * np.ones(2), rtol=1e-5)
+
+
+def test_multi_sgd_mom_update():
+    rng = np.random.RandomState(8)
+    ws = [nd.array(rng.randn(3).astype(np.float32)) for _ in range(2)]
+    gs = [nd.array(rng.randn(3).astype(np.float32)) for _ in range(2)]
+    moms = [nd.zeros((3,)) for _ in range(2)]
+    w0 = [_np(w) for w in ws]
+    g0 = [_np(g) for g in gs]
+    outs = nd.multi_sgd_mom_update(
+        ws[0], gs[0], moms[0], ws[1], gs[1], moms[1],
+        lrs=(0.1, 0.2), wds=(0.0, 0.0), momentum=0.9, num_weights=2,
+        out=ws)
+    for i, lr in enumerate((0.1, 0.2)):
+        np.testing.assert_allclose(_np(moms[i]), -lr * g0[i], rtol=1e-6)
+        np.testing.assert_allclose(_np(ws[i]), w0[i] - lr * g0[i], rtol=1e-6)
+
+
+def test_preloaded_multi_sgd_update():
+    rng = np.random.RandomState(9)
+    w1 = nd.array(rng.randn(4).astype(np.float32))
+    g1 = nd.array(rng.randn(4).astype(np.float32))
+    w0 = _np(w1)
+    lrs = nd.array(np.array([0.5], np.float32))
+    wds = nd.array(np.array([0.0], np.float32))
+    out = nd.preloaded_multi_sgd_update(w1, g1, lrs, wds, num_weights=1)
+    if isinstance(out, (list, tuple)):
+        out = out[0]
+    np.testing.assert_allclose(_np(out), w0 - 0.5 * _np(g1), rtol=1e-6)
+
+
+def test_multi_mp_sgd_mom_update():
+    rng = np.random.RandomState(10)
+    w32_np = rng.randn(4).astype(np.float32)
+    w = nd.array(w32_np).astype("float16")
+    w32 = nd.array(w32_np)
+    g = nd.array(rng.randn(4).astype(np.float32)).astype("float16")
+    mom = nd.zeros((4,))
+    out = nd.multi_mp_sgd_mom_update(
+        w, g, mom, w32, lrs=(0.1,), wds=(0.0,), momentum=0.9, num_weights=1,
+        out=[w])
+    ref = w32_np - 0.1 * _np(g).astype(np.float32)
+    np.testing.assert_allclose(_np(w32), ref, rtol=1e-6)
+    assert w.dtype == np.float16
+
+
+def test_sparse_and_group_adagrad():
+    rng = np.random.RandomState(11)
+    w = nd.array(rng.randn(4, 3).astype(np.float32))
+    h = nd.zeros((4, 3))
+    g_np = rng.randn(4, 3).astype(np.float32)
+    g_np[1] = 0.0  # a "missing" row: must stay untouched (lazy semantics)
+    w0 = _np(w)
+    nd.sparse_adagrad_update(w, nd.array(g_np), h, lr=0.1, epsilon=1e-7,
+                             out=w)
+    np.testing.assert_allclose(_np(w)[1], w0[1])
+    assert np.all(_np(h)[1] == 0)
+    assert np.any(_np(w)[0] != w0[0])
+
+    hist = nd.zeros((4,))
+    w2 = nd.array(w0)
+    nd.group_adagrad_update(w2, nd.array(g_np), hist, lr=0.1, out=w2)
+    np.testing.assert_allclose(_np(hist), np.mean(g_np * g_np, axis=1),
+                               rtol=1e-6)
+
+
+def test_update_ops_visible_in_symbol_namespace():
+    import mxnet_tpu.symbol as sym
+    s = sym.sgd_update(sym.Variable("w"), sym.Variable("g"), lr=0.1)
+    assert s is not None

@@ -1,0 +1,149 @@
+"""INT8 quantized op surface (reference src/operator/quantization/*.cc).
+Each test checks the int8 op against the float computation it approximates."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+INT8 = 127.0
+INT32 = float(0x7FFFFFFF)
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+def _quant(x):
+    r = np.abs(x).max()
+    q = np.clip(np.round(x / r * INT8), -127, 127).astype(np.int8)
+    return q, -r, r
+
+
+def test_quantized_fully_connected_approximates_float():
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (4, 8)).astype(np.float32)
+    w = rng.uniform(-1, 1, (5, 8)).astype(np.float32)
+    xq, xlo, xhi = _quant(x)
+    wq, wlo, whi = _quant(w)
+    out, mn, mx_ = nd.contrib.quantized_fully_connected(
+        nd.array(xq, dtype="int8"), nd.array(wq, dtype="int8"),
+        nd.array(np.float32(xlo)), nd.array(np.float32(xhi)),
+        nd.array(np.float32(wlo)), nd.array(np.float32(whi)),
+        num_hidden=5)
+    scale = float(_np(mx_)) / INT32
+    approx = _np(out).astype(np.float64) * scale
+    np.testing.assert_allclose(approx, x @ w.T, atol=0.05)
+
+
+def test_quantized_conv_with_bias():
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-1, 1, (1, 2, 5, 5)).astype(np.float32)
+    w = rng.uniform(-1, 1, (3, 2, 3, 3)).astype(np.float32)
+    b = rng.uniform(-1, 1, 3).astype(np.float32)
+    xq, xlo, xhi = _quant(x)
+    wq, wlo, whi = _quant(w)
+    bq, blo, bhi = _quant(b)
+    out, mn, mx_ = nd.contrib.quantized_conv(
+        nd.array(xq, dtype="int8"), nd.array(wq, dtype="int8"),
+        nd.array(bq, dtype="int8"),
+        nd.array(np.float32(xlo)), nd.array(np.float32(xhi)),
+        nd.array(np.float32(wlo)), nd.array(np.float32(whi)),
+        nd.array(np.float32(blo)), nd.array(np.float32(bhi)),
+        kernel=(3, 3), num_filter=3, no_bias=False)
+    scale = float(_np(mx_)) / INT32
+    approx = _np(out).astype(np.float64) * scale
+
+    import jax.numpy as jnp
+    from jax import lax
+    ref = lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(0, 0), (0, 0)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    ref = np.asarray(ref) + b.reshape(1, 3, 1, 1)
+    np.testing.assert_allclose(approx, ref, atol=0.1)
+
+
+def test_quantized_pooling_and_act_and_flatten():
+    x = np.array([[[[1, -2], [3, 4]]]], np.int8)
+    lo, hi = nd.array(np.float32(-4)), nd.array(np.float32(4))
+    out, mn, mx_ = nd.contrib.quantized_pooling(
+        nd.array(x, dtype="int8"), lo, hi, kernel=(2, 2), pool_type="max")
+    assert _np(out).ravel().tolist() == [4]
+    assert float(_np(mn)) == -4.0
+
+    out, mn, _ = nd.contrib.quantized_act(nd.array(x, dtype="int8"), lo, hi)
+    assert _np(out).min() == 0
+
+    out, _, _ = nd.contrib.quantized_flatten(nd.array(x, dtype="int8"), lo, hi)
+    assert out.shape == (1, 4)
+
+
+def test_quantized_elemwise_add_and_mul():
+    a = np.array([100, -50], np.int8)
+    b = np.array([27, 27], np.int8)
+    la, ha = nd.array(np.float32(-1)), nd.array(np.float32(1))
+    lb, hb = nd.array(np.float32(-2)), nd.array(np.float32(2))
+    out, mn, mx_ = nd.contrib.quantized_elemwise_add(
+        nd.array(a, dtype="int8"), nd.array(b, dtype="int8"), la, ha, lb, hb)
+    scale = float(_np(mx_)) / INT32
+    fa, fb = a / INT8 * 1.0, b / INT8 * 2.0
+    np.testing.assert_allclose(_np(out) * scale, fa + fb, atol=1e-2)
+
+    out, mn, mx_ = nd.contrib.quantized_elemwise_mul(
+        nd.array(a, dtype="int8"), nd.array(b, dtype="int8"), la, ha, lb, hb)
+    scale = float(_np(mx_)) / INT32
+    np.testing.assert_allclose(_np(out) * scale, fa * fb, atol=1e-2)
+
+
+def test_quantized_concat_rescales_to_common_range():
+    a = np.array([[127]], np.int8)    # represents 1.0 in range 1
+    b = np.array([[127]], np.int8)    # represents 2.0 in range 2
+    out, mn, mx_ = nd.contrib.quantized_concat(
+        nd.array(a, dtype="int8"), nd.array(b, dtype="int8"),
+        nd.array(np.float32(-1)), nd.array(np.float32(1)),
+        nd.array(np.float32(-2)), nd.array(np.float32(2)),
+        num_args=2, dim=1)
+    assert float(_np(mx_)) == 2.0
+    vals = _np(out).ravel() / INT8 * 2.0
+    np.testing.assert_allclose(vals, [1.0, 2.0], atol=0.02)
+
+
+def test_quantized_batch_norm():
+    rng = np.random.RandomState(2)
+    x = rng.uniform(-1, 1, (2, 3, 4, 4)).astype(np.float32)
+    xq, lo, hi = _quant(x)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    out, mn, mx_ = nd.contrib.quantized_batch_norm(
+        nd.array(xq, dtype="int8"), nd.array(gamma), nd.array(beta),
+        nd.array(mean), nd.array(var),
+        nd.array(np.float32(lo)), nd.array(np.float32(hi)),
+        eps=1e-5, min_calib_range=-3.0, max_calib_range=3.0)
+    ref = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(var.reshape(1, 3, 1, 1) + 1e-5)
+    approx = _np(out).astype(np.float32) / INT8 * 3.0
+    np.testing.assert_allclose(approx, ref, atol=0.1)
+
+
+def test_quantized_embedding():
+    w = np.array([[1, 2], [3, 4], [5, 6]], np.int8)
+    out, mn, mx_ = nd.contrib.quantized_embedding(
+        nd.array(np.array([2, 0], np.float32)), nd.array(w, dtype="int8"),
+        nd.array(np.float32(-1)), nd.array(np.float32(1)),
+        input_dim=3, output_dim=2)
+    assert _np(out).tolist() == [[5, 6], [1, 2]]
+
+
+def test_calibrate_entropy_op():
+    rng = np.random.RandomState(3)
+    samples = rng.randn(20000).astype(np.float32)
+    amax = float(np.abs(samples).max())
+    hist, edges = np.histogram(samples, bins=1001, range=(-amax, amax))
+    mn, mx_ = nd.contrib.calibrate_entropy(
+        nd.array(hist.astype(np.float32)), nd.array(edges.astype(np.float32)),
+        num_quantized_bins=255)
+    th = float(_np(mx_))
+    assert 0 < th <= amax
+    # for a gaussian the KL-optimal clip is well inside the max
+    assert th < amax

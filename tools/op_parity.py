@@ -1,0 +1,87 @@
+"""Op-name parity audit vs the reference registry.
+
+Extracts every NNVM_REGISTER_OP / MXNET_REGISTER_OP_PROPERTY name from
+/root/reference/src/operator and checks whether a user-facing equivalent
+exists here (ops registry, mx.nd, mx.np, mx.npx, mx.nd.image, mx.nd.contrib,
+mx.nd.linalg, mx.nd.sparse namespaces).  Internal-only names (backward nodes,
+CUDA/MKLDNN/TVM/TensorRT plumbing) are excluded: our autograd derives
+backward from each op's vjp so `_backward_*` never needs registration.
+"""
+from __future__ import annotations
+import os, re, subprocess, sys
+
+REF = "/root/reference/src/operator"
+
+SKIP = re.compile(
+    r"^_backward|^_Fused|^_TensorRT$|^_sg_mkldnn|tvm|^CuDNN|^_contrib_backward"
+    r"|^_npi_.*backward|_backward$|^_broadcast_backward$|^name$"
+    r"|_$"  # token-paste macro artifacts (_sample_##distr etc.)
+)
+
+# reference op -> where the equivalent capability lives here (not name-mapped)
+EQUIVALENTS = {
+    "Custom": "nd.Custom / mxnet_tpu.operator.CustomOp",
+    "_npi_boolean_mask_assign_scalar": "np ndarray boolean __setitem__",
+    "_npi_boolean_mask_assign_tensor": "np ndarray boolean __setitem__",
+    "_npi_normal_n": "np.random.normal(size=...)",
+    "_npi_uniform_n": "np.random.uniform(size=...)",
+    "_npi_rtrue_divide_scalar": "np ndarray __rtruediv__",
+    "_npi_share_memory": "np.shares_memory",
+    "_npi_tensordot_int_axes": "np.tensordot(axes=int)",
+}
+
+def ref_ops():
+    out = subprocess.run(
+        ["grep", "-rhoE",
+         r"(NNVM_REGISTER_OP|MXNET_REGISTER_OP_PROPERTY)\((_?[A-Za-z0-9_]+)",
+         REF, "--include=*.cc"], capture_output=True, text=True).stdout
+    names = set()
+    for line in out.splitlines():
+        names.add(line.split("(", 1)[1])
+    return sorted(n for n in names if not SKIP.search(n))
+
+def local_names():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import mxnet_tpu as mx
+    import mxnet_tpu.ndarray as nd
+    from mxnet_tpu.ops import registry
+    have = set()
+    try:
+        have |= set(registry.list_ops())
+    except AttributeError:
+        have |= set(registry._OPS)
+    for mod in (nd, mx.np, mx.npx, getattr(nd, "image", None),
+                getattr(nd, "contrib", None), getattr(nd, "linalg", None),
+                getattr(nd, "sparse", None), getattr(nd, "random", None),
+                getattr(mx.np, "random", None), getattr(mx.np, "linalg", None)):
+        if mod is not None:
+            have |= {a for a in dir(mod) if not a.startswith("__")}
+    return have
+
+ALIAS_PREFIXES = ["", "_", "_contrib_", "_np_", "_npi_", "_npx_", "_image_",
+                  "_linalg_", "_sparse_", "_random_", "_sample_"]
+
+def covered(name, have):
+    cands = {name, name.lstrip("_")}
+    for p in ALIAS_PREFIXES:
+        if name.startswith(p) and p:
+            cands.add(name[len(p):])
+    # _npi_foo_scalar ~ foo ; ...
+    for c in list(cands):
+        if c.endswith("_scalar"):
+            cands.add(c[:-7])
+    return any(c in have for c in cands)
+
+def main():
+    have = local_names()
+    refs = ref_ops()
+    missing = [r for r in refs if not covered(r, have)
+               and r not in EQUIVALENTS]
+    print(f"reference user-facing ops: {len(refs)}; covered: {len(refs)-len(missing)}; missing: {len(missing)}")
+    for m in missing:
+        print(" ", m)
+    return 0
+
+if __name__ == "__main__":
+    sys.exit(main())
