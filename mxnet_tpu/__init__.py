@@ -18,6 +18,49 @@ Quick start::
         loss = gluon.loss.SoftmaxCrossEntropyLoss()(net(x), nd.zeros((32,)))
     loss.backward()
 """
+def _maybe_init_distributed():
+    """Join the jax.distributed cluster BEFORE any jax computation runs —
+    jax refuses to initialize afterwards. tools/launch.py (the reference
+    tools/launch.py analog) sets these env vars for each worker; a bare
+    `import mxnet_tpu` in the worker then connects automatically (the
+    coordinator replaces the reference's ps-lite scheduler rendezvous)."""
+    import os
+    coord = os.environ.get("MXNET_TPU_COORDINATOR")
+    if coord is None and os.environ.get("DMLC_PS_ROOT_URI"):
+        # reference-compatible env (docs/faq/distributed_training.md:260)
+        coord = (os.environ["DMLC_PS_ROOT_URI"] + ":"
+                 + os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    n = int(os.environ.get("MXNET_TPU_NUM_WORKERS",
+                           os.environ.get("DMLC_NUM_WORKER", "1")))
+    if not coord or n <= 1:
+        return
+    import jax
+    try:
+        # NB: jax.process_count() would itself initialize the XLA backend,
+        # which then forbids distributed.initialize — probe the distributed
+        # client state instead
+        from jax._src import distributed as _dist
+        if _dist.global_state.client is not None:
+            return  # already initialized by the caller
+    except Exception:
+        pass
+    if os.environ.get("MXNET_TPU_RANK_FROM_MPI"):
+        rank = (os.environ.get("OMPI_COMM_WORLD_RANK")
+                or os.environ.get("PMI_RANK") or "0")
+    else:
+        rank = os.environ.get("MXNET_TPU_RANK",
+                              os.environ.get("DMLC_WORKER_ID"))
+    try:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=n,
+                                   process_id=int(rank or 0))
+    except RuntimeError:
+        pass  # jax already ran computations (interactive use) — kvstore
+        #       creation will surface the error with context
+
+
+_maybe_init_distributed()
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, num_gpus, num_tpus, current_context, cpu_pinned

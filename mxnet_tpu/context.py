@@ -60,7 +60,7 @@ class Context:
         devs = _devices_of(self.device_type)
         if not devs:
             # graceful fallback: tpu requested but only cpu present (or vice versa)
-            devs = jax.devices()
+            devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
     def empty_cache(self):
@@ -97,15 +97,18 @@ _dev_cache = {}
 
 def _devices_of(kind: str):
     if kind not in _dev_cache:
+        # local_devices, not devices: in a multi-process (jax.distributed)
+        # job the global list contains other workers' non-addressable
+        # devices — Context must only ever resolve to a local one
         if kind == "cpu":
             try:
-                _dev_cache[kind] = jax.devices("cpu")
+                _dev_cache[kind] = jax.local_devices(backend="cpu")
             except RuntimeError:
                 _dev_cache[kind] = []
         else:
             # Any accelerator backend counts as "tpu" (axon tunnels report
             # platform-specific names; default backend is the accelerator).
-            devs = [d for d in jax.devices() if d.platform != "cpu"]
+            devs = [d for d in jax.local_devices() if d.platform != "cpu"]
             _dev_cache[kind] = devs
     return _dev_cache[kind]
 

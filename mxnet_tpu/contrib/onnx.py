@@ -1,10 +1,11 @@
-"""ONNX interop (reference python/mxnet/contrib/onnx/).
+"""ONNX interop (reference python/mxnet/contrib/onnx/ — mx2onnx export +
+onnx2mx import).
 
-The `onnx` package is not part of this environment, so export/import are
-gated: when onnx IS installed, export_model serializes a Symbol graph to an
-ONNX ModelProto covering the common layer ops; without it, both entry points
-raise with a pointer to the portable alternative (HybridBlock.export /
-Symbol JSON + params — loadable by any mxnet_tpu build).
+Self-contained: when the `onnx` pip package is installed it is used
+directly; otherwise serialization falls back to the vendored protobuf
+subset in `onnx_proto/` (same wire format — files interchange with stock
+onnx/onnxruntime). Both `export_model` and `import_model` therefore always
+work, unlike the reference which hard-requires the pip package.
 """
 from __future__ import annotations
 
@@ -13,13 +14,16 @@ from typing import Dict, List, Optional, Tuple
 import numpy as _np
 
 from ..base import MXNetError
+from . import onnx_proto as _shim
 
 try:
     import onnx as _onnx
     from onnx import helper as _oh, TensorProto as _TP
-    _HAS_ONNX = True
+    from onnx import numpy_helper as _onh
 except ImportError:
-    _HAS_ONNX = False
+    # the vendored subset serves the same API surface
+    _onnx, _oh, _TP, _onh = _shim, _shim.helper, _shim.TensorProto, \
+        _shim.numpy_helper
 
 
 _OP_MAP = {
@@ -47,20 +51,11 @@ _OP_MAP = {
 }
 
 
-def _require_onnx():
-    if not _HAS_ONNX:
-        raise MXNetError(
-            "the 'onnx' package is not installed in this environment; for a "
-            "portable serialized model use HybridBlock.export() (symbol JSON "
-            "+ params) or model.save_checkpoint()")
-
-
 def export_model(sym, params, input_shape: List[Tuple[int, ...]],
                  input_type=_np.float32, onnx_file_path: str = "model.onnx",
                  verbose: bool = False):
     """Export a Symbol + params to ONNX (reference
     contrib/onnx/mx2onnx/export_model.py). Requires the onnx package."""
-    _require_onnx()
     from .. import symbol as sym_mod
     if isinstance(sym, str):
         sym = sym_mod.load(sym)
@@ -152,9 +147,208 @@ def _attrs_for(op_name: str, p: Dict) -> Dict:
     return {}
 
 
+def _node_attrs(node) -> Dict:
+    if hasattr(_onnx, "attr_dict") or _onnx is _shim:
+        return _shim.attr_dict(node)
+    out = {}
+    for a in node.attribute:
+        out[a.name] = _oh.get_attribute_value(a)
+        if isinstance(out[a.name], bytes):
+            out[a.name] = out[a.name].decode()
+    return out
+
+
 def import_model(model_file: str):
     """ONNX -> (sym, arg_params, aux_params) (reference
-    contrib/onnx/onnx2mx/import_model.py). Requires the onnx package."""
-    _require_onnx()
-    raise MXNetError("ONNX import is not implemented yet; export the source "
-                     "model with HybridBlock.export-compatible tooling")
+    contrib/onnx/onnx2mx/import_model.py import_model:29). Covers the op set
+    produced by export_model plus the common elementwise/shape ops."""
+    from .. import symbol as sym_mod
+    from .. import ndarray as nd
+
+    model = _onnx.load(model_file)
+    graph = model.graph
+
+    params: Dict[str, _np.ndarray] = {}
+    for init in graph.initializer:
+        params[init.name] = _to_array(init)
+
+    env: Dict[str, object] = {}       # name -> Symbol
+    aux_names = set()
+    for vi in graph.input:
+        if vi.name not in params:
+            env[vi.name] = sym_mod.Variable(vi.name)
+    for name in params:
+        env[name] = sym_mod.Variable(name)
+
+    def A(node):
+        return _node_attrs(node)
+
+    const_only = set()   # initializers consumed as shapes/axes/bounds
+    tensor_used = set()  # initializers consumed as actual graph tensors
+
+    def const_of(name):
+        """Compile-time constant (shape/axes inputs must be initializers).
+        Does NOT remove it — another node may share the same initializer;
+        unused const-only entries are dropped after the walk."""
+        if name in params:
+            const_only.add(name)
+            return params[name]
+        raise MXNetError(f"ONNX import: input '{name}' must be a constant")
+
+    for node in graph.node:
+        ins = [env.get(i) for i in node.input]
+        at = A(node)
+        op = node.op_type
+        out = None
+        if op == "Conv":
+            k = at.get("kernel_shape", (3, 3))
+            no_bias = len(node.input) < 3
+            w = params.get(node.input[1])
+            out = sym_mod.Convolution(
+                ins[0], env[node.input[1]],
+                None if no_bias else env[node.input[2]],
+                kernel=tuple(k), num_filter=int(w.shape[0]) if w is not None else 0,
+                stride=tuple(at.get("strides", (1,) * len(k))),
+                pad=tuple(at.get("pads", (0,) * 2 * len(k))[:len(k)]),
+                dilate=tuple(at.get("dilations", (1,) * len(k))),
+                num_group=int(at.get("group", 1)), no_bias=no_bias)
+        elif op == "Gemm":
+            w = params.get(node.input[1])
+            if w is None:
+                num_hidden = 0
+            else:
+                num_hidden = int(w.shape[0] if at.get("transB") else w.shape[1])
+            out = sym_mod.FullyConnected(
+                ins[0], env[node.input[1]],
+                env[node.input[2]] if len(node.input) > 2 else None,
+                num_hidden=num_hidden,
+                no_bias=len(node.input) < 3)
+            if not at.get("transB") and w is not None:
+                # FullyConnected expects (out, in): pre-transpose the param
+                params[node.input[1]] = _np.ascontiguousarray(w.T)
+        elif op == "MatMul":
+            out = sym_mod.dot(ins[0], ins[1])
+        elif op in ("Relu", "Sigmoid", "Tanh", "Softplus"):
+            act = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+                   "Softplus": "softrelu"}[op]
+            out = sym_mod.Activation(ins[0], act_type=act)
+        elif op == "LeakyRelu":
+            out = sym_mod.LeakyReLU(ins[0], act_type="leaky",
+                                    slope=float(at.get("alpha", 0.01)))
+        elif op in ("MaxPool", "AveragePool"):
+            k = at.get("kernel_shape", (2, 2))
+            out = sym_mod.Pooling(
+                ins[0], kernel=tuple(k),
+                pool_type="max" if op == "MaxPool" else "avg",
+                stride=tuple(at.get("strides", (1,) * len(k))),
+                pad=tuple(at.get("pads", (0,) * 2 * len(k))[:len(k)]))
+        elif op == "GlobalAveragePool":
+            out = sym_mod.Pooling(ins[0], kernel=(1, 1), pool_type="avg",
+                                  global_pool=True)
+        elif op == "BatchNormalization":
+            out = sym_mod.BatchNorm(
+                ins[0], env[node.input[1]], env[node.input[2]],
+                env[node.input[3]], env[node.input[4]],
+                eps=float(at.get("epsilon", 1e-5)),
+                momentum=float(at.get("momentum", 0.9)),
+                fix_gamma=False, use_global_stats=True)
+            for aux in (node.input[3], node.input[4]):
+                aux_names.add(aux)
+        elif op == "LayerNormalization":
+            out = sym_mod.LayerNorm(ins[0], env[node.input[1]],
+                                    env[node.input[2]],
+                                    eps=float(at.get("epsilon", 1e-5)),
+                                    axis=int(at.get("axis", -1)))
+        elif op == "Concat":
+            out = sym_mod.Concat(*[env[i] for i in node.input],
+                                 num_args=len(node.input),
+                                 dim=int(at.get("axis", 1)))
+        elif op in ("Add", "Sub", "Mul", "Div"):
+            fn = {"Add": sym_mod.broadcast_add, "Sub": sym_mod.broadcast_sub,
+                  "Mul": sym_mod.broadcast_mul, "Div": sym_mod.broadcast_div}
+            out = fn[op](ins[0], ins[1])
+        elif op == "Sum":
+            out = sym_mod.add_n(*[env[i] for i in node.input])
+        elif op == "Reshape":
+            shape = const_of(node.input[1]).astype(int).tolist()
+            out = sym_mod.Reshape(ins[0], shape=tuple(shape))
+        elif op == "Flatten":
+            out = sym_mod.Flatten(ins[0])
+        elif op == "Softmax":
+            out = sym_mod.softmax(ins[0], axis=int(at.get("axis", -1)))
+        elif op == "Transpose":
+            perm = at.get("perm")
+            out = sym_mod.transpose(ins[0],
+                                    axes=tuple(perm) if perm else None)
+        elif op == "Dropout":
+            out = sym_mod.Dropout(ins[0], p=float(at.get("ratio", 0.5)))
+        elif op == "Identity":
+            out = sym_mod.identity(ins[0])
+        elif op == "Gather":
+            w = params.get(node.input[0])
+            out = sym_mod.Embedding(
+                ins[1], env[node.input[0]],
+                input_dim=int(w.shape[0]) if w is not None else 0,
+                output_dim=int(w.shape[1]) if w is not None else 0)
+        elif op == "Clip":
+            lo = float(const_of(node.input[1])) if len(node.input) > 1 else None
+            hi = float(const_of(node.input[2])) if len(node.input) > 2 else None
+            out = sym_mod.clip(ins[0], a_min=lo if lo is not None else -3.4e38,
+                               a_max=hi if hi is not None else 3.4e38)
+        elif op in ("Exp", "Log", "Sqrt", "Abs", "Neg", "Floor", "Ceil"):
+            out = getattr(sym_mod, op.lower())(ins[0])
+        elif op == "Constant":
+            val = at.get("value")
+            # with pip onnx, get_attribute_value returns the TensorProto
+            if not isinstance(val, _np.ndarray):
+                val = _to_array(val)
+            pname = node.output[0]
+            params[pname] = _np.asarray(val)
+            env[pname] = sym_mod.Variable(pname)
+            continue
+        else:
+            raise MXNetError(f"ONNX import: unsupported op {op}")
+        for iname in node.input:
+            if iname in params and iname not in const_only:
+                tensor_used.add(iname)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for oname, osym in zip(node.output, outs):
+            env[oname] = osym
+
+    heads = [env[vo.name] for vo in graph.output]
+    sym = heads[0] if len(heads) == 1 else sym_mod.Group(heads)
+
+    arg_params, aux_params = {}, {}
+    for name, arr in params.items():
+        if name in const_only and name not in tensor_used:
+            continue  # shape/axes-only initializer, not a graph tensor
+        target = aux_params if name in aux_names else arg_params
+        target[name] = nd.array(arr)
+    return sym, arg_params, aux_params
+
+
+def _to_array(tensor) -> _np.ndarray:
+    if _onnx is _shim:
+        return _shim.numpy_helper.to_array(tensor)
+    return _onh.to_array(tensor)
+
+
+def get_model_metadata(model_file: str):
+    """Input/output names+shapes of an ONNX file (reference
+    contrib/onnx/onnx2mx/import_model.py get_model_metadata:60)."""
+    model = _onnx.load(model_file)
+    graph = model.graph
+    inits = {i.name for i in graph.initializer}
+
+    def info(vi):
+        dims = tuple(
+            (d.dim_value if d.HasField("dim_value") else None)
+            if hasattr(d, "HasField") else d.dim_value
+            for d in vi.type.tensor_type.shape.dim)
+        return (vi.name, dims)
+
+    return {
+        "input_tensor_data": [info(v) for v in graph.input
+                              if v.name not in inits],
+        "output_tensor_data": [info(v) for v in graph.output],
+    }

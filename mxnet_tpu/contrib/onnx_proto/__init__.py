@@ -1,0 +1,197 @@
+"""Self-contained ONNX serialization shim.
+
+Exposes the slice of the `onnx` package API that mx.contrib.onnx uses
+(`load`/`save`, `helper.make_*`, `TensorProto` dtype enum, `numpy_helper`)
+over a vendored protobuf subset (`onnx_subset.proto`) whose field numbering
+matches the official schema byte-for-byte — models written here load in
+stock onnx/onnxruntime and vice versa. Used automatically when the real
+`onnx` package is absent (reference contrib/onnx requires the pip package;
+this removes that dependency).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import onnx_subset_pb2 as _P
+
+ModelProto = _P.ModelProto
+GraphProto = _P.GraphProto
+NodeProto = _P.NodeProto
+TensorProto = _P.TensorProto
+AttributeProto = _P.AttributeProto
+ValueInfoProto = _P.ValueInfoProto
+
+_NP_TO_ONNX = {
+    _np.dtype(_np.float32): TensorProto.FLOAT,
+    _np.dtype(_np.float64): TensorProto.DOUBLE,
+    _np.dtype(_np.float16): TensorProto.FLOAT16,
+    _np.dtype(_np.int32): TensorProto.INT32,
+    _np.dtype(_np.int64): TensorProto.INT64,
+    _np.dtype(_np.int8): TensorProto.INT8,
+    _np.dtype(_np.uint8): TensorProto.UINT8,
+    _np.dtype(_np.bool_): TensorProto.BOOL,
+}
+_ONNX_TO_NP = {v: k for k, v in _NP_TO_ONNX.items()}
+
+
+def load(path):
+    m = ModelProto()
+    with open(path, "rb") as f:
+        m.ParseFromString(f.read())
+    return m
+
+
+def save(model, path):
+    with open(path, "wb") as f:
+        f.write(model.SerializeToString())
+
+
+class numpy_helper:
+    @staticmethod
+    def to_array(t: "_P.TensorProto") -> _np.ndarray:
+        dt = _ONNX_TO_NP.get(t.data_type, _np.dtype(_np.float32))
+        shape = tuple(t.dims)
+        if t.raw_data:
+            return _np.frombuffer(t.raw_data, dtype=dt).reshape(shape).copy()
+        if t.float_data:
+            return _np.asarray(t.float_data, _np.float32).astype(dt).reshape(shape)
+        if t.int64_data:
+            return _np.asarray(t.int64_data, _np.int64).astype(dt).reshape(shape)
+        if t.int32_data:
+            return _np.asarray(t.int32_data, _np.int32).astype(dt).reshape(shape)
+        if t.double_data:
+            return _np.asarray(t.double_data, _np.float64).astype(dt).reshape(shape)
+        return _np.zeros(shape, dt)
+
+    @staticmethod
+    def from_array(arr: _np.ndarray, name: str = "") -> "_P.TensorProto":
+        t = TensorProto()
+        t.name = name
+        t.dims.extend(arr.shape)
+        t.data_type = _NP_TO_ONNX.get(arr.dtype, TensorProto.FLOAT)
+        t.raw_data = _np.ascontiguousarray(arr).tobytes()
+        return t
+
+
+class helper:
+    @staticmethod
+    def make_attribute(name, value):
+        a = AttributeProto()
+        a.name = name
+        if isinstance(value, float):
+            a.type = AttributeProto.FLOAT
+            a.f = value
+        elif isinstance(value, bool) or isinstance(value, int):
+            a.type = AttributeProto.INT
+            a.i = int(value)
+        elif isinstance(value, str):
+            a.type = AttributeProto.STRING
+            a.s = value.encode()
+        elif isinstance(value, bytes):
+            a.type = AttributeProto.STRING
+            a.s = value
+        elif isinstance(value, _P.TensorProto):
+            a.type = AttributeProto.TENSOR
+            a.t.CopyFrom(value)
+        elif isinstance(value, (list, tuple)):
+            if value and isinstance(value[0], float):
+                a.type = AttributeProto.FLOATS
+                a.floats.extend(value)
+            elif value and isinstance(value[0], str):
+                a.type = AttributeProto.STRINGS
+                a.strings.extend(v.encode() for v in value)
+            else:
+                a.type = AttributeProto.INTS
+                a.ints.extend(int(v) for v in value)
+        else:
+            raise TypeError(f"unsupported attribute value {value!r}")
+        return a
+
+    @staticmethod
+    def make_node(op_type, inputs, outputs, name=None, domain=None, **attrs):
+        n = NodeProto()
+        n.op_type = op_type
+        n.input.extend(inputs)
+        n.output.extend(outputs)
+        if name:
+            n.name = name
+        if domain:
+            n.domain = domain
+        for k, v in sorted(attrs.items()):
+            n.attribute.append(helper.make_attribute(k, v))
+        return n
+
+    @staticmethod
+    def make_tensor(name, data_type, dims, vals, raw=False):
+        t = TensorProto()
+        t.name = name
+        t.data_type = data_type
+        t.dims.extend(dims)
+        if raw:
+            t.raw_data = vals
+        elif data_type == TensorProto.FLOAT:
+            t.float_data.extend(float(v) for v in vals)
+        elif data_type == TensorProto.DOUBLE:
+            t.double_data.extend(float(v) for v in vals)
+        elif data_type in (TensorProto.INT64,):
+            t.int64_data.extend(int(v) for v in vals)
+        else:
+            t.int32_data.extend(int(v) for v in vals)
+        return t
+
+    @staticmethod
+    def make_tensor_value_info(name, elem_type, shape):
+        vi = ValueInfoProto()
+        vi.name = name
+        vi.type.tensor_type.elem_type = elem_type
+        if shape is not None:
+            for d in shape:
+                dim = vi.type.tensor_type.shape.dim.add()
+                if d is None or (isinstance(d, str)):
+                    dim.dim_param = str(d or "?")
+                else:
+                    dim.dim_value = int(d)
+        return vi
+
+    @staticmethod
+    def make_graph(nodes, name, inputs, outputs, initializer=()):
+        g = GraphProto()
+        g.name = name
+        g.node.extend(nodes)
+        g.input.extend(inputs)
+        g.output.extend(outputs)
+        g.initializer.extend(initializer)
+        return g
+
+    @staticmethod
+    def make_model(graph, producer_name="mxnet_tpu", opset=13):
+        m = ModelProto()
+        m.ir_version = 8
+        m.producer_name = producer_name
+        m.graph.CopyFrom(graph)
+        op = m.opset_import.add()
+        op.domain = ""
+        op.version = opset
+        return m
+
+
+def attr_dict(node: "_P.NodeProto"):
+    """Decode a NodeProto's attributes into a python dict."""
+    out = {}
+    for a in node.attribute:
+        T = AttributeProto
+        if a.type == T.FLOAT:
+            out[a.name] = a.f
+        elif a.type == T.INT:
+            out[a.name] = a.i
+        elif a.type == T.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == T.TENSOR:
+            out[a.name] = numpy_helper.to_array(a.t)
+        elif a.type == T.FLOATS:
+            out[a.name] = list(a.floats)
+        elif a.type == T.INTS:
+            out[a.name] = list(a.ints)
+        elif a.type == T.STRINGS:
+            out[a.name] = [s.decode() for s in a.strings]
+    return out

@@ -9,7 +9,6 @@ master weight is the f32 state and the bf16 copy is refreshed per step.
 """
 from __future__ import annotations
 
-import functools
 import math
 import pickle
 from typing import Any, Dict, Optional

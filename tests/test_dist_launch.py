@@ -1,0 +1,88 @@
+"""N-process distributed kvstore test (reference
+tests/nightly/dist_sync_kvstore.py launched via tools/launch.py --launcher
+local, ci/docker/runtime_functions.sh:1378).
+
+Spawns 2 local worker processes through tools/launch.py; each creates
+kv = mx.kv.create('dist_sync') over the jax.distributed coordinator (gloo on
+CPU here, ICI/DCN on a pod) and asserts cross-worker push/pull sums, barrier,
+and rank bookkeeping — the same math the reference test asserts against its
+parameter server.
+"""
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# one CPU device per process: the dist test exercises CROSS-process sync
+os.environ.pop("XLA_FLAGS", None)
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+kv = mx.kv.create("dist_sync")
+rank, size = kv.rank, kv.num_workers
+assert size == 2, size
+assert kv.type == "dist_sync"
+
+# 1) push/pull: each worker pushes (rank+1) * ones; server-sum = 3
+kv.init(3, nd.ones((3, 2)))
+kv.push(3, nd.ones((3, 2)) * (rank + 1))
+out = nd.zeros((3, 2))
+kv.pull(3, out=out)
+np.testing.assert_allclose(out.asnumpy(), np.full((3, 2), 3.0))
+
+# 2) pushpull fused
+kv.init("w", nd.zeros((4,)))
+o = nd.zeros((4,))
+kv.pushpull("w", nd.ones((4,)) * (rank + 1), out=o)
+np.testing.assert_allclose(o.asnumpy(), np.full((4,), 3.0))
+
+# 3) updater runs on the AGGREGATED value, identically on each worker
+kv2_store = {{}}
+def upd(key, merged, stored):
+    stored._set_data(stored._data + 0.5 * merged._data)
+kv.set_updater(upd)
+kv.init(9, nd.zeros((2,)))
+kv.push(9, nd.ones((2,)) * (rank + 1))
+out = nd.zeros((2,))
+kv.pull(9, out=out)
+np.testing.assert_allclose(out.asnumpy(), np.full((2,), 1.5))
+
+kv.barrier()
+open(os.path.join({tmp!r}, f"ok_{{rank}}"), "w").write("done")
+print("worker", rank, "ok")
+"""
+
+
+def test_launch_local_dist_sync_kvstore(tmp_path):
+    script = tmp_path / "dist_worker.py"
+    script.write_text(WORKER.format(repo=REPO, tmp=str(tmp_path)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=280)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert (tmp_path / "ok_0").exists() and (tmp_path / "ok_1").exists()
+
+
+def test_launch_help_and_server_note():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "1", "-s", "2", "--launcher", "local",
+         sys.executable, "-c", "print('hi')"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0
+    assert "collective" in r.stderr

@@ -126,3 +126,20 @@ def test_pushpull_persists_and_row_sparse_full_form():
                        row_ids=nd.array(onp.asarray([1, 0]), dtype="int32"))
     # full-form takes precedence: rows stay at their own indices
     onp.testing.assert_allclose(full.asnumpy(), table)
+
+
+def test_two_bit_gradient_compression_error_feedback():
+    """reference gradient_compression.cc: values quantize to
+    {-threshold, 0, +threshold} and the residual carries to the next push."""
+    import numpy as np
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("g", nd.zeros((4,)))
+    kv.push("g", nd.array(np.array([0.3, 0.7, -0.9, 0.0], np.float32)))
+    out = nd.zeros((4,))
+    kv.pull("g", out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.0, 0.5, -0.5, 0.0])
+    # error feedback: 0.3 residual + 0.3 new -> 0.6 >= threshold
+    kv.push("g", nd.array(np.array([0.3, 0.0, 0.0, 0.0], np.float32)))
+    kv.pull("g", out=out)
+    assert out.asnumpy()[0] == 0.5

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Collective / kvstore bandwidth measurement (reference
+tools/bandwidth/measure.py — its kvstore push/pull bandwidth harness).
+
+Measures, per tensor size:
+  - fused allreduce (psum inside one jit over the device mesh) — the path
+    gradients actually take in the fused trainer;
+  - eager kvstore push+pull through the facade (includes host dispatch).
+
+Run on any device set (8 virtual CPU devices for CI, a real mesh on a pod):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python tools/bandwidth/measure.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sizes", default="1e5,1e6,1e7",
+                    help="comma-separated element counts")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("dp",))
+    print(f"devices: {n} x {devs[0].platform}")
+
+    for size_s in args.sizes.split(","):
+        size = int(float(size_s))
+        x = jnp.ones((n, size), jnp.float32)
+        x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+
+        @jax.jit
+        def allreduce(x):
+            # psum across the mesh: each device contributes its row
+            s = jnp.sum(x, axis=0)          # XLA lowers to all-reduce
+            return jnp.sum(s)                # scalar back to host
+
+        float(allreduce(x))
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            float(allreduce(x))
+        dt = (time.perf_counter() - t0) / args.iters
+        nbytes = size * 4
+        # ring allreduce moves 2*(n-1)/n of the buffer per device
+        gbps = 2 * (n - 1) / n * nbytes / dt / 1e9
+        print(f"fused psum   {nbytes / 1e6:8.1f} MB: {dt * 1e3:7.2f} ms "
+              f"({gbps:6.2f} GB/s algo)")
+
+        kv = mx.kv.create("device")
+        kv.init(0, nd.zeros((size,)))
+        vals = [nd.ones((size,)) for _ in range(n)]
+        out = nd.zeros((size,))
+        kv.push(0, vals)
+        kv.pull(0, out=out)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            kv.push(0, vals)
+            kv.pull(0, out=out)
+            out.wait_to_read()
+        dt = (time.perf_counter() - t0) / args.iters
+        print(f"kvstore p+p  {nbytes / 1e6:8.1f} MB: {dt * 1e3:7.2f} ms "
+              f"({nbytes * 2 / dt / 1e9:6.2f} GB/s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Re-run a test many times under different seeds to expose flakiness
+(reference tools/flakiness_checker.py).
+
+Usage:
+    python tools/flakiness_checker.py tests/test_operator.py::test_topk -n 20
+    python tools/flakiness_checker.py tests.test_gluon.test_dense -n 50
+
+Each trial runs pytest in a subprocess with MXNET_TEST_SEED set to a fresh
+seed (tests/conftest.py seeds numpy + the framework RNG from it), so a
+failure report always carries the seed needed to reproduce it.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+
+def to_pytest_id(spec: str) -> str:
+    if "::" in spec or os.path.sep in spec:
+        return spec
+    # module.path.test_name -> module/path.py::test_name
+    parts = spec.split(".")
+    return os.path.join(*parts[:-1]) + ".py::" + parts[-1]
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("test", help="pytest id or dotted path of the test")
+    ap.add_argument("-n", "--num-trials", type=int, default=10)
+    ap.add_argument("-s", "--seed", type=int, default=None,
+                    help="base seed (default: random)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    test_id = to_pytest_id(args.test)
+    base = args.seed if args.seed is not None else random.randint(0, 2**31)
+    failures = []
+    for i in range(args.num_trials):
+        seed = (base + i) % (2**31)
+        env = dict(os.environ, MXNET_TEST_SEED=str(seed))
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", test_id, "-x", "-q"],
+            env=env, capture_output=not args.verbose, text=True)
+        status = "PASS" if r.returncode == 0 else "FAIL"
+        print(f"trial {i + 1}/{args.num_trials} seed={seed}: {status}")
+        if r.returncode != 0:
+            failures.append(seed)
+            if not args.verbose:
+                print(r.stdout[-2000:])
+    if failures:
+        print(f"\n{len(failures)}/{args.num_trials} trials failed; "
+              f"reproduce with MXNET_TEST_SEED={failures[0]}")
+        return 1
+    print(f"\nall {args.num_trials} trials passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Distributed job launcher (reference tools/launch.py:71 — local/ssh/mpi
+launchers for ps-lite clusters).
+
+The TPU-native cluster has no parameter servers or scheduler process: every
+worker is a jax.distributed process and gradient sync is an XLA collective
+(or the kvstore's cross-process sum for the eager push/pull path). So this
+launcher only starts N *worker* processes and wires the coordinator address
+into their environment:
+
+  MXNET_TPU_RANK / MXNET_TPU_NUM_WORKERS / MXNET_TPU_COORDINATOR
+  (+ the reference's DMLC_* names for script compatibility)
+
+Launchers:
+  local  - N subprocesses on this machine (the reference's CI pattern:
+           `launch.py -n 4 --launcher local python dist_sync_kvstore.py`,
+           ci/docker/runtime_functions.sh:1378)
+  ssh    - one worker per line of --host-file via ssh
+  mpi    - delegate process placement to mpirun
+
+-s (server count) is accepted and ignored with a note, since collectives
+replace the servers.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _worker_env(rank, n, coord):
+    env = dict(os.environ)
+    env.update({
+        "MXNET_TPU_RANK": str(rank),
+        "MXNET_TPU_NUM_WORKERS": str(n),
+        "MXNET_TPU_COORDINATOR": coord,
+        # reference-compatible names (docs/faq/distributed_training.md:260)
+        "DMLC_ROLE": "worker",
+        "DMLC_WORKER_ID": str(rank),
+        "DMLC_NUM_WORKER": str(n),
+        "DMLC_PS_ROOT_URI": coord.split(":")[0],
+        "DMLC_PS_ROOT_PORT": coord.split(":")[1],
+    })
+    return env
+
+
+def launch_local(n, command):
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = [subprocess.Popen(command, env=_worker_env(r, n, coord))
+             for r in range(n)]
+    codes = [p.wait() for p in procs]
+    return max(codes)
+
+
+def launch_ssh(n, hosts, command):
+    coord = f"{hosts[0]}:{_free_port()}"
+    procs = []
+    for r in range(n):
+        host = hosts[r % len(hosts)]
+        env = _worker_env(r, n, coord)
+        exports = " ".join(f"{k}={v}" for k, v in env.items()
+                           if k.startswith(("MXNET_TPU_", "DMLC_")))
+        procs.append(subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", host,
+             f"cd {os.getcwd()} && env {exports} {' '.join(command)}"]))
+    return max(p.wait() for p in procs)
+
+
+def launch_mpi(n, command):
+    coord = f"{socket.gethostname()}:{_free_port()}"
+    env = _worker_env(0, n, coord)
+    # rank comes from OMPI/PMI env inside each process — a fixed
+    # MXNET_TPU_RANK would make every worker claim rank 0
+    del env["MXNET_TPU_RANK"], env["DMLC_WORKER_ID"]
+    env["MXNET_TPU_RANK_FROM_MPI"] = "1"
+    return subprocess.call(["mpirun", "-n", str(n)] + command, env=env)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="ignored: XLA collectives replace parameter servers")
+    ap.add_argument("--launcher", choices=["local", "ssh", "mpi"],
+                    default="local")
+    ap.add_argument("-H", "--host-file", default=None,
+                    help="one host per line (ssh launcher)")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    if args.num_servers:
+        print("note: -s ignored — gradient sync is an XLA collective, "
+              "no parameter servers are started", file=sys.stderr)
+    if args.launcher == "local":
+        rc = launch_local(args.num_workers, args.command)
+    elif args.launcher == "ssh":
+        hosts = [l.strip() for l in open(args.host_file) if l.strip()]
+        rc = launch_ssh(args.num_workers, hosts, args.command)
+    else:
+        rc = launch_mpi(args.num_workers, args.command)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
