@@ -1,0 +1,75 @@
+"""Secondary headline benchmark: BERT-base MLM pretraining tokens/sec/chip
+(the transformer-path counterpart of bench.py; BASELINE.md north-star
+metric "BERT tokens/sec/chip". The reference repo publishes no BERT number —
+its transformer support is the contrib interleaved-matmul ops — so this
+records our absolute figure.)
+
+Same methodology as bench.py: bf16 master-weight training, whole measured
+loop inside ONE compiled on-device lax.scan (trainer.run_steps), sync via
+host transfer. Prints ONE JSON line.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BATCH = int(os.environ.get("BERT_BATCH", 16))
+SEQ = int(os.environ.get("BERT_SEQ", 512))
+STEPS = int(os.environ.get("BERT_STEPS", 20))
+VOCAB = int(os.environ.get("BERT_VOCAB", 8192))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.models import bert_base
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+
+    net = bert_base(vocab_size=VOCAB)
+    with mx.cpu():
+        net.initialize(ctx=mx.cpu())
+        net(nd.zeros((1, SEQ), ctx=mx.cpu(), dtype="int32"))
+
+    def mlm_loss(logits, labels):
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return jnp.mean(logz - gold)
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = DataParallelTrainer(
+        net, mlm_loss, optimizer="adamw",
+        optimizer_params={"learning_rate": 1e-4}, mesh=mesh,
+        dtype=os.environ.get("BERT_DTYPE", "bfloat16"))
+
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randint(0, VOCAB, (BATCH, SEQ)), dtype="int32")
+    y = nd.array(rs.randint(0, VOCAB, (BATCH, SEQ)), dtype="int32")
+
+    float(trainer.step(x, y))
+    float(trainer.run_steps(x, y, STEPS)[-1])
+    t0 = time.perf_counter()
+    float(trainer.run_steps(x, y, STEPS)[-1])
+    dt = time.perf_counter() - t0
+
+    tokens_s = BATCH * SEQ * STEPS / dt
+    print(json.dumps({
+        "metric": "bert_base_mlm_tokens_per_sec",
+        "value": round(tokens_s, 0),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

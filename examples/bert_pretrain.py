@@ -44,7 +44,11 @@ def main():
 
     maker = bert_tiny if args.model == "tiny" else bert_base
     net = maker(vocab_size=args.vocab)
-    net.initialize(ctx=mx.current_context())
+    # deferred init on CPU: eager per-op accelerator compiles are slow; the
+    # trainer device_puts finished params onto the mesh afterwards
+    with mx.cpu():
+        net.initialize(ctx=mx.cpu())
+        net(nd.zeros((1, args.seq_len), ctx=mx.cpu(), dtype="int32"))
 
     def mlm_loss(logits, labels):
         logits = logits.astype(jnp.float32)
@@ -68,12 +72,11 @@ def main():
     # MLM-style target: predict the token itself on synthetic data
     y = nd.array(tokens, dtype="int32")
 
-    loss = trainer.step(x, y)  # compile
-    float(loss)
+    float(trainer.step(x, y))               # compile the single step
+    float(trainer.run_steps(x, y, args.steps)[-1])   # compile the scan loop
     tic = time.time()
-    for step in range(args.steps):
-        loss = trainer.step(x, y)
-    lossv = float(loss)
+    losses = trainer.run_steps(x, y, args.steps)     # ONE on-device loop:
+    lossv = float(losses[-1])               # per-step host dispatch excluded
     dt = time.time() - tic
     toks = args.batch_size * args.seq_len * args.steps
     print(f"loss={lossv:.3f}  {toks / dt:.0f} tokens/s "

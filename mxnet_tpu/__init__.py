@@ -35,15 +35,9 @@ def _maybe_init_distributed():
     if not coord or n <= 1:
         return
     import jax
-    try:
-        # NB: jax.process_count() would itself initialize the XLA backend,
-        # which then forbids distributed.initialize — probe the distributed
-        # client state instead
-        from jax._src import distributed as _dist
-        if _dist.global_state.client is not None:
-            return  # already initialized by the caller
-    except Exception:
-        pass
+    from ._dist_util import dist_client_active
+    if dist_client_active():
+        return  # already initialized by the caller
     if os.environ.get("MXNET_TPU_RANK_FROM_MPI"):
         rank = (os.environ.get("OMPI_COMM_WORLD_RANK")
                 or os.environ.get("PMI_RANK") or "0")

@@ -1,0 +1,15 @@
+"""Shared jax.distributed probes (no package-level imports — this must be
+importable before anything touches the XLA backend)."""
+from __future__ import annotations
+
+
+def dist_client_active() -> bool:
+    """Whether jax.distributed is already initialized, WITHOUT calling
+    jax.process_count() (which would initialize the XLA backend and make a
+    later jax.distributed.initialize impossible). Probes jax's private
+    distributed state — the single place to update on a jax upgrade."""
+    try:
+        from jax._src import distributed as _dist
+        return _dist.global_state.client is not None
+    except Exception:
+        return False

@@ -147,8 +147,22 @@ def _attrs_for(op_name: str, p: Dict) -> Dict:
     return {}
 
 
+def _split_pads(at, ndim):
+    """ONNX pads = [d1_begin..dn_begin, d1_end..dn_end]. Returns
+    (symmetric_tuple, None) when begin == end, else (None, (begins, ends))
+    so the caller can insert an explicit Pad."""
+    pads = at.get("pads")
+    if not pads:
+        return (0,) * ndim, None
+    begins = tuple(int(v) for v in pads[:ndim])
+    ends = tuple(int(v) for v in pads[ndim:2 * ndim])
+    if begins == ends:
+        return begins, None
+    return None, (begins, ends)
+
+
 def _node_attrs(node) -> Dict:
-    if hasattr(_onnx, "attr_dict") or _onnx is _shim:
+    if _onnx is _shim:
         return _shim.attr_dict(node)
     out = {}
     for a in node.attribute:
@@ -204,12 +218,20 @@ def import_model(model_file: str):
             k = at.get("kernel_shape", (3, 3))
             no_bias = len(node.input) < 3
             w = params.get(node.input[1])
+            sym_pad, asym = _split_pads(at, len(k))
+            data_in = ins[0]
+            if asym is not None:
+                begins, ends = asym
+                pw = (0, 0, 0, 0) + sum(zip(begins, ends), ())
+                data_in = sym_mod.pad(data_in, mode="constant",
+                                      pad_width=pw, constant_value=0)
+                sym_pad = (0,) * len(k)
             out = sym_mod.Convolution(
-                ins[0], env[node.input[1]],
+                data_in, env[node.input[1]],
                 None if no_bias else env[node.input[2]],
                 kernel=tuple(k), num_filter=int(w.shape[0]) if w is not None else 0,
                 stride=tuple(at.get("strides", (1,) * len(k))),
-                pad=tuple(at.get("pads", (0,) * 2 * len(k))[:len(k)]),
+                pad=sym_pad,
                 dilate=tuple(at.get("dilations", (1,) * len(k))),
                 num_group=int(at.get("group", 1)), no_bias=no_bias)
         elif op == "Gemm":
@@ -218,11 +240,26 @@ def import_model(model_file: str):
                 num_hidden = 0
             else:
                 num_hidden = int(w.shape[0] if at.get("transB") else w.shape[1])
-            out = sym_mod.FullyConnected(
-                ins[0], env[node.input[1]],
-                env[node.input[2]] if len(node.input) > 2 else None,
-                num_hidden=num_hidden,
-                no_bias=len(node.input) < 3)
+            alpha = float(at.get("alpha", 1.0))
+            beta = float(at.get("beta", 1.0))
+            a_in = ins[0]
+            if at.get("transA"):
+                a_in = sym_mod.transpose(a_in)
+            has_c = len(node.input) > 2
+            if alpha == 1.0 and beta == 1.0:
+                out = sym_mod.FullyConnected(
+                    a_in, env[node.input[1]],
+                    env[node.input[2]] if has_c else None,
+                    num_hidden=num_hidden, no_bias=not has_c)
+            else:
+                # alpha*A.B (+ beta*C): scale around a bias-free FC
+                ab = sym_mod.FullyConnected(
+                    a_in, env[node.input[1]], None,
+                    num_hidden=num_hidden, no_bias=True)
+                out = ab * alpha
+                if has_c:
+                    out = sym_mod.broadcast_add(
+                        out, env[node.input[2]] * beta)
             if not at.get("transB") and w is not None:
                 # FullyConnected expects (out, in): pre-transpose the param
                 params[node.input[1]] = _np.ascontiguousarray(w.T)
@@ -237,11 +274,23 @@ def import_model(model_file: str):
                                     slope=float(at.get("alpha", 0.01)))
         elif op in ("MaxPool", "AveragePool"):
             k = at.get("kernel_shape", (2, 2))
+            sym_pad, asym = _split_pads(at, len(k))
+            data_in = ins[0]
+            if asym is not None:
+                begins, ends = asym
+                pw = (0, 0, 0, 0) + sum(zip(begins, ends), ())
+                # max-pool pads with -inf semantics in ONNX; constant 0 only
+                # matters for avg with count_include_pad — document via value
+                data_in = sym_mod.pad(data_in, mode="edge", pad_width=pw) \
+                    if op == "MaxPool" else sym_mod.pad(
+                        data_in, mode="constant", pad_width=pw,
+                        constant_value=0)
+                sym_pad = (0,) * len(k)
             out = sym_mod.Pooling(
-                ins[0], kernel=tuple(k),
+                data_in, kernel=tuple(k),
                 pool_type="max" if op == "MaxPool" else "avg",
                 stride=tuple(at.get("strides", (1,) * len(k))),
-                pad=tuple(at.get("pads", (0,) * 2 * len(k))[:len(k)]))
+                pad=sym_pad)
         elif op == "GlobalAveragePool":
             out = sym_mod.Pooling(ins[0], kernel=(1, 1), pool_type="avg",
                                   global_pool=True)
@@ -285,6 +334,9 @@ def import_model(model_file: str):
         elif op == "Identity":
             out = sym_mod.identity(ins[0])
         elif op == "Gather":
+            if int(at.get("axis", 0)) != 0:
+                raise MXNetError("ONNX import: Gather supports axis=0 only "
+                                 "(Embedding-style lookup)")
             w = params.get(node.input[0])
             out = sym_mod.Embedding(
                 ins[1], env[node.input[0]],
@@ -328,9 +380,7 @@ def import_model(model_file: str):
 
 
 def _to_array(tensor) -> _np.ndarray:
-    if _onnx is _shim:
-        return _shim.numpy_helper.to_array(tensor)
-    return _onh.to_array(tensor)
+    return _onh.to_array(tensor)  # shim or pip onnx — aliased at import
 
 
 def get_model_metadata(model_file: str):

@@ -29,15 +29,7 @@ from ..ndarray import NDArray
 from .. import optimizer as opt_mod
 
 
-def _dist_client_active() -> bool:
-    """Whether jax.distributed is already initialized, WITHOUT touching
-    jax.process_count() (which would initialize the XLA backend and make a
-    later jax.distributed.initialize impossible)."""
-    try:
-        from jax._src import distributed as _dist
-        return _dist.global_state.client is not None
-    except Exception:
-        return False
+from .._dist_util import dist_client_active as _dist_client_active
 
 
 class KVStore:

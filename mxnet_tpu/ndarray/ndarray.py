@@ -476,12 +476,20 @@ def invoke(op: Union[str, Op], inputs: Sequence[NDArray], params: Dict[str, Any]
                  and any(x._ag_node is not None for x in inputs))
     vjp_fn = None
     was_tuple = False
-    if need_grad:
-        # vjp over the unjitted fn: linearizing through an inner pjit breaks
-        # for some primitives (reduce_window_max) on this jax version
-        outs_raw, vjp_fn = jax.vjp(op.unbound(params), *raw)
-    else:
-        outs_raw = op(*raw, **params)
+    from ..ops import registry as _reg
+    _plat = _reg._platform_of(raw)
+    _tok = _reg.exec_platform.set(_plat) if _plat is not None else None
+    try:
+        if need_grad:
+            # vjp over the unjitted fn: linearizing through an inner pjit
+            # breaks for some primitives (reduce_window_max) on this jax
+            # version
+            outs_raw, vjp_fn = jax.vjp(op.unbound(params), *raw)
+        else:
+            outs_raw = op(*raw, **params)
+    finally:
+        if _tok is not None:
+            _reg.exec_platform.reset(_tok)
     if isinstance(outs_raw, tuple):
         was_tuple = True
     else:

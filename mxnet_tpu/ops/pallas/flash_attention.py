@@ -52,6 +52,12 @@ def _on_tpu(x) -> bool:
         devs = x.devices()
         return all(d.platform == "tpu" for d in devs)
     except Exception:  # tracer — no concrete placement
+        from ..registry import exec_platform
+        plat = exec_platform.get()
+        if plat is not None:
+            # the surrounding invoke/compile recorded what backend this
+            # computation is actually being built for
+            return plat == "tpu"
         dev = jax.config.jax_default_device
         if dev is not None:
             return getattr(dev, "platform", str(dev)) == "tpu"

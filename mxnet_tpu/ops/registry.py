@@ -20,6 +20,7 @@ may declare a `params` spec used for doc + coercion of list->tuple etc.
 """
 from __future__ import annotations
 
+import contextvars
 import functools
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -29,6 +30,25 @@ import numpy as _np
 from ..base import MXNetError, env
 
 _OP_REGISTRY: Dict[str, "Op"] = {}
+
+# Platform the CURRENT computation is being built for. Backend-dependent op
+# lowerings (e.g. Pallas flash attention vs the lax.scan fallback) cannot
+# trust jax.default_backend() under a trace — on a machine with a TPU plugin
+# it says "tpu" even while jit is compiling for CPU arrays. The eager invoke
+# path and the graph compilers set this from the CONCRETE inputs/devices.
+exec_platform: contextvars.ContextVar = contextvars.ContextVar(
+    "mxnet_tpu_exec_platform", default=None)
+
+
+def _platform_of(arrays) -> Optional[str]:
+    for a in arrays:
+        try:
+            devs = a.devices()
+        except Exception:
+            continue
+        for d in devs:
+            return d.platform
+    return None
 
 
 def _hashable(v):
@@ -88,7 +108,14 @@ class Op:
     def __call__(self, *arrays, **params):
         if any(isinstance(a, jax.core.Tracer) for a in arrays):
             return self.unbound(params)(*arrays)
-        return self.bound(params)(*arrays)
+        plat = _platform_of(arrays)
+        if plat is None:
+            return self.bound(params)(*arrays)
+        token = exec_platform.set(plat)
+        try:
+            return self.bound(params)(*arrays)
+        finally:
+            exec_platform.reset(token)
 
     def __repr__(self):
         return f"<Op {self.name}>"

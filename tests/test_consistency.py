@@ -1,0 +1,127 @@
+"""Cross-dtype consistency suite (reference tests/python/gpu/
+test_operator_gpu.py pattern: the same op run under different backends/dtypes
+must agree within dtype-appropriate tolerance; with no second hardware
+backend in CI, fp32-vs-low-precision is the substitute — SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run(fn, inputs_np, dtype):
+    ins = [nd.array(a).astype(dtype) for a in inputs_np]
+    out = fn(*ins)
+    out = out[0] if isinstance(out, list) else out
+    return out.asnumpy().astype(np.float64)
+
+
+_RTOL = {"float16": 2e-2, "bfloat16": 6e-2}
+
+
+def _consistent(fn, inputs_np, dtypes=("float16", "bfloat16")):
+    ref = _run(fn, inputs_np, "float32")
+    for dt in dtypes:
+        got = _run(fn, inputs_np, dt)
+        assert_almost_equal(ref, got, rtol=_RTOL[dt], atol=_RTOL[dt],
+                            names=("float32", dt))
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(7)
+
+
+def test_convolution_consistency(rng):
+    x = rng.randn(2, 3, 10, 10).astype(np.float32)
+    w = rng.randn(8, 3, 3, 3).astype(np.float32) * 0.2
+    b = rng.randn(8).astype(np.float32) * 0.1
+    _consistent(lambda a, c, d: nd.Convolution(
+        a, c, d, kernel=(3, 3), num_filter=8, pad=(1, 1)), [x, w, b])
+
+
+def test_fully_connected_consistency(rng):
+    x = rng.randn(4, 32).astype(np.float32)
+    w = rng.randn(16, 32).astype(np.float32) * 0.2
+    b = np.zeros(16, np.float32)
+    _consistent(lambda a, c, d: nd.FullyConnected(
+        a, c, d, num_hidden=16), [x, w, b])
+
+
+def test_pooling_consistency(rng):
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+    _consistent(lambda a: nd.Pooling(a, kernel=(2, 2), stride=(2, 2),
+                                     pool_type="max"), [x])
+    _consistent(lambda a: nd.Pooling(a, kernel=(2, 2), stride=(2, 2),
+                                     pool_type="avg"), [x])
+
+
+def test_batchnorm_consistency(rng):
+    x = rng.randn(4, 3, 6, 6).astype(np.float32)
+    g = np.abs(rng.randn(3)).astype(np.float32) + 0.5
+    b = rng.randn(3).astype(np.float32) * 0.1
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+
+    def f(a, gg, bb, m1, m2):
+        out = nd.BatchNorm(a, gg, bb, m1, m2, fix_gamma=False,
+                           use_global_stats=True)
+        return out[0] if isinstance(out, list) else out
+
+    _consistent(f, [x, g, b, mm, mv])
+
+
+def test_softmax_activation_consistency(rng):
+    x = rng.randn(4, 10).astype(np.float32)
+    _consistent(lambda a: nd.softmax(a, axis=-1), [x])
+    _consistent(lambda a: nd.Activation(a, act_type="tanh"), [x])
+    _consistent(lambda a: nd.Activation(a, act_type="sigmoid"), [x])
+
+
+def test_elemwise_broadcast_consistency(rng):
+    a = rng.randn(4, 5).astype(np.float32)
+    b = rng.randn(1, 5).astype(np.float32)
+    _consistent(lambda x, y: nd.broadcast_add(x, y), [a, b])
+    _consistent(lambda x, y: nd.broadcast_mul(x, y), [a, b])
+    _consistent(lambda x: nd.exp(nd.clip(x, a_min=-4, a_max=4)), [a])
+
+
+def test_reduce_consistency(rng):
+    x = rng.randn(3, 4, 5).astype(np.float32)
+    _consistent(lambda a: nd.sum(a, axis=(1, 2)), [x])
+    _consistent(lambda a: nd.mean(a, axis=1), [x])
+    _consistent(lambda a: nd.max(a, axis=0), [x])
+
+
+def test_dot_consistency(rng):
+    a = rng.randn(8, 16).astype(np.float32)
+    b = rng.randn(16, 8).astype(np.float32)
+    _consistent(lambda x, y: nd.dot(x, y), [a, b])
+
+
+def test_flash_attention_consistency(rng):
+    q = rng.randn(1, 2, 16, 8).astype(np.float32) * 0.5
+    k = rng.randn(1, 2, 16, 8).astype(np.float32) * 0.5
+    v = rng.randn(1, 2, 16, 8).astype(np.float32)
+    _consistent(lambda a, b, c: nd.invoke(
+        "_contrib_flash_attention", [a, b, c], {}), [q, k, v])
+
+
+def test_gradient_consistency_through_dtypes(rng):
+    """Backward pass agrees across dtypes too (the AMP training contract)."""
+    from mxnet_tpu import autograd
+    x_np = rng.randn(4, 8).astype(np.float32)
+    w_np = rng.randn(8, 8).astype(np.float32) * 0.3
+    grads = {}
+    for dt in ("float32", "bfloat16"):
+        x = nd.array(x_np).astype(dt)
+        w = nd.array(w_np).astype(dt)
+        w.attach_grad()
+        with autograd.record():
+            y = nd.dot(x, w)
+            loss = nd.sum(y * y)
+        loss.backward()
+        grads[dt] = w.grad.asnumpy().astype(np.float64)
+    assert_almost_equal(grads["float32"], grads["bfloat16"], rtol=6e-2,
+                        atol=6e-2, names=("f32-grad", "bf16-grad"))

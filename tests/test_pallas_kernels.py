@@ -149,3 +149,32 @@ def test_fused_adam_matches_reference():
         ref = w - 1e-3 * mhat / (jnp.sqrt(vhat) + 1e-8)
         np.testing.assert_allclose(np.asarray(wn), np.asarray(ref),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_flash_dispatch_respects_exec_platform():
+    """Regression: under a trace, the Pallas-vs-fallback decision must use
+    the execution platform recorded by the surrounding invoke/compile, not
+    jax.default_backend() (which says 'tpu' on a TPU machine even while
+    compiling for CPU arrays — that crashed CPU deferred-init of models
+    containing flash attention)."""
+    import importlib
+    from mxnet_tpu.ops import registry
+    # the package __init__ re-exports the flash_attention FUNCTION under the
+    # same name — load the module itself
+    fa = importlib.import_module("mxnet_tpu.ops.pallas.flash_attention")
+
+    class TracerLike:
+        def devices(self):
+            raise AttributeError("tracers have no concrete placement")
+
+    tok = registry.exec_platform.set("cpu")
+    try:
+        assert fa._on_tpu(TracerLike()) is False
+    finally:
+        registry.exec_platform.reset(tok)
+    tok = registry.exec_platform.set("tpu")
+    try:
+        if fa._HAS_PALLAS:
+            assert fa._on_tpu(TracerLike()) is True
+    finally:
+        registry.exec_platform.reset(tok)

@@ -54,12 +54,38 @@ def _worker_env(rank, n, coord):
     return env
 
 
+def _wait_all(procs):
+    """Wait for every worker; on the FIRST failure kill the survivors (the
+    reference launcher's behavior) so a pre-rendezvous crash can't leave the
+    rest blocked in the coordinator forever. Any non-zero/signal exit makes
+    the launcher fail."""
+    import time
+    failed = None
+    while True:
+        running = [p for p in procs if p.poll() is None]
+        for p in procs:
+            rc = p.poll()
+            if rc is not None and rc != 0 and failed is None:
+                failed = rc
+        if failed is not None:
+            for p in running:
+                p.terminate()
+            for p in running:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            return 1
+        if not running:
+            return 0
+        time.sleep(0.2)
+
+
 def launch_local(n, command):
     coord = f"127.0.0.1:{_free_port()}"
     procs = [subprocess.Popen(command, env=_worker_env(r, n, coord))
              for r in range(n)]
-    codes = [p.wait() for p in procs]
-    return max(codes)
+    return _wait_all(procs)
 
 
 def launch_ssh(n, hosts, command):
@@ -73,7 +99,7 @@ def launch_ssh(n, hosts, command):
         procs.append(subprocess.Popen(
             ["ssh", "-o", "StrictHostKeyChecking=no", host,
              f"cd {os.getcwd()} && env {exports} {' '.join(command)}"]))
-    return max(p.wait() for p in procs)
+    return _wait_all(procs)
 
 
 def launch_mpi(n, command):
