@@ -1,0 +1,75 @@
+"""Long-context attention benchmark: Pallas flash attention fwd+bwd at
+growing sequence lengths (the capability the reference lacks entirely —
+SURVEY.md §5-g; its longest-sequence support is bucketing).
+
+O(T) memory: naive attention materializes the (T, T) score matrix —
+bf16 at T=32k that is 2 GB per head — while the flash kernel streams
+blocks, so sequence length scales until HBM holds Q/K/V only.
+
+Prints one line per length; methodology per bench.py (single jit, scan
+loop, host-transfer sync).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+B = int(os.environ.get("LC_BATCH", 1))
+H = int(os.environ.get("LC_HEADS", 16))
+D = int(os.environ.get("LC_DIM", 64))
+STEPS = int(os.environ.get("LC_STEPS", 10))
+LENGTHS = [int(t) for t in os.environ.get(
+    "LC_LENGTHS", "4096,8192,16384,32768").split(",")]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+
+    for T in LENGTHS:
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(B, H, T, D) * 0.1, jnp.bfloat16)
+        k = jnp.asarray(rng.randn(B, H, T, D) * 0.1, jnp.bfloat16)
+        v = jnp.asarray(rng.randn(B, H, T, D) * 0.1, jnp.bfloat16)
+
+        @jax.jit
+        def run(q, k, v):
+            def body(c, _):
+                def loss(q, k, v):
+                    return jnp.sum(flash_attention(
+                        q, k, v, causal=True).astype(jnp.float32))
+                # differentiate w.r.t. ALL of q/k/v: closure-captured k/v
+                # would let AD prune the dK/dV work the FLOP model charges
+                l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+                gsum = sum(jnp.sum(g.astype(jnp.float32)) for g in grads)
+                return c + l + gsum * 0, None
+            out, _ = lax.scan(body, jnp.float32(0), None, length=STEPS)
+            return out
+
+        try:
+            float(run(q, k, v))
+            t0 = time.perf_counter()
+            float(run(q, k, v))
+            dt = time.perf_counter() - t0
+        except Exception as e:  # noqa: BLE001 — report OOM per length
+            print(f"T={T:>6}: FAILED ({type(e).__name__})")
+            continue
+        # causal attention FLOPs: fwd = 2 matmuls x 2*B*H*T^2*D, halved by
+        # causality = 2*B*H*T^2*D; bwd (dQ,dK,dV + S recompute ~ 5 matmuls)
+        # = 2.5x fwd. Total 3.5 * 2 * B*H*T^2*D.
+        flops = 7.0 * B * H * T * T * D * STEPS
+        toks = B * T * STEPS
+        print(f"T={T:>6}: {toks / dt:>10.0f} tokens/s  "
+              f"{flops / dt / 1e12:6.1f} TF/s  ({dt / STEPS * 1e3:6.1f} ms/step)")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,6 +92,7 @@ from . import monitor
 from . import operator
 from . import visualization
 from . import rtc
+from . import library
 from . import name
 from . import attribute
 from .attribute import AttrScope

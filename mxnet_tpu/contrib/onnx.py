@@ -161,6 +161,19 @@ def _split_pads(at, ndim):
     return None, (begins, ends)
 
 
+def _apply_pads(sym_mod, data_in, at, ndim, mode="constant"):
+    """Resolve ONNX pads onto (possibly explicitly padded) input + a
+    symmetric pad tuple for the op (shared by Conv and the pooling ops)."""
+    sym_pad, asym = _split_pads(at, ndim)
+    if asym is None:
+        return data_in, sym_pad
+    begins, ends = asym
+    pw = (0, 0, 0, 0) + sum(zip(begins, ends), ())
+    kwargs = {"constant_value": 0} if mode == "constant" else {}
+    return (sym_mod.pad(data_in, mode=mode, pad_width=pw, **kwargs),
+            (0,) * ndim)
+
+
 def _node_attrs(node) -> Dict:
     if _onnx is _shim:
         return _shim.attr_dict(node)
@@ -218,14 +231,7 @@ def import_model(model_file: str):
             k = at.get("kernel_shape", (3, 3))
             no_bias = len(node.input) < 3
             w = params.get(node.input[1])
-            sym_pad, asym = _split_pads(at, len(k))
-            data_in = ins[0]
-            if asym is not None:
-                begins, ends = asym
-                pw = (0, 0, 0, 0) + sum(zip(begins, ends), ())
-                data_in = sym_mod.pad(data_in, mode="constant",
-                                      pad_width=pw, constant_value=0)
-                sym_pad = (0,) * len(k)
+            data_in, sym_pad = _apply_pads(sym_mod, ins[0], at, len(k))
             out = sym_mod.Convolution(
                 data_in, env[node.input[1]],
                 None if no_bias else env[node.input[2]],
@@ -274,23 +280,37 @@ def import_model(model_file: str):
                                     slope=float(at.get("alpha", 0.01)))
         elif op in ("MaxPool", "AveragePool"):
             k = at.get("kernel_shape", (2, 2))
-            sym_pad, asym = _split_pads(at, len(k))
-            data_in = ins[0]
-            if asym is not None:
-                begins, ends = asym
-                pw = (0, 0, 0, 0) + sum(zip(begins, ends), ())
-                # max-pool pads with -inf semantics in ONNX; constant 0 only
-                # matters for avg with count_include_pad — document via value
-                data_in = sym_mod.pad(data_in, mode="edge", pad_width=pw) \
-                    if op == "MaxPool" else sym_mod.pad(
-                        data_in, mode="constant", pad_width=pw,
-                        constant_value=0)
-                sym_pad = (0,) * len(k)
-            out = sym_mod.Pooling(
-                data_in, kernel=tuple(k),
-                pool_type="max" if op == "MaxPool" else "avg",
-                stride=tuple(at.get("strides", (1,) * len(k))),
-                pad=sym_pad)
+            strides = tuple(at.get("strides", (1,) * len(k)))
+            # ONNX default count_include_pad=0: padded cells are excluded
+            # from the average's divisor
+            incl = bool(at.get("count_include_pad", 0))
+            if op == "MaxPool":
+                # edge-padding is equivalent to ONNX's -inf pad for max
+                data_in, sym_pad = _apply_pads(sym_mod, ins[0], at, len(k),
+                                               mode="edge")
+                out = sym_mod.Pooling(data_in, kernel=tuple(k),
+                                      pool_type="max", stride=strides,
+                                      pad=sym_pad)
+            else:
+                data_in, sym_pad = _apply_pads(sym_mod, ins[0], at, len(k))
+                out = sym_mod.Pooling(
+                    data_in, kernel=tuple(k), pool_type="avg",
+                    stride=strides, pad=sym_pad,
+                    count_include_pad=incl)
+                if not incl and data_in is not ins[0]:
+                    # explicit pre-pad hid the padding from the op: rebuild
+                    # the exclude-pad divisor with a ones-mask pool
+                    ones = sym_mod.ones_like(ins[0])
+                    ones_p, _ = _apply_pads(sym_mod, ones, at, len(k))
+                    cnt = sym_mod.Pooling(
+                        ones_p, kernel=tuple(k), pool_type="avg",
+                        stride=strides, pad=sym_pad,
+                        count_include_pad=True)
+                    out = sym_mod.broadcast_div(
+                        sym_mod.Pooling(
+                            data_in, kernel=tuple(k), pool_type="avg",
+                            stride=strides, pad=sym_pad,
+                            count_include_pad=True), cnt)
         elif op == "GlobalAveragePool":
             out = sym_mod.Pooling(ins[0], kernel=(1, 1), pool_type="avg",
                                   global_pool=True)

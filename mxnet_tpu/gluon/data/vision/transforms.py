@@ -193,8 +193,78 @@ class RandomColorJitter(Block):
             self._ts.append(RandomContrast(contrast))
         if saturation:
             self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
 
     def forward(self, x):
         for t in self._ts:
             x = t(x)
         return x
+
+
+class RandomHue(Block):
+    """Random hue jitter (reference transforms.py RandomHue): rotate RGB
+    around the luminance axis by the YIQ hue-rotation matrix."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        a = _np.asarray(x, dtype=_np.float32)
+        f = _np.random.uniform(-self._h, self._h)
+        theta = f * _np.pi
+        u, w = _np.cos(theta), _np.sin(theta)
+        t_yiq = _np.array([[0.299, 0.587, 0.114],
+                           [0.596, -0.274, -0.321],
+                           [0.211, -0.523, 0.311]], _np.float32)
+        t_rgb = _np.array([[1.0, 0.956, 0.621],
+                           [1.0, -0.272, -0.647],
+                           [1.0, -1.107, 1.705]], _np.float32)
+        rot = _np.diag(_np.array([1.0, u, u], _np.float32))
+        rot[1, 2] = -w
+        rot[2, 1] = w
+        m = t_rgb @ rot @ t_yiq
+        return _np.clip(a @ m.T, 0, 255)
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (reference transforms.py
+    RandomLighting)."""
+
+    _EIGVAL = _np.array([55.46, 4.794, 1.148], _np.float32)
+    _EIGVEC = _np.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], _np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        a = _np.asarray(x, dtype=_np.float32)
+        alpha = _np.random.normal(0, self._alpha, 3).astype(_np.float32)
+        shift = self._EIGVEC @ (alpha * self._EIGVAL)
+        return _np.clip(a + shift, 0, 255)
+
+
+class CropResize(Block):
+    """Fixed crop then resize (reference transforms.py CropResize)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._box = (x, y, width, height)
+        self._size = size
+        self._interp = interpolation
+
+    def forward(self, img):
+        from .... import image as _img
+        x0, y0, w, h = self._box
+        a = _np.asarray(img)
+        out = a[y0:y0 + h, x0:x0 + w]
+        if self._size:
+            sz = self._size if isinstance(self._size, (tuple, list)) \
+                else (self._size, self._size)
+            out = _np.asarray(_img.imresize(
+                array(out), sz[0], sz[1], interp=self._interp).asnumpy())
+        return out

@@ -1,0 +1,53 @@
+"""Runtime operator-library loading (reference python/mxnet/library.py:28
+`mx.library.load` -> MXLoadLib, include/mxnet/lib_api.h).
+
+The reference loads a compiled .so exporting the C operator ABI. Here custom
+operators are pure-jax functions registered through the same registry the
+built-ins use, so an "operator library" is a Python module (or package
+directory) that calls `mxnet_tpu.ops.register(...)` at import time. `load`
+imports it by file path and reports the newly registered operators — after
+which they are live in `mx.nd`, `mx.sym` and hybridized blocks exactly like
+MXLoadLib-loaded ops were.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+from .base import MXNetError
+from .ops.registry import all_ops
+
+
+def load(path, verbose=True):
+    """Load an operator library (a Python module registering ops).
+
+    Returns the list of operator names the library registered.
+    """
+    if not os.path.exists(path):
+        raise MXNetError(f"library not found: {path}")
+    if path.endswith(".so"):
+        raise MXNetError(
+            "compiled operator libraries use the reference's C ABI; here an "
+            "operator library is a Python module calling "
+            "mxnet_tpu.ops.register — see mxnet_tpu/operator.py for the "
+            "CustomOp alternative")
+    if os.path.isdir(path):
+        init = os.path.join(path, "__init__.py")
+        if not os.path.exists(init):
+            raise MXNetError(
+                f"operator-library package {path} has no __init__.py")
+        path = init
+    before = set(all_ops())
+    name = f"mxnet_tpu_oplib_{os.path.basename(os.path.dirname(path) if path.endswith('__init__.py') else path).rsplit('.', 1)[0]}"
+    spec = importlib.util.spec_from_file_location(name, path)
+    if spec is None or spec.loader is None:
+        raise MXNetError(f"cannot import operator library {path}")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    new_ops = sorted(set(all_ops()) - before)
+    if verbose:
+        for op in new_ops:
+            print(f"loaded op: {op}")
+    return new_ops

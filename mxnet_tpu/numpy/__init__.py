@@ -31,6 +31,29 @@ class ndarray(NDArray):
     def __repr__(self):
         return f"array({self.asnumpy()!r})".replace("array(array", "array(")
 
+    # Arithmetic follows NUMPY promotion rules (true division, weak-type
+    # scalar promotion) — NOT the legacy nd semantics where the scalar is
+    # cast to the tensor dtype (int32/2 == 0 there). Routed through _apply
+    # so the autograd tape records.
+    def _np_bin(self, other, jfn, reverse=False):
+        a, b = (other, self) if reverse else (self, other)
+        return _apply(jfn, (a, b), {})
+
+    def __add__(self, o): return self._np_bin(o, jnp.add)
+    def __radd__(self, o): return self._np_bin(o, jnp.add, True)
+    def __sub__(self, o): return self._np_bin(o, jnp.subtract)
+    def __rsub__(self, o): return self._np_bin(o, jnp.subtract, True)
+    def __mul__(self, o): return self._np_bin(o, jnp.multiply)
+    def __rmul__(self, o): return self._np_bin(o, jnp.multiply, True)
+    def __truediv__(self, o): return self._np_bin(o, jnp.true_divide)
+    def __rtruediv__(self, o): return self._np_bin(o, jnp.true_divide, True)
+    def __floordiv__(self, o): return self._np_bin(o, jnp.floor_divide)
+    def __rfloordiv__(self, o): return self._np_bin(o, jnp.floor_divide, True)
+    def __mod__(self, o): return self._np_bin(o, jnp.mod)
+    def __rmod__(self, o): return self._np_bin(o, jnp.mod, True)
+    def __pow__(self, o): return self._np_bin(o, jnp.power)
+    def __rpow__(self, o): return self._np_bin(o, jnp.power, True)
+
     # numpy-style methods delegate to module functions
     def mean(self, axis=None, dtype=None, keepdims=False):
         return mean(self, axis=axis, dtype=dtype, keepdims=keepdims)

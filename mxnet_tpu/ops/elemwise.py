@@ -187,6 +187,14 @@ _SCALAR = {
 }
 for _name, _f in _SCALAR.items():
     def _sfn(x, *, scalar, _f=_f):
+        # reference semantics (elemwise_binary_scalar_op.h): scalar cast to
+        # the TENSOR's dtype and the result stays in that dtype — int32 + 1
+        # is int32, int division truncates (jnp's true-divide would weak-
+        # promote to float)
+        xd = jnp.asarray(x).dtype
+        if jnp.issubdtype(xd, jnp.number):
+            s = jnp.asarray(scalar, xd)
+            return _f(x, s).astype(xd)
         return _f(x, scalar)
     register(_name)(_sfn)
 

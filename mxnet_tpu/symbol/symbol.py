@@ -621,7 +621,9 @@ def _op_arg_spec(op: Op) -> Tuple[Tuple[str, bool], ...]:
     try:
         sig = inspect.signature(op.fn)
         for p in sig.parameters.values():
-            if p.kind == p.POSITIONAL_OR_KEYWORD:
+            # POSITIONAL_ONLY too: jnp ufunc-style fns are `(x1, x2, /)`
+            # (jnp.divide et al.) — missing them dropped the op's inputs
+            if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY):
                 spec.append((p.name, p.default is p.empty))
             elif p.kind == p.VAR_POSITIONAL:
                 spec.append(("*" + p.name, False))

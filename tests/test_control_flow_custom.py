@@ -151,3 +151,24 @@ def test_cond_mixed_inputs():
 def test_foreach_rejects_non_ndarray():
     with pytest.raises(Exception):
         foreach(lambda x, s: (x, s), [nd.zeros((2, 1)), 1.5], nd.zeros((1,)))
+
+
+def test_library_load_registers_ops(tmp_path):
+    """mx.library.load parity (reference library.py:28 / MXLoadLib): an
+    operator library is a Python module registering ops at import."""
+    lib = tmp_path / "my_oplib.py"
+    lib.write_text(
+        "import jax.numpy as jnp\n"
+        "from mxnet_tpu.ops import register\n"
+        "@register('_custom_double_it')\n"
+        "def double_it(x):\n"
+        "    return x * 2\n")
+    new = mx.library.load(str(lib), verbose=False)
+    assert "_custom_double_it" in new
+    out = nd.invoke("_custom_double_it", [nd.ones((3,))], {})
+    assert out.asnumpy().tolist() == [2, 2, 2]
+    # visible through the symbol namespace too
+    import mxnet_tpu.symbol as sym
+    s = sym._custom_double_it(sym.Variable("x"))
+    e = s.bind(mx.cpu(), {"x": nd.ones((2,))})
+    assert e.forward()[0].asnumpy().tolist() == [2, 2]

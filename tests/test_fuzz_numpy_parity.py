@@ -1,0 +1,157 @@
+"""Differential fuzz: nd ops vs numpy reference semantics over randomized
+shapes (fixed seeds — reference tests/python/unittest/test_operator.py's
+property-style checks, condensed)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+SHAPES = [(3,), (2, 4), (3, 1, 5), (2, 3, 2, 2)]
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_elemwise_binary_broadcast(shape):
+    rng = np.random.RandomState(hash(shape) % 2**31)
+    a = rng.randn(*shape).astype(np.float32)
+    bshape = tuple(1 if rng.rand() < 0.4 else s for s in shape)
+    b = rng.randn(*bshape).astype(np.float32) + 2.0
+    for name, ref in [("broadcast_add", np.add),
+                      ("broadcast_sub", np.subtract),
+                      ("broadcast_mul", np.multiply),
+                      ("broadcast_div", np.divide),
+                      ("broadcast_maximum", np.maximum),
+                      ("broadcast_minimum", np.minimum),
+                      ("broadcast_power", np.power),
+                      ("broadcast_hypot", np.hypot)]:
+        if name == "broadcast_power":
+            aa, bb = np.abs(a) + 0.5, np.clip(b, -2, 2)
+        else:
+            aa, bb = a, b
+        got = _np(getattr(nd, name)(nd.array(aa), nd.array(bb)))
+        np.testing.assert_allclose(got, ref(aa, bb), rtol=2e-5, atol=1e-5,
+                                   err_msg=name)
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_reductions_all_axes(shape):
+    rng = np.random.RandomState(hash(shape) % 2**31 + 1)
+    a = rng.randn(*shape).astype(np.float32)
+    axes = [None] + list(range(len(shape))) + [tuple(range(len(shape)))]
+    for axis in axes:
+        for name, ref in [("sum", np.sum), ("mean", np.mean),
+                          ("max", np.max), ("min", np.min),
+                          ("prod", np.prod)]:
+            kw = {} if axis is None else {"axis": axis}
+            got = _np(getattr(nd, name)(nd.array(a), **kw))
+            want = ref(a, axis=axis)
+            np.testing.assert_allclose(np.squeeze(got), np.squeeze(want),
+                                       rtol=2e-5, atol=1e-5,
+                                       err_msg=f"{name} axis={axis}")
+
+
+def test_indexing_family():
+    rng = np.random.RandomState(3)
+    a = rng.randn(5, 7).astype(np.float32)
+    idx = rng.randint(0, 5, 4)
+    np.testing.assert_allclose(
+        _np(nd.take(nd.array(a), nd.array(idx.astype(np.float32)), axis=0)),
+        a[idx])
+    # clip mode with out-of-range indices
+    oob = np.array([-3, 9], np.float32)
+    np.testing.assert_allclose(
+        _np(nd.take(nd.array(a), nd.array(oob), axis=0, mode="clip")),
+        a[[0, 4]])
+    # one_hot
+    got = _np(nd.one_hot(nd.array(np.array([0, 2], np.float32)), depth=4))
+    np.testing.assert_allclose(got, np.eye(4, dtype=np.float32)[[0, 2]])
+    # gather_nd: MXNet convention — indices (M, N), coordinate of output
+    # element j is indices[:, j] (NOT numpy's row-tuples)
+    indices = np.array([[0, 1], [2, 3]], np.float32)
+    g = _np(nd.gather_nd(nd.array(a), nd.array(indices)))
+    np.testing.assert_allclose(g, a[[0, 1], [2, 3]])
+
+
+def test_ordering_family():
+    rng = np.random.RandomState(4)
+    a = rng.randn(4, 9).astype(np.float32)
+    np.testing.assert_allclose(_np(nd.sort(nd.array(a), axis=1)),
+                               np.sort(a, axis=1))
+    np.testing.assert_allclose(_np(nd.argsort(nd.array(a), axis=1)),
+                               np.argsort(a, axis=1, kind="stable"))
+    np.testing.assert_allclose(_np(nd.argmax(nd.array(a), axis=1)),
+                               np.argmax(a, axis=1))
+    # topk returns indices by default (mxnet semantics)
+    got = nd.topk(nd.array(a), axis=1, k=3)
+    got = _np(got[0] if isinstance(got, list) else got)
+    want = np.argsort(-a, axis=1, kind="stable")[:, :3]
+    np.testing.assert_allclose(got, want)
+
+
+def test_shape_manipulation_family():
+    rng = np.random.RandomState(5)
+    a = rng.randn(2, 3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        _np(nd.transpose(nd.array(a), axes=(2, 0, 1))), a.transpose(2, 0, 1))
+    np.testing.assert_allclose(
+        _np(nd.reverse(nd.array(a), axis=1)), a[:, ::-1])
+    np.testing.assert_allclose(
+        _np(nd.tile(nd.array(a), reps=(2, 1, 1))), np.tile(a, (2, 1, 1)))
+    np.testing.assert_allclose(
+        _np(nd.repeat(nd.array(a), repeats=2, axis=2)),
+        np.repeat(a, 2, axis=2))
+    np.testing.assert_allclose(
+        _np(nd.flip(nd.array(a), axis=0)), a[::-1])
+    np.testing.assert_allclose(
+        _np(nd.expand_dims(nd.array(a), axis=1)), a[:, None])
+    s = _np(nd.squeeze(nd.expand_dims(nd.array(a), axis=1)))
+    np.testing.assert_allclose(s, a)
+
+
+def test_zero_size_arrays_through_ops():
+    z = nd.zeros((0, 3))
+    assert _np(z + 1).shape == (0, 3)
+    assert _np(nd.sum(z, axis=1)).shape == (0,)
+    assert _np(nd.concat(z, z, dim=0)).shape == (0, 3)
+    assert _np(nd.transpose(z)).shape == (3, 0)
+
+
+def test_unary_math_family():
+    rng = np.random.RandomState(6)
+    a = rng.uniform(0.1, 3.0, (3, 4)).astype(np.float32)
+    for name, ref in [("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+                      ("rsqrt", lambda x: 1 / np.sqrt(x)),
+                      ("cbrt", np.cbrt), ("abs", np.abs),
+                      ("floor", np.floor), ("ceil", np.ceil),
+                      ("rint", np.rint), ("sign", np.sign),
+                      ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+                      ("erf", None), ("gamma", None), ("gammaln", None),
+                      ("log1p", np.log1p), ("expm1", np.expm1)]:
+        got = _np(getattr(nd, name)(nd.array(a)))
+        if ref is None:
+            import scipy.special as sp
+            ref = {"erf": sp.erf, "gamma": sp.gamma,
+                   "gammaln": sp.gammaln}[name]
+        np.testing.assert_allclose(got, ref(a), rtol=2e-5, atol=1e-5,
+                                   err_msg=name)
+
+
+def test_dtype_promotion_scalar_ops():
+    a16 = nd.ones((3,), dtype="float16")
+    assert (a16 * 2).dtype == np.float16
+    assert (a16 + 1.5).dtype == np.float16
+    i32 = nd.ones((3,), dtype="int32")
+    assert (i32 + 1).dtype == np.int32
+    assert _np(i32 + 1).tolist() == [2, 2, 2]
+    # reference semantics: scalar cast to tensor dtype -> int division
+    # truncates (mx.np has true-division semantics instead)
+    assert (i32 / 2).dtype == np.int32
+    assert _np(i32 / 2).tolist() == [0, 0, 0]
+    import mxnet_tpu as mxx
+    npdiv = mxx.np.array([1, 1], dtype="int32") / 2
+    np.testing.assert_allclose(npdiv.asnumpy(), [0.5, 0.5])

@@ -317,3 +317,35 @@ def test_clip_global_norm():
     assert total > 1.0
     new_total = float(np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays)))
     assert abs(new_total - 1.0) < 1e-4
+
+
+def test_vision_transform_completeness():
+    """Every transform class the reference vision.transforms exposes must
+    exist and run (reference python/mxnet/gluon/data/vision/transforms.py)."""
+    import numpy as onp
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    img = onp.random.RandomState(0).randint(
+        0, 255, (10, 12, 3)).astype(onp.uint8)
+    cases = [
+        (T.ToTensor(), (3, 10, 12)),
+        (T.Resize(8), (8, 8, 3)),
+        (T.CenterCrop(6), (6, 6, 3)),
+        (T.CropResize(1, 1, 8, 6, size=5), (5, 5, 3)),
+        (T.RandomFlipLeftRight(), (10, 12, 3)),
+        (T.RandomFlipTopBottom(), (10, 12, 3)),
+        (T.RandomBrightness(0.1), (10, 12, 3)),
+        (T.RandomContrast(0.1), (10, 12, 3)),
+        (T.RandomSaturation(0.1), (10, 12, 3)),
+        (T.RandomHue(0.1), (10, 12, 3)),
+        (T.RandomLighting(0.1), (10, 12, 3)),
+        (T.RandomColorJitter(0.1, 0.1, 0.1, 0.1), (10, 12, 3)),
+        (T.Cast("float32"), (10, 12, 3)),
+    ]
+    for t, want in cases:
+        out = t(img)
+        got = tuple(onp.asarray(
+            out.asnumpy() if hasattr(out, "asnumpy") else out).shape)
+        assert got == want, f"{type(t).__name__}: {got} != {want}"
+    # hue=0 jitter is identity-composed; hue>0 must change values
+    out = T.RandomHue(0.5)(img.astype(onp.float32))
+    assert onp.asarray(out).shape == (10, 12, 3)

@@ -198,3 +198,38 @@ def test_import_gemm_alpha_beta_transA():
     e = s.bind(mx.cpu(), {"A": nd.array(a), **args})
     out = e.forward()[0].asnumpy()
     np.testing.assert_allclose(out, 0.5 * (a @ b) + 2.0 * c, rtol=1e-5)
+
+
+def test_import_average_pool_count_include_pad():
+    """ONNX default count_include_pad=0: padded cells are excluded from the
+    divisor (regression: importer produced include-pad averages)."""
+    from mxnet_tpu.contrib import onnx_proto as P
+    h = P.helper
+    import tempfile, os
+
+    def build(pads, **kw):
+        n = h.make_node("AveragePool", ["data"], ["y"], kernel_shape=[2, 2],
+                        pads=pads, **kw)
+        g = h.make_graph(
+            [n], "g",
+            [h.make_tensor_value_info("data", P.TensorProto.FLOAT,
+                                      (1, 1, 2, 2))],
+            [h.make_tensor_value_info("y", P.TensorProto.FLOAT, None)])
+        path = os.path.join(tempfile.mkdtemp(), "ap.onnx")
+        P.save(h.make_model(g), path)
+        return path
+
+    ones = nd.ones((1, 1, 2, 2))
+    # symmetric pads, exclude-pad default: all outputs stay 1.0
+    s, args, aux = mxonnx.import_model(build([1, 1, 1, 1]))
+    out = s.bind(mx.cpu(), {"data": ones}).forward()[0].asnumpy()
+    np.testing.assert_allclose(out, np.ones_like(out))
+    # asymmetric pads, exclude-pad: still 1.0 everywhere
+    s, args, aux = mxonnx.import_model(build([0, 0, 1, 1]))
+    out = s.bind(mx.cpu(), {"data": ones}).forward()[0].asnumpy()
+    np.testing.assert_allclose(out, np.ones_like(out))
+    # count_include_pad=1: the corner average includes one padded zero pair
+    s, args, aux = mxonnx.import_model(build([0, 0, 1, 1],
+                                             count_include_pad=1))
+    out = s.bind(mx.cpu(), {"data": ones}).forward()[0].asnumpy()
+    assert out.min() < 1.0

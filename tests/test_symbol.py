@@ -356,3 +356,25 @@ def test_attrscope_applies_to_variables():
     with mx.AttrScope(lr_mult="0.1"):
         w = sym.Variable("w_scoped")
     assert w._heads[0][0].attrs.get("lr_mult") == "0.1"
+
+
+def test_symbol_positional_only_ops():
+    """Ops registered directly from jnp ufunc-style functions have
+    positional-only `(x1, x2, /)` signatures; the symbol input-spec builder
+    must count those as inputs (regression: 37 ops — sym.broadcast_div,
+    sym.exp, sym.tanh, ... — raised 'too many positional inputs')."""
+    import numpy as onp
+    unary = ["abs", "exp", "log1p", "sqrt", "tanh", "floor", "sign", "cbrt"]
+    a = nd.array(onp.array([0.5, 1.5], onp.float32))
+    for name in unary:
+        s = getattr(sym, name)(sym.Variable("x"))
+        out = s.bind(mx.cpu(), {"x": a}).forward()[0]
+        ref = getattr(onp, name if name != "abs" else "abs")(a.asnumpy())
+        onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+    binary = ["broadcast_div", "broadcast_power", "broadcast_mod",
+              "broadcast_hypot", "arctan2"]
+    b = nd.array(onp.array([2.0, 4.0], onp.float32))
+    for name in binary:
+        s = getattr(sym, name)(sym.Variable("x"), sym.Variable("y"))
+        out = s.bind(mx.cpu(), {"x": a, "y": b}).forward()[0]
+        assert out.shape == (2,), name
