@@ -1,0 +1,86 @@
+"""Symbolic-vs-eager duality fuzz: every op here is defined ONCE (a pure jax
+function), so the symbol executor and the eager invoke path must produce
+identical results. This is the architecture's core invariant (SURVEY.md §7:
+one definition -> eager jit-cache + symbolic trace); drift means the spec
+builder or the executor mishandled a signature (regression class: the
+positional-only bug that silently broke 37 sym ops)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu import nd
+
+
+def _eager_vs_symbol(op_name, arrays_np, params):
+    eager = nd.invoke(op_name, [nd.array(a) for a in arrays_np], dict(params))
+    eager = eager[0] if isinstance(eager, list) else eager
+
+    vars_ = [sym.Variable(f"in{i}") for i in range(len(arrays_np))]
+    s = sym.invoke_op(op_name, vars_, dict(params)) if hasattr(sym, "invoke_op") \
+        else getattr(sym, op_name)(*vars_, **params)
+    s = s[0] if isinstance(s, (list, tuple)) else s
+    ex = s.bind(mx.cpu(), {f"in{i}": nd.array(a)
+                           for i, a in enumerate(arrays_np)})
+    symbolic = ex.forward()[0]
+    np.testing.assert_allclose(eager.asnumpy(), symbolic.asnumpy(),
+                               rtol=1e-5, atol=1e-6,
+                               err_msg=f"{op_name} eager != symbolic")
+
+
+RNG = np.random.RandomState(11)
+A23 = RNG.randn(2, 3).astype(np.float32)
+B23 = (RNG.randn(2, 3) + 2).astype(np.float32)
+A234 = RNG.randn(2, 3, 4).astype(np.float32)
+POS = np.abs(A23) + 0.5
+
+CASES = [
+    # (op, inputs, params)
+    ("broadcast_add", [A23, B23], {}),
+    ("broadcast_div", [A23, B23], {}),
+    ("broadcast_power", [POS, B23], {}),
+    ("broadcast_hypot", [A23, B23], {}),
+    ("elemwise_div", [A23, B23], {}),
+    ("exp", [A23], {}),
+    ("log", [POS], {}),
+    ("sqrt", [POS], {}),
+    ("cbrt", [A23], {}),
+    ("tanh", [A23], {}),
+    ("arctan2", [A23, B23], {}),
+    ("rint", [A23], {}),
+    ("sign", [A23], {}),
+    ("square", [A23], {}),
+    ("sum", [A234], {"axis": 1}),
+    ("mean", [A234], {"axis": (0, 2)}),
+    ("norm", [A23], {}),
+    ("dot", [A23, B23.T.copy()], {}),
+    ("transpose", [A234], {"axes": (2, 0, 1)}),
+    ("Reshape", [A234], {"shape": (6, 4)}),
+    ("slice_axis", [A234], {"axis": 1, "begin": 0, "end": 2}),
+    ("clip", [A23], {"a_min": -0.5, "a_max": 0.5}),
+    ("relu", [A23], {}),
+    ("softmax", [A23], {"axis": -1}),
+    ("log_softmax", [A23], {"axis": -1}),
+    ("sigmoid", [A23], {}),
+    ("Flatten", [A234], {}),
+    ("expand_dims", [A23], {"axis": 1}),
+    ("tile", [A23], {"reps": (2, 2)}),
+    ("repeat", [A23], {"repeats": 2, "axis": 1}),
+    ("reverse", [A234], {"axis": 1}),
+    ("where", [(A23 > 0).astype(np.float32), A23, B23], {}),
+    ("add_n", [A23, B23, A23], {}),
+    ("batch_take", [A23, np.array([0, 2], np.float32)], {}),
+    ("L2Normalization", [A23], {}),
+    ("smooth_l1", [A23], {"scalar": 1.0}),
+    ("gamma", [POS], {}),
+    ("erf", [A23], {}),
+    ("_plus_scalar", [A23], {"scalar": 2.5}),
+    ("_power_scalar", [POS], {"scalar": 2.0}),
+    ("_maximum_scalar", [A23], {"scalar": 0.0}),
+]
+
+
+@pytest.mark.parametrize("op_name,arrays,params",
+                         CASES, ids=[c[0] for c in CASES])
+def test_eager_symbol_parity(op_name, arrays, params):
+    _eager_vs_symbol(op_name, arrays, params)
