@@ -108,7 +108,18 @@ def _devices_of(kind: str):
         else:
             # Any accelerator backend counts as "tpu" (axon tunnels report
             # platform-specific names; default backend is the accelerator).
-            devs = [d for d in jax.local_devices() if d.platform != "cpu"]
+            # A broken accelerator runtime (e.g. libtpu version mismatch)
+            # must degrade to "no accelerator" so the default context falls
+            # back to cpu(0) instead of crashing every eager op.
+            try:
+                devs = [d for d in jax.local_devices() if d.platform != "cpu"]
+            except Exception as e:
+                import warnings
+                warnings.warn(
+                    f"accelerator device enumeration failed ({e!r}); "
+                    "falling back to cpu — training will run on the host CPU",
+                    RuntimeWarning, stacklevel=3)
+                devs = []
             _dev_cache[kind] = devs
     return _dev_cache[kind]
 

@@ -12,7 +12,19 @@ import jax
 import jax.numpy as jnp
 
 _lock = threading.Lock()
-_key = jax.random.key(0)
+# Created lazily on first use: importing mxnet_tpu must not initialize any
+# XLA backend (a module-level jax.random.key(0) is an eager op on the default
+# backend, which breaks hosts whose accelerator runtime is unusable and makes
+# explicit-CPU flows like __graft_entry__.dryrun_multichip non-hermetic).
+_key = None
+
+
+def _global_key():
+    """The process-wide stream key, creating it on first use (caller holds _lock)."""
+    global _key
+    if _key is None:
+        _key = jax.random.key(0)
+    return _key
 
 # Inside a hybridize() trace the key must be a traced input, not a baked-in
 # constant: blocks push the trace's key here and next_key() splits from it.
@@ -44,7 +56,7 @@ def next_key():
         _trace_keys[-1] = k1
         return k2
     with _lock:
-        _key, sub = jax.random.split(_key)
+        _key, sub = jax.random.split(_global_key())
     return sub
 
 
@@ -56,7 +68,7 @@ def next_key_raw():
 def get_state_raw():
     """Raw uint32 key data of the global stream (for checkpointing)."""
     with _lock:
-        return jax.random.key_data(_key)
+        return jax.random.key_data(_global_key())
 
 
 def set_state_raw(raw):

@@ -31,6 +31,11 @@ def cpu_devices():
     return jax.devices("cpu")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test (subprocess compiles, trainings)")
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything(request):
     """with_seed parity (reference tests/python/unittest/common.py:161):
