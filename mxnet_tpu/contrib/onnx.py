@@ -251,24 +251,31 @@ def import_model(model_file: str):
             a_in = ins[0]
             if at.get("transA"):
                 a_in = sym_mod.transpose(a_in)
+            w_sym = env[node.input[1]]
+            if not at.get("transB") and w is not None:
+                # FullyConnected expects (out, in). Materialize the
+                # transposed weight under a fresh per-node name — mutating
+                # the shared initializer in place would hand a second
+                # consumer (tied weights, two Gemm nodes sharing B) a
+                # double-transposed array.
+                w_name = f"{node.input[1]}__T__{node.output[0]}"
+                params[w_name] = _np.ascontiguousarray(w.T)
+                w_sym = env.setdefault(w_name, sym_mod.Variable(w_name))
             has_c = len(node.input) > 2
             if alpha == 1.0 and beta == 1.0:
                 out = sym_mod.FullyConnected(
-                    a_in, env[node.input[1]],
+                    a_in, w_sym,
                     env[node.input[2]] if has_c else None,
                     num_hidden=num_hidden, no_bias=not has_c)
             else:
                 # alpha*A.B (+ beta*C): scale around a bias-free FC
                 ab = sym_mod.FullyConnected(
-                    a_in, env[node.input[1]], None,
+                    a_in, w_sym, None,
                     num_hidden=num_hidden, no_bias=True)
                 out = ab * alpha
                 if has_c:
                     out = sym_mod.broadcast_add(
                         out, env[node.input[2]] * beta)
-            if not at.get("transB") and w is not None:
-                # FullyConnected expects (out, in): pre-transpose the param
-                params[node.input[1]] = _np.ascontiguousarray(w.T)
         elif op == "MatMul":
             out = sym_mod.dot(ins[0], ins[1])
         elif op in ("Relu", "Sigmoid", "Tanh", "Softplus"):
@@ -363,8 +370,14 @@ def import_model(model_file: str):
                 input_dim=int(w.shape[0]) if w is not None else 0,
                 output_dim=int(w.shape[1]) if w is not None else 0)
         elif op == "Clip":
-            lo = float(const_of(node.input[1])) if len(node.input) > 1 else None
-            hi = float(const_of(node.input[2])) if len(node.input) > 2 else None
+            # opset >= 11 passes bounds as inputs; opset <= 10 as the
+            # 'min'/'max' node attributes (e.g. ReLU6 exports)
+            lo = (float(const_of(node.input[1])) if len(node.input) > 1
+                  and node.input[1] else at.get("min"))
+            hi = (float(const_of(node.input[2])) if len(node.input) > 2
+                  and node.input[2] else at.get("max"))
+            lo = float(lo) if lo is not None else None
+            hi = float(hi) if hi is not None else None
             out = sym_mod.clip(ins[0], a_min=lo if lo is not None else -3.4e38,
                                a_max=hi if hi is not None else 3.4e38)
         elif op in ("Exp", "Log", "Sqrt", "Abs", "Neg", "Floor", "Ceil"):

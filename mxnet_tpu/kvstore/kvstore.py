@@ -42,6 +42,9 @@ class KVStore:
         self._compression = {}
         self._comp_residual = {}
 
+    def _supports_compression(self):
+        return False
+
     # -- identity ----------------------------------------------------------
     @property
     def type(self):
@@ -190,7 +193,18 @@ class KVStore:
         added to the next push (error feedback), so the scheme is unbiased
         over time. On a TPU pod the 2-bit tensor is what rides the
         ICI/DCN collective — a 16x traffic cut, same as the reference's
-        ps-lite path."""
+        ps-lite path.
+
+        As in the reference (kvstore_local.h SetGradientCompression raises
+        for non-dist stores), compression is only supported on dist stores —
+        a 'local'/'device' store silently quantizing gradients would degrade
+        single-machine training with no signal."""
+        if not self._supports_compression():
+            raise MXNetError(
+                "gradient compression is only supported on dist kvstore "
+                f"types (got {type(self).__name__}); use kv.create('dist_sync') "
+                "or DataParallelTrainer(..., compression=...) for the fused "
+                "in-jit path")
         params = dict(compression_params)
         ctype = params.get("type", "2bit")
         if ctype not in ("2bit", "none"):
@@ -280,6 +294,9 @@ class KVStoreDist(KVStore):
     the reference's dist_async. Single-host fallback behaves like 'local'
     with rank 0 of 1 (same as reference launched without a scheduler).
     """
+
+    def _supports_compression(self):
+        return True
 
     def __init__(self, sync=True):
         super().__init__()

@@ -20,20 +20,35 @@ import jax.numpy as jnp
 from .registry import register
 
 
-def _rescaled(g, rescale_grad, clip_gradient):
-    """Works with both static python hyperparams (registered-op path: the
-    clip test resolves at trace time) and traced scalars (the Optimizer-class
-    kernels jit these same functions with lr/wd/clip as runtime args so a
-    learning-rate change never retraces)."""
-    g = g * rescale_grad
+def _clip(g, clip_gradient):
+    """Reference semantics: clipping is enabled whenever clip_gradient >= 0
+    (src/operator/optimizer_op-inl.h:388,1303 — every kernel tests >= 0.f;
+    the default of -1 means off). Works with both static python hyperparams
+    (registered-op path: the test resolves at trace time) and traced scalars
+    (the Optimizer-class kernels jit these same functions with lr/wd/clip as
+    runtime args so a learning-rate change never retraces)."""
     if clip_gradient is None:
         return g
     if isinstance(clip_gradient, (int, float)):
-        if clip_gradient > 0:
+        if clip_gradient >= 0:
             g = jnp.clip(g, -clip_gradient, clip_gradient)
         return g
-    return jnp.where(clip_gradient > 0,
+    return jnp.where(clip_gradient >= 0,
                      jnp.clip(g, -clip_gradient, clip_gradient), g)
+
+
+def _rescaled(g, rescale_grad, clip_gradient):
+    """SGD/Signum/Adagrad/LAMB family: clip(rescale_grad * grad), weight
+    decay applied AFTER clipping (reference SGDKernel
+    src/operator/optimizer_op-inl.h:388-396, SignumKernel, LambUpdatePhaseOne)."""
+    return _clip(g * rescale_grad, clip_gradient)
+
+
+def _rescaled_wd(g, weight, wd, rescale_grad, clip_gradient):
+    """Adam/FTML/RMSProp family: wd*weight folds into the gradient BEFORE
+    clipping (reference AdamUpdateKernel src/operator/optimizer_op-inl.h:1302,
+    FTMLKernel :1214, RMSPropAlexUpdateKernel :1965, RMSPropUpdateKernel)."""
+    return _clip(g * rescale_grad + wd * weight, clip_gradient)
 
 
 def _f32(x):
@@ -124,7 +139,7 @@ def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
 def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                 lazy_update=True):
-    g = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    g = _rescaled_wd(grad, weight, wd, rescale_grad, clip_gradient)
     m2 = beta1 * mean + (1 - beta1) * g
     v2 = beta2 * var + (1 - beta2) * g * g
     return weight - lr * m2 / (jnp.sqrt(v2) + epsilon), m2, v2
@@ -132,9 +147,7 @@ def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
 
 def _adamw_core(w32, g, mean, var, rescale_tensor, lr, eta, beta1, beta2,
                 epsilon, wd, clip_gradient):
-    g = _f32(g) * rescale_tensor
-    if clip_gradient is not None and clip_gradient > 0:
-        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = _clip(_f32(g) * rescale_tensor, clip_gradient)
     m2 = beta1 * mean + (1 - beta1) * g
     v2 = beta2 * var + (1 - beta2) * g * g
     w2 = w32 - eta * (lr * m2 / (jnp.sqrt(v2) + epsilon) + wd * w32)
@@ -184,7 +197,7 @@ def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
           state_inputs=((2, 1), (3, 2), (4, 3)))
 def ftml_update(weight, grad, d, v, z, lr, t, beta1=0.6, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
-    g = _rescaled(grad, rescale_grad, clip_grad) + wd * weight
+    g = _rescaled_wd(grad, weight, wd, rescale_grad, clip_grad)
     v2 = beta2 * v + (1 - beta2) * g * g
     d2 = (1 - beta1 ** t) / lr * (jnp.sqrt(v2 / (1 - beta2 ** t)) + epsilon)
     sigma = d2 - beta1 * d
@@ -196,11 +209,10 @@ def ftml_update(weight, grad, d, v, z, lr, t, beta1=0.6, beta2=0.999,
           state_inputs=((2, 1),))
 def rmsprop_update(weight, grad, n, lr, rho=0.95, epsilon=1e-8, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
-    g = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    g = _rescaled_wd(grad, weight, wd, rescale_grad, clip_gradient)
     n2 = rho * n + (1 - rho) * g * g
     w2 = weight - lr * g / jnp.sqrt(n2 + epsilon)
-    if clip_weights is not None and clip_weights > 0:
-        w2 = jnp.clip(w2, -clip_weights, clip_weights)
+    w2 = _clip(w2, clip_weights)
     return w2, n2
 
 
@@ -209,13 +221,12 @@ def rmsprop_update(weight, grad, n, lr, rho=0.95, epsilon=1e-8, wd=0.0,
 def rmspropalex_update(weight, grad, n, g, delta, lr, rho=0.95, momentum=0.9,
                        epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0, clip_weights=-1.0):
-    gr = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    gr = _rescaled_wd(grad, weight, wd, rescale_grad, clip_gradient)
     n2 = rho * n + (1 - rho) * gr * gr
     gavg2 = rho * g + (1 - rho) * gr
     delta2 = momentum * delta - lr * gr / jnp.sqrt(n2 - gavg2 * gavg2 + epsilon)
     w2 = weight + delta2
-    if clip_weights is not None and clip_weights > 0:
-        w2 = jnp.clip(w2, -clip_weights, clip_weights)
+    w2 = _clip(w2, clip_weights)
     return w2, n2, gavg2, delta2
 
 

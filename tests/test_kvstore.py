@@ -128,11 +128,20 @@ def test_pushpull_persists_and_row_sparse_full_form():
     onp.testing.assert_allclose(full.asnumpy(), table)
 
 
+def test_gradient_compression_rejected_on_local_store():
+    """reference kvstore_local.h: compression is dist-only; a local store
+    silently quantizing gradients would degrade training with no signal."""
+    import pytest
+    kv = mx.kv.create("local")
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+
+
 def test_two_bit_gradient_compression_error_feedback():
     """reference gradient_compression.cc: values quantize to
     {-threshold, 0, +threshold} and the residual carries to the next push."""
     import numpy as np
-    kv = mx.kv.create("local")
+    kv = mx.kv.create("dist_sync")
     kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
     kv.init("g", nd.zeros((4,)))
     kv.push("g", nd.array(np.array([0.3, 0.7, -0.9, 0.0], np.float32)))

@@ -233,3 +233,50 @@ def test_import_average_pool_count_include_pad():
                                              count_include_pad=1))
     out = s.bind(mx.cpu(), {"data": ones}).forward()[0].asnumpy()
     assert out.min() < 1.0
+
+
+def test_gemm_shared_initializer_not_mutated(tmp_path):
+    """Two Gemm nodes sharing one B initializer with transB=0: importing must
+    not transpose the shared initializer in place (the second consumer would
+    see a double-transposed weight)."""
+    from mxnet_tpu.contrib import onnx_proto as oh
+    rng = np.random.RandomState(3)
+    B = rng.randn(6, 4).astype(np.float32)          # (in, out), transB=0
+    bias = rng.randn(4).astype(np.float32)
+    g1 = oh.helper.make_node("Gemm", ["x", "B", "bias"], ["h1"])
+    g2 = oh.helper.make_node("Gemm", ["x", "B", "bias"], ["h2"])
+    add = oh.helper.make_node("Add", ["h1", "h2"], ["y"])
+    graph = oh.helper.make_graph(
+        [g1, g2, add], "shared_b",
+        [oh.helper.make_tensor_value_info("x", 1, (2, 6))],
+        [oh.helper.make_tensor_value_info("y", 1, (2, 4))],
+        initializer=[oh.numpy_helper.from_array(B, "B"),
+                     oh.numpy_helper.from_array(bias, "bias")])
+    model = oh.helper.make_model(graph)
+    path = str(tmp_path / "shared_b.onnx")
+    oh.save(model, path)
+
+    s2, args, aux = mxonnx.import_model(path)
+    x = rng.randn(2, 6).astype(np.float32)
+    e = s2.bind(mx.cpu(), {**args, **aux, "x": nd.array(x)})
+    got = e.forward()[0].asnumpy()
+    want = 2 * (x @ B + bias)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_clip_opset10_attributes(tmp_path):
+    """opset <= 10 Clip carries min/max as node attributes (ReLU6 pattern)."""
+    from mxnet_tpu.contrib import onnx_proto as oh
+    n = oh.helper.make_node("Clip", ["x"], ["y"], min=0.0, max=6.0)
+    graph = oh.helper.make_graph(
+        [n], "clip10",
+        [oh.helper.make_tensor_value_info("x", 1, (2, 3))],
+        [oh.helper.make_tensor_value_info("y", 1, (2, 3))])
+    model = oh.helper.make_model(graph, opset=10)
+    path = str(tmp_path / "clip10.onnx")
+    oh.save(model, path)
+    s2, args, aux = mxonnx.import_model(path)
+    x = np.array([[-3.0, 2.0, 9.0], [0.5, 7.0, -0.1]], np.float32)
+    e = s2.bind(mx.cpu(), {"x": nd.array(x), **args, **aux})
+    got = e.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, np.clip(x, 0.0, 6.0))

@@ -258,3 +258,36 @@ def test_update_ops_visible_in_symbol_namespace():
     import mxnet_tpu.symbol as sym
     s = sym.sgd_update(sym.Variable("w"), sym.Variable("g"), lr=0.1)
     assert s is not None
+
+
+def test_adam_wd_before_clip_matches_reference():
+    """AdamUpdateKernel (src/operator/optimizer_op-inl.h:1302): the update
+    folds wd*weight into the gradient BEFORE clipping, and clip_gradient >= 0
+    enables clipping."""
+    import numpy as np
+    w = nd.array(np.array([1.0, -2.0, 3.0], np.float32))
+    g = nd.array(np.array([10.0, -10.0, 0.1], np.float32))
+    m = nd.zeros((3,))
+    v = nd.zeros((3,))
+    lr, wd, clip, b1, b2, eps = 0.1, 0.5, 1.0, 0.9, 0.999, 1e-8
+    out = nd.adam_update(w, g, m, v, lr=lr, beta1=b1, beta2=b2, epsilon=eps,
+                         wd=wd, rescale_grad=1.0, clip_gradient=clip)
+    gr = np.clip(np.array([10.0, -10.0, 0.1]) + wd * np.array([1.0, -2.0, 3.0]),
+                 -clip, clip)
+    m_np = (1 - b1) * gr
+    v_np = (1 - b2) * gr * gr
+    want = np.array([1.0, -2.0, 3.0]) - lr * m_np / (np.sqrt(v_np) + eps)
+    np.testing.assert_allclose(out.asnumpy(), want.astype(np.float32),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_sgd_clip_enabled_at_zero():
+    """Reference tests clip_gradient >= 0: clip=0 zeroes the rescaled grad
+    (only wd remains)."""
+    import numpy as np
+    w = nd.array(np.array([2.0], np.float32))
+    g = nd.array(np.array([5.0], np.float32))
+    out = nd.sgd_update(w, g, lr=0.1, wd=0.5, rescale_grad=1.0,
+                        clip_gradient=0.0)
+    np.testing.assert_allclose(out.asnumpy(), [2.0 - 0.1 * (0.0 + 0.5 * 2.0)],
+                               rtol=1e-6)
