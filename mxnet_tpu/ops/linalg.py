@@ -83,10 +83,12 @@ def linalg_makediag(x, *, offset=0):
 
 @register("linalg_extracttrian")
 def linalg_extracttrian(A, *, offset=0, lower=True):
+    # indices are static (shape-derived), so compute them with numpy — a
+    # traced mask.sum() is not a valid gather size under jit
+    import numpy as _onp
     n = A.shape[-1]
-    mask = jnp.tril(jnp.ones((n, n), bool), k=offset) if lower else \
-        jnp.triu(jnp.ones((n, n), bool), k=offset)
-    rows, cols = jnp.where(mask, size=int(mask.sum()))
+    rows, cols = (_onp.tril_indices(n, offset) if lower
+                  else _onp.triu_indices(n, offset))
     return A[..., rows, cols]
 
 

@@ -145,6 +145,20 @@ def slice_axis(x, *, axis, begin, end):
     return x[tuple(idx)]
 
 
+@register("reshape_like")
+def reshape_like(lhs, rhs, *, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """reference tensor/elemwise_unary_op_basic.cc:485 — reshape dims
+    [lhs_begin, lhs_end) of lhs to rhs's dims [rhs_begin, rhs_end)."""
+    lrank, rrank = lhs.ndim, rhs.ndim
+    lb = 0 if lhs_begin is None else lhs_begin % lrank
+    le = lrank if lhs_end is None else lhs_end % (lrank + 1)
+    rb = 0 if rhs_begin is None else rhs_begin % rrank
+    re_ = rrank if rhs_end is None else rhs_end % (rrank + 1)
+    new_shape = lhs.shape[:lb] + rhs.shape[rb:re_] + lhs.shape[le:]
+    return lhs.reshape(new_shape)
+
+
 @register("slice_like")
 def slice_like(x, y, *, axes=None):
     if axes is None or len(axes) == 0:

@@ -263,6 +263,11 @@ def group_norm(data, gamma, beta, *, num_groups=1, eps=1e-5):
     mean = jnp.mean(x32, axis=red, keepdims=True)
     var = jnp.var(x32, axis=red, keepdims=True)
     out = ((x32 - mean) * lax.rsqrt(var + eps)).reshape(data.shape)
+    # reference gamma/beta have shape (num_groups,) (group_norm.cc:50);
+    # per-channel (C,) is also accepted for gluon-style affine params
+    if gamma.shape[0] == num_groups and num_groups != c:
+        gamma = jnp.repeat(gamma, c // num_groups)
+        beta = jnp.repeat(beta, c // num_groups)
     shape = (1, c) + (1,) * len(rest)
     out = out * gamma.astype(jnp.float32).reshape(shape) + beta.astype(jnp.float32).reshape(shape)
     return out.astype(data.dtype)

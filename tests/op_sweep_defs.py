@@ -1,0 +1,994 @@
+"""Case table for the registry-wide operator correctness sweep.
+
+Models the intent of the reference's tests/python/unittest/test_operator.py
+(9,850 lines of per-op forward checks) + test_utils.py check_numeric_gradient:
+every op with a numpy/scipy/torch-expressible reference gets a direct numeric
+forward check across a couple of shapes, and (where differentiable and
+smooth) an autograd-vs-finite-difference gradient check on a tiny shape.
+
+The table is consumed by tests/test_op_sweep.py, whose coverage gate accounts
+for EVERY user-facing reference op name (tools/op_parity.py list): each must
+be swept here, numerically tested in another test file (ELSEWHERE), or
+exempted with a reason (EXEMPT).
+"""
+import math
+
+import numpy as np
+
+F32 = np.float32
+
+
+class Case:
+    __slots__ = ("op", "ns", "make_inputs", "kwargs", "ref", "grad",
+                 "rtol", "atol", "id", "varargs", "grad_atol")
+
+    def __init__(self, op, make_inputs, ref, kwargs=None, grad=False,
+                 rtol=1e-5, atol=1e-5, ns="nd", ident="", varargs=False,
+                 grad_atol=1e-3):
+        self.varargs = varargs
+        self.grad_atol = grad_atol
+        self.op = op
+        self.ns = ns
+        self.make_inputs = make_inputs
+        self.kwargs = kwargs or {}
+        self.ref = ref
+        self.grad = grad
+        self.rtol = rtol
+        self.atol = atol
+        self.id = f"{op}{'-' + ident if ident else ''}"
+
+
+CASES = []
+
+
+def add(op, make_inputs, ref, **kw):
+    CASES.append(Case(op, make_inputs, ref, **kw))
+
+
+# -- input domains -----------------------------------------------------------
+# Gradient checks use finite differences, so inputs stay away from kinks
+# (|x| >= 0.2 for abs/relu-style) and from domain edges (log, arcsin).
+
+def std(*shapes):
+    def make(rng):
+        return [rng.uniform(-2.0, 2.0, s).astype(F32) for s in shapes]
+    return make
+
+
+def far0(*shapes):
+    """Away from 0 (kinks of abs/relu/sign) but both signs present."""
+    def make(rng):
+        out = []
+        for s in shapes:
+            x = rng.uniform(0.3, 2.0, s) * rng.choice([-1.0, 1.0], s)
+            out.append(x.astype(F32))
+        return out
+    return make
+
+
+def pos(*shapes, lo=0.4, hi=2.4):
+    def make(rng):
+        return [rng.uniform(lo, hi, s).astype(F32) for s in shapes]
+    return make
+
+
+def unit(*shapes):
+    def make(rng):
+        return [rng.uniform(-0.85, 0.85, s).astype(F32) for s in shapes]
+    return make
+
+
+def gt1(*shapes):
+    def make(rng):
+        return [rng.uniform(1.2, 3.0, s).astype(F32) for s in shapes]
+    return make
+
+
+def ints(*shapes, lo=0, hi=5, dtype=np.int32):
+    def make(rng):
+        return [rng.randint(lo, hi, s).astype(dtype) for s in shapes]
+    return make
+
+
+def mixed(*specs):
+    """specs: callables each returning a list; concatenates their outputs."""
+    def make(rng):
+        out = []
+        for sp in specs:
+            out.extend(sp(rng))
+        return out
+    return make
+
+
+def const(*arrays):
+    def make(rng):
+        return [np.asarray(a) for a in arrays]
+    return make
+
+
+def spd(n, batch=()):
+    """Symmetric positive-definite matrices."""
+    def make(rng):
+        a = rng.uniform(-1, 1, batch + (n, n))
+        m = np.einsum("...ij,...kj->...ik", a, a) + 3.0 * np.eye(n)
+        return [m.astype(F32)]
+    return make
+
+
+# ===========================================================================
+# 1. Unary elementwise
+# ===========================================================================
+_SELU_ALPHA = 1.6732632423543772
+_SELU_SCALE = 1.0507009873554805
+
+UNARY = {
+    # name: (numpy ref, input domain, gradcheck)
+    "abs": (np.abs, far0, True),
+    "negative": (np.negative, std, True),
+    "reciprocal": (lambda x: 1.0 / x, far0, True),
+    "square": (np.square, std, True),
+    "sqrt": (np.sqrt, pos, True),
+    "rsqrt": (lambda x: 1.0 / np.sqrt(x), pos, True),
+    "cbrt": (np.cbrt, pos, True),
+    "rcbrt": (lambda x: 1.0 / np.cbrt(x), pos, True),
+    "exp": (np.exp, std, True),
+    "exp2": (np.exp2, std, True),
+    "expm1": (np.expm1, std, True),
+    "log": (np.log, pos, True),
+    "log2": (np.log2, pos, True),
+    "log10": (np.log10, pos, True),
+    "log1p": (np.log1p, pos, True),
+    "sin": (np.sin, std, True),
+    "cos": (np.cos, std, True),
+    "tan": (np.tan, unit, True),
+    "arcsin": (np.arcsin, unit, True),
+    "arccos": (np.arccos, unit, True),
+    "arctan": (np.arctan, std, True),
+    "sinh": (np.sinh, std, True),
+    "cosh": (np.cosh, std, True),
+    "tanh": (np.tanh, std, True),
+    "arcsinh": (np.arcsinh, std, True),
+    "arccosh": (np.arccosh, gt1, True),
+    "arctanh": (np.arctanh, unit, True),
+    "degrees": (np.degrees, std, True),
+    "radians": (np.radians, std, True),
+    "floor": (np.floor, far0, False),
+    "ceil": (np.ceil, far0, False),
+    "trunc": (np.trunc, far0, False),
+    "rint": (np.rint, far0, False),
+    "round": (lambda x: np.floor(x + 0.5), far0, False),  # MXNet round: half away via floor(x+.5)
+    "fix": (np.fix, far0, False),
+    "sign": (np.sign, far0, False),
+    "identity": (lambda x: x, std, True),
+    "_copy": (lambda x: x, std, True),
+    "erf": (lambda x: np.vectorize(math.erf)(x).astype(F32), std, True),
+    "erfinv": (lambda x: _sp().erfinv(x).astype(F32), unit, True),
+    "gamma": (lambda x: _sp().gamma(x).astype(F32), pos, True),
+    "gammaln": (lambda x: _sp().gammaln(x).astype(F32), pos, True),
+    "relu": (lambda x: np.maximum(x, 0), far0, True),
+    "sigmoid": (lambda x: 1 / (1 + np.exp(-x)), std, True),
+    "softsign": (lambda x: x / (1 + np.abs(x)), far0, True),
+    "softrelu": (lambda x: np.log1p(np.exp(x)), std, True),
+    "gelu": (lambda x: 0.5 * x * (1 + np.vectorize(math.erf)(x / math.sqrt(2))), std, True),
+    "silu": (lambda x: x / (1 + np.exp(-x)), std, True),
+    "swish": (lambda x: x / (1 + np.exp(-x)), std, True),
+    "mish": (lambda x: x * np.tanh(np.log1p(np.exp(x))), std, True),
+    "hard_sigmoid": (lambda x: np.clip(0.2 * x + 0.5, 0, 1), far0, False),
+    "logical_not": (lambda x: (x == 0).astype(F32), far0, False),
+    "BlockGrad": (lambda x: x, std, False),
+    "stop_gradient": (lambda x: x, std, False),
+    "make_loss": (lambda x: x, std, False),
+    "MakeLoss": (lambda x: x, std, False),
+}
+
+
+def _sp():
+    import scipy.special
+    return scipy.special
+
+
+for _name, (_ref, _dom, _grad) in UNARY.items():
+    add(_name, _dom((3, 4)), _ref, ident="2d")
+    add(_name, _dom((2, 3, 2)), _ref, ident="3d", grad=_grad)
+
+# LeakyReLU act types
+add("LeakyReLU", far0((2, 6)), lambda x: np.where(x > 0, x, 0.25 * x),
+    kwargs={"act_type": "leaky", "slope": 0.25}, grad=True)
+add("LeakyReLU", far0((2, 6)),
+    lambda x: np.where(x > 0, x, 0.3 * np.expm1(x)),
+    kwargs={"act_type": "elu", "slope": 0.3}, ident="elu", grad=True)
+add("LeakyReLU", far0((2, 6)),
+    lambda x: np.where(x > 0, _SELU_SCALE * x,
+                       _SELU_SCALE * _SELU_ALPHA * np.expm1(x)),
+    kwargs={"act_type": "selu"}, ident="selu", grad=True)
+for _act, _fn in [("relu", lambda x: np.maximum(x, 0)),
+                  ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+                  ("tanh", np.tanh),
+                  ("softrelu", lambda x: np.log1p(np.exp(x))),
+                  ("softsign", lambda x: x / (1 + np.abs(x)))]:
+    add("Activation", far0((3, 5)), _fn, kwargs={"act_type": _act},
+        ident=_act, grad=True)
+
+# ===========================================================================
+# 2. Binary elementwise: broadcast_*, elemwise_*, _scalar variants
+# ===========================================================================
+
+
+def _bc_shapes():
+    return [((2, 3), (2, 3)), ((3, 1), (1, 4)), ((2, 1, 2), (2, 2))]
+
+
+BINARY = {
+    "broadcast_add": (np.add, std, True),
+    "broadcast_plus": (np.add, std, True),
+    "broadcast_sub": (np.subtract, std, True),
+    "broadcast_minus": (np.subtract, std, True),
+    "broadcast_mul": (np.multiply, std, True),
+    "broadcast_div": (lambda a, b: a / b, far0, True),
+    "broadcast_mod": (np.fmod, pos, False),
+    "broadcast_power": (lambda a, b: np.power(a, b), pos, True),
+    "broadcast_maximum": (np.maximum, std, False),
+    "broadcast_minimum": (np.minimum, std, False),
+    "broadcast_hypot": (np.hypot, far0, True),
+    "broadcast_equal": (lambda a, b: (a == b).astype(F32), std, False),
+    "broadcast_not_equal": (lambda a, b: (a != b).astype(F32), std, False),
+    "broadcast_greater": (lambda a, b: (a > b).astype(F32), std, False),
+    "broadcast_greater_equal": (lambda a, b: (a >= b).astype(F32), std, False),
+    "broadcast_lesser": (lambda a, b: (a < b).astype(F32), std, False),
+    "broadcast_lesser_equal": (lambda a, b: (a <= b).astype(F32), std, False),
+    "broadcast_logical_and": (lambda a, b: ((a != 0) & (b != 0)).astype(F32), far0, False),
+    "broadcast_logical_or": (lambda a, b: ((a != 0) | (b != 0)).astype(F32), far0, False),
+    "broadcast_logical_xor": (lambda a, b: ((a != 0) ^ (b != 0)).astype(F32), far0, False),
+}
+
+for _name, (_ref, _dom, _grad) in BINARY.items():
+    for _i, (_sa, _sb) in enumerate(_bc_shapes()):
+        add(_name, _dom(_sa, _sb), _ref, ident=f"s{_i}",
+            grad=_grad and _i == 0)
+
+ELEMWISE = {
+    "elemwise_add": (np.add, std, True),
+    "elemwise_sub": (np.subtract, std, True),
+    "elemwise_mul": (np.multiply, std, True),
+    "elemwise_div": (lambda a, b: a / b, far0, True),
+    "_maximum": (np.maximum, std, False),
+    "_minimum": (np.minimum, std, False),
+    "_hypot": (np.hypot, far0, True),
+    "_mod": (np.fmod, pos, False),
+    "_power": (lambda a, b: np.power(a, b), pos, True),
+    "_equal": (lambda a, b: (a == b).astype(F32), std, False),
+    "_not_equal": (lambda a, b: (a != b).astype(F32), std, False),
+    "_greater": (lambda a, b: (a > b).astype(F32), std, False),
+    "_greater_equal": (lambda a, b: (a >= b).astype(F32), std, False),
+    "_lesser": (lambda a, b: (a < b).astype(F32), std, False),
+    "_lesser_equal": (lambda a, b: (a <= b).astype(F32), std, False),
+    "arctan2": (np.arctan2, far0, True),
+    "ldexp": (lambda a, b: np.ldexp(a, b.astype(np.int64)).astype(F32), const(np.full((2, 3), 1.5, F32), np.full((2, 3), 2.0, F32)), False),
+}
+
+for _name, (_ref, _dom, _grad) in ELEMWISE.items():
+    mk = _dom if callable(_dom) and not _dom.__name__ == "make" else _dom
+    if _name == "ldexp":
+        add(_name, _dom, _ref)
+    else:
+        add(_name, _dom((3, 4), (3, 4)), _ref, grad=_grad)
+
+SCALAR = {
+    "_plus_scalar": (lambda x, s: x + s, std, True),
+    "_minus_scalar": (lambda x, s: x - s, std, True),
+    "_rminus_scalar": (lambda x, s: s - x, std, True),
+    "_mul_scalar": (lambda x, s: x * s, std, True),
+    "_div_scalar": (lambda x, s: x / s, std, True),
+    "_rdiv_scalar": (lambda x, s: s / x, far0, True),
+    "_mod_scalar": (lambda x, s: np.fmod(x, s), pos, False),
+    "_rmod_scalar": (lambda x, s: np.fmod(s, x), pos, False),
+    "_power_scalar": (lambda x, s: np.power(x, s), pos, True),
+    "_rpower_scalar": (lambda x, s: np.power(s, x), std, True),
+    "_maximum_scalar": (lambda x, s: np.maximum(x, s), std, False),
+    "_minimum_scalar": (lambda x, s: np.minimum(x, s), std, False),
+    "_hypot_scalar": (lambda x, s: np.hypot(x, s), std, True),
+    "_equal_scalar": (lambda x, s: (x == s).astype(F32), std, False),
+    "_not_equal_scalar": (lambda x, s: (x != s).astype(F32), std, False),
+    "_greater_scalar": (lambda x, s: (x > s).astype(F32), std, False),
+    "_greater_equal_scalar": (lambda x, s: (x >= s).astype(F32), std, False),
+    "_lesser_scalar": (lambda x, s: (x < s).astype(F32), std, False),
+    "_lesser_equal_scalar": (lambda x, s: (x <= s).astype(F32), std, False),
+    "_logical_and_scalar": (lambda x, s: ((x != 0) & (s != 0)).astype(F32), far0, False),
+    "_logical_or_scalar": (lambda x, s: ((x != 0) | (s != 0)).astype(F32), far0, False),
+    "_logical_xor_scalar": (lambda x, s: ((x != 0) ^ (s != 0)).astype(F32), far0, False),
+}
+
+for _name, (_ref, _dom, _grad) in SCALAR.items():
+    _s = 1.5
+    add(_name, _dom((3, 4)), (lambda r: (lambda x, _r=r, _sv=_s: _r(x, _sv)))(_ref),
+        kwargs={"scalar": _s}, grad=_grad)
+
+add("smooth_l1", std((3, 4)),
+    lambda x: np.where(np.abs(x) < 1.0, 0.5 * x * x, np.abs(x) - 0.5),
+    kwargs={"scalar": 1.0}, grad=False)
+add("_scatter_elemwise_div", far0((3, 4), (3, 4)), lambda a, b: a / b)
+
+# ===========================================================================
+# 3. Reductions / softmax / sorting / cumulative
+# ===========================================================================
+REDUCE = {
+    "sum": (np.sum, std, True),
+    "mean": (np.mean, std, True),
+    "prod": (np.prod, pos, True),
+    "nansum": (np.nansum, std, False),
+    "nanprod": (np.nanprod, pos, False),
+    "max": (np.max, std, False),
+    "min": (np.min, std, False),
+}
+for _name, (_ref, _dom, _grad) in REDUCE.items():
+    add(_name, _dom((2, 3, 4)), _ref, ident="all")
+    add(_name, _dom((2, 3, 4)),
+        (lambda r: (lambda x, _r=r: _r(x, axis=1)))(_ref),
+        kwargs={"axis": 1}, ident="ax1", grad=_grad)
+    add(_name, _dom((2, 3, 4)),
+        (lambda r: (lambda x, _r=r: _r(x, axis=(0, 2), keepdims=True)))(_ref),
+        kwargs={"axis": (0, 2), "keepdims": True}, ident="ax02k")
+
+add("max_axis", std((2, 3, 4)), lambda x: np.max(x, axis=2), kwargs={"axis": 2})
+add("min_axis", std((2, 3, 4)), lambda x: np.min(x, axis=2), kwargs={"axis": 2})
+add("argmax", std((3, 5)), lambda x: np.argmax(x, axis=1).astype(F32), kwargs={"axis": 1})
+add("argmin", std((3, 5)), lambda x: np.argmin(x, axis=1).astype(F32), kwargs={"axis": 1})
+add("argmax_channel", std((3, 5)), lambda x: np.argmax(x, axis=-1).astype(F32))
+add("norm", std((3, 4)), lambda x: np.asarray(np.linalg.norm(x), F32),
+    ident="fro")
+add("norm", std((3, 4)), lambda x: np.asarray(np.abs(x).sum(axis=1), F32),
+    kwargs={"ord": 1, "axis": 1}, ident="l1ax")
+add("norm", std((3, 4)), lambda x: np.asarray(np.sqrt((x * x).sum(axis=0)), F32),
+    kwargs={"ord": 2, "axis": 0}, ident="l2ax", grad=True)
+add("logsumexp", std((3, 4)),
+    lambda x: np.log(np.exp(x).sum(axis=1)), kwargs={"axis": 1}, grad=True)
+add("moments", std((2, 6)),
+    lambda x: (x.mean(axis=1), x.var(axis=1)), kwargs={"axes": (1,)})
+add("all_finite", const(np.ones((2, 2), F32)), lambda x: np.ones((1,), F32))
+add("all_finite", const(np.array([[1.0, np.inf], [0.0, 1.0]], F32)),
+    lambda x: np.zeros((1,), F32), ident="inf")
+
+
+def _softmax_np(x, axis=-1, temperature=None):
+    x = x.astype(np.float64)
+    if temperature:
+        x = x / temperature
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return (e / e.sum(axis=axis, keepdims=True)).astype(F32)
+
+
+add("softmax", std((3, 5)), _softmax_np, grad=True)
+add("softmax", std((2, 3, 4)), lambda x: _softmax_np(x, axis=1),
+    kwargs={"axis": 1}, ident="ax1")
+add("softmax", std((3, 5)), lambda x: _softmax_np(x, temperature=2.0),
+    kwargs={"temperature": 2.0}, ident="temp")
+add("softmin", std((3, 5)), lambda x: _softmax_np(-x), grad=True)
+add("log_softmax", std((3, 5)), lambda x: np.log(_softmax_np(x)), grad=True,
+    atol=1e-4)
+add("SoftmaxActivation", std((3, 5)), _softmax_np)
+add("Softmax", mixed(std((3, 5)), ints((3,), hi=5)),
+    lambda x, y: _softmax_np(x))
+
+add("sort", std((3, 6)), lambda x: np.sort(x, axis=-1))
+add("sort", std((3, 6)), lambda x: -np.sort(-x, axis=-1),
+    kwargs={"is_ascend": False}, ident="desc")
+add("argsort", std((3, 6)), lambda x: np.argsort(x, axis=-1, kind="stable").astype(F32))
+add("topk", std((3, 6)),
+    lambda x: np.argsort(-x, axis=-1, kind="stable")[:, :2].astype(F32),
+    kwargs={"k": 2, "ret_typ": "indices"})
+add("topk", std((3, 6)),
+    lambda x: -np.sort(-x, axis=-1)[:, :2],
+    kwargs={"k": 2, "ret_typ": "value"}, ident="val")
+add("cumsum", std((3, 4)), lambda x: np.cumsum(x, axis=1), kwargs={"axis": 1},
+    grad=True)
+add("cumprod", pos((3, 4)), lambda x: np.cumprod(x, axis=1), kwargs={"axis": 1})
+
+# ===========================================================================
+# 4. Shape / indexing / creation
+# ===========================================================================
+add("reshape", std((2, 6)), lambda x: x.reshape(3, 4), kwargs={"shape": (3, 4)},
+    grad=True)
+add("Reshape", std((2, 6)), lambda x: x.reshape(4, 3), kwargs={"shape": (4, 3)})
+add("reshape", std((2, 6)), lambda x: x.reshape(2, 6), kwargs={"shape": (-1, 6)},
+    ident="neg1")
+add("reshape_like", std((2, 6), (3, 4)), lambda x, y: x.reshape(3, 4))
+add("flatten", std((2, 3, 4)), lambda x: x.reshape(2, 12), grad=True)
+add("Flatten", std((2, 3, 4)), lambda x: x.reshape(2, 12))
+add("transpose", std((2, 3, 4)), lambda x: x.transpose(2, 0, 1),
+    kwargs={"axes": (2, 0, 1)}, grad=True)
+add("transpose", std((3, 4)), lambda x: x.T)
+add("swapaxes", std((2, 3, 4)), lambda x: x.swapaxes(0, 2),
+    kwargs={"dim1": 0, "dim2": 2})
+add("SwapAxis", std((2, 3, 4)), lambda x: x.swapaxes(1, 2),
+    kwargs={"dim1": 1, "dim2": 2})
+add("expand_dims", std((3, 4)), lambda x: x[:, None, :], kwargs={"axis": 1},
+    grad=True)
+add("squeeze", const(np.ones((2, 1, 3), F32)), lambda x: x.squeeze(1),
+    kwargs={"axis": 1})
+add("stack", std((3, 4), (3, 4)), lambda a, b: np.stack([a, b], axis=1),
+    kwargs={"axis": 1}, grad=True)
+add("concat", std((2, 3), (2, 5)), lambda a, b: np.concatenate([a, b], axis=1),
+    kwargs={"dim": 1}, grad=True)
+add("Concat", std((2, 3), (3, 3)), lambda a, b: np.concatenate([a, b], axis=0),
+    kwargs={"dim": 0})
+add("add_n", std((3, 4), (3, 4), (3, 4)), lambda a, b, c: a + b + c, grad=True)
+add("ElementWiseSum", std((3, 4), (3, 4)), lambda a, b: a + b)
+add("slice", std((4, 6)), lambda x: x[1:3, 2:5],
+    kwargs={"begin": (1, 2), "end": (3, 5)}, grad=True)
+add("slice", std((4, 6)), lambda x: x[::2, ::3],
+    kwargs={"begin": (None, None), "end": (None, None), "step": (2, 3)},
+    ident="step")
+add("slice_axis", std((4, 6)), lambda x: x[:, 1:4],
+    kwargs={"axis": 1, "begin": 1, "end": 4}, grad=True)
+add("slice_like", std((4, 6), (2, 3)), lambda x, y: x[:2, :3])
+add("reverse", std((3, 4)), lambda x: x[::-1], kwargs={"axis": 0}, grad=True)
+add("flip", std((3, 4)), lambda x: x[:, ::-1], kwargs={"axis": 1})
+add("tile", std((2, 3)), lambda x: np.tile(x, (2, 2)), kwargs={"reps": (2, 2)},
+    grad=True)
+add("repeat", std((2, 3)), lambda x: np.repeat(x, 2, axis=1),
+    kwargs={"repeats": 2, "axis": 1}, grad=True)
+add("repeat", std((2, 3)), lambda x: np.repeat(x.ravel(), 2),
+    kwargs={"repeats": 2}, ident="flat")
+add("pad", const(np.arange(24, dtype=F32).reshape(1, 1, 4, 6) + 1),
+    lambda x: np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 2)), mode="constant",
+                     constant_values=3.0),
+    kwargs={"mode": "constant", "pad_width": (0, 0, 0, 0, 1, 1, 2, 2),
+            "constant_value": 3.0})
+add("pad", const(np.arange(24, dtype=F32).reshape(1, 1, 4, 6) + 1),
+    lambda x: np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)), mode="edge"),
+    kwargs={"mode": "edge", "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)},
+    ident="edge")
+add("Pad", const(np.arange(24, dtype=F32).reshape(1, 1, 4, 6) + 1),
+    lambda x: np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)), mode="reflect"),
+    kwargs={"mode": "reflect", "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)},
+    ident="reflect")
+add("clip", std((3, 4)), lambda x: np.clip(x, -1.0, 1.0),
+    kwargs={"a_min": -1.0, "a_max": 1.0}, grad=False)
+add("where", mixed(ints((3, 4), lo=0, hi=2), std((3, 4), (3, 4))),
+    lambda c, a, b: np.where(c != 0, a, b))
+add("cast", std((3, 4)), lambda x: x.astype(np.float64),
+    kwargs={"dtype": "float64"})
+add("Cast", std((3, 4)), lambda x: x.astype(np.int32),
+    kwargs={"dtype": "int32"})
+add("zeros_like", std((3, 4)), np.zeros_like)
+add("ones_like", std((3, 4)), np.ones_like)
+add("shape_array", std((3, 4)), lambda x: np.array([3, 4], np.int64))
+add("size_array", std((3, 4)), lambda x: np.array([12], np.int64))
+add("diag", std((4, 4)), lambda x: np.diag(x))
+add("diag", std((4, 4)), lambda x: np.diag(x, k=1), kwargs={"k": 1}, ident="k1")
+add("diag", std((4,)), lambda x: np.diag(x), ident="fromvec")
+add("broadcast_to", std((1, 4)), lambda x: np.broadcast_to(x, (3, 4)),
+    kwargs={"shape": (3, 4)})
+add("broadcast_like", std((1, 4), (3, 4)),
+    lambda x, y: np.broadcast_to(x, (3, 4)))
+add("broadcast_axis", std((1, 4)), lambda x: np.broadcast_to(x, (3, 4)),
+    kwargs={"axis": 0, "size": 3})
+add("broadcast_axes", std((1, 4)), lambda x: np.broadcast_to(x, (3, 4)),
+    kwargs={"axis": 0, "size": 3})
+add("depth_to_space", std((1, 8, 2, 3)),
+    lambda x: x.reshape(1, 2, 2, 2, 2, 3).transpose(0, 3, 4, 1, 5, 2)
+               .reshape(1, 2, 4, 6),
+    kwargs={"block_size": 2})
+add("space_to_depth", std((1, 2, 4, 6)),
+    lambda x: x.reshape(1, 2, 2, 2, 3, 2).transpose(0, 3, 5, 1, 2, 4)
+               .reshape(1, 8, 2, 3),
+    kwargs={"block_size": 2})
+add("one_hot", ints((5,), hi=4), lambda i: np.eye(4, dtype=F32)[i],
+    kwargs={"depth": 4})
+add("take", mixed(std((5, 3)), ints((4,), hi=5)),
+    lambda x, i: np.take(x, i, axis=0), kwargs={"axis": 0})
+add("take", mixed(std((3, 5)), ints((2, 2), hi=5)),
+    lambda x, i: np.take(x, i, axis=1), kwargs={"axis": 1}, ident="ax1")
+add("batch_take", mixed(std((3, 4)), ints((3,), hi=4)),
+    lambda x, i: x[np.arange(3), i])
+add("pick", mixed(std((3, 4)), ints((3,), hi=4)),
+    lambda x, i: x[np.arange(3), i], kwargs={"axis": 1})
+add("pick", mixed(std((3, 4)), ints((3,), hi=4)),
+    lambda x, i: x[np.arange(3), i][:, None],
+    kwargs={"axis": 1, "keepdims": True}, ident="keep")
+add("Embedding", mixed(ints((2, 3), hi=6), std((6, 4))),
+    lambda i, w: w[i], kwargs={"input_dim": 6, "output_dim": 4})
+add("SparseEmbedding", mixed(ints((2, 3), hi=6), std((6, 4))),
+    lambda i, w: w[i], kwargs={"input_dim": 6, "output_dim": 4})
+add("gather_nd", mixed(std((4, 5)), const(np.array([[0, 2], [1, 3]], np.int64))),
+    lambda x, idx: x[[0, 2], [1, 3]])
+add("scatter_nd", mixed(std((2,)), const(np.array([[0, 2], [1, 3]], np.int64).T)),
+    lambda v, idx: _scatter_nd_ref(v, idx, (4, 5)),
+    kwargs={"shape": (4, 5)})
+add("ravel_multi_index", const(np.array([[1, 2], [0, 3]], np.int64)),
+    lambda idx: np.ravel_multi_index(tuple(idx), (3, 4)).astype(np.int64),
+    kwargs={"shape": (3, 4)})
+add("unravel_index", const(np.array([4, 11], np.int64)),
+    lambda f: np.stack(np.unravel_index(f, (3, 4))).astype(np.int64),
+    kwargs={"shape": (3, 4)})
+add("split", std((4, 6)),
+    lambda x: tuple(np.split(x, 3, axis=1)),
+    kwargs={"num_outputs": 3, "axis": 1})
+add("SliceChannel", std((4, 6)),
+    lambda x: tuple(np.split(x, 2, axis=0)),
+    kwargs={"num_outputs": 2, "axis": 0})
+add("split_v2", std((4, 6)),
+    lambda x: tuple(np.split(x, [2, 3], axis=1)),
+    kwargs={"indices_or_sections": (2, 3), "axis": 1})
+add("eye_like", std((3, 4)), lambda x: np.eye(3, 4, dtype=F32))
+add("_identity_with_attr_like_rhs", std((3, 4), (3, 4)), lambda x, y: x)
+add("sequence_mask", mixed(std((4, 2, 3)), const(np.array([2, 4], F32))),
+    lambda d, sl: _seq_mask_ref(d, sl, 0.0),
+    kwargs={"use_sequence_length": True})
+add("SequenceMask", mixed(std((4, 2, 3)), const(np.array([1, 3], F32))),
+    lambda d, sl: _seq_mask_ref(d, sl, -1.0),
+    kwargs={"use_sequence_length": True, "value": -1.0}, ident="val")
+add("sequence_reverse", mixed(std((4, 2, 3)), const(np.array([2, 4], F32))),
+    lambda d, sl: _seq_rev_ref(d, sl),
+    kwargs={"use_sequence_length": True})
+add("SequenceReverse", std((4, 2, 3)), lambda d: d[::-1])
+add("sequence_last", mixed(std((4, 2, 3)), const(np.array([2, 4], F32))),
+    lambda d, sl: d[sl.astype(int) - 1, np.arange(2)],
+    kwargs={"use_sequence_length": True})
+add("SequenceLast", std((4, 2, 3)), lambda d: d[-1])
+add("rnn_param_concat", std((6,), (8,)),
+    lambda a, b: np.concatenate([a, b]), kwargs={"dim": 0})
+
+
+def _scatter_nd_ref(v, idx, shape):
+    out = np.zeros(shape, v.dtype)
+    out[tuple(idx)] = v
+    return out
+
+
+def _seq_mask_ref(d, sl, value):
+    out = d.copy()
+    for b in range(d.shape[1]):
+        out[int(sl[b]):, b] = value
+    return out
+
+
+def _seq_rev_ref(d, sl):
+    out = d.copy()
+    for b in range(d.shape[1]):
+        n = int(sl[b])
+        out[:n, b] = d[:n, b][::-1]
+    return out
+
+
+# creation ops (no array inputs — invoked with kwargs only)
+add("zeros", const(), lambda: np.zeros((2, 3), F32), kwargs={"shape": (2, 3)})
+add("_zeros", const(), lambda: np.zeros((2, 3), F32), kwargs={"shape": (2, 3)})
+add("_zeros_without_dtype", const(), lambda: np.zeros((2, 3), F32),
+    kwargs={"shape": (2, 3)})
+add("ones", const(), lambda: np.ones((2, 3), F32), kwargs={"shape": (2, 3)})
+add("_ones", const(), lambda: np.ones((2, 3), F32), kwargs={"shape": (2, 3)})
+add("full", const(), lambda: np.full((2, 3), 7.5, F32),
+    kwargs={"shape": (2, 3), "val": 7.5})
+add("_full", const(), lambda: np.full((2, 3), 7.5, F32),
+    kwargs={"shape": (2, 3), "value": 7.5})
+add("arange", const(), lambda: np.arange(2, 11, 3, dtype=F32),
+    kwargs={"start": 2, "stop": 11, "step": 3})
+add("_arange", const(), lambda: np.arange(0, 5, dtype=F32),
+    kwargs={"start": 0, "stop": 5})
+add("linspace", const(), lambda: np.linspace(0, 1, 5, dtype=F32),
+    kwargs={"start": 0, "stop": 1, "num": 5})
+add("_linspace", const(), lambda: np.linspace(0, 2, 4, dtype=F32),
+    kwargs={"start": 0, "stop": 2, "num": 4})
+add("eye", const(), lambda: np.eye(3, 4, 1, dtype=F32),
+    kwargs={"N": 3, "M": 4, "k": 1})
+add("_eye", const(), lambda: np.eye(3, dtype=F32), kwargs={"N": 3})
+
+# ===========================================================================
+# 5. NN ops (torch / formula references)
+# ===========================================================================
+
+
+def _t():
+    import torch
+    return torch
+
+
+def _conv2d_ref(x, w, b, stride=(1, 1), pad=(0, 0), dilate=(1, 1), groups=1):
+    t = _t()
+    with t.no_grad():
+        out = t.nn.functional.conv2d(
+            t.from_numpy(x).double(), t.from_numpy(w).double(),
+            t.from_numpy(b).double() if b is not None else None,
+            stride=stride, padding=pad, dilation=dilate, groups=groups)
+    return out.numpy().astype(F32)
+
+
+def _deconv2d_ref(x, w, b, stride=(1, 1), pad=(0, 0), dilate=(1, 1), groups=1):
+    t = _t()
+    with t.no_grad():
+        out = t.nn.functional.conv_transpose2d(
+            t.from_numpy(x).double(), t.from_numpy(w).double(),
+            t.from_numpy(b).double() if b is not None else None,
+            stride=stride, padding=pad, dilation=dilate, groups=groups)
+    return out.numpy().astype(F32)
+
+
+add("Convolution", std((2, 3, 5, 5), (4, 3, 3, 3), (4,)),
+    lambda x, w, b: _conv2d_ref(x, w, b),
+    kwargs={"kernel": (3, 3), "num_filter": 4}, grad=False)
+add("Convolution", std((1, 2, 6, 6), (4, 2, 3, 3), (4,)),
+    lambda x, w, b: _conv2d_ref(x, w, b, stride=(2, 2), pad=(1, 1)),
+    kwargs={"kernel": (3, 3), "num_filter": 4, "stride": (2, 2),
+            "pad": (1, 1)}, ident="s2p1", rtol=1e-4, atol=1e-4)
+add("Convolution", std((1, 4, 5, 5), (4, 2, 3, 3), (4,)),
+    lambda x, w, b: _conv2d_ref(x, w, b, groups=2),
+    kwargs={"kernel": (3, 3), "num_filter": 4, "num_group": 2}, ident="g2",
+    rtol=1e-4, atol=1e-4)
+add("Convolution_v1", std((2, 3, 5, 5), (4, 3, 3, 3), (4,)),
+    lambda x, w, b: _conv2d_ref(x, w, b),
+    kwargs={"kernel": (3, 3), "num_filter": 4})
+add("Deconvolution", std((1, 3, 4, 4), (3, 4, 3, 3), (4,)),
+    lambda x, w, b: _deconv2d_ref(x, w, b),
+    kwargs={"kernel": (3, 3), "num_filter": 4}, rtol=1e-4, atol=1e-4)
+
+
+def _pool_ref(x, kind, k, stride=None, pad=(0, 0), include_pad=True):
+    t = _t()
+    stride = stride or k
+    with t.no_grad():
+        xt = t.from_numpy(x).double()
+        if kind == "max":
+            out = t.nn.functional.max_pool2d(xt, k, stride=stride, padding=pad)
+        elif kind == "avg":
+            out = t.nn.functional.avg_pool2d(
+                xt, k, stride=stride, padding=pad,
+                count_include_pad=include_pad)
+        else:  # lp, p=2
+            out = t.nn.functional.lp_pool2d(xt, 2, k, stride=stride)
+    return out.numpy().astype(F32)
+
+
+add("Pooling", std((2, 3, 6, 6)), lambda x: _pool_ref(x, "max", (2, 2)),
+    kwargs={"kernel": (2, 2), "pool_type": "max", "stride": (2, 2)})
+add("Pooling", std((2, 3, 6, 6)),
+    lambda x: _pool_ref(x, "avg", (3, 3), stride=(2, 2)),
+    kwargs={"kernel": (3, 3), "pool_type": "avg", "stride": (2, 2)},
+    ident="avg")
+add("Pooling", std((2, 3, 5, 5)), lambda x: x.max(axis=(2, 3), keepdims=True),
+    kwargs={"kernel": (2, 2), "pool_type": "max", "global_pool": True},
+    ident="gmax")
+add("Pooling_v1", std((2, 3, 6, 6)), lambda x: _pool_ref(x, "max", (2, 2)),
+    kwargs={"kernel": (2, 2), "pool_type": "max", "stride": (2, 2)})
+add("FullyConnected", std((4, 6), (3, 6), (3,)),
+    lambda x, w, b: x @ w.T + b, kwargs={"num_hidden": 3}, grad=True)
+add("FullyConnected", std((4, 6), (3, 6)),
+    lambda x, w: x @ w.T, kwargs={"num_hidden": 3, "no_bias": True},
+    ident="nobias")
+add("dot", std((3, 4), (4, 5)), lambda a, b: a @ b, grad=True)
+add("dot", std((4, 3), (4, 5)), lambda a, b: a.T @ b,
+    kwargs={"transpose_a": True}, ident="ta")
+add("batch_dot", std((3, 2, 4), (3, 4, 5)), lambda a, b: np.matmul(a, b),
+    grad=True, grad_atol=4e-3)
+add("BatchNorm",
+    mixed(std((2, 3, 4, 4)), pos((3,)), std((3,)), std((3,)), pos((3,))),
+    lambda x, g, b, mm, mv: (g.reshape(1, 3, 1, 1) *
+                             (x - mm.reshape(1, 3, 1, 1)) /
+                             np.sqrt(mv.reshape(1, 3, 1, 1) + 1e-3) +
+                             b.reshape(1, 3, 1, 1)),
+    kwargs={"use_global_stats": True, "fix_gamma": False}, atol=1e-4)
+add("BatchNorm_v1",
+    mixed(std((2, 3, 4, 4)), pos((3,)), std((3,)), std((3,)), pos((3,))),
+    lambda x, g, b, mm, mv: (g.reshape(1, 3, 1, 1) *
+                             (x - mm.reshape(1, 3, 1, 1)) /
+                             np.sqrt(mv.reshape(1, 3, 1, 1) + 1e-3) +
+                             b.reshape(1, 3, 1, 1)),
+    kwargs={"use_global_stats": True, "fix_gamma": False}, atol=1e-4)
+add("LayerNorm", mixed(std((3, 6)), pos((6,)), std((6,))),
+    lambda x, g, b: ((x - x.mean(-1, keepdims=True)) /
+                     np.sqrt(x.var(-1, keepdims=True) + 1e-5)) * g + b,
+    atol=1e-4, grad=False)
+add("InstanceNorm", mixed(std((2, 3, 4, 4)), pos((3,)), std((3,))),
+    lambda x, g, b: _instnorm_ref(x, g, b), atol=1e-4)
+add("GroupNorm", mixed(std((2, 4, 3, 3)), pos((2,)), std((2,))),
+    lambda x, g, b: _groupnorm_ref(x, g, b, 2),
+    kwargs={"num_groups": 2}, atol=1e-4)
+add("L2Normalization", std((3, 6)),
+    lambda x: x / np.sqrt((x * x).sum(axis=1, keepdims=True) + 1e-10),
+    kwargs={"mode": "instance"}, atol=1e-4)
+add("LRN", std((2, 6, 3, 3)), lambda x: _lrn_ref(x, 5, 1e-4, 0.75, 2.0),
+    kwargs={"nsize": 5}, atol=1e-4)
+add("Dropout", std((3, 4)), lambda x: x, kwargs={"p": 0.0}, ident="p0")
+add("SoftmaxOutput", mixed(std((3, 5)), ints((3,), hi=5)),
+    lambda x, y: _softmax_np(x))
+add("softmax_cross_entropy", mixed(std((3, 5)), ints((3,), hi=5)),
+    lambda x, y: np.asarray(
+        -np.log(_softmax_np(x).astype(np.float64))[np.arange(3), y].sum(),
+        F32), atol=1e-4)
+add("LinearRegressionOutput", std((3, 4), (3, 4)), lambda x, y: x)
+add("MAERegressionOutput", std((3, 4), (3, 4)), lambda x, y: x)
+add("LogisticRegressionOutput", std((3, 4), (3, 4)),
+    lambda x, y: 1 / (1 + np.exp(-x)))
+add("SVMOutput", mixed(std((3, 5)), ints((3,), hi=5)), lambda x, y: x)
+add("IdentityAttachKLSparseReg", std((3, 4)), lambda x: x)
+add("_contrib_div_sqrt_dim", std((3, 8)),
+    lambda x: x / np.sqrt(8.0))
+add("_contrib_quadratic", std((3, 4)),
+    lambda x: 2.0 * x * x + 3.0 * x + 1.5,
+    kwargs={"a": 2.0, "b": 3.0, "c": 1.5}, grad=True)
+add("_contrib_index_array", const(np.zeros((2, 3), F32)),
+    lambda x: np.stack(np.meshgrid(np.arange(2), np.arange(3),
+                                   indexing="ij"), -1).astype(np.int64))
+add("_contrib_index_copy",
+    mixed(std((5, 3)), const(np.array([1, 3], np.int64)), std((2, 3))),
+    lambda x, idx, new: _index_copy_ref(x, idx, new))
+add("_contrib_getnnz", const(np.array([[0.0, 1.0], [2.0, 0.0]], F32)),
+    lambda x: np.asarray(2, np.int32))
+add("_contrib_fft", std((2, 8)), lambda x: _fft_ref(x), atol=1e-4)
+add("_contrib_ifft", std((2, 16)), lambda x: _ifft_ref(x), atol=1e-4)
+
+
+def _instnorm_ref(x, g, b):
+    mu = x.mean(axis=(2, 3), keepdims=True)
+    var = x.var(axis=(2, 3), keepdims=True)
+    xn = (x - mu) / np.sqrt(var + 1e-3)
+    return xn * g.reshape(1, -1, 1, 1) + b.reshape(1, -1, 1, 1)
+
+
+def _groupnorm_ref(x, g, b, ngroups):
+    n, c, h, w = x.shape
+    xg = x.reshape(n, ngroups, c // ngroups, h, w)
+    mu = xg.mean(axis=(2, 3, 4), keepdims=True)
+    var = xg.var(axis=(2, 3, 4), keepdims=True)
+    xn = ((xg - mu) / np.sqrt(var + 1e-5)).reshape(n, c, h, w)
+    return (xn * np.repeat(g, c // ngroups).reshape(1, c, 1, 1) +
+            np.repeat(b, c // ngroups).reshape(1, c, 1, 1))
+
+
+def _lrn_ref(x, nsize, alpha, beta, knorm):
+    c = x.shape[1]
+    half = nsize // 2
+    sq = x * x
+    out = np.empty_like(x)
+    for i in range(c):
+        lo, hi = max(0, i - half), min(c, i + half + 1)
+        denom = knorm + (alpha / nsize) * sq[:, lo:hi].sum(axis=1)
+        out[:, i] = x[:, i] / denom ** beta
+    return out
+
+
+def _fft_ref(x):
+    f = np.fft.fft(x.astype(np.float64), axis=-1)
+    out = np.empty(x.shape[:-1] + (2 * x.shape[-1],))
+    out[..., 0::2] = f.real
+    out[..., 1::2] = f.imag
+    return out.astype(F32)
+
+
+def _ifft_ref(x):
+    comp = x[..., 0::2] + 1j * x[..., 1::2]
+    return (np.fft.ifft(comp, axis=-1).real * comp.shape[-1]).astype(F32)
+
+
+def _index_copy_ref(x, idx, new):
+    out = x.copy()
+    out[idx] = new
+    return out
+
+
+# im2col / col2im
+add("im2col", std((1, 2, 4, 4)),
+    lambda x: _t().nn.functional.unfold(
+        _t().from_numpy(x).double(), (3, 3)).numpy().astype(F32),
+    kwargs={"kernel": (3, 3)})
+add("col2im",
+    std((1, 18, 4)),
+    lambda c: _t().nn.functional.fold(
+        _t().from_numpy(c).double(), (4, 4), (3, 3)).numpy().astype(F32),
+    kwargs={"output_size": (4, 4), "kernel": (3, 3)})
+
+# ===========================================================================
+# 6. Linalg
+# ===========================================================================
+
+
+def _lower4(rng):
+    m = rng.uniform(0.5, 1.5, (4, 4))
+    return [np.tril(m).astype(F32) + np.eye(4, dtype=F32)]
+
+
+def _lower(n):
+    def make(rng):
+        m = rng.uniform(0.5, 1.5, (n, n))
+        return [np.tril(m).astype(F32) + np.eye(n, dtype=F32)]
+    return make
+
+
+add("linalg_gemm", std((3, 4), (4, 5), (3, 5)),
+    lambda a, b, c: 1.5 * (a @ b) + 0.5 * c,
+    kwargs={"alpha": 1.5, "beta": 0.5}, grad=True)
+add("linalg_gemm", std((4, 3), (4, 5), (3, 5)),
+    lambda a, b, c: (a.T @ b) + c,
+    kwargs={"transpose_a": True}, ident="ta")
+add("linalg_gemm2", std((3, 4), (4, 5)), lambda a, b: a @ b, grad=True)
+add("linalg_gemm2", std((2, 3, 4), (2, 5, 4)),
+    lambda a, b: np.matmul(a, b.transpose(0, 2, 1)),
+    kwargs={"transpose_b": True}, ident="batch-tb")
+add("linalg_syrk", std((3, 4)), lambda a: a @ a.T)
+add("linalg_syrk", std((3, 4)), lambda a: 2.0 * (a.T @ a),
+    kwargs={"transpose": True, "alpha": 2.0}, ident="t")
+add("linalg_potrf", spd(4), lambda m: np.linalg.cholesky(m), atol=1e-3,
+    rtol=1e-3)
+add("linalg_potri", _lower4, lambda l: np.linalg.inv(l @ l.T),
+    atol=1e-2, rtol=1e-2)
+add("linalg_det", spd(3), lambda m: np.linalg.det(m), rtol=1e-3, atol=1e-3)
+add("det", spd(3), lambda m: np.linalg.det(m), rtol=1e-3, atol=1e-3)
+add("linalg_slogdet", spd(3),
+    lambda m: tuple(np.asarray(v, F32) for v in np.linalg.slogdet(m)),
+    rtol=1e-3, atol=1e-3)
+add("slogdet", spd(3),
+    lambda m: tuple(np.asarray(v, F32) for v in np.linalg.slogdet(m)),
+    rtol=1e-3, atol=1e-3)
+add("linalg_inverse", spd(3), lambda m: np.linalg.inv(m), rtol=1e-3, atol=1e-3)
+add("inverse", spd(3), lambda m: np.linalg.inv(m), rtol=1e-3, atol=1e-3)
+add("linalg_sumlogdiag", spd(3),
+    lambda m: np.asarray(np.log(np.diag(m)).sum(), F32).reshape(()) + 0,
+    rtol=1e-4, atol=1e-4)
+add("linalg_extractdiag", std((4, 4)), lambda m: np.diag(m))
+add("linalg_makediag", std((4,)), lambda v: np.diag(v))
+add("linalg_extracttrian", const(np.arange(16, dtype=F32).reshape(4, 4)),
+    lambda m: m[np.tril_indices(4)])
+add("linalg_maketrian", const(np.arange(10, dtype=F32) + 1),
+    lambda v: _maketrian_ref(v, 4))
+add("linalg_trmm", mixed(_lower(3), std((3, 4))),
+    lambda l, x: l @ x, rtol=1e-4, atol=1e-4)
+add("linalg_trsm", mixed(_lower(3), std((3, 4))),
+    lambda l, x: np.linalg.solve(l, x), rtol=1e-3, atol=1e-3)
+add("khatri_rao", std((2, 3), (4, 3)),
+    lambda a, b: np.einsum("ik,jk->ijk", a, b).reshape(8, 3))
+
+
+def _maketrian_ref(v, n):
+    out = np.zeros((n, n), F32)
+    out[np.tril_indices(n)] = v
+    return out
+
+
+# ===========================================================================
+# 7. Random-pdf ops (deterministic density evaluations)
+# ===========================================================================
+add("random_pdf_normal", mixed(std((2, 4)), pos((2,)), pos((2,))),
+    lambda s, mu, sig: np.exp(-0.5 * ((s - mu[:, None]) / sig[:, None]) ** 2) /
+    (sig[:, None] * np.sqrt(2 * np.pi)), atol=1e-5)
+add("random_pdf_uniform", mixed(pos((2, 4)), const(np.zeros(2, F32)),
+                                const(np.full(2, 3.0, F32))),
+    lambda s, lo, hi: np.where((s >= lo[:, None]) & (s <= hi[:, None]),
+                               1.0 / (hi - lo)[:, None], 0.0).astype(F32))
+add("random_pdf_exponential", mixed(pos((2, 4)), pos((2,))),
+    lambda s, lam: lam[:, None] * np.exp(-lam[:, None] * s))
+add("random_pdf_gamma", mixed(pos((2, 4)), pos((2,)), pos((2,))),
+    lambda s, a, b: _gamma_pdf(s, a[:, None], b[:, None]), atol=1e-4)
+add("random_pdf_poisson", mixed(ints((2, 4), hi=6), pos((2,))),
+    lambda s, lam: np.exp(-lam[:, None]) * lam[:, None] ** s /
+    _sp().gamma(s + 1.0), atol=1e-5)
+add("random_pdf_dirichlet", mixed(const(np.array([[0.3, 0.7], [0.5, 0.5]], F32)),
+                                  pos((2, 2))),
+    lambda s, a: _dirichlet_pdf(s, a), atol=1e-4)
+
+
+def _gamma_pdf(s, a, b):
+    # reference pdf_op.h PDF_Gamma: rate convention
+    # exp(a log b + (a-1) log x - b x - lgamma(a))
+    return np.exp(a * np.log(b) + (a - 1) * np.log(s) - b * s -
+                  _sp().gammaln(a)).astype(F32)
+
+
+def _dirichlet_pdf(s, a):
+    from scipy.stats import dirichlet
+    out = np.array([dirichlet.pdf(s[i] / s[i].sum(), a[i])
+                    for i in range(s.shape[0])], F32)
+    return out
+
+
+# ===========================================================================
+# 8. np namespace extras (invoked via mx.np.<name>)
+# ===========================================================================
+
+add("hypot", far0((3, 4), (3, 4)), np.hypot, ns="np")
+add("deg2rad", std((3, 4)), np.deg2rad, ns="np")
+add("rad2deg", std((3, 4)), np.rad2deg, ns="np")
+add("diff", std((3, 6)), lambda x: np.diff(x, axis=1), ns="np")
+add("trace", std((4, 4)), lambda x: np.asarray(np.trace(x), F32), ns="np")
+add("tensordot", std((2, 3, 4), (3, 4, 5)),
+    lambda a, b: np.tensordot(a, b, axes=2), ns="np", kwargs={"axes": 2})
+add("unique", const(np.array([3.0, 1.0, 3.0, 2.0, 1.0], F32)),
+    lambda x: np.unique(x), ns="np")
+add("tril", std((4, 4)), np.tril, ns="np")
+add("rot90", std((3, 4)), lambda x: np.rot90(x), ns="np")
+add("around", std((3, 4)), np.around, ns="np")
+add("bincount", ints((8,), hi=5), lambda x: np.bincount(x).astype(np.int64),
+    ns="np")
+add("nan_to_num", const(np.array([[np.nan, 1.0], [np.inf, -np.inf]], F32)),
+    lambda x: np.nan_to_num(x), ns="np")
+add("moveaxis", std((2, 3, 4)), lambda x: np.moveaxis(x, 0, 2), ns="np",
+    kwargs={"source": 0, "destination": 2})
+add("roll", std((3, 4)), lambda x: np.roll(x, 2, axis=1), ns="np",
+    kwargs={"shift": 2, "axis": 1})
+add("nonzero", const(np.array([[0.0, 2.0], [3.0, 0.0]], F32)),
+    lambda x: tuple(i.astype(np.int64) for i in np.nonzero(x)), ns="np")
+add("logspace", const(), lambda: np.logspace(0, 2, 5).astype(F32), ns="np",
+    kwargs={"start": 0, "stop": 2, "num": 5}, atol=1e-3, rtol=1e-4)
+add("hanning", const(), lambda: np.hanning(6).astype(F32), ns="np",
+    kwargs={"M": 6}, atol=1e-6)
+add("hamming", const(), lambda: np.hamming(6).astype(F32), ns="np",
+    kwargs={"M": 6}, atol=1e-6)
+add("blackman", const(), lambda: np.blackman(6).astype(F32), ns="np",
+    kwargs={"M": 6}, atol=1e-6)
+add("full_like", std((3, 4)), lambda x: np.full_like(x, 2.5), ns="np",
+    kwargs={"fill_value": 2.5})
+add("std", std((3, 4)), lambda x: np.asarray(x.std(), F32), ns="np",
+    atol=1e-5)
+add("var", std((3, 4)), lambda x: np.asarray(x.var(), F32), ns="np",
+    atol=1e-5)
+
+# image ops
+add("image_to_tensor", pos((4, 5, 3), lo=0.0, hi=1.0),
+    lambda x: x.transpose(2, 0, 1) / 255.0, atol=1e-6)
+add("image_normalize", pos((3, 4, 5), lo=0.1, hi=1.0),
+    lambda x: (x - 0.5) / 0.25,
+    kwargs={"mean": (0.5, 0.5, 0.5), "std": (0.25, 0.25, 0.25)})
+add("image_flip_left_right", std((4, 5, 3)), lambda x: x[:, ::-1, :])
+add("image_flip_top_bottom", std((4, 5, 3)), lambda x: x[::-1, :, :])
+add("image_crop", std((6, 8, 3)), lambda x: x[1:5, 2:7, :],
+    kwargs={"x": 2, "y": 1, "width": 5, "height": 4})
+
+
+# np namespace: logic/stacking/linalg extras (reference _npi_* / _np_* ops)
+add("all", const(np.array([[1.0, 2.0], [3.0, 4.0]], F32)),
+    lambda x: np.asarray(np.all(x), np.bool_), ns="np")
+add("all", const(np.array([[1.0, 0.0], [3.0, 4.0]], F32)),
+    lambda x: np.all(x, axis=1), ns="np", kwargs={"axis": 1}, ident="ax1")
+add("any", const(np.array([[0.0, 0.0], [3.0, 0.0]], F32)),
+    lambda x: np.any(x, axis=1), ns="np", kwargs={"axis": 1})
+add("diagflat", std((2, 3)), lambda x: np.diagflat(x), ns="np")
+add("diagonal", std((3, 4)), lambda x: np.diagonal(x), ns="np")
+add("diagonal", std((2, 3, 3)),
+    lambda x: np.diagonal(x, axis1=1, axis2=2), ns="np",
+    kwargs={"axis1": 1, "axis2": 2}, ident="batch")
+add("average", std((3, 4)), lambda x: np.asarray(np.average(x), F32), ns="np")
+add("bitwise_not", ints((3, 4), lo=0, hi=8),
+    lambda x: np.bitwise_not(x), ns="np")
+add("bitwise_or", mixed(ints((3, 4), hi=8), ints((3, 4), hi=8)),
+    lambda a, b: np.bitwise_or(a, b), ns="np")
+add("bitwise_xor", mixed(ints((3, 4), hi=8), ints((3, 4), hi=8)),
+    lambda a, b: np.bitwise_xor(a, b), ns="np")
+add("lcm", mixed(ints((3, 4), lo=1, hi=9), ints((3, 4), lo=1, hi=9)),
+    lambda a, b: np.lcm(a, b), ns="np")
+add("concatenate", std((2, 3), (2, 4)),
+    lambda a, b: np.concatenate([a, b], axis=1), ns="np",
+    kwargs={"axis": 1}, varargs=True)
+add("column_stack", std((4,), (4,)),
+    lambda a, b: np.column_stack([a, b]), ns="np", varargs=True)
+add("vstack", std((2, 3), (1, 3)),
+    lambda a, b: np.vstack([a, b]), ns="np", varargs=True)
+add("dstack", std((2, 3), (2, 3)),
+    lambda a, b: np.dstack([a, b]), ns="np", varargs=True)
+add("hsplit", std((2, 6)),
+    lambda x: tuple(np.hsplit(x, 3)), ns="np", kwargs={"indices_or_sections": 3})
+add("delete", std((2, 6)),
+    lambda x: np.delete(x, 2, axis=1), ns="np", kwargs={"obj": 2, "axis": 1})
+add("indices", const(), lambda: np.indices((2, 3)).astype(np.int64), ns="np",
+    kwargs={"dimensions": (2, 3)})
+add("true_divide", far0((3, 4), (3, 4)), lambda a, b: a / b, ns="np")
+add("cholesky", spd(4), lambda m: np.linalg.cholesky(m), ns="np.linalg",
+    rtol=1e-3, atol=1e-3)
+add("solve", mixed(spd(3), std((3, 2))),
+    lambda a, b: np.linalg.solve(a, b), ns="np.linalg", rtol=1e-3, atol=1e-3)
+def _invertible4(rng):
+    m = rng.uniform(-1, 1, (4, 4))
+    m = m @ m.T + 3.0 * np.eye(4)
+    return [m.reshape(2, 2, 2, 2).astype(F32)]
+
+
+add("tensorinv", _invertible4, lambda a: np.linalg.tensorinv(a, ind=2),
+    ns="np.linalg", rtol=1e-3, atol=1e-3)
+add("tensorsolve", mixed(_invertible4, std((2, 2))),
+    lambda a, b: np.linalg.tensorsolve(a, b), ns="np.linalg",
+    rtol=1e-3, atol=1e-3)
+add("UpSampling", std((1, 2, 3, 3)),
+    lambda x: x.repeat(2, axis=2).repeat(2, axis=3),
+    kwargs={"scale": 2, "sample_type": "nearest"})
+add("histogram", const(np.array([0.1, 0.4, 0.6, 0.9, 0.4], F32)),
+    lambda x: (np.histogram(x, bins=4, range=(0.0, 1.0))[0].astype(np.int64),
+               np.histogram(x, bins=4, range=(0.0, 1.0))[1].astype(F32)),
+    ns="np", kwargs={"bins": 4, "range": (0.0, 1.0)})

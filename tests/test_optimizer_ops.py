@@ -291,3 +291,80 @@ def test_sgd_clip_enabled_at_zero():
                         clip_gradient=0.0)
     np.testing.assert_allclose(out.asnumpy(), [2.0 - 0.1 * (0.0 + 0.5 * 2.0)],
                                rtol=1e-6)
+
+
+def test_ftrl_matches_reference_math():
+    """FtrlUpdateKernel (src/operator/optimizer_op-inl.h:2135-2157)."""
+    rng = np.random.RandomState(5)
+    w0 = rng.randn(6).astype(np.float32)
+    g0 = rng.randn(6).astype(np.float32)
+    z0 = rng.randn(6).astype(np.float32) * 0.1
+    n0 = np.abs(rng.randn(6)).astype(np.float32)
+    lr, lamda1, beta, wd = 0.1, 0.05, 1.0, 0.01
+    w, g = nd.array(w0), nd.array(g0)
+    z, n = nd.array(z0), nd.array(n0)
+    out = nd.ftrl_update(w, g, z, n, lr=lr, lamda1=lamda1, beta=beta, wd=wd)
+    zr = z0 + g0 - (np.sqrt(n0 + g0 * g0) - np.sqrt(n0)) * w0 / lr
+    nr = n0 + g0 * g0
+    want = ((np.sign(zr) * lamda1 - zr) /
+            ((beta + np.sqrt(nr)) / lr + wd) * (np.abs(zr) > lamda1))
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(z.asnumpy(), zr, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(n.asnumpy(), nr, rtol=1e-5, atol=1e-6)
+
+
+def test_ftml_matches_reference_math():
+    """FTMLKernel (src/operator/optimizer_op-inl.h:1205-1226)."""
+    rng = np.random.RandomState(6)
+    w0 = rng.randn(5).astype(np.float32)
+    g0 = rng.randn(5).astype(np.float32)
+    d0 = np.abs(rng.randn(5)).astype(np.float32)
+    v0 = np.abs(rng.randn(5)).astype(np.float32)
+    z0 = rng.randn(5).astype(np.float32) * 0.1
+    lr, t, b1, b2, eps, wd = 0.05, 3, 0.6, 0.999, 1e-8, 0.01
+    w = nd.array(w0)
+    d, v, z = nd.array(d0), nd.array(v0), nd.array(z0)
+    out = nd.ftml_update(w, nd.array(g0), d, v, z, lr=lr, t=t, beta1=b1,
+                         beta2=b2, epsilon=eps, wd=wd)
+    gi = g0 + wd * w0
+    vr = b2 * v0 + (1 - b2) * gi * gi
+    dt = (1 - b1 ** t) / lr * (np.sqrt(vr / (1 - b2 ** t)) + eps)
+    zr = b1 * z0 + (1 - b1) * gi - (dt - b1 * d0) * w0
+    want = -zr / dt
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+
+def test_rmsprop_matches_reference_math():
+    """RMSPropUpdateKernel: n = (1-g1) grad^2 + g1 n; w -= lr g/sqrt(n+eps),
+    with wd folded before clipping."""
+    rng = np.random.RandomState(7)
+    w0 = rng.randn(5).astype(np.float32)
+    g0 = rng.randn(5).astype(np.float32)
+    n0 = np.abs(rng.randn(5)).astype(np.float32)
+    lr, rho, eps, wd, clip = 0.01, 0.9, 1e-8, 0.1, 0.8
+    out = nd.rmsprop_update(nd.array(w0), nd.array(g0), nd.array(n0), lr=lr,
+                            rho=rho, epsilon=eps, wd=wd, clip_gradient=clip)
+    gr = np.clip(g0 + wd * w0, -clip, clip)
+    nr = rho * n0 + (1 - rho) * gr * gr
+    want = w0 - lr * gr / np.sqrt(nr + eps)
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5, atol=1e-6)
+
+
+def test_rmspropalex_matches_reference_math():
+    """RMSPropAlexUpdateKernel (src/operator/optimizer_op-inl.h:1953)."""
+    rng = np.random.RandomState(8)
+    w0 = rng.randn(5).astype(np.float32)
+    g0 = rng.randn(5).astype(np.float32)
+    n0 = np.abs(rng.randn(5)).astype(np.float32)
+    ga0 = rng.randn(5).astype(np.float32) * 0.1
+    dl0 = rng.randn(5).astype(np.float32) * 0.1
+    lr, rho, mom, eps, wd = 0.01, 0.95, 0.9, 1e-8, 0.02
+    out = nd.rmspropalex_update(
+        nd.array(w0), nd.array(g0), nd.array(n0), nd.array(ga0),
+        nd.array(dl0), lr=lr, rho=rho, momentum=mom, epsilon=eps, wd=wd)
+    gr = g0 + wd * w0
+    nr = rho * n0 + (1 - rho) * gr * gr
+    gar = rho * ga0 + (1 - rho) * gr
+    dlr = mom * dl0 - lr * gr / np.sqrt(nr - gar * gar + eps)
+    want = w0 + dlr
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-4, atol=1e-5)
