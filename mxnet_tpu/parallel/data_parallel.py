@@ -200,7 +200,8 @@ class DataParallelTrainer:
 
     def __init__(self, net: HybridBlock, loss, optimizer="sgd",
                  optimizer_params=None, mesh: Optional[Mesh] = None,
-                 batch_axis_name: str = "dp", dtype=None, data_spec=None):
+                 batch_axis_name: str = "dp", dtype=None, data_spec=None,
+                 compression=None):
         self.net = net
         # Mixed precision: dtype="bfloat16" (or "float16") runs forward/backward
         # in low precision with fp32 master weights + fp32 optimizer math —
@@ -259,6 +260,39 @@ class DataParallelTrainer:
         # later use of the net or a second trainer on it)
         self._params_raw = [jax.device_put(jnp.array(w, copy=True), s)
                             for w, s in zip(self._params_raw, self._param_shardings)]
+
+        # 2-bit gradient compression with per-device error feedback
+        # (reference src/kvstore/gradient_compression.cc:60). Each device
+        # quantizes its LOCAL gradient (+ residual) to {-thr, 0, +thr}
+        # before the cross-dp reduce — the collective then carries the
+        # quantized tensor, like the reference's ps-lite push path. Needs
+        # explicit per-device semantics, so the compressed step runs the
+        # grad computation under shard_map over the dp axis; that is only
+        # well-defined for pure data parallelism (replicated params,
+        # batch-only data sharding), matching the reference's dist-DP scope.
+        self._compression = dict(compression) if compression else None
+        if self._compression:
+            ctype = self._compression.get("type", "2bit")
+            if ctype != "2bit":
+                raise MXNetError(f"unsupported gradient compression {ctype!r}")
+            bad = [p.name for p, s in zip(self._plist, self._param_shardings)
+                   if any(ax is not None for ax in s.spec)]
+            if bad or tuple(self.data_spec) != (self.batch_axis,):
+                raise MXNetError(
+                    "gradient compression requires pure data parallelism "
+                    "(replicated parameters, data sharded over the batch "
+                    f"axis only); offending params={bad[:3]} "
+                    f"data_spec={self.data_spec}")
+            ndp = self.mesh.shape[self.batch_axis]
+            thr_sh = NamedSharding(self.mesh, P(self.batch_axis))
+            self._comp_resid = [
+                jax.device_put(
+                    jnp.zeros((ndp,) + w.shape, jnp.float32), thr_sh)
+                if t and jnp.issubdtype(w.dtype, jnp.floating) else
+                jax.device_put(jnp.zeros((ndp, 1), jnp.float32), thr_sh)
+                for w, t in zip(self._params_raw, self._trainable)]
+        else:
+            self._comp_resid = []
 
     # -- loss plumbing -------------------------------------------------------
     def _loss_raw(self, pred_raw, label_raw):
@@ -329,10 +363,105 @@ class DataParallelTrainer:
             return new_params, new_state, lossv, finite, aux
         return step
 
+    def _build_step_compressed(self):
+        """Fused step with 2-bit compression + error feedback before the
+        cross-dp reduce (reference gradient_compression.cc semantics on the
+        XLA collective path). Per-device gradients exist only under explicit
+        SPMD, so the whole step body runs in shard_map over the dp axis."""
+        apply_fn = _make_apply_fn(self.net, self._plist, train=True)
+        update_fn = self._update_fn
+        loss_raw = self._loss_raw
+        wds = [self.optimizer._get_wd(i) for i in range(len(self._plist))]
+        trainable = self._trainable
+        mesh = self.mesh
+        ax = self.batch_axis
+        thr = jnp.float32(self._compression.get("threshold", 0.5))
+        cdt = self.compute_dtype
+        scaled = self._scaler is not None
+
+        def _low(a):
+            if cdt is not None and jnp.issubdtype(a.dtype, jnp.floating):
+                return a.astype(cdt)
+            return a
+
+        def body(params, opt_state, resid, key, x, y, lr, t, loss_scale):
+            # x/y/resid are the device-local tiles; params are replicated
+            idx = lax.axis_index(ax)
+            kk = jax.random.wrap_key_data(key.astype(jnp.uint32),
+                                          impl="threefry2x32")
+            key_local = jax.random.key_data(jax.random.fold_in(kk, idx))
+
+            def lossf(ps):
+                out, aux = apply_fn(key_local, [_low(p) for p in ps], _low(x))
+                pred = out if not isinstance(out, tuple) else out[0]
+                lossv = loss_raw(pred, y)  # mean over the LOCAL batch
+                return lossv * loss_scale, (lossv, aux)
+
+            (_, (lossv, aux)), grads = jax.value_and_grad(
+                lossf, has_aux=True)(params)
+            if scaled:
+                inv = 1.0 / loss_scale
+                grads = [g * inv if jnp.issubdtype(g.dtype, jnp.floating)
+                         else g for g in grads]
+                fin = jnp.bool_(True)
+                for i, g in enumerate(grads):
+                    if trainable[i] and jnp.issubdtype(g.dtype, jnp.floating):
+                        fin = jnp.logical_and(
+                            fin, jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+                finite = lax.pmin(fin.astype(jnp.int32), ax).astype(jnp.bool_)
+            else:
+                finite = jnp.bool_(True)
+
+            new_params, new_state, new_resid = [], [], []
+            for i, (g, w, s, r) in enumerate(
+                    zip(grads, params, opt_state, resid)):
+                if not trainable[i]:
+                    new_params.append(w)
+                    new_state.append(s)
+                    new_resid.append(r)
+                    continue
+                if jnp.issubdtype(w.dtype, jnp.floating):
+                    # quantize LOCAL grad + residual to {-thr, 0, +thr};
+                    # only the 2-bit tensor rides the collective
+                    acc = g.astype(jnp.float32) + r[0]
+                    q = jnp.where(acc >= thr, thr,
+                                  jnp.where(acc <= -thr, -thr,
+                                            jnp.zeros_like(acc)))
+                    new_resid.append((acc - q)[None])
+                    gg = lax.pmean(q, ax)
+                else:
+                    new_resid.append(r)
+                    gg = lax.pmean(g, ax)
+                w2, s2 = update_fn(gg, w, s, t, lr, jnp.float32(wds[i]))
+                w2 = w2.astype(w.dtype)
+                if scaled:
+                    w2 = jnp.where(finite, w2, w)
+                    s2 = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(finite, new, old), s2, s)
+                new_params.append(w2)
+                new_state.append(s2)
+            glob_loss = lax.pmean(lossv, ax)
+            aux = jax.tree_util.tree_map(
+                lambda v: lax.pmean(v, ax)
+                if jnp.issubdtype(v.dtype, jnp.floating) else v, aux)
+            return new_params, new_state, new_resid, glob_loss, finite, aux
+
+        dp = P(ax)
+        rep = P()
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(rep, rep, dp, rep, dp, dp, rep, rep, rep),
+            out_specs=(rep, rep, dp, rep, rep, rep),
+            check_vma=False)
+
     def _get_step(self, sig):
         fn = self._step_jit.get(sig)
         if fn is None:
-            fn = jax.jit(self._build_step(None, None), donate_argnums=(0, 1))
+            if self._compression:
+                fn = jax.jit(self._build_step_compressed(),
+                             donate_argnums=(0, 1, 2))
+            else:
+                fn = jax.jit(self._build_step(None, None), donate_argnums=(0, 1))
             self._step_jit[sig] = fn
         return fn
 
@@ -340,26 +469,36 @@ class DataParallelTrainer:
         key = (sig, "multi", n)
         fn = self._step_jit.get(key)
         if fn is None:
-            body = self._build_step(None, None)
+            compressed = bool(self._compression)
+            body = self._build_step_compressed() if compressed \
+                else self._build_step(None, None)
 
-            @functools.partial(jax.jit, donate_argnums=(0, 1))
-            def multi(params, opt_state, key_raw, x, y, lr, t0, loss_scale):
+            @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+            def multi(params, opt_state, resid, key_raw, x, y, lr, t0,
+                      loss_scale):
                 kk = jax.random.wrap_key_data(key_raw.astype(jnp.uint32),
                                               impl="threefry2x32")
 
                 def sbody(carry, i):
-                    params, opt_state, t = carry
+                    params, opt_state, resid, t = carry
                     ki = jax.random.key_data(jax.random.fold_in(kk, i))
                     # per-step batch when x is stacked (n, B, ...), else reuse
                     xi = x[i] if stacked else x
                     yi = y[i] if stacked else y
-                    p2, s2, lossv, finite, aux = body(
-                        params, opt_state, ki, xi, yi, lr[i], t, loss_scale)
-                    return (p2, s2, t + 1.0), (lossv, finite)
+                    if compressed:
+                        p2, s2, r2, lossv, finite, aux = body(
+                            params, opt_state, resid, ki, xi, yi, lr[i], t,
+                            loss_scale)
+                    else:
+                        p2, s2, lossv, finite, aux = body(
+                            params, opt_state, ki, xi, yi, lr[i], t,
+                            loss_scale)
+                        r2 = resid
+                    return (p2, s2, r2, t + 1.0), (lossv, finite)
 
-                (p, s, _), (losses, finites) = lax.scan(
-                    sbody, (params, opt_state, t0), jnp.arange(n))
-                return p, s, losses, jnp.all(finites)
+                (p, s, r, _), (losses, finites) = lax.scan(
+                    sbody, (params, opt_state, resid, t0), jnp.arange(n))
+                return p, s, r, losses, jnp.all(finites)
             fn = multi
             self._step_jit[key] = fn
         return fn
@@ -398,9 +537,10 @@ class DataParallelTrainer:
         xr = jax.device_put(xr, NamedSharding(self.mesh, P(*spec[:xr.ndim])))
         yr = jax.device_put(yr, NamedSharding(self.mesh, P(*spec[:yr.ndim])))
         scale = jnp.float32(self._scaler.loss_scale if self._scaler else 1.0)
-        self._params_raw, self._opt_state, losses, finite = fn(
-            self._params_raw, self._opt_state, key, xr, yr, lr,
-            jnp.float32(self._t + 1), scale)
+        (self._params_raw, self._opt_state, self._comp_resid, losses,
+         finite) = fn(
+            self._params_raw, self._opt_state, self._comp_resid, key, xr, yr,
+            lr, jnp.float32(self._t + 1), scale)
         self._t += n
         self.optimizer.num_update = self._t
         if self._scaler is not None:
@@ -424,9 +564,15 @@ class DataParallelTrainer:
             else P(*self.data_spec[:yr.ndim])
         yr = jax.device_put(yr, NamedSharding(self.mesh, y_spec))
         scale = jnp.float32(self._scaler.loss_scale if self._scaler else 1.0)
-        self._params_raw, self._opt_state, lossv, finite, aux = fn(
-            self._params_raw, self._opt_state, key, xr, yr, lr,
-            jnp.float32(self._t), scale)
+        if self._compression:
+            (self._params_raw, self._opt_state, self._comp_resid, lossv,
+             finite, aux) = fn(
+                self._params_raw, self._opt_state, self._comp_resid,
+                jnp.asarray(key), xr, yr, lr, jnp.float32(self._t), scale)
+        else:
+            self._params_raw, self._opt_state, lossv, finite, aux = fn(
+                self._params_raw, self._opt_state, key, xr, yr, lr,
+                jnp.float32(self._t), scale)
         if self._scaler is not None:
             self._scaler.update_scale(not bool(finite))
         return lossv
