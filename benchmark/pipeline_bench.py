@@ -1,0 +1,86 @@
+"""Host data-pipeline throughput: JPEG RecordIO -> ImageRecordIter
+(threaded decode + random-crop/flip + normalize), no accelerator involved.
+
+Answers "can the host feed the chip?" (reference
+src/io/iter_image_recordio_2.cc threaded pipeline): compare the printed
+img/s against bench.py's train img/s on the chip. Prints ONE JSON line.
+
+Env: PIPE_N (images packed), PIPE_SIDE (stored side), PIPE_BATCH,
+PIPE_THREADS, PIPE_STEPS.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = int(os.environ.get("PIPE_N", 512))
+SIDE = int(os.environ.get("PIPE_SIDE", 256))
+BATCH = int(os.environ.get("PIPE_BATCH", 64))
+THREADS = int(os.environ.get("PIPE_THREADS", os.cpu_count() or 4))
+STEPS = int(os.environ.get("PIPE_STEPS", 40))
+
+
+def make_dataset(root):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    lines = []
+    for i in range(N):
+        img = rng.randint(0, 255, (SIDE, SIDE, 3)).astype(np.uint8)
+        fname = f"img_{i:04d}.jpg"
+        Image.fromarray(img).save(os.path.join(root, fname), quality=90)
+        lines.append(f"{i}\t{i % 1000}\t{fname}")
+    with open(os.path.join(root, "data.lst"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def main():
+    from mxnet_tpu.io import ImageRecordIter
+
+    with tempfile.TemporaryDirectory() as root:
+        make_dataset(root)
+        prefix = os.path.join(root, "data")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "im2rec.py"),
+             prefix, root], check=True, capture_output=True, timeout=600)
+
+        it = ImageRecordIter(
+            path_imgrec=prefix + ".rec", data_shape=(3, 224, 224),
+            batch_size=BATCH, shuffle=True, rand_crop=True, rand_mirror=True,
+            mean_r=123.68, mean_g=116.28, mean_b=103.53,
+            std_r=58.4, std_g=57.1, std_b=57.4,
+            preprocess_threads=THREADS, prefetch_buffer=4)
+
+        def run(steps):
+            done = 0
+            t0 = time.perf_counter()
+            while done < steps:
+                try:
+                    b = it.next()
+                except StopIteration:
+                    it.reset()
+                    continue
+                done += 1
+            return time.perf_counter() - t0
+
+        run(5)  # warm caches / producer
+        dt = run(STEPS)
+        img_s = BATCH * STEPS / dt
+        print(json.dumps({
+            "metric": "jpeg_pipeline_throughput",
+            "value": round(img_s, 1),
+            "unit": "img/s (host, 224x224 out)",
+            "threads": THREADS,
+        }))
+
+
+if __name__ == "__main__":
+    main()

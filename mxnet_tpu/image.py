@@ -1,8 +1,16 @@
-"""mx.image namespace (reference python/mxnet/image/). Host-side image ops;
-cv2 used when present, with numpy fallbacks for .npy/array inputs."""
+"""mx.image namespace (reference python/mxnet/image/image.py + the C++
+default augmenters in src/io/image_aug_default.cc).
+
+Host-side image decode + augmentation. TPU-first split of labor: everything
+here runs on the host CPU (decode, resize, crop, flip, color jitter, PCA
+lighting) producing ready CHW float tensors; the chip only ever sees the
+fused train step. cv2 is used when present, PIL as fallback, and raw
+numpy for .npy/array payloads — nothing below requires the accelerator.
+"""
 from __future__ import annotations
 
 import os
+import random as _pyrandom
 
 import numpy as _np
 
@@ -10,35 +18,71 @@ from .base import MXNetError
 from .ndarray import NDArray, array
 
 
-def imread(filename, flag=1, to_rgb=True):
-    if filename.endswith(".npy"):
-        return array(_np.load(filename))
+def _cv2():
     try:
         import cv2
+        return cv2
     except ImportError:
-        raise MXNetError("imread requires cv2 for encoded images; "
-                         ".npy arrays are supported natively")
-    img = cv2.imread(filename, flag)
-    if img is None:
-        raise MXNetError(f"cannot read {filename}")
-    if to_rgb and img.ndim == 3:
-        img = img[:, :, ::-1]
-    return array(img.copy())
+        return None
+
+
+def _pil():
+    try:
+        from PIL import Image
+        return Image
+    except ImportError:
+        return None
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read an image file to an HWC uint8 NDArray (reference image.py:imread)."""
+    if filename.endswith(".npy"):
+        return array(_np.load(filename))
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
 
 
 def imdecode(buf, flag=1, to_rgb=True):
-    try:
-        import cv2
-    except ImportError:
-        raise MXNetError("imdecode requires cv2")
-    img = cv2.imdecode(_np.frombuffer(buf, dtype=_np.uint8), flag)
-    if to_rgb and img is not None and img.ndim == 3:
-        img = img[:, :, ::-1]
-    return array(img.copy())
+    """Decode an encoded image buffer (JPEG/PNG/...) to HWC uint8."""
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    elif isinstance(buf, _np.ndarray):
+        buf = buf.tobytes()
+    cv2 = _cv2()
+    if cv2 is not None:
+        img = cv2.imdecode(_np.frombuffer(buf, dtype=_np.uint8), flag)
+        if img is None:
+            raise MXNetError("cv2 cannot decode buffer")
+        if to_rgb and img.ndim == 3:
+            img = img[:, :, ::-1]
+        return array(img.copy())
+    Image = _pil()
+    if Image is not None:
+        import io as _io
+        img = Image.open(_io.BytesIO(buf))
+        img = img.convert("RGB" if flag else "L")
+        a = _np.asarray(img)
+        if not to_rgb and a.ndim == 3:
+            a = a[:, :, ::-1]
+        return array(_np.ascontiguousarray(a))
+    raise MXNetError("imdecode requires cv2 or PIL")
 
 
 def imresize(src, w, h, interp=1):
+    """Resize to (h, w). Bilinear via cv2/PIL; nearest numpy fallback."""
     a = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    cv2 = _cv2()
+    if cv2 is not None:
+        inter = {0: cv2.INTER_NEAREST, 1: cv2.INTER_LINEAR,
+                 2: cv2.INTER_CUBIC, 3: cv2.INTER_AREA}.get(interp,
+                                                            cv2.INTER_LINEAR)
+        return array(cv2.resize(a, (w, h), interpolation=inter))
+    Image = _pil()
+    if Image is not None and a.dtype == _np.uint8:
+        mode = Image.fromarray(a)
+        rs = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC}
+        return array(_np.asarray(mode.resize((w, h),
+                                             rs.get(interp, Image.BILINEAR))))
     ri = (_np.arange(h) * a.shape[0] / h).astype(int).clip(0, a.shape[0] - 1)
     ci = (_np.arange(w) * a.shape[1] / w).astype(int).clip(0, a.shape[1] - 1)
     return array(a[ri][:, ci])
@@ -80,6 +124,28 @@ def random_crop(src, size, interp=1):
     return fixed_crop(a, x0, y0, ow, oh), (x0, y0, ow, oh)
 
 
+def random_size_crop(src, size, area, ratio, interp=1):
+    """Random area+aspect crop (reference image.py:random_size_crop — the
+    Inception-style augmentation)."""
+    a = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    h, w = a.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _pyrandom.uniform(*area) * src_area
+        log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
+        ar = _np.exp(_pyrandom.uniform(*log_ratio))
+        nw = int(round(_np.sqrt(target_area * ar)))
+        nh = int(round(_np.sqrt(target_area / ar)))
+        if nw <= w and nh <= h:
+            x0 = _pyrandom.randint(0, w - nw)
+            y0 = _pyrandom.randint(0, h - nh)
+            return fixed_crop(a, x0, y0, nw, nh, size, interp), \
+                (x0, y0, nw, nh)
+    return center_crop(a, size, interp)
+
+
 def color_normalize(src, mean, std=None):
     a = src.asnumpy().astype("float32") if isinstance(src, NDArray) else \
         _np.asarray(src, dtype="float32")
@@ -87,3 +153,326 @@ def color_normalize(src, mean, std=None):
     if std is not None:
         a = a / _np.asarray(std)
     return array(a)
+
+
+# ---------------------------------------------------------------------------
+# Augmenters (reference python/mxnet/image/image.py Augmenter classes +
+# src/io/image_aug_default.cc DefaultImageAugmenter). Each operates on an
+# HWC float32 numpy array and returns one; pipelines compose left to right.
+# ---------------------------------------------------------------------------
+
+class Augmenter:
+    """Image augmenter base (reference image.py:Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    """Resize shorter edge to `size`."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return _npx(resize_short(src, self.size, self.interp))
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return _npx(imresize(src, self.size[0], self.size[1], self.interp))
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return _npx(random_crop(src, self.size, self.interp)[0])
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return _npx(center_crop(src, self.size, self.interp)[0])
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = size, area, ratio, interp
+
+    def __call__(self, src):
+        return _npx(random_size_crop(src, self.size, self.area, self.ratio,
+                                     self.interp)[0])
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return _npx(src)[:, ::-1]
+        return _npx(src)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return _npx(src) * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], "float32")
+
+    def __call__(self, src):
+        src = _npx(src)
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (src * self._coef).sum()
+        gray = 3.0 * (1.0 - alpha) / src.size * gray
+        return src * alpha + gray
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], "float32")
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        src = _npx(src)
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (src * self._coef).sum(axis=2, keepdims=True)
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class HueJitterAug(Augmenter):
+    """Hue rotation in YIQ space (reference image.py:HueJitterAug)."""
+    _u = _np.array([[0.299, 0.587, 0.114],
+                    [0.596, -0.274, -0.321],
+                    [0.211, -0.523, 0.311]], "float32")
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        src = _npx(src)
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u = _np.cos(alpha * _np.pi)
+        w = _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]], "float32")
+        t = _np.linalg.inv(self._u) @ bt @ self._u
+        return _np.dot(src, t.T.astype("float32"))
+
+
+class LightingAug(Augmenter):
+    """PCA-based RGB noise (AlexNet lighting; reference image.py:LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, "float32")
+        self.eigvec = _np.asarray(eigvec, "float32")
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,)).astype("float32")
+        rgb = (self.eigvec * alpha) @ self.eigval
+        return _npx(src) + rgb
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = _np.asarray(mean, "float32") if mean is not None else None
+        self.std = _np.asarray(std, "float32") if std is not None else None
+
+    def __call__(self, src):
+        src = _npx(src)
+        if self.mean is not None:
+            src = src - self.mean
+        if self.std is not None:
+            src = src / self.std
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return _npx(src).astype(self.typ)
+
+
+def _npx(x):
+    """To float32 HWC numpy."""
+    if isinstance(x, NDArray):
+        x = x.asnumpy()
+    return _np.asarray(x, dtype="float32")
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmenter list (reference image.py:CreateAugmenter;
+    the flags mirror the C++ DefaultImageAugmenter parameters)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness:
+        auglist.append(BrightnessJitterAug(brightness))
+    if contrast:
+        auglist.append(ContrastJitterAug(contrast))
+    if saturation:
+        auglist.append(SaturationJitterAug(saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Python-side image iterator over a .lst file or in-memory imglist
+    (reference python/mxnet/image/image.py:ImageIter). Decodes + augments on
+    the host; yields io.DataBatch of CHW float32."""
+
+    def __init__(self, batch_size, data_shape, path_imglist=None,
+                 path_root="", imglist=None, aug_list=None, shuffle=False,
+                 seed=0, label_width=1, **kwargs):
+        from .io.io import DataBatch  # noqa: F401 (type used in next())
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        items = []
+        if path_imglist:
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    items.append(([float(x) for x in parts[1:-1]],
+                                  os.path.join(path_root, parts[-1])))
+        elif imglist:
+            for lab, fname in imglist:
+                lab = [float(lab)] if _np.isscalar(lab) else \
+                    [float(x) for x in lab]
+                items.append((lab, os.path.join(path_root, fname)))
+        else:
+            raise MXNetError("ImageIter needs path_imglist or imglist")
+        self.items = items
+        self.shuffle = shuffle
+        self._rng = _np.random.RandomState(seed)
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(self.data_shape, **kwargs)
+        self.reset()
+
+    def reset(self):
+        self._order = _np.arange(len(self.items))
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+        self._cur = 0
+
+    @property
+    def provide_data(self):
+        return [("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shp = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [("softmax_label", shp)]
+
+    def __iter__(self):
+        return self
+
+    def _load(self, fname):
+        img = imread(fname).asnumpy().astype("float32")
+        for aug in self.auglist:
+            img = aug(img)
+        img = _np.asarray(img, "float32")
+        return _np.moveaxis(img, -1, 0)  # HWC -> CHW
+
+    def next(self):
+        from .io.io import DataBatch
+        from .ndarray import array as nd_array
+        if self._cur >= len(self.items):
+            raise StopIteration
+        xs, ys = [], []
+        while len(xs) < self.batch_size and self._cur < len(self.items):
+            lab, fname = self.items[self._order[self._cur]]
+            self._cur += 1
+            xs.append(self._load(fname))
+            ys.append(lab[0] if self.label_width == 1 else
+                      lab[:self.label_width])
+        pad = self.batch_size - len(xs)
+        if pad:
+            xs += [xs[-1]] * pad
+            ys += [ys[-1]] * pad
+        return DataBatch(data=[nd_array(_np.stack(xs))],
+                         label=[nd_array(_np.asarray(ys, "float32"))],
+                         pad=pad)
+
+    __next__ = next

@@ -379,7 +379,9 @@ class ImageRecordIter(DataIter):
                  label_width=1, shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
                  preprocess_threads=4, prefetch_buffer=4, ctx=None,
-                 synthetic=False, synthetic_size=256, seed=0, **kwargs):
+                 synthetic=False, synthetic_size=256, seed=0, resize=0,
+                 brightness=0, contrast=0, saturation=0, pca_noise=0,
+                 rand_resize=False, **kwargs):
         super().__init__(batch_size)
         self.data_shape = tuple(data_shape)
         self._ctx = ctx or current_context()
@@ -394,6 +396,24 @@ class ImageRecordIter(DataIter):
         self._inner = None
         self._reader = None
         self._cached = None
+        self._nthreads = max(int(preprocess_threads), 1)
+        self._prefetch = max(int(prefetch_buffer), 1)
+        self._producer = None
+        self._batch_q = None
+        self._stop_flag = None
+        # encoded-image augmenter pipeline (reference image_aug_default.cc
+        # flags): resize-short -> random/center crop -> flip -> color jitter
+        # -> PCA lighting; normalization stays in _augment (shared with the
+        # raw-CHW payload path)
+        from .. import image as _img
+        c, h, w = self.data_shape
+        if resize == 0 and (rand_crop or rand_resize):
+            resize = max(h, w) + max(h, w) // 8
+        self._auglist = _img.CreateAugmenter(
+            (c, h, w), resize=resize, rand_crop=rand_crop,
+            rand_resize=rand_resize, rand_mirror=rand_mirror,
+            brightness=brightness, contrast=contrast, saturation=saturation,
+            pca_noise=pca_noise)
         if path_imgrec and not synthetic:
             if not os.path.exists(path_imgrec):
                 raise MXNetError(f"record file not found: {path_imgrec}")
@@ -417,14 +437,17 @@ class ImageRecordIter(DataIter):
                                   shuffle=shuffle, ctx=self._ctx)
 
     def _decode(self, payload: bytes) -> _np.ndarray:
+        """payload -> CHW float32, augmented. Raw CHW uint8/float32 buffers
+        pass straight to the crop/mirror path; encoded images run the full
+        augmenter pipeline (decode -> resize -> crop -> flip -> jitter)."""
         c, h, w = self.data_shape
         n_u8 = c * h * w
         if len(payload) == n_u8:
             img = _np.frombuffer(payload, _np.uint8).reshape(self.data_shape)
-            return img.astype(_np.float32)
+            return img.astype(_np.float32), True
         if len(payload) == n_u8 * 4:
             return _np.frombuffer(payload, _np.float32).reshape(
-                self.data_shape).copy()
+                self.data_shape).copy(), True
         from .. import image as _img
         try:
             hwc = _img.imdecode(_np.frombuffer(payload, _np.uint8))
@@ -433,53 +456,107 @@ class ImageRecordIter(DataIter):
                 "record payload is neither a raw CHW uint8/float32 buffer "
                 f"matching data_shape {self.data_shape} nor decodable as a "
                 f"compressed image ({e})")
-        if self._rand_crop:
-            # resize the short side, then _augment random-crops to (h, w)
-            hwc = _img.resize_short(hwc, max(h, w) + max(h, w) // 8)
-        else:
-            hwc = _img.imresize(hwc, w, h)
-        arr = hwc.asnumpy() if hasattr(hwc, "asnumpy") else _np.asarray(hwc)
-        return _np.moveaxis(arr.astype(_np.float32), -1, 0)
+        arr = hwc.asnumpy().astype(_np.float32)
+        for aug in self._auglist:
+            arr = aug(arr)
+        return _np.moveaxis(_np.asarray(arr, _np.float32), -1, 0), False
 
-    def _augment(self, img: _np.ndarray) -> _np.ndarray:
+    def _augment(self, img: _np.ndarray, raw: bool) -> _np.ndarray:
+        """Crop/mirror for raw-CHW payloads (encoded images get those from
+        the augmenter pipeline inside _decode), then mean/std normalize."""
         c, h, w = self.data_shape
-        if img.shape[1:] != (h, w):
-            # crop to target: random position with rand_crop, center otherwise
-            ih, iw = img.shape[1], img.shape[2]
-            if self._rand_crop:
-                y0 = self._rng.randint(0, max(ih - h, 0) + 1)
-                x0 = self._rng.randint(0, max(iw - w, 0) + 1)
-            else:
-                y0, x0 = max(ih - h, 0) // 2, max(iw - w, 0) // 2
-            img = img[:, y0:y0 + h, x0:x0 + w]
-        if self._rand_mirror and self._rng.rand() < 0.5:
-            img = img[:, :, ::-1]
+        if raw:
+            if img.shape[1:] != (h, w):
+                # crop: random position with rand_crop, center otherwise
+                ih, iw = img.shape[1], img.shape[2]
+                if self._rand_crop:
+                    y0 = self._rng.randint(0, max(ih - h, 0) + 1)
+                    x0 = self._rng.randint(0, max(iw - w, 0) + 1)
+                else:
+                    y0, x0 = max(ih - h, 0) // 2, max(iw - w, 0) // 2
+                img = img[:, y0:y0 + h, x0:x0 + w]
+            if self._rand_mirror and self._rng.rand() < 0.5:
+                img = img[:, :, ::-1]
         img = (img - self._mean) / self._std
         return _np.ascontiguousarray(img)
 
-    def _next_record_batch(self):
+    def _process_one(self, rec):
         from ..recordio import unpack
-        xs, ys = [], []
-        while len(xs) < self.batch_size:
-            rec = self._reader.next()
-            if rec is None:
-                break
-            header, payload = unpack(rec)
-            lab = header.label
-            lab = float(lab) if _np.isscalar(lab) else _np.asarray(
-                lab, "float32")[:self._label_width]
-            xs.append(self._augment(self._decode(payload)))
-            ys.append(lab)
-        if not xs:
-            return None
-        pad = self.batch_size - len(xs)
-        if pad:
-            xs += [xs[-1]] * pad
-            ys += [ys[-1]] * pad
+        header, payload = unpack(rec)
+        lab = header.label
+        lab = float(lab) if _np.isscalar(lab) else _np.asarray(
+            lab, "float32")[:self._label_width]
+        img, raw = self._decode(payload)
+        return self._augment(img, raw), lab
+
+    def _produce(self, stop, q):
+        """Producer thread: read records serially, decode+augment on a
+        thread pool (reference iter_image_recordio_2.cc:880 threaded
+        pipeline), assemble batches in order, feed the prefetch queue."""
+        import concurrent.futures as cf
         from ..ndarray import array
-        data = array(_np.stack(xs))
-        label = array(_np.asarray(ys, "float32"))
-        return DataBatch(data=[data], label=[label], pad=pad)
+        try:
+            with cf.ThreadPoolExecutor(self._nthreads) as pool:
+                while not stop.is_set():
+                    recs = []
+                    while len(recs) < self.batch_size:
+                        rec = self._reader.next()
+                        if rec is None:
+                            break
+                        recs.append(rec)
+                    if not recs:
+                        q.put(None)
+                        return
+                    results = list(pool.map(self._process_one, recs))
+                    xs = [r[0] for r in results]
+                    ys = [r[1] for r in results]
+                    pad = self.batch_size - len(xs)
+                    if pad:
+                        xs += [xs[-1]] * pad
+                        ys += [ys[-1]] * pad
+                    batch = DataBatch(data=[array(_np.stack(xs))],
+                                      label=[array(_np.asarray(ys, "float32"))],
+                                      pad=pad)
+                    while not stop.is_set():
+                        try:
+                            q.put(batch, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+        except Exception as e:  # surface errors at next()
+            q.put(e)
+
+    def _ensure_producer(self):
+        if self._producer is None or not self._producer.is_alive():
+            if self._batch_q is None:
+                self._stop_flag = threading.Event()
+                self._batch_q = queue.Queue(maxsize=self._prefetch)
+                self._producer = threading.Thread(
+                    target=self._produce,
+                    args=(self._stop_flag, self._batch_q), daemon=True)
+                self._producer.start()
+
+    def _next_record_batch(self):
+        self._ensure_producer()
+        item = self._batch_q.get()
+        if isinstance(item, Exception):
+            raise item
+        if item is None:
+            self._batch_q = None  # producer finished; reset() restarts it
+            self._producer = None
+        return item
+
+    def _stop_producer(self):
+        if self._producer is not None:
+            self._stop_flag.set()
+            try:
+                while True:
+                    self._batch_q.get_nowait()
+            except queue.Empty:
+                pass
+            self._producer.join(timeout=5)
+            self._producer = None
+        self._batch_q = None
 
     @property
     def provide_data(self):
@@ -499,6 +576,8 @@ class ImageRecordIter(DataIter):
         if self._inner is not None:
             self._inner.reset()
         else:
+            self._stop_producer()
+            self._cached = None
             self._reader.reset()
 
     def next(self):

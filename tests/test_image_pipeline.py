@@ -1,0 +1,158 @@
+"""Real-data image pipeline, end to end (VERDICT r1 item 5).
+
+JPEGs on disk -> tools/im2rec.py packing -> ImageRecordIter threaded
+decode + augment (reference src/io/iter_image_recordio_2.cc:880 +
+image_aug_default.cc) -> fused DataParallelTrainer — proving the host
+pipeline can actually feed the chip from encoded images, not just
+synthetic arrays."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu import image as mimg
+from mxnet_tpu.io import ImageRecordIter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_IMG = 48
+N_CLASS = 4
+SIDE = 48  # stored image side; training crops to 32
+
+
+def _make_jpeg_dataset(root):
+    """Class-separable JPEGs: each class gets a distinct base color."""
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    base = np.array([[220, 30, 30], [30, 220, 30], [30, 30, 220],
+                     [200, 200, 30]], np.uint8)
+    lines = []
+    for i in range(N_IMG):
+        cls = i % N_CLASS
+        img = np.clip(base[cls][None, None, :].astype(np.int16) +
+                      rng.randint(-25, 25, (SIDE, SIDE, 3)), 0, 255)
+        fname = f"img_{i:03d}.jpg"
+        Image.fromarray(img.astype(np.uint8)).save(
+            os.path.join(root, fname), quality=92)
+        lines.append(f"{i}\t{cls}\t{fname}")
+    with open(os.path.join(root, "data.lst"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+@pytest.fixture(scope="module")
+def recfile(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("jpegs"))
+    _make_jpeg_dataset(root)
+    prefix = os.path.join(root, "data")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+         prefix, root], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert os.path.exists(prefix + ".rec")
+    return prefix + ".rec", root
+
+
+def test_imagerecorditer_decodes_and_augments(recfile):
+    rec, _ = recfile
+    it = ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 32, 32), batch_size=8,
+        shuffle=True, rand_crop=True, rand_mirror=True, brightness=0.1,
+        mean_r=128, mean_g=128, mean_b=128, std_r=64, std_g=64, std_b=64,
+        preprocess_threads=3, prefetch_buffer=2)
+    seen = 0
+    for batch in it:
+        x = batch.data[0].asnumpy()
+        y = batch.label[0].asnumpy()
+        assert x.shape == (8, 3, 32, 32)
+        assert np.isfinite(x).all()
+        # normalized pixels land in a small range around 0
+        assert abs(x.mean()) < 3.0 and x.std() > 0.05
+        assert set(np.unique(y)).issubset(set(range(N_CLASS)))
+        seen += 8 - batch.pad
+    assert seen == N_IMG
+    # second epoch after reset
+    it.reset()
+    b2 = next(iter(it))
+    assert b2.data[0].shape == (8, 3, 32, 32)
+
+
+def test_pipeline_feeds_fused_trainer(recfile):
+    """JPEG pipeline -> fused train step: color-separable classes must be
+    learned within a handful of steps (reference test_conv.py spirit)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+
+    rec, _ = recfile
+    mx.random.seed(42)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1), gluon.nn.Activation("relu"),
+            gluon.nn.GlobalAvgPool2D(), gluon.nn.Dense(N_CLASS))
+    net.initialize()
+    net(nd.zeros((2, 3, 32, 32)))
+
+    def loss_fn(logits, labels):
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+        return jnp.mean(logz - gold)
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices("cpu")[:1])
+    tr = DataParallelTrainer(net, loss_fn, optimizer="adam",
+                             optimizer_params={"learning_rate": 0.02},
+                             mesh=mesh)
+    it = ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 32, 32), batch_size=16,
+        shuffle=True, rand_crop=True, rand_mirror=True,
+        mean_r=128, mean_g=128, mean_b=128, std_r=64, std_g=64, std_b=64,
+        preprocess_threads=2)
+    losses = []
+    for _ in range(6):  # epochs
+        for batch in it:
+            y = batch.label[0].astype("int32")
+            losses.append(float(tr.step(batch.data[0], y)))
+        it.reset()
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_imageiter_from_lst(recfile):
+    _, root = recfile
+    it = mimg.ImageIter(batch_size=8, data_shape=(3, 32, 32),
+                        path_imglist=os.path.join(root, "data.lst"),
+                        path_root=root, shuffle=True, rand_crop=True,
+                        rand_mirror=True)
+    batch = next(it)
+    assert batch.data[0].shape == (8, 3, 32, 32)
+    assert np.isfinite(batch.data[0].asnumpy()).all()
+
+
+def test_augmenter_pipeline_units():
+    rng = np.random.RandomState(1)
+    img = rng.randint(0, 255, (40, 50, 3)).astype(np.float32)
+    flip = mimg.HorizontalFlipAug(p=1.0)
+    np.testing.assert_allclose(flip(img), img[:, ::-1])
+    crop = mimg.CenterCropAug((32, 32))
+    assert crop(img).shape == (32, 32, 3)
+    norm = mimg.ColorNormalizeAug(np.array([1.0, 2.0, 3.0]),
+                                  np.array([2.0, 2.0, 2.0]))
+    np.testing.assert_allclose(norm(img),
+                               (img - np.array([1, 2, 3], np.float32)) / 2)
+    bright = mimg.BrightnessJitterAug(0.0)
+    np.testing.assert_allclose(bright(img), img)
+    sat = mimg.SaturationJitterAug(0.0)
+    np.testing.assert_allclose(sat(img), img, rtol=1e-6)
+    auglist = mimg.CreateAugmenter((3, 32, 32), rand_crop=True,
+                                   rand_mirror=True, brightness=0.2,
+                                   contrast=0.2, saturation=0.2,
+                                   pca_noise=0.1, mean=True, std=True)
+    out = img
+    for a in auglist:
+        out = a(out)
+    assert np.asarray(out).shape == (32, 32, 3)
+    assert np.isfinite(np.asarray(out)).all()
