@@ -1,0 +1,128 @@
+"""Wide & Deep on synthetic sparse data (reference example/sparse/wide_deep).
+
+Demonstrates the sparse training path end to end:
+- synthetic categorical data written as a LibSVM file, read back through
+  `mx.io.LibSVMIter` as CSR batches (reference src/io/iter_libsvm.cc);
+- a wide (linear over sparse features) + deep (embedding -> MLP) model;
+- the embedding table lives in a KVStore and each batch pulls ONLY the rows
+  it touches via `row_sparse_pull` (reference kvstore row_sparse semantics,
+  example/sparse/wide_deep/train.py) before the gradient push.
+
+Run: python examples/wide_deep_sparse.py [--epochs N] [--rows N]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd  # noqa: E402
+from mxnet_tpu.io import LibSVMIter  # noqa: E402
+
+N_FEAT = 64          # vocabulary of categorical features
+N_ACTIVE = 6         # features active per example
+EMBED_DIM = 8
+
+
+def make_libsvm(path, rows, seed=0):
+    """Class-separable sparse data: even feature ids vote for class 1."""
+    rng = np.random.RandomState(seed)
+    with open(path, "w") as f:
+        for _ in range(rows):
+            feats = rng.choice(N_FEAT, size=N_ACTIVE, replace=False)
+            score = sum(1 if fid % 2 == 0 else -1 for fid in feats)
+            label = int(score + rng.randn() * 0.5 > 0)
+            toks = " ".join(f"{fid}:{1.0}" for fid in sorted(feats))
+            f.write(f"{label} {toks}\n")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--rows", type=int, default=512)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, "wd.libsvm")
+    make_libsvm(path, args.rows)
+
+    rng = np.random.RandomState(1)
+    # wide: one weight per sparse feature; deep: embedding -> MLP
+    wide_w = nd.array(np.zeros((N_FEAT, 1), np.float32))
+    embed = nd.array((rng.randn(N_FEAT, EMBED_DIM) * 0.1).astype(np.float32))
+    w1 = nd.array((rng.randn(EMBED_DIM, 16) * 0.3).astype(np.float32))
+    b1 = nd.array(np.zeros((16,), np.float32))
+    w2 = nd.array((rng.randn(16, 1) * 0.3).astype(np.float32))
+    b2 = nd.array(np.zeros((1,), np.float32))
+
+    # the embedding table lives in the kvstore; workers pull only the rows a
+    # batch touches (row_sparse_pull) and push row-sparse gradients back
+    kv = mx.kv.create("device")
+    kv.init("embed", embed)
+    # server-side optimizer: pushed row-sparse gradients are applied by the
+    # store's updater (reference kvstore_dist_server.h server-side SGD)
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=args.lr))
+
+    params = [wide_w, embed, w1, b1, w2, b2]
+    for p in params:
+        p.attach_grad()
+
+    def forward(x_dense):
+        wide = nd.dot(x_dense, wide_w)                       # (B, 1)
+        # deep: average the embeddings of active features
+        deep_in = nd.dot(x_dense, embed) / float(N_ACTIVE)   # (B, E)
+        h = nd.relu(nd.dot(deep_in, w1) + b1)
+        deep = nd.dot(h, w2) + b2
+        return (wide + deep)[:, 0]
+
+    n_correct = n_total = 0
+    for epoch in range(args.epochs):
+        it = LibSVMIter(data_libsvm=path, data_shape=(N_FEAT,),
+                        batch_size=args.batch_size, round_batch=False)
+        epoch_loss, nb = 0.0, 0
+        n_correct = n_total = 0
+        for batch in it:
+            x = batch.data[0].tostype("default")
+            y = batch.label[0]
+            # row_sparse_pull: refresh ONLY the embedding rows this batch
+            # touches (row ids = active feature columns)
+            row_ids = nd.array(
+                np.nonzero(x.asnumpy().any(axis=0))[0].astype(np.int64),
+                dtype="int64")
+            kv.row_sparse_pull("embed", out=embed, row_ids=row_ids)
+            with autograd.record():
+                logits = forward(x)
+                # logistic loss
+                loss = nd.mean(nd.log1p(nd.exp(-(2 * y - 1) * logits)))
+            loss.backward()
+            # wide/deep dense params: local SGD update
+            for p in (wide_w, w1, b1, w2, b2):
+                p -= args.lr * p.grad
+                p.grad[:] = 0
+            # embedding: push the row-sparse gradient; the store's SGD
+            # updater applies it server-side
+            from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+            kv.push("embed", RowSparseNDArray(embed.grad._data, embed.ctx))
+            embed.grad[:] = 0
+            epoch_loss += float(loss)
+            nb += 1
+            pred = (logits.asnumpy() > 0).astype(int)
+            n_correct += int((pred == y.asnumpy().astype(int)).sum())
+            n_total += len(pred) - batch.pad
+        print(f"epoch {epoch}: loss {epoch_loss / max(nb, 1):.4f} "
+              f"acc {n_correct / max(n_total, 1):.3f}")
+
+    print(f"final accuracy {n_correct / max(n_total, 1):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,7 @@
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, MNISTIter, CSVIter, ImageRecordIter)
+                 PrefetchingIter, MNISTIter, CSVIter, ImageRecordIter,
+                 LibSVMIter, ImageDetRecordIter)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "MNISTIter", "CSVIter", "ImageRecordIter"]
+           "PrefetchingIter", "MNISTIter", "CSVIter", "ImageRecordIter",
+           "LibSVMIter", "ImageDetRecordIter"]

@@ -658,3 +658,197 @@ class _PyRecordStream:
         self._rec.reset()
         if self._shuffle:
             self._reshuffle()
+
+
+class LibSVMIter(DataIter):
+    """LibSVM text reader yielding CSR data batches (reference
+    src/io/iter_libsvm.cc:67). Line format: ``label[,label...] idx:val ...``;
+    when ``label_libsvm`` is given, labels are read as CSR from a second
+    libsvm file (multi-label), matching the reference's dual-parser mode.
+
+    Data batches are CSRNDArray (dense-backed here — SURVEY.md §7 hard-part
+    4: XLA has no dynamic sparsity, so CSR is an API-level view; the chip
+    consumes the dense block)."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 num_parts=1, part_index=0, ctx=None, **kwargs):
+        super().__init__(batch_size)
+        if isinstance(data_shape, int):
+            data_shape = (data_shape,)
+        if isinstance(label_shape, int):
+            label_shape = (label_shape,)
+        if len(tuple(data_shape)) != 1:
+            raise MXNetError("LibSVMIter: data_shape must be 1-D "
+                             "(feature dimension), like the reference")
+        self.data_shape = tuple(data_shape)
+        self.label_shape = tuple(label_shape)
+        self._ctx = ctx or current_context()
+        self._round_batch = round_batch
+        rows, labels = self._parse(data_libsvm, self.data_shape[0])
+        if label_libsvm:
+            if int(_np.prod(self.label_shape)) <= 1:
+                raise MXNetError("label_shape must be >1 with label_libsvm "
+                                 "(iter_libsvm.cc:86)")
+            lab_rows, _ = self._parse(label_libsvm, self.label_shape[0])
+            self._label = lab_rows
+            self._label_csr = True
+        else:
+            if int(_np.prod(self.label_shape)) != 1:
+                raise MXNetError("label_shape is expected to be (1,) when "
+                                 "label_libsvm is NULL (iter_libsvm.cc:88)")
+            self._label = _np.asarray(labels, "float32")
+            self._label_csr = False
+        if num_parts > 1:
+            rows = rows[part_index::num_parts]
+            self._label = self._label[part_index::num_parts]
+        self._data = rows
+        self._n = len(rows)
+        self._cur = 0
+
+    @staticmethod
+    def _parse(path, width):
+        """-> (dense rows [n, width] float32, first-label column)."""
+        rows, labels = [], []
+        with open(path) as fin:
+            for line in fin:
+                line = line.strip()
+                if not line:
+                    continue
+                parts = line.split()
+                labels.append(float(parts[0].split(",")[0]))
+                row = _np.zeros((width,), "float32")
+                for tok in parts[1:]:
+                    if ":" not in tok:
+                        continue
+                    i, v = tok.split(":")
+                    row[int(i)] = float(v)
+                rows.append(row)
+        return _np.stack(rows) if rows else _np.zeros((0, width), "float32"), \
+            labels
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shp = (self.batch_size,) + (self.label_shape if self._label_csr
+                                    else ())
+        return [DataDesc("softmax_label", shp)]
+
+    def reset(self):
+        self._cur = 0
+
+    def iter_next(self):
+        return self._cur < self._n
+
+    def next(self):
+        from ..ndarray.sparse import csr_matrix as _csr
+        def _csr_batch(a, ctx):
+            return _csr(a, ctx=ctx)
+        if self._cur >= self._n:
+            raise StopIteration
+        end = min(self._cur + self.batch_size, self._n)
+        xs = self._data[self._cur:end]
+        ys = self._label[self._cur:end]
+        pad = self.batch_size - (end - self._cur)
+        if pad:
+            if self._round_batch and self._n >= self.batch_size:
+                # wrap around to the beginning, reference round_batch
+                xs = _np.concatenate([xs, self._data[:pad]])
+                ys = _np.concatenate([ys, self._label[:pad]])
+                pad = 0
+            else:
+                xs = _np.concatenate([xs, _np.repeat(xs[-1:], pad, 0)])
+                ys = _np.concatenate([ys, _np.repeat(ys[-1:], pad, 0)])
+        self._cur = end
+        data = _csr_batch(xs, self._ctx)
+        label = _csr_batch(ys, self._ctx) if self._label_csr else \
+            array(ys, ctx=self._ctx)
+        return DataBatch(data=[data], label=[label], pad=pad)
+
+
+class ImageDetRecordIter(DataIter):
+    """Detection RecordIO iterator (reference
+    src/io/iter_image_det_recordio.cc). Records carry variable-length
+    object labels ``[header_width, object_width, extras..., obj0..., ...]``;
+    each batch pads every sample's label block to the widest in the batch
+    (or ``label_pad_width``) with ``label_pad_value``, exactly like the
+    reference, so SSD-style targets can be stacked densely."""
+
+    def __init__(self, path_imgrec, data_shape=(3, 300, 300), batch_size=1,
+                 shuffle=False, label_pad_width=0, label_pad_value=-1.0,
+                 mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
+                 rand_mirror=False, preprocess_threads=4, prefetch_buffer=4,
+                 seed=0, ctx=None, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self._ctx = ctx or current_context()
+        self._pad_width = int(label_pad_width)
+        self._pad_value = float(label_pad_value)
+        # reuse ImageRecordIter's reader/decode/augment machinery but read
+        # synchronously — detection labels are ragged, so batching happens
+        # here (rand_mirror is intentionally OFF: flipping would need the
+        # box coordinates rewritten; augment at training level instead)
+        self._inner = ImageRecordIter(
+            path_imgrec=path_imgrec, data_shape=data_shape,
+            batch_size=batch_size, shuffle=shuffle, rand_mirror=False,
+            mean_r=mean_r, mean_g=mean_g, mean_b=mean_b,
+            std_r=std_r, std_g=std_g, std_b=std_b,
+            preprocess_threads=preprocess_threads,
+            prefetch_buffer=prefetch_buffer, seed=seed, ctx=ctx, **kwargs)
+        self._cached = None
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        w = self._pad_width if self._pad_width else None
+        return [DataDesc("label", (self.batch_size, w))]
+
+    def reset(self):
+        self._cached = None
+        self._inner._reader.reset()
+
+    def iter_next(self):
+        if self._cached is None:
+            self._cached = self._read_batch()
+        return self._cached is not None
+
+    def _read_batch(self):
+        from ..recordio import unpack
+        inner = self._inner
+        xs, labs = [], []
+        while len(xs) < self.batch_size:
+            rec = inner._reader.next()
+            if rec is None:
+                break
+            header, payload = unpack(rec)
+            lab = _np.atleast_1d(_np.asarray(header.label, "float32"))
+            img, raw = inner._decode(payload)
+            xs.append(inner._augment(img, raw))
+            labs.append(lab)
+        if not xs:
+            return None
+        pad = self.batch_size - len(xs)
+        if pad:
+            xs += [xs[-1]] * pad
+            labs += [labs[-1]] * pad
+        width = max(max(len(r) for r in labs), self._pad_width)
+        out = _np.full((len(labs), width), self._pad_value, "float32")
+        for i, r in enumerate(labs):
+            out[i, :len(r)] = r
+        return DataBatch(data=[array(_np.stack(xs), ctx=self._ctx)],
+                         label=[array(out, ctx=self._ctx)], pad=pad)
+
+    def next(self):
+        if self._cached is not None:
+            b, self._cached = self._cached, None
+            return b
+        b = self._read_batch()
+        if b is None:
+            raise StopIteration
+        return b

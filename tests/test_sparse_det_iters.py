@@ -1,0 +1,143 @@
+"""LibSVMIter + ImageDetRecordIter (reference src/io/iter_libsvm.cc:67,
+src/io/iter_image_det_recordio.cc) and the sparse Wide&Deep example."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, recordio
+from mxnet_tpu.io import LibSVMIter, ImageDetRecordIter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_libsvm(path, rows, labels):
+    with open(path, "w") as f:
+        for lab, row in zip(labels, rows):
+            toks = [f"{i}:{v}" for i, v in row]
+            f.write(f"{lab} " + " ".join(toks) + "\n")
+
+
+def test_libsvm_iter_basic(tmp_path):
+    path = str(tmp_path / "data.libsvm")
+    rows = [[(0, 1.0), (3, 2.5)], [(1, -1.0)], [(2, 4.0), (4, 0.5)],
+            [(0, 3.0)], [(4, 1.5)]]
+    labels = [1, 0, 1, 0, 1]
+    _write_libsvm(path, rows, labels)
+    it = LibSVMIter(data_libsvm=path, data_shape=(5,), batch_size=2,
+                    round_batch=False)
+    b1 = it.next()
+    assert b1.data[0].stype == "csr"
+    np.testing.assert_allclose(
+        b1.data[0].asnumpy(),
+        [[1.0, 0, 0, 2.5, 0], [0, -1.0, 0, 0, 0]])
+    np.testing.assert_allclose(b1.label[0].asnumpy(), [1, 0])
+    b2 = it.next()
+    b3 = it.next()  # 5th row + pad
+    assert b3.pad == 1
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    again = it.next()
+    np.testing.assert_allclose(again.data[0].asnumpy(), b1.data[0].asnumpy())
+    # CSR view exposes indices/indptr like the reference
+    assert b1.data[0].indices is not None
+
+
+def test_libsvm_iter_round_batch_wraps(tmp_path):
+    path = str(tmp_path / "data.libsvm")
+    _write_libsvm(path, [[(0, float(i + 1))] for i in range(5)],
+                  list(range(5)))
+    it = LibSVMIter(data_libsvm=path, data_shape=(3,), batch_size=2,
+                    round_batch=True)
+    batches = [it.next() for _ in range(3)]
+    # last batch wraps to the first row instead of padding
+    assert batches[2].pad == 0
+    np.testing.assert_allclose(batches[2].data[0].asnumpy()[:, 0], [5.0, 1.0])
+
+
+def test_libsvm_iter_multilabel(tmp_path):
+    dpath = str(tmp_path / "data.libsvm")
+    lpath = str(tmp_path / "label.libsvm")
+    _write_libsvm(dpath, [[(0, 1.0)], [(1, 2.0)]], [0, 0])
+    _write_libsvm(lpath, [[(0, 1.0), (2, 1.0)], [(1, 1.0)]], [0, 0])
+    it = LibSVMIter(data_libsvm=dpath, data_shape=(2,), label_libsvm=lpath,
+                    label_shape=(3,), batch_size=2)
+    b = it.next()
+    assert b.label[0].stype == "csr"
+    np.testing.assert_allclose(b.label[0].asnumpy(),
+                               [[1.0, 0, 1.0], [0, 1.0, 0]])
+
+
+def test_libsvm_rejects_bad_shapes(tmp_path):
+    path = str(tmp_path / "d.libsvm")
+    _write_libsvm(path, [[(0, 1.0)]], [0])
+    with pytest.raises(mx.MXNetError):
+        LibSVMIter(data_libsvm=path, data_shape=(2, 2), batch_size=1)
+    with pytest.raises(mx.MXNetError):
+        LibSVMIter(data_libsvm=path, data_shape=(2,), label_shape=(3,),
+                   batch_size=1)
+
+
+@pytest.fixture()
+def det_rec(tmp_path):
+    """Records with variable-length detection labels
+    [header_width=2, object_width=5, (cls, x0, y0, x1, y1)...]."""
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    rec_path = str(tmp_path / "det.rec")
+    idx_path = str(tmp_path / "det.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(7):
+        img = rng.randint(0, 255, (32, 32, 3)).astype(np.uint8)
+        import io as _io
+        buf = _io.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG")
+        nobj = 1 + i % 3
+        label = [2.0, 5.0]
+        for j in range(nobj):
+            label += [float(j % 4), 0.1 * j, 0.1, 0.5 + 0.1 * j, 0.8]
+        header = recordio.IRHeader(0, label, i, 0)
+        w.write_idx(i, recordio.pack(header, buf.getvalue()))
+    w.close()
+    return rec_path
+
+
+def test_image_det_record_iter(det_rec):
+    it = ImageDetRecordIter(path_imgrec=det_rec, data_shape=(3, 24, 24),
+                            batch_size=4, label_pad_value=-1.0)
+    b1 = it.next()
+    assert b1.data[0].shape == (4, 3, 24, 24)
+    lab = b1.label[0].asnumpy()
+    # widest sample in batch 1 has 3 objects: 2 + 3*5 = 17 columns
+    assert lab.shape[1] == 17
+    np.testing.assert_allclose(lab[0, :2], [2.0, 5.0])  # header
+    assert (lab[0, 7:] == -1.0).all()  # 1-object row padded with -1
+    b2 = it.next()
+    assert b2.pad == 1  # 7 records, batch 4
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    assert it.next().data[0].shape == (4, 3, 24, 24)
+
+
+def test_image_det_record_iter_fixed_pad(det_rec):
+    it = ImageDetRecordIter(path_imgrec=det_rec, data_shape=(3, 24, 24),
+                            batch_size=7, label_pad_width=30)
+    lab = it.next().label[0].asnumpy()
+    assert lab.shape == (7, 30)
+
+
+def test_wide_deep_sparse_example(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "wide_deep_sparse.py"),
+         "--epochs", "4", "--rows", "256"],
+        capture_output=True, text=True, timeout=560,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "final accuracy" in proc.stdout
+    acc = float(proc.stdout.split("final accuracy")[-1].split()[0])
+    assert acc > 0.7, proc.stdout
