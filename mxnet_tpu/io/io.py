@@ -297,9 +297,13 @@ class MNISTIter(DataIter):
             labs = self._read_idx(label)
         else:
             rng = _np.random.RandomState(seed)
-            # class-dependent means so a real model can actually learn
+            # class-dependent SPATIALLY-STRUCTURED means (low-frequency 4x4
+            # patterns upsampled to 28x28): per-pixel noise patterns would be
+            # learnable by a linear probe but invisible to conv+pool nets,
+            # which need large coherent regions
             labs = rng.randint(0, 10, size=(synthetic_size,)).astype("uint8")
-            base = rng.rand(10, 28, 28).astype("float32")
+            base4 = rng.rand(10, 4, 4).astype("float32")
+            base = _np.kron(base4, _np.ones((7, 7), "float32"))
             imgs = (base[labs] * 255 * 0.5 +
                     rng.rand(synthetic_size, 28, 28) * 127).astype("uint8")
         if num_parts > 1:

@@ -1,0 +1,97 @@
+"""Convergence gates (VERDICT r1 item 7; reference
+tests/python/train/test_conv.py keeps a real small training green in CI).
+
+These fail on silent numerics regressions that smoke tests miss: a conv
+net must actually reach high accuracy on MNIST-like data, and BERT-tiny
+MLM must drive its loss down on the 8-device CPU mesh."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.io import MNISTIter
+from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+
+
+def _ce_loss(logits, labels):
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+@pytest.mark.slow
+def test_conv_net_converges_on_mnist():
+    """LeNet-style conv net trains to >=0.93 train accuracy (reference
+    tests/python/train/test_conv.py gate)."""
+    mx.random.seed(99)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 5, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Conv2D(16, 3, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize()
+    net(nd.zeros((2, 1, 28, 28)))
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices("cpu")[:1])
+    tr = DataParallelTrainer(net, _ce_loss, optimizer="adam",
+                             optimizer_params={"learning_rate": 2e-3},
+                             mesh=mesh)
+    it = MNISTIter(batch_size=64, shuffle=True, synthetic_size=1024, seed=3)
+    first_loss = None
+    for _ in range(3):  # epochs
+        for batch in it:
+            y = batch.label[0].astype("int32")
+            loss = float(tr.step(batch.data[0], y))
+            if first_loss is None:
+                first_loss = loss
+        it.reset()
+    tr.sync()
+
+    # evaluate train accuracy with the updated params
+    correct = total = 0
+    for batch in it:
+        logits = net(batch.data[0])
+        pred = logits.asnumpy().argmax(axis=1)
+        lab = batch.label[0].asnumpy().astype(int)
+        n = len(lab) - batch.pad
+        correct += int((pred[:n] == lab[:n]).sum())
+        total += n
+    acc = correct / total
+    assert acc >= 0.93, f"conv net failed to learn: acc={acc:.3f}"
+
+
+@pytest.mark.slow
+def test_bert_tiny_mlm_loss_drops_on_mesh():
+    """BERT-tiny MLM on the 8-device dp mesh: loss must fall >=30% over
+    40 steps — a convergence gate for the transformer + sharded-trainer
+    path, not just a finiteness check."""
+    from mxnet_tpu.models import bert_tiny
+
+    vocab = 256
+    mx.random.seed(7)
+    net = bert_tiny(vocab_size=vocab)
+    net.initialize()
+    net(nd.zeros((2, 32), dtype="int32"))
+
+    mesh = make_mesh({"dp": 8}, devices=jax.devices("cpu")[:8])
+    tr = DataParallelTrainer(net, _ce_loss, optimizer="adam",
+                             optimizer_params={"learning_rate": 5e-4},
+                             mesh=mesh)
+    rs = np.random.RandomState(0)
+    # fixed corpus with structure: token t is usually followed by t+1
+    base = rs.randint(0, vocab - 1, (16, 32))
+    seq = (base // 7) * 7 % (vocab - 1)  # heavy repetition -> learnable
+    x = nd.array(seq, dtype="int32")
+    y = nd.array((seq + 1) % vocab, dtype="int32")
+    losses = [float(tr.step(x, y)) for _ in range(40)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.7 * losses[0], (
+        f"BERT-tiny MLM did not learn: {losses[0]:.3f} -> {losses[-1]:.3f}")
