@@ -36,6 +36,10 @@ from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
 _AUX_STACK: List[List[Tuple[Parameter, Any]]] = []
 _TRACE_DEPTH = [0]  # >0 while tracing/probing: children fold into the trace
+# during a symbolic trace: stack of {id(Parameter): structured name} for the
+# root block being exported, so nested blocks name their param Variables by
+# the same keys save_parameters uses
+_SYM_PARAM_NAMES: list = []
 
 
 def in_trace() -> bool:
@@ -317,6 +321,11 @@ class HybridBlock(Block):
     def forward(self, *args):
         x = args[0] if args else None
         if not isinstance(x, NDArray):
+            from ..symbol.symbol import Symbol
+            if isinstance(x, Symbol):
+                # symbolic trace: gluon -> Symbol graph (reference
+                # HybridBlock._build_cache's symbol pass; used by export)
+                return self._forward_symbolic(*args)
             raise MXNetError(f"{type(self).__name__}.forward expects NDArray input")
         # inside an enclosing trace, fold into the same XLA program instead of
         # nesting another cached graph (keeps one fused computation)
@@ -342,6 +351,26 @@ class HybridBlock(Block):
                     p._finish_deferred_init()
                 kwargs[name] = p.data()
         return self.hybrid_forward(nd, *args, **kwargs)
+
+    def _forward_symbolic(self, *args):
+        """Trace this block into a Symbol graph. Parameter Variables are
+        named by their structured path (the save_parameters key), so the
+        exported symbol binds directly against the exported params file."""
+        from .. import symbol as sym_mod
+        own_map = not _SYM_PARAM_NAMES
+        if own_map:
+            _SYM_PARAM_NAMES.append(
+                {id(p): k for k, p in
+                 self._collect_params_with_prefix().items()})
+        name_of = _SYM_PARAM_NAMES[-1]
+        try:
+            kwargs = {}
+            for name, p in self._reg_params.items():
+                kwargs[name] = sym_mod.Variable(name_of.get(id(p), p.name))
+            return self.hybrid_forward(sym_mod, *args, **kwargs)
+        finally:
+            if own_map:
+                _SYM_PARAM_NAMES.pop()
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
@@ -442,16 +471,28 @@ class HybridBlock(Block):
         return graph
 
     # -- deployment -----------------------------------------------------------
-    def export(self, path, epoch=0, remove_amp_cast=True):
-        """Save params + architecture manifest (reference HybridBlock.export).
-        The compiled program is XLA's concern; we persist parameters and a
-        config manifest for SymbolBlock-style reload."""
-        import json
-        self.save_parameters(f"{path}-{epoch:04d}.params")
-        manifest = {"framework": "mxnet_tpu", "class": type(self).__name__,
-                    "prefix": self._prefix}
-        with open(f"{path}-symbol.json", "w") as f:
-            json.dump(manifest, f)
+    def export(self, path, epoch=0, remove_amp_cast=True, n_inputs=1):
+        """Serialize to symbol-JSON + params (reference HybridBlock.export,
+        python/mxnet/gluon/block.py:1150): the block is traced symbolically
+        into a Symbol graph whose parameter Variables carry the structured
+        save_parameters names, and the params file uses the reference
+        arg:/aux: checkpoint format — so `SymbolBlock.imports`,
+        `model.load_checkpoint`, Module, and the ONNX exporter can all
+        consume the artifact without the python model code."""
+        from .. import symbol as sym_mod
+        from ..model import save_params_file
+
+        inputs = [sym_mod.Variable("data" if i == 0 else f"data{i}")
+                  for i in range(n_inputs)]
+        out = self(*inputs)
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        out.save(f"{path}-symbol.json")
+        arg, aux = {}, {}
+        aux_names = set(out.list_auxiliary_states())
+        for k, p in self._collect_params_with_prefix().items():
+            (aux if k in aux_names else arg)[k] = p.data()
+        save_params_file(f"{path}-{epoch:04d}.params", arg, aux)
         return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
 
     def optimize_for(self, x, backend=None, **kwargs):
@@ -460,12 +501,62 @@ class HybridBlock(Block):
 
 
 class SymbolBlock(HybridBlock):
-    """Load an exported model (reference gluon/block.py:1193). Until a
-    serialized-jaxpr format lands, SymbolBlock wraps a python-constructed
-    block + params file."""
+    """Serve an exported symbol graph without its python model code
+    (reference gluon/block.py:1193; together with HybridBlock.export this
+    replaces the c_predict_api load-and-run deployment path)."""
+
+    def __init__(self, outputs, inputs, params=None, prefix=None, **kwargs):
+        super().__init__(prefix=prefix or "", **kwargs)
+        from .. import symbol as sym_mod
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(list(outputs))
+        self._out_sym = outputs
+        self._input_names = [i.name if hasattr(i, "name") else str(i)
+                             for i in (inputs if isinstance(inputs, (list, tuple))
+                                       else [inputs])]
+        self._arg_params = dict(params or {})
+        self._exec_cache = {}
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
-        raise MXNetError(
-            "SymbolBlock.imports requires the jaxpr-serialization round; "
-            "reconstruct the architecture in python and load_parameters()")
+        from .. import symbol as sym_mod
+        from ..model import load_params
+        out = sym_mod.load(symbol_file)
+        params = {}
+        if param_file:
+            arg, aux = load_params(param_file)
+            params = {**arg, **aux}
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        blk = SymbolBlock(out, [sym_mod.Variable(n) for n in input_names],
+                          params=params)
+        blk._ctx = ctx
+        return blk
+
+    def forward(self, *args):
+        from ..context import current_context
+        ctx = getattr(self, "_ctx", None) or \
+            (args[0].ctx if isinstance(args[0], NDArray) else current_context())
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in args)
+        ex = self._exec_cache.get(key)
+        if ex is None:
+            binds = dict(zip(self._input_names, args))
+            for k, v in self._arg_params.items():
+                v = v if isinstance(v, NDArray) else NDArray(v._data)
+                binds[k] = v.as_in_context(ctx)  # params follow the bind ctx
+            ex = self._out_sym.bind(ctx, binds)
+            self._exec_cache[key] = ex
+            outs = ex.forward()
+        else:
+            outs = ex.forward(**dict(zip(self._input_names, args)))
+        return outs[0] if len(outs) == 1 else outs
+
+    def collect_params(self, select=None):
+        from .parameter import Parameter, ParameterDict
+        pd = ParameterDict()
+        for k, v in self._arg_params.items():
+            p = Parameter(k, shape=v.shape)
+            p._load_init(v if isinstance(v, NDArray) else NDArray(v._data),
+                         None) if hasattr(p, "_load_init") else None
+            pd._params[k] = p
+        return pd

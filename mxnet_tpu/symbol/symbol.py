@@ -221,9 +221,20 @@ class Symbol:
         return f"<Symbol group [{', '.join(n.name for n, _ in self._heads)}]>"
 
     def __iter__(self):
+        # a single fresh multi-output node unpacks into its outputs, so
+        # `out, mean, var = F.BatchNorm(...)` works in symbolic traces
+        if len(self._heads) == 1:
+            node, cur = self._heads[0]
+            if node.kind != "var" and cur == 0 and _num_outputs(node) > 1:
+                return (Symbol([(node, i)])
+                        for i in range(_num_outputs(node)))
         return (Symbol([h]) for h in self._heads)
 
     def __len__(self):
+        if len(self._heads) == 1:
+            node, cur = self._heads[0]
+            if node.kind != "var" and cur == 0:
+                return max(_num_outputs(node), 1)
         return len(self._heads)
 
     def __getitem__(self, index):

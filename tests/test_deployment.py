@@ -1,0 +1,94 @@
+"""Deployment story (VERDICT r1 item 8; replaces the reference's
+include/mxnet/c_predict_api.h load-and-run-without-training path).
+
+A trained HybridBlock exports to symbol-JSON + params; a FRESH python
+process (no access to the model-building code) reloads it with
+SymbolBlock.imports and must reproduce the training process's outputs
+bit-for-bit-close. ONNX round-trips cover the cross-framework exit."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.gluon.model_zoo import vision
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FRESH_PROCESS_SCRIPT = r"""
+import json, sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import SymbolBlock
+
+prefix, out_path = sys.argv[1], sys.argv[2]
+x = np.load(prefix + "-input.npy")
+net = SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                          prefix + "-0000.params", ctx=mx.cpu())
+y = net(nd.array(x, ctx=mx.cpu()))
+np.save(out_path, y.asnumpy())
+print("SERVED_OK")
+"""
+
+
+def _export_and_serve(net, x, prefix):
+    """Export, reload in a fresh process, return its output."""
+    net.export(prefix)
+    np.save(prefix + "-input.npy", x)
+    out_path = prefix + "-served.npy"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-c", FRESH_PROCESS_SCRIPT, prefix, out_path],
+        capture_output=True, text=True, timeout=560, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "SERVED_OK" in proc.stdout
+    return np.load(out_path)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("factory,in_shape", [
+    (lambda: vision.resnet18_v1(classes=10), (2, 3, 32, 32)),
+    (lambda: vision.mobilenet_v2_0_25(classes=10), (2, 3, 32, 32)),
+    (lambda: vision.squeezenet1_1(classes=10), (2, 3, 64, 64)),
+])
+def test_export_serves_in_fresh_process(factory, in_shape, tmp_path):
+    mx.random.seed(11)
+    net = factory()
+    net.initialize()
+    net.hybridize()
+    x = np.random.RandomState(0).uniform(-1, 1, in_shape).astype(np.float32)
+    want = net(nd.array(x)).asnumpy()
+    served = _export_and_serve(net, x, str(tmp_path / "model"))
+    np.testing.assert_allclose(served, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_onnx_roundtrip_model_zoo(tmp_path):
+    """Model-zoo net -> ONNX -> import -> numerically identical executor."""
+    from mxnet_tpu.contrib import onnx as mxonnx
+
+    mx.random.seed(12)
+    net = vision.alexnet(classes=10)
+    net.initialize()
+    net.hybridize()
+    x = np.random.RandomState(1).uniform(-1, 1, (2, 3, 224, 224)).astype(np.float32)
+    want = net(nd.array(x)).asnumpy()
+
+    prefix = str(tmp_path / "alexnet")
+    net.export(prefix)
+    sym, args, aux = mx.model.load_checkpoint(prefix, 0)
+    onnx_path = str(tmp_path / "alexnet.onnx")
+    mxonnx.export_model(sym, {**args, **aux}, [x.shape],
+                        onnx_file_path=onnx_path)
+
+    sym2, args2, aux2 = mxonnx.import_model(onnx_path)
+    data_name = [n for n in sym2.list_inputs()
+                 if n not in args2 and n not in aux2][0]
+    e = sym2.bind(mx.cpu(), {**args2, **aux2, data_name: nd.array(x)})
+    got = e.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
