@@ -30,7 +30,7 @@ PEAK_BF16 = 197e12      # TPU v5e nominal bf16 peak FLOP/s
 MEASURED_PEAK = 147e12  # sustained 8192^3 bf16 matmul on this chip/harness
 
 BATCH = int(os.environ.get("BENCH_BATCH", 32))
-WARMUP = int(os.environ.get("BENCH_WARMUP", 5))
+WARMUP = int(os.environ.get("BENCH_WARMUP", 1))
 STEPS = int(os.environ.get("BENCH_STEPS", 60))
 IMAGE = int(os.environ.get("BENCH_IMAGE", 224))
 QUICK = os.environ.get("BENCH_QUICK") == "1"
@@ -60,12 +60,29 @@ def _loss_tokens(logits, labels):
     return jnp.mean(logz - gold)
 
 
+def _enable_compile_cache():
+    """Persistent XLA compilation cache: repeat runs (and the driver's
+    end-of-round run on the same host) skip the multi-minute tunnel
+    compiles. Harmless when the backend ignores it."""
+    import jax
+    cache_dir = os.environ.get(
+        "BENCH_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "mxnet_tpu_bench"))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+
 def _timed_steps(trainer, x, y, steps, warmup):
     """One compiled on-device lax.scan loop; sync via host transfer (the
-    tunneled TPU backend's block_until_ready can return early)."""
-    for _ in range(warmup):
-        float(trainer.step(x, y))
-    float(trainer.run_steps(x, y, steps)[-1])  # compile the scan
+    tunneled TPU backend's block_until_ready can return early). Warmup IS
+    the first run_steps call — same jit signature as the measured run, so
+    each config costs exactly one compile."""
+    for _ in range(max(warmup, 1)):
+        float(trainer.run_steps(x, y, steps)[-1])
     t0 = time.perf_counter()
     losses = trainer.run_steps(x, y, steps)
     float(losses[-1])
@@ -136,6 +153,7 @@ def bench_bert(batch, seq, steps, warmup):
 
 
 def main():
+    _enable_compile_cache()
     headline = bench_resnet(BATCH, IMAGE, STEPS, WARMUP)
     result = {
         "metric": "resnet50_train_throughput_bs32",

@@ -1,0 +1,107 @@
+"""Char-level LSTM language model (reference example/rnn/char-lstm +
+example/gluon/word_language_model): embed -> LSTM -> vocab head, trained
+with truncated BPTT on next-character prediction, then free-running
+sampling. Runs on a built-in corpus so it is hermetic.
+
+Run: python examples/char_rnn.py [--epochs N]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd, gluon  # noqa: E402
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "pack my box with five dozen liquor jugs. "
+    "how vexingly quick daft zebras jump! "
+) * 40
+
+SEQ_LEN = 32
+
+
+class CharLM(gluon.HybridBlock):
+    def __init__(self, vocab, hidden=96, layers=1, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.embed = gluon.nn.Embedding(vocab, 32)
+            self.lstm = gluon.rnn.LSTM(hidden, num_layers=layers,
+                                       layout="NTC")
+            self.head = gluon.nn.Dense(vocab, flatten=False)
+
+    def hybrid_forward(self, F, x, *states):
+        e = self.embed(x)
+        out, new_states = self.lstm(e, list(states))
+        return self.head(out), new_states
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    chars = sorted(set(CORPUS))
+    stoi = {c: i for i, c in enumerate(chars)}
+    data = np.array([stoi[c] for c in CORPUS], np.int32)
+    vocab = len(chars)
+    print(f"corpus {len(data)} chars, vocab {vocab}")
+
+    # (N, T) next-char batches
+    n_seq = (len(data) - 1) // SEQ_LEN
+    xs = data[:n_seq * SEQ_LEN].reshape(n_seq, SEQ_LEN)
+    ys = data[1:n_seq * SEQ_LEN + 1].reshape(n_seq, SEQ_LEN)
+
+    mx.random.seed(0)
+    net = CharLM(vocab)
+    net.initialize()
+    net(nd.zeros((2, SEQ_LEN), dtype="int32"),
+        *net.lstm.begin_state(batch_size=2))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rng = np.random.RandomState(0)
+    for epoch in range(args.epochs):
+        order = rng.permutation(n_seq)
+        total = nb = 0
+        for i in range(0, n_seq - args.batch_size + 1, args.batch_size):
+            sel = order[i:i + args.batch_size]
+            x = nd.array(xs[sel], dtype="int32")
+            y = nd.array(ys[sel], dtype="int32")
+            s0 = net.lstm.begin_state(batch_size=len(sel))
+            with autograd.record():
+                logits, _ = net(x, *s0)
+                loss = loss_fn(logits.reshape(-1, vocab), y.reshape(-1))
+                loss = loss.mean()
+            loss.backward()
+            trainer.step(1)
+            total += float(loss)
+            nb += 1
+        print(f"epoch {epoch}: loss {total / nb:.3f}")
+
+    # free-running sample
+    seed = "the "
+    state = net.lstm.begin_state(batch_size=1)
+    out_chars = list(seed)
+    x = nd.array(np.array([[stoi[c] for c in seed]], np.int32), dtype="int32")
+    for _ in range(60):
+        logits, state = net(x, *state)
+        nxt = int(logits.asnumpy()[0, -1].argmax())
+        out_chars.append(chars[nxt])
+        x = nd.array(np.array([[nxt]], np.int32), dtype="int32")
+    print("sample:", "".join(out_chars))
+    print(f"final loss {total / nb:.3f}")
+
+
+if __name__ == "__main__":
+    main()

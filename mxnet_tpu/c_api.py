@@ -1,0 +1,298 @@
+"""Flat, handle-based procedural facade over the framework — the
+`c_api`-shaped module boundary (reference include/mxnet/c_api.h, 3,245
+lines of `MX*` entry points; SURVEY.md §7 asked to keep this seam).
+
+Purpose: future non-python bindings (C/C++/Scala/Julia via cffi or the
+CPython C API) talk to ONE flat surface of functions over opaque integer
+handles — exactly how every reference frontend binds libmxnet.so. Nothing
+here adds capability; it re-exposes the object API in the reference's
+calling convention:
+
+- handles are process-unique ints (`NDArrayHandle`, `SymbolHandle`,
+  `ExecutorHandle`, `KVStoreHandle`), freed explicitly;
+- every call returns 0 on success; failures raise MXNetError whose text
+  is retrievable via `MXGetLastError()` (the reference's errno pattern);
+- outputs are returned (pythonic) rather than written through pointers —
+  a binding layer maps those to out-params mechanically.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as _np
+
+from .base import MXNetError
+
+_lock = threading.Lock()
+_handles: Dict[int, Any] = {}
+_next_id = itertools.count(1)
+_last_error = threading.local()
+
+
+def _register(obj) -> int:
+    with _lock:
+        h = next(_next_id)
+        _handles[h] = obj
+    return h
+
+
+def _get(handle: int):
+    try:
+        return _handles[handle]
+    except KeyError:
+        raise MXNetError(f"invalid handle {handle}") from None
+
+
+def _api(fn):
+    """Record failures for MXGetLastError, reference c_api error pattern."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:
+            _last_error.msg = str(e)
+            raise
+    return wrapper
+
+
+def MXGetLastError() -> str:
+    return getattr(_last_error, "msg", "")
+
+
+def MXGetVersion() -> int:
+    import re
+    from . import __version__
+    nums = re.findall(r"\d+", str(__version__))[:3] + ["0", "0", "0"]
+    return int(nums[0]) * 10000 + int(nums[1]) * 100 + int(nums[2])
+
+
+# -- NDArray ----------------------------------------------------------------
+
+@_api
+def MXNDArrayCreate(shape, dtype="float32", ctx=None) -> int:
+    from .ndarray import zeros
+    return _register(zeros(tuple(shape), dtype=dtype, ctx=ctx))
+
+
+@_api
+def MXNDArrayCreateFromNumpy(arr) -> int:
+    from .ndarray import array
+    a = _np.asarray(arr)
+    return _register(array(a, dtype=str(a.dtype)))
+
+
+@_api
+def MXNDArrayFree(handle: int) -> int:
+    with _lock:
+        _handles.pop(handle, None)
+    return 0
+
+
+@_api
+def MXNDArrayGetShape(handle: int) -> Tuple[int, ...]:
+    return tuple(_get(handle).shape)
+
+
+@_api
+def MXNDArrayGetDType(handle: int) -> str:
+    return str(_get(handle).dtype)
+
+
+@_api
+def MXNDArraySyncCopyToCPU(handle: int) -> _np.ndarray:
+    return _get(handle).asnumpy()
+
+
+@_api
+def MXNDArraySyncCopyFromCPU(handle: int, arr) -> int:
+    from .ndarray import array
+    nd = _get(handle)
+    nd._set_data(array(_np.asarray(arr), dtype=str(nd.dtype))._data)
+    return 0
+
+
+@_api
+def MXNDArrayWaitToRead(handle: int) -> int:
+    _get(handle).wait_to_read()
+    return 0
+
+
+@_api
+def MXNDArrayWaitAll() -> int:
+    from .ndarray import waitall
+    waitall()
+    return 0
+
+
+@_api
+def MXNDArraySave(fname: str, handles: List[int], keys: List[str]) -> int:
+    from .serialization import save_ndarrays
+    save_ndarrays(fname, {k: _get(h) for k, h in zip(keys, handles)})
+    return 0
+
+
+@_api
+def MXNDArrayLoad(fname: str) -> Tuple[List[str], List[int]]:
+    from .serialization import load_ndarrays
+    loaded = load_ndarrays(fname)
+    return list(loaded.keys()), [_register(v) for v in loaded.values()]
+
+
+# -- Operator invocation (MXImperativeInvoke) -------------------------------
+
+@_api
+def MXListAllOpNames() -> List[str]:
+    from .ops import registry
+    return sorted(registry.all_ops())
+
+
+@_api
+def MXImperativeInvoke(op_name: str, in_handles: List[int],
+                       **params) -> List[int]:
+    """reference c_api.cc MXImperativeInvokeEx: run a registered op on
+    NDArray handles, returning output handles."""
+    from . import ndarray as nd_mod
+    fn = getattr(nd_mod, op_name, None)
+    if fn is None:
+        raise MXNetError(f"unknown operator {op_name!r}")
+    out = fn(*[_get(h) for h in in_handles], **params)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    return [_register(o) for o in outs]
+
+
+# -- Symbol -----------------------------------------------------------------
+
+@_api
+def MXSymbolCreateVariable(name: str) -> int:
+    from . import symbol as sym_mod
+    return _register(sym_mod.Variable(name))
+
+
+@_api
+def MXSymbolCreateAtomicSymbol(op_name: str, in_handles: List[int],
+                               name: Optional[str] = None, **params) -> int:
+    from . import symbol as sym_mod
+    fn = getattr(sym_mod, op_name, None)
+    if fn is None:
+        raise MXNetError(f"unknown operator {op_name!r}")
+    if name is not None:
+        params = dict(params, name=name)
+    return _register(fn(*[_get(h) for h in in_handles], **params))
+
+
+@_api
+def MXSymbolSaveToJSON(handle: int) -> str:
+    return _get(handle).tojson()
+
+
+@_api
+def MXSymbolCreateFromJSON(json_str: str) -> int:
+    from .symbol.symbol import load_json
+    return _register(load_json(json_str))
+
+
+@_api
+def MXSymbolListArguments(handle: int) -> List[str]:
+    return list(_get(handle).list_arguments())
+
+
+@_api
+def MXSymbolListOutputs(handle: int) -> List[str]:
+    return list(_get(handle).list_outputs())
+
+
+@_api
+def MXSymbolInferShape(handle: int, **kwargs):
+    return _get(handle).infer_shape(**kwargs)
+
+
+@_api
+def MXSymbolFree(handle: int) -> int:
+    return MXNDArrayFree(handle)
+
+
+# -- Executor ---------------------------------------------------------------
+
+@_api
+def MXExecutorBind(sym_handle: int, arg_handles: Dict[str, int],
+                   ctx=None) -> int:
+    sym = _get(sym_handle)
+    binds = {k: _get(h) for k, h in arg_handles.items()}
+    return _register(sym.bind(ctx, binds))
+
+
+@_api
+def MXExecutorForward(handle: int, is_train: bool = False) -> List[int]:
+    outs = _get(handle).forward(is_train=is_train)
+    return [_register(o) for o in outs]
+
+
+@_api
+def MXExecutorBackward(handle: int, out_grad_handles: List[int]) -> int:
+    _get(handle).backward([_get(h) for h in out_grad_handles])
+    return 0
+
+
+@_api
+def MXExecutorFree(handle: int) -> int:
+    return MXNDArrayFree(handle)
+
+
+# -- KVStore ----------------------------------------------------------------
+
+@_api
+def MXKVStoreCreate(kind: str = "local") -> int:
+    from . import kvstore as kvs
+    return _register(kvs.create(kind))
+
+
+def _kv_vals(keys, handles):
+    if isinstance(handles, (list, tuple)):
+        vals = [_get(h) for h in handles]
+        # scalar key with a single handle arrives as a 1-list from bindings
+        if not isinstance(keys, (list, tuple)) and len(vals) == 1:
+            return vals[0]
+        return vals
+    return _get(handles)
+
+
+@_api
+def MXKVStoreInit(handle: int, keys, value_handles) -> int:
+    _get(handle).init(keys, _kv_vals(keys, value_handles))
+    return 0
+
+
+@_api
+def MXKVStorePush(handle: int, keys, value_handles) -> int:
+    _get(handle).push(keys, _kv_vals(keys, value_handles))
+    return 0
+
+
+@_api
+def MXKVStorePull(handle: int, keys, out_handles) -> int:
+    _get(handle).pull(keys, out=_kv_vals(keys, out_handles))
+    return 0
+
+
+@_api
+def MXKVStoreFree(handle: int) -> int:
+    return MXNDArrayFree(handle)
+
+
+# -- Misc -------------------------------------------------------------------
+
+@_api
+def MXRandomSeed(seed: int) -> int:
+    from . import random as rnd
+    rnd.seed(seed)
+    return 0
+
+
+@_api
+def MXLibInfoFeatures() -> List[str]:
+    from .runtime import feature_list
+    return [f.name for f in feature_list()]

@@ -293,6 +293,14 @@ class KVStoreDist(KVStore):
     Async mode applies local pushes without cross-worker aggregation, like
     the reference's dist_async. Single-host fallback behaves like 'local'
     with rank 0 of 1 (same as reference launched without a scheduler).
+
+    PERFORMANCE NOTE: this class is a compatibility facade. `_cross` moves
+    the full tensor through a host-mediated allgather per push — an N×
+    bandwidth regression vs the reference's key-sharded server
+    (kvstore_dist.h:606) and vs XLA's ICI collectives. The fast multi-chip
+    path is `parallel.DataParallelTrainer`, whose one-jit step lets XLA
+    lower the gradient reduction to on-device psum; use this store only for
+    eager-mode compatibility with reference dist scripts.
     """
 
     def _supports_compression(self):

@@ -74,3 +74,27 @@ class Monitor:
         """(reference monitor.py:124)"""
         for n, k, v in self.toc():
             logging.info("Batch: %7d %30s %s", n, k, v)
+
+    def install_block(self, block):
+        """Attach to a gluon Block via forward hooks: records the same
+        mean-|x| statistics per child block output (the gluon-era analog of
+        install_to_executor; reference monitor only covered executors)."""
+        def hook(blk, inputs, output, _prefix=getattr(block, "_prefix", "")):
+            if not self.activated:
+                return
+            name = getattr(blk, "_prefix", "") or type(blk).__name__
+            if not self.re_prog.match(name):
+                return
+            outs = output if isinstance(output, (list, tuple)) else [output]
+            for i, o in enumerate(outs):
+                if isinstance(o, NDArray):
+                    self.queue.append(
+                        (self.step, f"{name}output{i if i else ''}",
+                         self.stat_func(o)))
+        handles = []
+        for child in block._children.values() if hasattr(block, "_children") \
+                else []:
+            handles.append(child.register_forward_hook(hook))
+        if not handles:
+            handles.append(block.register_forward_hook(hook))
+        return handles

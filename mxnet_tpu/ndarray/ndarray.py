@@ -36,10 +36,24 @@ __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
 import collections
 import weakref
 
-_INFLIGHT: collections.deque = collections.deque(maxlen=4096)
+_INFLIGHT: collections.deque = collections.deque()
+_INFLIGHT_CAP = 4096
 
 
 def _track(arr: "NDArray"):
+    # bounded without losing Engine::WaitForAll parity: on overflow the
+    # OLDEST tracked arrays are synced before being dropped (they are the
+    # most likely to be done already), never silently forgotten
+    if len(_INFLIGHT) >= _INFLIGHT_CAP:
+        for _ in range(_INFLIGHT_CAP // 2):
+            if not _INFLIGHT:
+                break
+            a = _INFLIGHT.popleft()()
+            if a is not None:
+                try:
+                    a._data.block_until_ready()
+                except Exception:
+                    pass
     _INFLIGHT.append(weakref.ref(arr))
 
 

@@ -153,9 +153,13 @@ def functional_optimizer(opt: "opt_mod.Optimizer"):
                      f"{type(opt).__name__}; use gluon.Trainer or add one")
 
 
-def _make_apply_fn(block: HybridBlock, plist: List[Parameter], train: bool):
+def _make_apply_fn(block: HybridBlock, plist: List[Parameter], train: bool,
+                   aux_order_out: Optional[List[Parameter]] = None):
     """Pure fn(key_raw, params_raw_list, *inputs_raw) -> (outputs, aux_list).
-    Same parameter-swap trick as HybridBlock's cached graph."""
+    Same parameter-swap trick as HybridBlock's cached graph. When
+    aux_order_out is given, the Parameters whose aux values the forward
+    emits (BN running stats) are recorded there on the first call, in the
+    same order as the returned aux_list."""
     def apply_fn(key_raw, params_raw, *raw_inputs):
         in_nds = [NDArray(r) for r in raw_inputs]
         saved = [p._data._data for p in plist]
@@ -181,6 +185,8 @@ def _make_apply_fn(block: HybridBlock, plist: List[Parameter], train: bool):
         leaves = jax.tree_util.tree_leaves(
             out, is_leaf=lambda x: isinstance(x, NDArray))
         raw_out = [l._data if isinstance(l, NDArray) else l for l in leaves]
+        if aux_order_out is not None and not aux_order_out:
+            aux_order_out.extend(p for p, _ in aux)
         return raw_out[0] if len(raw_out) == 1 else tuple(raw_out), \
             [v for _, v in aux]
     return apply_fn
@@ -303,7 +309,10 @@ class DataParallelTrainer:
         return jnp.mean(self.loss(pred_raw, label_raw))
 
     def _build_step(self, x_shape_dtype, y_shape_dtype):
-        apply_fn = _make_apply_fn(self.net, self._plist, train=True)
+        aux_order: List[Parameter] = []
+        apply_fn = _make_apply_fn(self.net, self._plist, train=True,
+                                  aux_order_out=aux_order)
+        plist = self._plist
         update_fn = self._update_fn
         loss_raw = self._loss_raw
         wds = [self.optimizer._get_wd(i) for i in range(len(self._plist))]
@@ -360,6 +369,14 @@ class DataParallelTrainer:
                 else:
                     new_params.append(w)
                     new_state.append(s)
+            # BN running stats (aux) flow through the param carry so they
+            # accumulate across steps and sync() sees them — non-trainable
+            # params otherwise pass through untouched
+            idx_of = {id(p): i for i, p in enumerate(plist)}
+            for p, v in zip(aux_order, aux):
+                j = idx_of.get(id(p))
+                if j is not None and not trainable[j]:
+                    new_params[j] = v.astype(new_params[j].dtype)
             return new_params, new_state, lossv, finite, aux
         return step
 
@@ -368,7 +385,10 @@ class DataParallelTrainer:
         cross-dp reduce (reference gradient_compression.cc semantics on the
         XLA collective path). Per-device gradients exist only under explicit
         SPMD, so the whole step body runs in shard_map over the dp axis."""
-        apply_fn = _make_apply_fn(self.net, self._plist, train=True)
+        aux_order: List[Parameter] = []
+        apply_fn = _make_apply_fn(self.net, self._plist, train=True,
+                                  aux_order_out=aux_order)
+        plist = self._plist
         update_fn = self._update_fn
         loss_raw = self._loss_raw
         wds = [self.optimizer._get_wd(i) for i in range(len(self._plist))]
@@ -444,6 +464,12 @@ class DataParallelTrainer:
             aux = jax.tree_util.tree_map(
                 lambda v: lax.pmean(v, ax)
                 if jnp.issubdtype(v.dtype, jnp.floating) else v, aux)
+            # cross-device-averaged BN running stats flow through the carry
+            idx_of = {id(p): i for i, p in enumerate(plist)}
+            for p, v in zip(aux_order, aux):
+                j = idx_of.get(id(p))
+                if j is not None and not trainable[j]:
+                    new_params[j] = v.astype(new_params[j].dtype)
             return new_params, new_state, new_resid, glob_loss, finite, aux
 
         dp = P(ax)

@@ -1,0 +1,77 @@
+"""The c_api-shaped boundary module (reference include/mxnet/c_api.h):
+flat functions over opaque handles, the seam future non-python bindings
+attach to. Exercises a full imperative + symbolic + kvstore workflow the
+way a foreign frontend would."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import c_api
+
+
+def test_ndarray_roundtrip_and_ops():
+    h = c_api.MXNDArrayCreateFromNumpy(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert c_api.MXNDArrayGetShape(h) == (2, 3)
+    assert c_api.MXNDArrayGetDType(h) == "float32"
+    out, = c_api.MXImperativeInvoke("square", [h])
+    np.testing.assert_allclose(
+        c_api.MXNDArraySyncCopyToCPU(out),
+        np.arange(6, dtype=np.float32).reshape(2, 3) ** 2)
+    assert c_api.MXNDArrayWaitToRead(out) == 0
+    assert c_api.MXNDArrayWaitAll() == 0
+    assert c_api.MXNDArrayFree(h) == 0
+    with pytest.raises(mx.MXNetError):
+        c_api.MXNDArrayGetShape(h)
+    assert "invalid handle" in c_api.MXGetLastError()
+
+
+def test_invoke_with_params_and_multi_output():
+    h = c_api.MXNDArrayCreateFromNumpy(
+        np.random.RandomState(0).rand(4, 6).astype(np.float32))
+    outs = c_api.MXImperativeInvoke("split", [h], num_outputs=2, axis=1)
+    assert len(outs) == 2
+    assert c_api.MXNDArrayGetShape(outs[0]) == (4, 3)
+
+
+def test_symbol_compose_infer_bind_forward_backward():
+    x = c_api.MXSymbolCreateVariable("x")
+    w = c_api.MXSymbolCreateVariable("w")
+    fc = c_api.MXSymbolCreateAtomicSymbol(
+        "FullyConnected", [x, w], num_hidden=3, no_bias=True)
+    out = c_api.MXSymbolCreateAtomicSymbol("relu", [fc])
+    args = c_api.MXSymbolListArguments(out)
+    assert set(args) == {"x", "w"}
+    js = c_api.MXSymbolSaveToJSON(out)
+    out2 = c_api.MXSymbolCreateFromJSON(js)
+    assert set(c_api.MXSymbolListArguments(out2)) == {"x", "w"}
+
+    rng = np.random.RandomState(1)
+    xv = rng.randn(2, 4).astype(np.float32)
+    wv = rng.randn(3, 4).astype(np.float32)
+    hx = c_api.MXNDArrayCreateFromNumpy(xv)
+    hw = c_api.MXNDArrayCreateFromNumpy(wv)
+    ex = c_api.MXExecutorBind(out2, {"x": hx, "w": hw})
+    outs = c_api.MXExecutorForward(ex)
+    got = c_api.MXNDArraySyncCopyToCPU(outs[0])
+    np.testing.assert_allclose(got, np.maximum(xv @ wv.T, 0), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_kvstore_handles():
+    kv = c_api.MXKVStoreCreate("local")
+    v = c_api.MXNDArrayCreateFromNumpy(np.ones((3,), np.float32))
+    c_api.MXKVStoreInit(kv, "w", [v])
+    g = c_api.MXNDArrayCreateFromNumpy(np.full((3,), 2.0, np.float32))
+    c_api.MXKVStorePush(kv, "w", [g])
+    out = c_api.MXNDArrayCreate((3,))
+    c_api.MXKVStorePull(kv, "w", [out])
+    np.testing.assert_allclose(c_api.MXNDArraySyncCopyToCPU(out),
+                               [2.0, 2.0, 2.0])
+
+
+def test_misc_entry_points():
+    assert c_api.MXGetVersion() >= 10000
+    assert "FullyConnected" in c_api.MXListAllOpNames()
+    assert c_api.MXRandomSeed(7) == 0
+    feats = c_api.MXLibInfoFeatures()
+    assert "TPU" in feats and "SHARDING" in feats

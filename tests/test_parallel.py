@@ -319,3 +319,34 @@ def test_compression_rejects_tensor_parallel():
     with pytest.raises(mx.MXNetError):
         DataParallelTrainer(net, _loss_fn, mesh=mesh,
                             compression={"type": "2bit", "threshold": 0.5})
+
+
+def test_fused_trainer_updates_bn_running_stats():
+    """BN running stats (aux) must accumulate through the fused step's
+    param carry and reach the gluon Parameters on sync() — otherwise any
+    eval after fused training uses init stats and is garbage."""
+    rs = onp.random.RandomState(9)
+    mx.random.seed(31)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8), gluon.nn.BatchNorm(), gluon.nn.Dense(4))
+    net.initialize()
+    net(nd.zeros((2, 6)))
+    mesh = make_mesh({"dp": 1}, devices=_devices(1))
+    tr = DataParallelTrainer(net, _loss_fn, optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.05},
+                             mesh=mesh)
+    # input with a strongly nonzero mean so running_mean must move
+    x = nd.array((rs.randn(16, 6) + 5.0).astype(onp.float32))
+    y = nd.array(rs.randint(0, 4, (16,)), dtype="int32")
+    before = {k: p.data().asnumpy().copy()
+              for k, p in net.collect_params().items()
+              if "running" in k}
+    assert before, "net has no BN running stats?"
+    for _ in range(5):
+        tr.step(x, y)
+    tr.sync()
+    moved = False
+    for k, p in net.collect_params().items():
+        if "running" in k:
+            moved = moved or not onp.allclose(p.data().asnumpy(), before[k])
+    assert moved, "running stats never updated through the fused trainer"
