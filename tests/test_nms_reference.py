@@ -1,0 +1,115 @@
+"""box_nms corner cases against an independent pure-python NMS
+(reference tests cover these in tests/python/unittest/test_operator.py
+test_box_nms — thousands of lines of pinned cases; this suite checks the
+same semantic corners: per-class vs force_suppress, topk truncation,
+valid_thresh filtering, background_id skipping, batch independence)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _iou(a, b):
+    x1 = max(a[0], b[0]); y1 = max(a[1], b[1])
+    x2 = min(a[2], b[2]); y2 = min(a[3], b[3])
+    inter = max(0.0, x2 - x1) * max(0.0, y2 - y1)
+    ua = ((a[2] - a[0]) * (a[3] - a[1]) +
+          (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / ua if ua > 0 else 0.0
+
+
+def _ref_nms(rows, overlap_thresh, valid_thresh, topk, force_suppress,
+             id_index, background_id):
+    """Independent greedy NMS: returns surviving row indices in score order."""
+    order = np.argsort(-rows[:, 1], kind="stable")
+    order = [i for i in order if rows[i, 1] > valid_thresh]
+    if id_index >= 0 and background_id >= 0:
+        order = [i for i in order if rows[i, id_index] != background_id]
+    if topk > 0:
+        order = order[:topk]
+    keep = []
+    for i in order:
+        ok = True
+        for j in keep:
+            same_cls = force_suppress or id_index < 0 or \
+                rows[i, id_index] == rows[j, id_index]
+            if same_cls and _iou(rows[i, 2:6], rows[j, 2:6]) > overlap_thresh:
+                ok = False
+                break
+        if ok:
+            keep.append(i)
+    return keep
+
+
+def _run_both(rows, **kw):
+    out = nd.box_nms(nd.array(rows.astype(np.float32)),
+                     id_index=0, **kw).asnumpy()
+    keep = _ref_nms(rows, kw.get("overlap_thresh", 0.5),
+                    kw.get("valid_thresh", 0.0), kw.get("topk", -1),
+                    kw.get("force_suppress", False), 0,
+                    kw.get("background_id", -1))
+    return out, keep
+
+
+def _surviving(out):
+    """Rows not fully -1, as a set of (id, score) pairs."""
+    alive = out[~np.all(out == -1, axis=-1)]
+    return {(round(float(r[0]), 4), round(float(r[1]), 4)) for r in alive}
+
+
+def _expected(rows, keep):
+    return {(round(float(rows[i, 0]), 4), round(float(rows[i, 1]), 4))
+            for i in keep}
+
+
+def _random_rows(rng, n, n_cls=3):
+    rows = np.zeros((n, 6), np.float32)
+    rows[:, 0] = rng.randint(0, n_cls, n)
+    rows[:, 1] = rng.uniform(0.05, 1.0, n)
+    x1 = rng.uniform(0, 0.6, n); y1 = rng.uniform(0, 0.6, n)
+    rows[:, 2] = x1; rows[:, 3] = y1
+    rows[:, 4] = x1 + rng.uniform(0.1, 0.4, n)
+    rows[:, 5] = y1 + rng.uniform(0.1, 0.4, n)
+    return rows
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("kw", [
+    {},
+    {"force_suppress": True},
+    {"overlap_thresh": 0.3},
+    {"topk": 3},
+    {"valid_thresh": 0.4},
+    {"background_id": 0},
+    {"topk": 2, "force_suppress": True, "overlap_thresh": 0.4},
+])
+def test_box_nms_matches_reference(seed, kw):
+    rng = np.random.RandomState(seed)
+    rows = _random_rows(rng, 12)
+    out, keep = _run_both(rows, **kw)
+    assert _surviving(out) == _expected(rows, keep), (kw, rows)
+
+
+def test_box_nms_batch_independent():
+    rng = np.random.RandomState(9)
+    b0 = _random_rows(rng, 8)
+    b1 = _random_rows(rng, 8)
+    both = np.stack([b0, b1])
+    out = nd.box_nms(nd.array(both), id_index=0).asnumpy()
+    s0 = nd.box_nms(nd.array(b0), id_index=0).asnumpy()
+    s1 = nd.box_nms(nd.array(b1), id_index=0).asnumpy()
+    assert _surviving(out[0]) == _surviving(s0)
+    assert _surviving(out[1]) == _surviving(s1)
+
+
+def test_box_nms_all_suppressed_and_empty():
+    # identical boxes, same class: only the best survives
+    rows = np.array([[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                     [0, 0.8, 0.1, 0.1, 0.5, 0.5],
+                     [0, 0.7, 0.1, 0.1, 0.5, 0.5]], np.float32)
+    out = nd.box_nms(nd.array(rows), id_index=0).asnumpy()
+    assert len(_surviving(out)) == 1
+    # all below valid_thresh: everything suppressed
+    out2 = nd.box_nms(nd.array(rows), id_index=0, valid_thresh=0.95).asnumpy()
+    assert len(_surviving(out2)) == 0

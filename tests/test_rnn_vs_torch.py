@@ -1,0 +1,84 @@
+"""Fused RNN layers vs torch.nn references (reference
+tests/python/unittest/test_gluon_rnn.py checks against hand/cuDNN
+numerics; torch-cpu plays that role here). Weights are copied across —
+both frameworks use the cuDNN i,f,g,o (LSTM) / r,z,n (GRU) gate order."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+
+
+def _torch():
+    import torch
+    return torch
+
+
+def _copy_weights(net, tnet, mode, layers, bidirectional=False):
+    t = _torch()
+    with t.no_grad():
+        for i in range(layers):
+            for d, tag in enumerate(["l", "r"] if bidirectional else ["l"]):
+                sfx = f"_l{i}" + ("_reverse" if tag == "r" else "")
+                getattr(tnet, f"weight_ih{sfx}").copy_(
+                    t.from_numpy(getattr(net, f"{tag}{i}_i2h_weight")
+                                 .data().asnumpy()))
+                getattr(tnet, f"weight_hh{sfx}").copy_(
+                    t.from_numpy(getattr(net, f"{tag}{i}_h2h_weight")
+                                 .data().asnumpy()))
+                getattr(tnet, f"bias_ih{sfx}").copy_(
+                    t.from_numpy(getattr(net, f"{tag}{i}_i2h_bias")
+                                 .data().asnumpy()))
+                getattr(tnet, f"bias_hh{sfx}").copy_(
+                    t.from_numpy(getattr(net, f"{tag}{i}_h2h_bias")
+                                 .data().asnumpy()))
+
+
+@pytest.mark.parametrize("mode,layers,bi", [
+    ("lstm", 1, False), ("lstm", 2, False), ("lstm", 1, True),
+    ("gru", 1, False), ("gru", 2, False),
+    ("rnn_tanh", 1, False),
+])
+def test_rnn_layer_matches_torch(mode, layers, bi):
+    t = _torch()
+    T, N, I, H = 5, 3, 6, 8
+    rng = np.random.RandomState(hash((mode, layers, bi)) % 2 ** 31)
+    x = rng.randn(T, N, I).astype(np.float32)
+
+    mx.random.seed(1)
+    cls = {"lstm": gluon.rnn.LSTM, "gru": gluon.rnn.GRU,
+           "rnn_tanh": lambda h, **kw: gluon.rnn.RNN(h, activation="tanh",
+                                                     **kw)}[mode]
+    net = cls(H, num_layers=layers, layout="TNC", bidirectional=bi)
+    net.initialize()
+    out = net(nd.array(x), net.begin_state(batch_size=N))
+
+    tcls = {"lstm": t.nn.LSTM, "gru": t.nn.GRU,
+            "rnn_tanh": lambda i, h, **kw: t.nn.RNN(i, h, nonlinearity="tanh",
+                                                    **kw)}[mode]
+    tnet = tcls(I, H, num_layers=layers, bidirectional=bi)
+    _copy_weights(net, tnet, mode, layers, bi)
+    with t.no_grad():
+        tout, _ = tnet(t.from_numpy(x))
+
+    got = out[0].asnumpy() if isinstance(out, (list, tuple)) else out.asnumpy()
+    np.testing.assert_allclose(got, tout.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_states_match_torch():
+    t = _torch()
+    T, N, I, H = 4, 2, 5, 7
+    rng = np.random.RandomState(3)
+    x = rng.randn(T, N, I).astype(np.float32)
+    mx.random.seed(2)
+    net = gluon.rnn.LSTM(H, num_layers=1, layout="TNC")
+    net.initialize()
+    out, (h_n, c_n) = net(nd.array(x), net.begin_state(batch_size=N))
+    tnet = t.nn.LSTM(I, H, num_layers=1)
+    _copy_weights(net, tnet, "lstm", 1)
+    with t.no_grad():
+        tout, (th, tc) = tnet(t.from_numpy(x))
+    np.testing.assert_allclose(h_n.asnumpy(), th.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(c_n.asnumpy(), tc.numpy(), rtol=1e-4,
+                               atol=1e-5)
