@@ -404,9 +404,15 @@ def import_model(model_file: str):
     sym = heads[0] if len(heads) == 1 else sym_mod.Group(heads)
 
     arg_params, aux_params = {}, {}
+    graph_inputs = set(sym.list_inputs())
     for name, arr in params.items():
         if name in const_only and name not in tensor_used:
             continue  # shape/axes-only initializer, not a graph tensor
+        if name not in graph_inputs:
+            # initializer superseded during import (e.g. a Gemm transB=0
+            # weight replaced by its __T__ transposed copy) — dropping it
+            # keeps arg_params exactly the bindable set
+            continue
         target = aux_params if name in aux_names else arg_params
         target[name] = nd.array(arr)
     return sym, arg_params, aux_params

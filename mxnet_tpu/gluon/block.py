@@ -552,11 +552,14 @@ class SymbolBlock(HybridBlock):
         return outs[0] if len(outs) == 1 else outs
 
     def collect_params(self, select=None):
+        import re as _re
         from .parameter import Parameter, ParameterDict
         pd = ParameterDict()
+        pat = _re.compile(select) if select else None
         for k, v in self._arg_params.items():
-            p = Parameter(k, shape=v.shape)
-            p._load_init(v if isinstance(v, NDArray) else NDArray(v._data),
-                         None) if hasattr(p, "_load_init") else None
+            if pat is not None and not pat.match(k):
+                continue
+            p = Parameter(k, shape=tuple(v.shape), grad_req="null")
+            p.set_data(v if isinstance(v, NDArray) else NDArray(v._data))
             pd._params[k] = p
         return pd

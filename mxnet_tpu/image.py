@@ -119,8 +119,10 @@ def random_crop(src, size, interp=1):
     a = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
     h, w = a.shape[:2]
     ow, oh = size
-    x0 = _np.random.randint(0, max(w - ow, 0) + 1)
-    y0 = _np.random.randint(0, max(h - oh, 0) + 1)
+    # python's random (not np.random): atomic under the GIL, safe for the
+    # threaded decode pool
+    x0 = _pyrandom.randint(0, max(w - ow, 0))
+    y0 = _pyrandom.randint(0, max(h - oh, 0))
     return fixed_crop(a, x0, y0, ow, oh), (x0, y0, ow, oh)
 
 
@@ -314,7 +316,8 @@ class LightingAug(Augmenter):
         self.eigvec = _np.asarray(eigvec, "float32")
 
     def __call__(self, src):
-        alpha = _np.random.normal(0, self.alphastd, size=(3,)).astype("float32")
+        alpha = _np.array([_pyrandom.gauss(0, self.alphastd)
+                           for _ in range(3)], "float32")
         rgb = (self.eigvec * alpha) @ self.eigval
         return _npx(src) + rgb
 

@@ -396,7 +396,13 @@ class ImageRecordIter(DataIter):
         self._rand_crop = rand_crop
         self._rand_mirror = rand_mirror
         self._label_width = label_width
-        self._rng = _np.random.RandomState(seed)
+        # per-worker-thread RNG (reference iter_image_recordio_2.cc seeds
+        # one prnd per decode thread): RandomState is not thread-safe, so
+        # each pool worker gets its own stream derived from `seed` —
+        # reproducible per worker, order across workers is scheduling-
+        # dependent exactly like the reference's threaded pipeline
+        self._seed = seed
+        self._tls = threading.local()
         self._inner = None
         self._reader = None
         self._cached = None
@@ -463,7 +469,22 @@ class ImageRecordIter(DataIter):
         arr = hwc.asnumpy().astype(_np.float32)
         for aug in self._auglist:
             arr = aug(arr)
-        return _np.moveaxis(_np.asarray(arr, _np.float32), -1, 0), False
+        arr = _np.asarray(arr, _np.float32)
+        if arr.shape[:2] != (h, w):
+            # source smaller than the crop target: force exact size (the
+            # reference's C++ default augmenter also resizes as a last step)
+            from .. import image as _img
+            arr = _img.imresize(arr, w, h).asnumpy().astype(_np.float32)
+        return _np.moveaxis(arr, -1, 0), False
+
+    @property
+    def _rng(self):
+        rng = getattr(self._tls, "rng", None)
+        if rng is None:
+            rng = _np.random.RandomState(
+                (self._seed + threading.get_ident()) % (2 ** 31))
+            self._tls.rng = rng
+        return rng
 
     def _augment(self, img: _np.ndarray, raw: bool) -> _np.ndarray:
         """Crop/mirror for raw-CHW payloads (encoded images get those from
@@ -544,6 +565,10 @@ class ImageRecordIter(DataIter):
         self._ensure_producer()
         item = self._batch_q.get()
         if isinstance(item, Exception):
+            # clear the dead producer so a retrying caller restarts it
+            # instead of blocking on an empty queue forever
+            self._batch_q = None
+            self._producer = None
             raise item
         if item is None:
             self._batch_q = None  # producer finished; reset() restarts it

@@ -151,10 +151,18 @@ def reshape_like(lhs, rhs, *, lhs_begin=None, lhs_end=None, rhs_begin=None,
     """reference tensor/elemwise_unary_op_basic.cc:485 — reshape dims
     [lhs_begin, lhs_end) of lhs to rhs's dims [rhs_begin, rhs_end)."""
     lrank, rrank = lhs.ndim, rhs.ndim
-    lb = 0 if lhs_begin is None else lhs_begin % lrank
-    le = lrank if lhs_end is None else lhs_end % (lrank + 1)
-    rb = 0 if rhs_begin is None else rhs_begin % rrank
-    re_ = rrank if rhs_end is None else rhs_end % (rrank + 1)
+
+    def _resolve(v, rank, default):
+        # reference GetReshapeLikeParams: negative indices add ndim
+        # (so end=-1 means "up to the LAST axis", i.e. rank-1)
+        if v is None:
+            return default
+        return v + rank if v < 0 else v
+
+    lb = _resolve(lhs_begin, lrank, 0)
+    le = _resolve(lhs_end, lrank, lrank)
+    rb = _resolve(rhs_begin, rrank, 0)
+    re_ = _resolve(rhs_end, rrank, rrank)
     new_shape = lhs.shape[:lb] + rhs.shape[rb:re_] + lhs.shape[le:]
     return lhs.reshape(new_shape)
 

@@ -203,10 +203,14 @@ _AUX_ARGS = {"BatchNorm": ("moving_mean", "moving_var")}
 class Symbol:
     """An output list over the symbolic DAG (single symbol == one output)."""
 
-    __slots__ = ("_heads",)
+    __slots__ = ("_heads", "_selected")
 
-    def __init__(self, heads: List[Tuple[_Node, int]]):
+    def __init__(self, heads: List[Tuple[_Node, int]], selected: bool = False):
         self._heads = heads
+        # True when this Symbol came from an explicit output selection
+        # (sym[i]) — it then has exactly ONE output even if the underlying
+        # node is multi-output, so iteration/len must not re-expand it
+        self._selected = selected
 
     # -- identity ------------------------------------------------------------
     @property
@@ -222,16 +226,17 @@ class Symbol:
 
     def __iter__(self):
         # a single fresh multi-output node unpacks into its outputs, so
-        # `out, mean, var = F.BatchNorm(...)` works in symbolic traces
-        if len(self._heads) == 1:
+        # `out, mean, var = F.BatchNorm(...)` works in symbolic traces;
+        # an explicitly selected output (sym[i]) never re-expands
+        if len(self._heads) == 1 and not self._selected:
             node, cur = self._heads[0]
             if node.kind != "var" and cur == 0 and _num_outputs(node) > 1:
-                return (Symbol([(node, i)])
+                return (Symbol([(node, i)], selected=True)
                         for i in range(_num_outputs(node)))
-        return (Symbol([h]) for h in self._heads)
+        return (Symbol([h], selected=True) for h in self._heads)
 
     def __len__(self):
-        if len(self._heads) == 1:
+        if len(self._heads) == 1 and not self._selected:
             node, cur = self._heads[0]
             if node.kind != "var" and cur == 0:
                 return max(_num_outputs(node), 1)
@@ -249,20 +254,20 @@ class Symbol:
                     raise MXNetError(
                         f"output index {index} out of range "
                         f"({len(self._heads)} outputs)")
-                return Symbol([self._heads[index]])
+                return Symbol([self._heads[index]], selected=True)
             node, cur = self._heads[0]
             if cur != 0:
                 # already an explicit output selection: it has ONE output
                 if index != 0:
                     raise MXNetError(
                         f"output index {index} out of range (1 output)")
-                return Symbol([(node, cur)])
+                return Symbol([(node, cur)], selected=True)
             nout = _num_outputs(node)
             if not 0 <= index < nout:
                 raise MXNetError(
                     f"output index {index} out of range for {node.name} "
                     f"({nout} outputs)")
-            return Symbol([(node, index)])
+            return Symbol([(node, index)], selected=True)
         raise TypeError(index)
 
     # -- graph walking -------------------------------------------------------

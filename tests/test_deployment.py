@@ -92,3 +92,21 @@ def test_onnx_roundtrip_model_zoo(tmp_path):
     e = sym2.bind(mx.cpu(), {**args2, **aux2, data_name: nd.array(x)})
     got = e.forward()[0].asnumpy()
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_symbolblock_collect_params_carries_data(tmp_path):
+    """Imported SymbolBlock must expose loaded params with real data
+    (re-saveable), not shape-only shells."""
+    mx.random.seed(5)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    net(nd.zeros((1, 3)))
+    prefix = str(tmp_path / "m")
+    net.export(prefix)
+    blk = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                    prefix + "-0000.params", ctx=mx.cpu())
+    pd = blk.collect_params()
+    assert len(pd.keys()) == 2
+    for p in pd.values():
+        assert p.data() is not None and p.data().size > 0

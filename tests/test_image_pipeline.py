@@ -156,3 +156,46 @@ def test_augmenter_pipeline_units():
         out = a(out)
     assert np.asarray(out).shape == (32, 32, 3)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_imagerecorditer_upscales_small_images(tmp_path):
+    """Source images smaller than data_shape must be resized, not cropped
+    into fragments (default flags build only a CenterCrop)."""
+    from PIL import Image
+    rng = np.random.RandomState(2)
+    rec = str(tmp_path / "small.rec")
+    idx = str(tmp_path / "small.idx")
+    from mxnet_tpu import recordio
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(4):
+        img = rng.randint(0, 255, (20, 15, 3)).astype(np.uint8)
+        import io as _io
+        buf = _io.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG")
+        w.write_idx(i, recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                     buf.getvalue()))
+    w.close()
+    it = ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                         batch_size=4)
+    b = it.next()
+    assert b.data[0].shape == (4, 3, 32, 32)
+    assert np.isfinite(b.data[0].asnumpy()).all()
+
+
+def test_imagerecorditer_error_then_retry_raises_again(tmp_path):
+    """A decode error must surface on next() AND leave the iterator in a
+    restartable state (no deadlock on the following call)."""
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "bad.rec")
+    idx = str(tmp_path / "bad.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    w.write_idx(0, recordio.pack(recordio.IRHeader(0, 0.0, 0, 0),
+                                 b"not-an-image-at-all"))
+    w.close()
+    it = ImageRecordIter(path_imgrec=rec, data_shape=(3, 16, 16),
+                         batch_size=1)
+    with pytest.raises(Exception):
+        it.next()
+    # second call must not hang; it restarts the producer and re-raises
+    with pytest.raises(Exception):
+        it.next()

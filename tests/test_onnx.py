@@ -257,6 +257,8 @@ def test_gemm_shared_initializer_not_mutated(tmp_path):
     oh.save(model, path)
 
     s2, args, aux = mxonnx.import_model(path)
+    # the superseded untransposed initializer must not linger in arg_params
+    assert "B" not in args and "B" not in aux
     x = rng.randn(2, 6).astype(np.float32)
     e = s2.bind(mx.cpu(), {**args, **aux, "x": nd.array(x)})
     got = e.forward()[0].asnumpy()

@@ -365,3 +365,29 @@ def test_linalg_gelqf_reconstruction():
     np.testing.assert_allclose(l @ q, a, rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(q @ q.T, np.eye(2), rtol=1e-4, atol=1e-4)
     assert abs(l[0, 1]) < 1e-5, "L must be lower-triangular"
+
+
+def test_reshape_like_negative_ends():
+    """reference GetReshapeLikeParams: negative begin/end add ndim, so
+    lhs_end=-1 means 'up to the last axis'."""
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    y = nd.array(np.zeros((6, 4), np.float32))
+    out = nd.reshape_like(x, y, lhs_begin=0, lhs_end=-1, rhs_begin=0,
+                          rhs_end=-1)
+    assert out.shape == (6, 4)
+    out2 = nd.reshape_like(x, y)
+    assert out2.shape == (6, 4)
+
+
+def test_symbol_selected_output_is_single():
+    """sym[i] has exactly ONE output even for multi-output nodes — it must
+    not re-expand under len()/iteration."""
+    import mxnet_tpu.symbol as sym
+    d = sym.Variable("d")
+    g, b = sym.Variable("g"), sym.Variable("b")
+    mm, mv = sym.Variable("mm"), sym.Variable("mv")
+    bn = sym.BatchNorm(d, g, b, mm, mv)
+    assert len(bn) == 3
+    out0 = bn[0]
+    assert len(out0) == 1
+    assert len(list(out0)) == 1
