@@ -141,3 +141,18 @@ def test_wide_deep_sparse_example(tmp_path):
     assert "final accuracy" in proc.stdout
     acc = float(proc.stdout.split("final accuracy")[-1].split()[0])
     assert acc > 0.7, proc.stdout
+
+
+@pytest.mark.slow
+def test_dcgan_example_reaches_equilibrium(tmp_path):
+    """reference example/gan/dcgan.py analog: adversarial two-trainer
+    training must stay healthy (D does not win outright)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "dcgan.py"),
+         "--steps", "15"],
+        capture_output=True, text=True, timeout=560,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    mean_fake = float(proc.stdout.split("final mean D(fake) = ")[-1]
+                      .split()[0])
+    assert 0.15 < mean_fake < 0.85, proc.stdout
