@@ -56,11 +56,20 @@ def main():
     x = nd.array(rs.randint(0, VOCAB, (BATCH, SEQ)), dtype="int32")
     y = nd.array(rs.randint(0, VOCAB, (BATCH, SEQ)), dtype="int32")
 
-    float(trainer.step(x, y))
-    float(trainer.run_steps(x, y, STEPS)[-1])
-    t0 = time.perf_counter()
-    float(trainer.run_steps(x, y, STEPS)[-1])
-    dt = time.perf_counter() - t0
+    # adaptive warmup — the terminal runs fresh executables slow for the
+    # first few invocations (BENCHMARKS.md timing traps)
+    def once():
+        t0 = time.perf_counter()
+        float(trainer.run_steps(x, y, STEPS)[-1])
+        return time.perf_counter() - t0
+
+    prev = once()  # includes compile
+    for _ in range(6):
+        dt = once()
+        if dt > 0.6 * prev:
+            break
+        prev = dt
+    dt = once()
 
     tokens_s = BATCH * SEQ * STEPS / dt
     print(json.dumps({

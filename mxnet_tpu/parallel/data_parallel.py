@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 from jax import lax
 import jax.numpy as jnp
+import numpy as _np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..base import MXNetError
@@ -264,8 +265,19 @@ class DataParallelTrainer:
         # copy=True: the step jit donates these buffers, and without a copy
         # donation would delete the gluon Parameters' own arrays (breaking any
         # later use of the net or a second trainer on it)
-        self._params_raw = [jax.device_put(jnp.array(w, copy=True), s)
+        self._params_raw = [self._put_replicated(jnp.array(w, copy=True), s)
                             for w, s in zip(self._params_raw, self._param_shardings)]
+        if self._is_multiprocess():
+            # multi-controller jit needs GLOBAL arrays everywhere: lift the
+            # (identical-per-process, seeded) optimizer state onto the mesh.
+            # Requires every process to have initialized the net with the
+            # same seed — the same contract as the reference's dist workers
+            # starting from a rank-0 broadcast.
+            self._opt_state = [
+                jax.tree_util.tree_map(
+                    lambda l: self._put_replicated(l, s), st) if t else st
+                for st, s, t in zip(self._opt_state, self._param_shardings,
+                                    self._trainable)]
 
         # 2-bit gradient compression with per-device error feedback
         # (reference src/kvstore/gradient_compression.cc:60). Each device
@@ -299,6 +311,31 @@ class DataParallelTrainer:
                 for w, t in zip(self._params_raw, self._trainable)]
         else:
             self._comp_resid = []
+
+    # -- multi-process placement --------------------------------------------
+    def _is_multiprocess(self):
+        return any(d.process_index != jax.process_index()
+                   for d in self.mesh.devices.flat)
+
+    def _put_replicated(self, arr, sharding):
+        """Place a host value onto a (possibly multi-host) sharding. With a
+        mesh spanning processes, jax.device_put cannot target non-addressable
+        devices — build the global array from per-shard callbacks instead
+        (every process holds the full value, so any index is servable)."""
+        if not self._is_multiprocess():
+            return jax.device_put(arr, sharding)
+        host = _np.asarray(arr)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
+
+    def _put_batch(self, arr, sharding):
+        """Batch input: in multi-process SPMD each process passes its LOCAL
+        shard of the global batch (reference dist-DP feeds per-worker
+        partitions); single-process passes the global batch."""
+        if not self._is_multiprocess():
+            return jax.device_put(arr, sharding)
+        return jax.make_array_from_process_local_data(
+            sharding, _np.asarray(arr))
 
     # -- loss plumbing -------------------------------------------------------
     def _loss_raw(self, pred_raw, label_raw):
@@ -555,18 +592,18 @@ class DataParallelTrainer:
         for i in range(n):
             self.optimizer.num_update = self._t + 1 + i
             lrs.append(float(self.optimizer.learning_rate))
-        lr = jnp.asarray(lrs, jnp.float32)
-        key = _rng.next_key_raw()
+        lr = _np.asarray(lrs, _np.float32)
+        key = _np.asarray(_rng.next_key_raw())
         spec = self.data_spec
         if stacked:
             spec = P(None, *self.data_spec)
-        xr = jax.device_put(xr, NamedSharding(self.mesh, P(*spec[:xr.ndim])))
-        yr = jax.device_put(yr, NamedSharding(self.mesh, P(*spec[:yr.ndim])))
-        scale = jnp.float32(self._scaler.loss_scale if self._scaler else 1.0)
+        xr = self._put_batch(xr, NamedSharding(self.mesh, P(*spec[:xr.ndim])))
+        yr = self._put_batch(yr, NamedSharding(self.mesh, P(*spec[:yr.ndim])))
+        scale = _np.float32(self._scaler.loss_scale if self._scaler else 1.0)
         (self._params_raw, self._opt_state, self._comp_resid, losses,
          finite) = fn(
             self._params_raw, self._opt_state, self._comp_resid, key, xr, yr,
-            lr, jnp.float32(self._t + 1), scale)
+            lr, _np.float32(self._t + 1), scale)
         self._t += n
         self.optimizer.num_update = self._t
         if self._scaler is not None:
@@ -583,22 +620,22 @@ class DataParallelTrainer:
         fn = self._get_step(sig)
         self._t += 1
         self.optimizer.num_update = self._t
-        lr = jnp.float32(self.optimizer.learning_rate)
-        key = _rng.next_key_raw()
-        xr = jax.device_put(xr, NamedSharding(self.mesh, self.data_spec))
+        lr = _np.float32(self.optimizer.learning_rate)
+        key = _np.asarray(_rng.next_key_raw())
+        xr = self._put_batch(xr, NamedSharding(self.mesh, self.data_spec))
         y_spec = self.data_spec if yr.ndim >= len(self.data_spec) \
             else P(*self.data_spec[:yr.ndim])
-        yr = jax.device_put(yr, NamedSharding(self.mesh, y_spec))
-        scale = jnp.float32(self._scaler.loss_scale if self._scaler else 1.0)
+        yr = self._put_batch(yr, NamedSharding(self.mesh, y_spec))
+        scale = _np.float32(self._scaler.loss_scale if self._scaler else 1.0)
         if self._compression:
             (self._params_raw, self._opt_state, self._comp_resid, lossv,
              finite, aux) = fn(
                 self._params_raw, self._opt_state, self._comp_resid,
-                jnp.asarray(key), xr, yr, lr, jnp.float32(self._t), scale)
+                key, xr, yr, lr, _np.float32(self._t), scale)
         else:
             self._params_raw, self._opt_state, lossv, finite, aux = fn(
                 self._params_raw, self._opt_state, key, xr, yr, lr,
-                jnp.float32(self._t), scale)
+                _np.float32(self._t), scale)
         if self._scaler is not None:
             self._scaler.update_scale(not bool(finite))
         return lossv
