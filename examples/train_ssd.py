@@ -74,6 +74,47 @@ def synthetic_batch(rng, batch, num_classes):
     return nd.array(x), nd.array(labels)
 
 
+def make_det_rec(path, n, num_classes, rng, side=64):
+    """Pack synthetic detection JPEGs into a det RecordIO: label =
+    [header_width=2, object_width=5, (cls, x1, y1, x2, y2)...]."""
+    from PIL import Image
+    import io as _io
+    from mxnet_tpu import recordio
+    w = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    for i in range(n):
+        img = (rng.uniform(0, 0.1, (side, side, 3)) * 255).astype(np.uint8)
+        cls = rng.randint(0, num_classes)
+        cx, cy = rng.uniform(0.3, 0.7, 2)
+        s = rng.uniform(0.15, 0.3)
+        x1, y1, x2, y2 = cx - s, cy - s, cx + s, cy + s
+        img[int(y1 * side):int(y2 * side),
+            int(x1 * side):int(x2 * side), cls % 3] = 255
+        buf = _io.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=92)
+        label = [2.0, 5.0, float(cls), x1, y1, x2, y2]
+        w.write_idx(i, recordio.pack(recordio.IRHeader(0, label, i, 0),
+                                     buf.getvalue()))
+    w.close()
+    return path + ".rec"
+
+
+def rec_batches(path, batch_size, image=64):
+    """ImageDetRecordIter -> (image batch, (B, n_obj, 5) labels)."""
+    from mxnet_tpu.io import ImageDetRecordIter
+    it = ImageDetRecordIter(path_imgrec=path, data_shape=(3, image, image),
+                            batch_size=batch_size, shuffle=True,
+                            std_r=255, std_g=255, std_b=255)
+    while True:
+        for b in it:
+            lab = b.label[0].asnumpy()
+            hw, ow = int(lab[0, 0]), int(lab[0, 1])
+            objs = lab[:, hw:]
+            n = max(objs.shape[1] // ow, 1)
+            labels = objs[:, :n * ow].reshape(len(lab), n, ow)[:, :, :5]
+            yield b.data[0], nd.array(labels.astype(np.float32))
+        it.reset()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=30)
@@ -81,6 +122,12 @@ def main():
     ap.add_argument("--num-classes", type=int, default=3)
     ap.add_argument("--lr", type=float, default=0.05)
     ap.add_argument("--synthetic", action="store_true")
+    ap.add_argument("--rec", default=None,
+                    help="detection RecordIO (made with --make-rec or "
+                         "im2rec); default generates one in a temp dir")
+    ap.add_argument("--use-rec", action="store_true",
+                    help="train from a det RecordIO via ImageDetRecordIter "
+                         "instead of in-memory synthetic batches")
     args = ap.parse_args()
 
     rng = np.random.RandomState(0)
@@ -91,10 +138,23 @@ def main():
     cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
     box_loss = gluon.loss.HuberLoss()
 
+    batches = None
+    if args.use_rec or args.rec:
+        rec = args.rec
+        if rec is None:
+            import tempfile
+            rec = make_det_rec(os.path.join(tempfile.mkdtemp(), "det"),
+                               256, args.num_classes, rng)
+            print(f"packed synthetic det RecordIO at {rec}")
+        batches = rec_batches(rec, args.batch_size)
+
     tic = time.time()
     first = last = None
     for step in range(args.steps):
-        x, labels = synthetic_batch(rng, args.batch_size, args.num_classes)
+        if batches is not None:
+            x, labels = next(batches)
+        else:
+            x, labels = synthetic_batch(rng, args.batch_size, args.num_classes)
         with autograd.record():
             anchors, cls_preds, box_preds = net(x)
             outs = nd.contrib.MultiBoxTarget(
