@@ -224,6 +224,13 @@ ELSEWHERE = {
     "_contrib_edge_id": "test_op_sweep.py::test_edge_id",
     "_linalg_syevd": "test_op_sweep.py::test_linalg_syevd_reconstruction",
     "_linalg_gelqf": "test_op_sweep.py::test_linalg_gelqf_reconstruction",
+    # samplers: moment/frequency-verified statistically
+    "_npi_normal": "test_samplers.py", "_npi_normal_n": "test_samplers.py",
+    "_npi_uniform": "test_samplers.py", "_npi_uniform_n": "test_samplers.py",
+    "_npi_bernoulli": "test_samplers.py",
+    "_npi_multinomial": "test_samplers.py",
+    "_sample_multinomial": "test_samplers.py",
+    "_shuffle": "test_samplers.py",
 }
 
 # Reference ops with no deterministic numeric contract to sweep.
@@ -232,15 +239,8 @@ EXEMPT = {
     "_NDArray": "graph-embedding of an existing array handle (plumbing)",
     "_Native": "host-callback escape hatch, exercised via mx.library tests",
     "__name": "macro artifact in the reference registry, not a real op",
-    "_npi_normal": "stochastic sampler (moment checks impractical per-op)",
-    "_npi_normal_n": "stochastic sampler",
-    "_npi_uniform": "stochastic sampler",
-    "_npi_uniform_n": "stochastic sampler",
-    "_npi_bernoulli": "stochastic sampler",
-    "_npi_choice": "stochastic sampler",
-    "_npi_multinomial": "stochastic sampler",
-    "_sample_multinomial": "stochastic sampler",
-    "_shuffle": "stochastic permutation",
+    "_npi_choice": "stochastic sampler; distribution family moment-checked "
+                   "in test_samplers.py via multinomial",
     "Dropout": "stochastic in train mode; p=0 identity swept",
     "SoftmaxActivation": "deprecated alias; swept via softmax",
     "IdentityAttachKLSparseReg": "regularizer attachment is a training-time "
