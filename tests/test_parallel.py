@@ -8,7 +8,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd, gluon
