@@ -87,19 +87,15 @@ def _timed_steps(trainer, x, y, steps, warmup):
     speed only after a couple of executions — a single warm call measures
     the slow mode. Keep warming until back-to-back timings stabilize
     (ratio > 0.6), bounded by max(warmup, 6) iterations."""
+    from benchmark.bench_util import measure_stabilized
+
     def once():
         t0 = time.perf_counter()
         losses = trainer.run_steps(x, y, steps)
         float(losses[-1])
         return time.perf_counter() - t0
 
-    prev = once()  # includes compile
-    for _ in range(max(warmup, 6)):
-        cur = once()
-        if cur > 0.6 * prev:
-            break
-        prev = cur
-    return once()
+    return measure_stabilized(once, max_warm=max(warmup, 6))
 
 
 def bench_resnet(batch, image, steps, warmup):

@@ -1,0 +1,22 @@
+"""Shared benchmark timing helpers.
+
+The axon terminal runs a freshly loaded executable ~40x slow for its
+first 1-3 invocations before reaching full speed (BENCHMARKS.md timing
+traps) — a single warm call measures the slow mode. `measure_stabilized`
+keeps warming until back-to-back timings stop improving, then returns
+one final measured duration.
+"""
+from __future__ import annotations
+
+
+def measure_stabilized(timed_fn, max_warm: int = 6, ratio: float = 0.6):
+    """timed_fn() -> seconds for one full measured unit (must sync).
+    First call may include compilation. Returns the duration of a final
+    run taken after consecutive timings stabilize (dt > ratio * prev)."""
+    prev = timed_fn()
+    for _ in range(max_warm):
+        cur = timed_fn()
+        if cur > ratio * prev:
+            break
+        prev = cur
+    return timed_fn()

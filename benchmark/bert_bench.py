@@ -58,18 +58,14 @@ def main():
 
     # adaptive warmup — the terminal runs fresh executables slow for the
     # first few invocations (BENCHMARKS.md timing traps)
+    from bench_util import measure_stabilized
+
     def once():
         t0 = time.perf_counter()
         float(trainer.run_steps(x, y, STEPS)[-1])
         return time.perf_counter() - t0
 
-    prev = once()  # includes compile
-    for _ in range(6):
-        dt = once()
-        if dt > 0.6 * prev:
-            break
-        prev = dt
-    dt = once()
+    dt = measure_stabilized(once)
 
     tokens_s = BATCH * SEQ * STEPS / dt
     print(json.dumps({

@@ -59,16 +59,9 @@ def main():
             float(run(q, k, v))
             return time.perf_counter() - t0
 
+        from bench_util import measure_stabilized
         try:
-            # adaptive warmup: the axon terminal runs a freshly loaded
-            # executable ~40x slow for its first invocations (BENCHMARKS.md)
-            prev = timed()  # includes compile
-            for _ in range(6):
-                dt = timed()
-                if dt > 0.6 * prev:
-                    break
-                prev = dt
-            dt = timed()
+            dt = measure_stabilized(timed)
         except Exception as e:  # noqa: BLE001 — report OOM per length
             print(f"T={T:>6}: FAILED ({type(e).__name__})")
             continue

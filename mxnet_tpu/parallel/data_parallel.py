@@ -234,6 +234,10 @@ class DataParallelTrainer:
             from ..contrib.amp.loss_scaler import LossScaler
             self._scaler = LossScaler()
         self.mesh = mesh if mesh is not None else current_mesh()
+        # computed once: the mesh never changes after construction, and the
+        # per-step placement helpers sit on the hot path
+        self._multiprocess = any(d.process_index != jax.process_index()
+                                 for d in self.mesh.devices.flat)
         self.batch_axis = batch_axis_name
         # input PartitionSpec; default = batch over the dp axis only. Pass
         # e.g. P('dp', 'sp') to also shard the sequence dim (context parallel).
@@ -303,19 +307,32 @@ class DataParallelTrainer:
                     f"data_spec={self.data_spec}")
             ndp = self.mesh.shape[self.batch_axis]
             thr_sh = NamedSharding(self.mesh, P(self.batch_axis))
+
+            def _zeros_on(shape, sharding):
+                # zeros are servable from every process: placement works on
+                # multi-host meshes where device_put cannot reach
+                # non-addressable devices
+                if not self._multiprocess:
+                    return jax.device_put(jnp.zeros(shape, jnp.float32),
+                                          sharding)
+                def _shard_zeros(idx, _s=shape):
+                    dims = [len(range(*sl.indices(dim)))
+                            for sl, dim in zip(idx, _s)]
+                    return _np.zeros(tuple(dims), _np.float32)
+                return jax.make_array_from_callback(shape, sharding,
+                                                    _shard_zeros)
+
             self._comp_resid = [
-                jax.device_put(
-                    jnp.zeros((ndp,) + w.shape, jnp.float32), thr_sh)
+                _zeros_on((ndp,) + w.shape, thr_sh)
                 if t and jnp.issubdtype(w.dtype, jnp.floating) else
-                jax.device_put(jnp.zeros((ndp, 1), jnp.float32), thr_sh)
+                _zeros_on((ndp, 1), thr_sh)
                 for w, t in zip(self._params_raw, self._trainable)]
         else:
             self._comp_resid = []
 
     # -- multi-process placement --------------------------------------------
     def _is_multiprocess(self):
-        return any(d.process_index != jax.process_index()
-                   for d in self.mesh.devices.flat)
+        return self._multiprocess
 
     def _put_replicated(self, arr, sharding):
         """Place a host value onto a (possibly multi-host) sharding. With a
