@@ -94,3 +94,71 @@ def test_two_process_hybrid_mesh_trainer(tmp_path):
     l1 = (tmp_path / "loss_1").read_text().split()
     # multi-controller SPMD: both workers observe the SAME global loss
     assert l0 == l1, (l0, l1)
+
+
+COMP_WORKER = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.parallel import make_mesh, P, DataParallelTrainer
+
+rank = jax.process_index()
+mesh = make_mesh({{"dp": 8}}, devices=jax.devices())  # dp spans both hosts
+
+mx.random.seed(77)
+net = gluon.nn.HybridSequential()
+net.add(gluon.nn.Dense(16), gluon.nn.Activation("relu"), gluon.nn.Dense(4))
+net.initialize()
+net(nd.zeros((2, 8)))
+
+def loss_fn(logits, labels):
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None].astype(jnp.int32),
+                               axis=-1)[:, 0]
+    return jnp.mean(logz - gold)
+
+tr = DataParallelTrainer(net, loss_fn, optimizer="sgd",
+                         optimizer_params={{"learning_rate": 0.3}}, mesh=mesh,
+                         compression={{"type": "2bit", "threshold": 0.01}})
+
+rs = onp.random.RandomState(5)
+gx = rs.uniform(-1, 1, (16, 8)).astype(onp.float32)
+gy = rs.randint(0, 4, (16,)).astype(onp.int64)
+lx, ly = gx[rank * 8:(rank + 1) * 8], gy[rank * 8:(rank + 1) * 8]
+losses = [float(tr.step(nd.array(lx), nd.array(ly, dtype="int32")))
+          for _ in range(12)]
+assert all(onp.isfinite(losses)), losses
+assert losses[-1] < losses[0], losses
+open(os.path.join({tmp!r}, f"closs_{{rank}}"), "w").write(
+    " ".join(f"{{l:.6f}}" for l in losses))
+print("compressed worker", rank, "ok")
+"""
+
+
+@pytest.mark.slow
+def test_two_process_compressed_trainer(tmp_path):
+    """2-bit in-jit gradient compression over a process-spanning dp mesh:
+    the quantized tensors ride the cross-host collective, residuals stay
+    host-local, and both controllers see the same global loss."""
+    script = tmp_path / "mh_comp_worker.py"
+    script.write_text(COMP_WORKER.format(repo=REPO, tmp=str(tmp_path)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=560)
+    assert r.returncode == 0, f"stdout:\n{r.stdout[-3000:]}\nstderr:\n{r.stderr[-3000:]}"
+    l0 = (tmp_path / "closs_0").read_text().split()
+    l1 = (tmp_path / "closs_1").read_text().split()
+    assert l0 == l1, (l0, l1)
