@@ -241,7 +241,7 @@ EXEMPT = {
     "__name": "macro artifact in the reference registry, not a real op",
     "_npi_choice": "stochastic sampler; distribution family moment-checked "
                    "in test_samplers.py via multinomial",
-    "Dropout": "stochastic in train mode; p=0 identity swept",
+    "Dropout": "train-mode mask statistics verified in test_samplers.py; p=0 identity swept",
     "SoftmaxActivation": "deprecated alias; swept via softmax",
     "IdentityAttachKLSparseReg": "regularizer attachment is a training-time "
                                  "side effect; identity forward swept",

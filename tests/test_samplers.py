@@ -92,3 +92,21 @@ def test_randint_range_and_uniformity():
     assert a.min() >= 3 and a.max() <= 8
     counts = np.bincount(a, minlength=9)[3:9] / N
     np.testing.assert_allclose(counts, np.full(6, 1 / 6), atol=0.02)
+
+
+def test_dropout_train_mode_statistics():
+    """Dropout in train mode: ~p of activations zeroed, survivors scaled
+    by 1/(1-p) so the expectation is preserved (upgrades the op-sweep
+    Dropout exemption beyond the p=0 identity check)."""
+    from mxnet_tpu import autograd
+    mx.random.seed(8)
+    x = nd.ones((200, 100))
+    with autograd.record():
+        autograd.set_training(True)
+        y = nd.Dropout(x, p=0.4)
+    a = y.asnumpy()
+    zero_frac = (a == 0).mean()
+    assert abs(zero_frac - 0.4) < 0.02, zero_frac
+    nz = a[a != 0]
+    np.testing.assert_allclose(nz, 1.0 / 0.6, rtol=1e-5)
+    assert abs(a.mean() - 1.0) < 0.02  # expectation preserved
