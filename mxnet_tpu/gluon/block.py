@@ -516,6 +516,8 @@ class SymbolBlock(HybridBlock):
                                        else [inputs])]
         self._arg_params = dict(params or {})
         self._exec_cache = {}
+        self._param_objs = None
+        self._feed_cache = None
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
@@ -533,33 +535,57 @@ class SymbolBlock(HybridBlock):
         blk._ctx = ctx
         return blk
 
+    def _live_params(self):
+        # persistent Parameter objects so collect_params()/set_data/load
+        # feed every subsequent forward (not a first-call snapshot)
+        if self._param_objs is None:
+            from .parameter import Parameter, ParameterDict
+            pd = ParameterDict()
+            for k, v in self._arg_params.items():
+                p = Parameter(k, shape=tuple(v.shape), dtype=str(v.dtype),
+                              grad_req="null")
+                p.set_data(v if isinstance(v, NDArray) else NDArray(v._data))
+                pd._params[k] = p
+            self._param_objs = pd
+        return self._param_objs
+
     def forward(self, *args):
         from ..context import current_context
         ctx = getattr(self, "_ctx", None) or \
             (args[0].ctx if isinstance(args[0], NDArray) else current_context())
         key = tuple((tuple(a.shape), str(a.dtype)) for a in args)
+        feed = dict(zip(self._input_names, args))
+        # params follow the bind ctx; the device copy is cached per
+        # (array identity, version) so serving pays it once, not per call
+        cache = getattr(self, "_feed_cache", None)
+        if cache is None or cache[0] is not ctx:
+            cache = (ctx, {})
+            self._feed_cache = cache
+        conv = cache[1]
+        for k, p in self._live_params()._params.items():
+            d = p.data()
+            ent = conv.get(k)
+            if ent is None or ent[0] is not d or ent[1] != d.version:
+                conv[k] = ent = (d, d.version, d.as_in_context(ctx))
+            feed[k] = ent[2]
         ex = self._exec_cache.get(key)
         if ex is None:
-            binds = dict(zip(self._input_names, args))
-            for k, v in self._arg_params.items():
-                v = v if isinstance(v, NDArray) else NDArray(v._data)
-                binds[k] = v.as_in_context(ctx)  # params follow the bind ctx
-            ex = self._out_sym.bind(ctx, binds)
+            ex = self._out_sym.bind(ctx, dict(feed))
             self._exec_cache[key] = ex
-            outs = ex.forward()
-        else:
-            outs = ex.forward(**dict(zip(self._input_names, args)))
+        # always re-feed current param values so post-construction
+        # set_data/load on collect_params() results affect inference
+        outs = ex.forward(**feed)
         return outs[0] if len(outs) == 1 else outs
 
     def collect_params(self, select=None):
         import re as _re
-        from .parameter import Parameter, ParameterDict
+        from .parameter import ParameterDict
+        live = self._live_params()
+        if not select:
+            return live
+        pat = _re.compile(select)
         pd = ParameterDict()
-        pat = _re.compile(select) if select else None
-        for k, v in self._arg_params.items():
-            if pat is not None and not pat.match(k):
-                continue
-            p = Parameter(k, shape=tuple(v.shape), grad_req="null")
-            p.set_data(v if isinstance(v, NDArray) else NDArray(v._data))
-            pd._params[k] = p
+        for k, p in live._params.items():
+            if pat.match(k):
+                pd._params[k] = p
         return pd

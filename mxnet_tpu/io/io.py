@@ -402,7 +402,7 @@ class ImageRecordIter(DataIter):
         # reproducible per worker, order across workers is scheduling-
         # dependent exactly like the reference's threaded pipeline
         self._seed = seed
-        self._tls = threading.local()
+        self._main_rng = None
         self._inner = None
         self._reader = None
         self._cached = None
@@ -479,11 +479,16 @@ class ImageRecordIter(DataIter):
 
     @property
     def _rng(self):
-        rng = getattr(self._tls, "rng", None)
+        # pool workers carry an rng attached by the pool initializer
+        # (stable per-worker index, reference seeds prnd per worker index
+        # too); non-pool callers (ImageDetRecordIter's synchronous path)
+        # share one deterministic per-iterator stream
+        rng = getattr(threading.current_thread(), "_mx_io_rng", None)
         if rng is None:
-            rng = _np.random.RandomState(
-                (self._seed + threading.get_ident()) % (2 ** 31))
-            self._tls.rng = rng
+            rng = self._main_rng
+            if rng is None:
+                rng = self._main_rng = _np.random.RandomState(
+                    self._seed % (2 ** 31))
         return rng
 
     def _augment(self, img: _np.ndarray, raw: bool) -> _np.ndarray:
@@ -520,8 +525,23 @@ class ImageRecordIter(DataIter):
         pipeline), assemble batches in order, feed the prefetch queue."""
         import concurrent.futures as cf
         from ..ndarray import array
+        # worker seeds 0..n-1 are handed out per POOL via the initializer:
+        # each epoch's fresh pool re-derives the same stream set, and a
+        # zombie thread from a timed-out previous pool keeps its own rng
+        # (attached to the thread object) without consuming a new index
+        lock = threading.Lock()
+        nxt = [0]
+        seed0 = self._seed
+
+        def _init_worker():
+            with lock:
+                widx = nxt[0]
+                nxt[0] += 1
+            threading.current_thread()._mx_io_rng = _np.random.RandomState(
+                (seed0 + widx) % (2 ** 31))
         try:
-            with cf.ThreadPoolExecutor(self._nthreads) as pool:
+            with cf.ThreadPoolExecutor(self._nthreads,
+                                       initializer=_init_worker) as pool:
                 while not stop.is_set():
                     recs = []
                     while len(recs) < self.batch_size:
@@ -820,6 +840,15 @@ class ImageDetRecordIter(DataIter):
         # synchronously — detection labels are ragged, so batching happens
         # here (rand_mirror is intentionally OFF: flipping would need the
         # box coordinates rewritten; augment at training level instead)
+        bad = sorted(k for k in ("rand_crop", "rand_resize", "resize",
+                                 "max_rotate_angle", "max_shear_ratio")
+                     if kwargs.get(k))
+        if bad:
+            raise MXNetError(
+                "ImageDetRecordIter does not support geometric augmentation "
+                f"({', '.join(bad)}): box labels would not be rewritten to "
+                "match (reference DefaultImageDetAugmenter adjusts them; "
+                "here augment at training level instead)")
         self._inner = ImageRecordIter(
             path_imgrec=path_imgrec, data_shape=data_shape,
             batch_size=batch_size, shuffle=shuffle, rand_mirror=False,
@@ -827,6 +856,12 @@ class ImageDetRecordIter(DataIter):
             std_r=std_r, std_g=std_g, std_b=std_b,
             preprocess_threads=preprocess_threads,
             prefetch_buffer=prefetch_buffer, seed=seed, ctx=ctx, **kwargs)
+        # encoded det images are RESIZED to the target, never cropped:
+        # a pure resize keeps normalized [0,1] box coordinates valid,
+        # a center/random crop would silently invalidate them
+        from .. import image as _img
+        c, h, w = self.data_shape
+        self._inner._auglist = [_img.ForceResizeAug((w, h))]
         self._cached = None
 
     @property

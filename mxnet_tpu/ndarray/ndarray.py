@@ -45,13 +45,16 @@ def _track(arr: "NDArray"):
     # OLDEST tracked arrays are synced before being dropped (they are the
     # most likely to be done already), never silently forgotten
     if len(_INFLIGHT) >= _INFLIGHT_CAP:
+        # drop the oldest half, blocking only on genuinely incomplete
+        # arrays — the oldest are overwhelmingly done already
         for _ in range(_INFLIGHT_CAP // 2):
             if not _INFLIGHT:
                 break
             a = _INFLIGHT.popleft()()
             if a is not None:
                 try:
-                    a._data.block_until_ready()
+                    if not a._data.is_ready():
+                        a._data.block_until_ready()
                 except Exception:
                     pass
     _INFLIGHT.append(weakref.ref(arr))

@@ -501,7 +501,12 @@ class DataParallelTrainer:
                     q = jnp.where(acc >= thr, thr,
                                   jnp.where(acc <= -thr, -thr,
                                             jnp.zeros_like(acc)))
-                    new_resid.append((acc - q)[None])
+                    if scaled:
+                        # an overflow step must not poison the error-feedback
+                        # carry: NaN acc would make q == 0 forever after
+                        new_resid.append(jnp.where(finite, acc - q, r[0])[None])
+                    else:
+                        new_resid.append((acc - q)[None])
                     gg = lax.pmean(q, ax)
                 else:
                     new_resid.append(r)

@@ -110,3 +110,27 @@ def test_symbolblock_collect_params_carries_data(tmp_path):
     assert len(pd.keys()) == 2
     for p in pd.values():
         assert p.data() is not None and p.data().size > 0
+
+
+def test_symbolblock_set_data_affects_inference(tmp_path):
+    """set_data on collect_params() results must feed subsequent forwards
+    (advisor round-2: params were a first-call snapshot before)."""
+    mx.random.seed(6)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, use_bias=False))
+    net.initialize()
+    x = nd.ones((1, 3))
+    net(x)
+    prefix = str(tmp_path / "m2")
+    net.export(prefix)
+    blk = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                    prefix + "-0000.params", ctx=mx.cpu())
+    out1 = blk(x).asnumpy()
+    pd = blk.collect_params()
+    for p in pd.values():
+        p.set_data(p.data() * 2.0)
+    out2 = blk(x).asnumpy()
+    np.testing.assert_allclose(out2, out1 * 2.0, rtol=1e-5)
+    # and after the executor cache is warm, too
+    out3 = blk(x).asnumpy()
+    np.testing.assert_allclose(out3, out2, rtol=1e-6)

@@ -106,3 +106,26 @@ def test_py_fallback_shuffles(tmp_path):
         ep2.append(r)
     assert sorted(ep1) == sorted(ep2) and len(ep1) == 32
     assert ep1 != ep2  # reshuffled across epochs
+
+
+def test_fixed_seed_reproducible_across_runs_and_epochs(tmp_path):
+    """With a fixed seed and preprocess_threads=1 the augmentation stream
+    must be identical run-to-run, including epochs after reset() (advisor
+    round-2: thread-ident seeding broke this)."""
+    path, _ = _write_rec(tmp_path, n=6, shape=(3, 8, 8))
+
+    def run():
+        it = ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                             batch_size=3, rand_mirror=1,
+                             preprocess_threads=1, seed=5)
+        out = []
+        for _ in range(2):  # two epochs
+            for b in it:
+                out.append(b.data[0].asnumpy().copy())
+            it.reset()
+        return out
+
+    a, b = run(), run()
+    assert len(a) == len(b) == 4
+    for x, y in zip(a, b):
+        onp.testing.assert_array_equal(x, y)

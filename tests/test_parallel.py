@@ -350,3 +350,39 @@ def test_fused_trainer_updates_bn_running_stats():
         if "running" in k:
             moved = moved or not onp.allclose(p.data().asnumpy(), before[k])
     assert moved, "running stats never updated through the fused trainer"
+
+
+def test_compressed_fp16_overflow_does_not_poison_residuals():
+    """An overflow (non-finite) step under float16 loss scaling must roll
+    back the error-feedback residuals too: a NaN residual would make the
+    quantizer emit 0 forever, silently freezing that parameter (advisor
+    round-2 medium finding)."""
+    rs = onp.random.RandomState(11)
+    xs = rs.uniform(-1, 1, (16, 16)).astype(onp.float32)
+    ys = rs.randint(0, 4, (16,))
+    x = nd.array(xs)
+    y = nd.array(ys, dtype="int32")
+    x_bad = nd.array(onp.where(onp.arange(16)[:, None] == 0, onp.nan,
+                               xs).astype(onp.float32))
+    mx.random.seed(13)
+    net = _mlp()
+    mesh = make_mesh({"dp": 8}, devices=_devices(8))
+    tr = DataParallelTrainer(net, _loss_fn, optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.2},
+                             mesh=mesh, dtype="float16",
+                             compression={"type": "2bit", "threshold": 0.01})
+    tr.step(x, y)
+    resid_before = [onp.asarray(r).copy() for r in tr._comp_resid]
+    w_before = [onp.asarray(w).copy() for w in tr._params_raw]
+    tr.step(x_bad, y)  # overflow step: grads are NaN
+    # weights AND residuals rolled back — nothing NaN anywhere
+    for b, a in zip(w_before, tr._params_raw):
+        onp.testing.assert_array_equal(onp.asarray(a), b)
+    for b, a in zip(resid_before, tr._comp_resid):
+        arr = onp.asarray(a)
+        assert onp.isfinite(arr).all(), "residual poisoned by overflow step"
+        onp.testing.assert_array_equal(arr, b)
+    # training continues to make progress afterwards
+    losses = [float(tr.step(x, y)) for _ in range(30)]
+    assert onp.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses

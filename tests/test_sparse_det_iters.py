@@ -156,3 +156,42 @@ def test_dcgan_example_reaches_equilibrium(tmp_path):
     mean_fake = float(proc.stdout.split("final mean D(fake) = ")[-1]
                       .split()[0])
     assert 0.15 < mean_fake < 0.85, proc.stdout
+
+
+def test_image_det_record_iter_rejects_geometric_augmentation(det_rec):
+    """Geometric kwargs would transform pixels while box labels pass
+    through unadjusted — must be rejected, not silently corrupted."""
+    with pytest.raises(mx.MXNetError):
+        ImageDetRecordIter(path_imgrec=det_rec, data_shape=(3, 24, 24),
+                           batch_size=2, rand_crop=1)
+    with pytest.raises(mx.MXNetError):
+        ImageDetRecordIter(path_imgrec=det_rec, data_shape=(3, 24, 24),
+                           batch_size=2, resize=48)
+
+
+def test_image_det_record_iter_resizes_not_crops(tmp_path):
+    """Oversized encoded det images must be RESIZED to data_shape (box
+    coords stay valid in normalized terms), never center-cropped."""
+    from PIL import Image
+    import io as _io
+    rec_path = str(tmp_path / "big.rec")
+    idx_path = str(tmp_path / "big.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    # image with a bright left half: a center crop of the middle would
+    # lose the left/right asymmetry, a resize keeps it
+    img = np.zeros((64, 64, 3), np.uint8)
+    img[:, :32] = 255
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    label = [2.0, 5.0, 0.0, 0.0, 0.0, 0.5, 1.0]
+    w.write_idx(0, recordio.pack(recordio.IRHeader(0, label, 0, 0),
+                                 buf.getvalue()))
+    w.close()
+    it = ImageDetRecordIter(path_imgrec=rec_path, data_shape=(3, 32, 32),
+                            batch_size=1)
+    b = it.next()
+    d = b.data[0].asnumpy()[0]
+    assert d.shape == (3, 32, 32)
+    # resized image keeps the bright-left/dark-right split at the box edge
+    assert d[:, :, :14].mean() > 200
+    assert d[:, :, 18:].mean() < 50
