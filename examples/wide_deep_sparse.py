@@ -69,8 +69,13 @@ def main():
     kv = mx.kv.create("device")
     kv.init("embed", embed)
     # server-side optimizer: pushed row-sparse gradients are applied by the
-    # store's updater (reference kvstore_dist_server.h server-side SGD)
-    kv.set_optimizer(mx.optimizer.SGD(learning_rate=args.lr))
+    # store's updater (reference kvstore_dist_server.h server-side SGD).
+    # momentum + wd + lazy_update: only the rows a batch touches get their
+    # momentum/wd decay (reference optimizer.py:526 lazy semantics) — vocab
+    # rows absent from the batch stay bit-identical, exactly like the
+    # reference wide_deep sparse training path
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=args.lr, momentum=0.9,
+                                      wd=1e-4, lazy_update=True))
 
     params = [wide_w, embed, w1, b1, w2, b2]
     for p in params:

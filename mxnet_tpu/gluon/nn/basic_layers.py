@@ -172,8 +172,13 @@ class Embedding(HybridBlock):
         super().__init__(prefix, params)
         self._input_dim = input_dim
         self._output_dim = output_dim
-        self.weight = self.params.get("weight", shape=(input_dim, output_dim),
-                                      dtype=dtype, init=weight_initializer)
+        # sparse_grad marks the weight's gradient row_sparse so optimizers
+        # with lazy_update skip rows absent from the batch (reference
+        # gluon/nn/basic_layers.py Embedding(sparse_grad=True))
+        self.weight = self.params.get(
+            "weight", shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer,
+            grad_stype="row_sparse" if sparse_grad else "default")
 
     def hybrid_forward(self, F, x, weight):
         return F.Embedding(x, weight, input_dim=self._input_dim,

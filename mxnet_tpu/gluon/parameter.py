@@ -118,6 +118,12 @@ class Parameter:
     def _init_grad(self):
         from .. import autograd
         self._grad = zeros(self.shape, ctx=self._data.ctx, dtype=self._data.dtype)
+        if self.grad_stype == "row_sparse":
+            # the grad ARRAY ITSELF is row_sparse (dense-backed) so every
+            # consumer — optimizer lazy dispatch, user clipping, kvstore
+            # push — sees the same mutable object with stype row_sparse
+            from ..ndarray.sparse import RowSparseNDArray
+            self._grad = RowSparseNDArray(self._grad._data, self._grad.ctx)
         autograd.mark_variables([self._data], [self._grad], grad_reqs=self._grad_req)
 
     # -- access --------------------------------------------------------------
@@ -245,16 +251,27 @@ class ParameterDict:
 
     def get(self, name, **kwargs) -> Parameter:
         full = self._prefix + name
+        p = None
         if full in self._params:
             p = self._params[full]
             for k, v in kwargs.items():
                 if v is not None and getattr(p, k, None) in (None, 0, (), "write") \
                         and k in ("shape", "dtype", "init"):
                     setattr(p, k, tuple(v) if k == "shape" and isinstance(v, (list, tuple)) else v)
+        elif self._shared is not None and full in self._shared:
+            p = self._shared[full]
+            self._params[full] = p
+        if p is not None:
+            # storage-type kwargs cannot be silently dropped for a shared
+            # parameter: dense vs row_sparse changes training numerics
+            # (reference ParameterDict.get asserts attribute consistency)
+            for k in ("grad_stype", "stype"):
+                want = kwargs.get(k)
+                if want is not None and getattr(p, k, "default") != want:
+                    raise MXNetError(
+                        f"parameter '{full}' already exists with "
+                        f"{k}={getattr(p, k, 'default')!r}; requested {want!r}")
             return p
-        if self._shared is not None and full in self._shared:
-            self._params[full] = self._shared[full]
-            return self._params[full]
         p = Parameter(full, **kwargs)
         self._params[full] = p
         return p

@@ -403,6 +403,7 @@ class ImageRecordIter(DataIter):
         # dependent exactly like the reference's threaded pipeline
         self._seed = seed
         self._main_rng = None
+        self._epoch_ctr = 0
         self._inner = None
         self._reader = None
         self._cached = None
@@ -525,12 +526,16 @@ class ImageRecordIter(DataIter):
         pipeline), assemble batches in order, feed the prefetch queue."""
         import concurrent.futures as cf
         from ..ndarray import array
-        # worker seeds 0..n-1 are handed out per POOL via the initializer:
-        # each epoch's fresh pool re-derives the same stream set, and a
-        # zombie thread from a timed-out previous pool keeps its own rng
-        # (attached to the thread object) without consuming a new index
+        # worker seeds are handed out per POOL via the initializer, mixed
+        # with an epoch counter: run-to-run a fixed seed reproduces the
+        # same streams, while successive epochs draw DIFFERENT augmentation
+        # randomness (reference threads advance their prnd across epochs).
+        # A zombie thread from a timed-out previous pool keeps its own rng
+        # (attached to the thread object) without consuming a new index.
         lock = threading.Lock()
         nxt = [0]
+        epoch = self._epoch_ctr
+        self._epoch_ctr += 1
         seed0 = self._seed
 
         def _init_worker():
@@ -538,7 +543,7 @@ class ImageRecordIter(DataIter):
                 widx = nxt[0]
                 nxt[0] += 1
             threading.current_thread()._mx_io_rng = _np.random.RandomState(
-                (seed0 + widx) % (2 ** 31))
+                (seed0 + 1000003 * epoch + widx) % (2 ** 31))
         try:
             with cf.ThreadPoolExecutor(self._nthreads,
                                        initializer=_init_worker) as pool:
@@ -857,11 +862,18 @@ class ImageDetRecordIter(DataIter):
             preprocess_threads=preprocess_threads,
             prefetch_buffer=prefetch_buffer, seed=seed, ctx=ctx, **kwargs)
         # encoded det images are RESIZED to the target, never cropped:
-        # a pure resize keeps normalized [0,1] box coordinates valid,
-        # a center/random crop would silently invalidate them
+        # a pure resize keeps normalized [0,1] box coordinates valid, a
+        # center/random crop would silently invalidate them. Photometric
+        # augmenters the caller requested (brightness/contrast/...) are
+        # box-safe and kept.
         from .. import image as _img
         c, h, w = self.data_shape
-        self._inner._auglist = [_img.ForceResizeAug((w, h))]
+        photometric = (_img.CastAug, _img.BrightnessJitterAug,
+                       _img.ContrastJitterAug, _img.SaturationJitterAug,
+                       _img.HueJitterAug, _img.LightingAug,
+                       _img.ColorNormalizeAug)
+        self._inner._auglist = [_img.ForceResizeAug((w, h))] + [
+            a for a in self._inner._auglist if isinstance(a, photometric)]
         self._cached = None
 
     @property

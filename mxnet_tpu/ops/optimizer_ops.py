@@ -59,6 +59,50 @@ def _f32(x):
 # SGD family
 # ---------------------------------------------------------------------------
 
+def _live_rows(grad):
+    """Rows 'present' in a dense-backed row_sparse gradient: any nonzero
+    element in the row (exactly RowSparseNDArray.indices semantics). The
+    TPU-native analog of iterating grad.indices — a masked dense update
+    XLA fuses into one kernel, no dynamic shapes."""
+    axes = tuple(range(1, grad.ndim))
+    if axes:
+        return jnp.any(grad != 0, axis=axes, keepdims=True)
+    return grad != 0
+
+
+def sgd_lazy_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    """Row-sparse lazy SGD (reference python/mxnet/optimizer/optimizer.py:526
+    docstring + src/operator/optimizer_op.cc SGDUpdateRspRspImpl): rows absent
+    from the gradient receive NO update — no wd decay either."""
+    live = _live_rows(grad)
+    g = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    return jnp.where(live, weight - lr * g, weight)
+
+
+def sgd_mom_lazy_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    """Lazy momentum SGD: momentum decays ONLY for gradient-present rows
+    (reference SGDMomLazyUpdateRspRspRspImpl semantics)."""
+    live = _live_rows(grad)
+    g = _rescaled(grad, rescale_grad, clip_gradient) + wd * weight
+    mom2 = jnp.where(live, momentum * mom - lr * g, mom)
+    return jnp.where(live, weight + mom2, weight), mom2
+
+
+def adam_lazy_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                     epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                     clip_gradient=-1.0):
+    """Lazy Adam: m/v/weight update only gradient-present rows (reference
+    AdamUpdateRspRspRspImpl) — untouched rows keep stale m/v unchanged."""
+    live = _live_rows(grad)
+    g = _rescaled_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    m2 = jnp.where(live, beta1 * mean + (1 - beta1) * g, mean)
+    v2 = jnp.where(live, beta2 * var + (1 - beta2) * g * g, var)
+    w2 = jnp.where(live, weight - lr * m2 / (jnp.sqrt(v2) + epsilon), weight)
+    return w2, m2, v2
+
+
 @register("sgd_update", differentiable=False)
 def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                lazy_update=True):

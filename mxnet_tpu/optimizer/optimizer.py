@@ -234,6 +234,33 @@ def _k_sgd_mom(w, g, mom, lr, wd, rescale, clip, momentum):
 
 
 @jax.jit
+def _k_sgd_lazy(w, g, lr, wd, rescale, clip):
+    return _oo.sgd_lazy_update(w, g, lr, wd=wd, rescale_grad=rescale,
+                               clip_gradient=clip)
+
+
+@jax.jit
+def _k_sgd_mom_lazy(w, g, mom, lr, wd, rescale, clip, momentum):
+    return _oo.sgd_mom_lazy_update(w, g, mom, lr, momentum=momentum, wd=wd,
+                                   rescale_grad=rescale, clip_gradient=clip)
+
+
+@jax.jit
+def _k_adam_lazy(w, g, m, v, lr, wd, rescale, clip, beta1, beta2, eps,
+                 coef1, coef2):
+    lr_t = lr * jnp.sqrt(coef2) / coef1
+    return _oo.adam_lazy_update(w, g, m, v, lr_t, beta1=beta1, beta2=beta2,
+                                epsilon=eps, wd=wd, rescale_grad=rescale,
+                                clip_gradient=clip)
+
+
+def _is_lazy(opt, grad):
+    """Reference gating (optimizer.py:598): lazy kicks in when the gradient
+    is row_sparse and the optimizer's lazy_update flag is on."""
+    return opt.lazy_update and getattr(grad, "stype", "default") == "row_sparse"
+
+
+@jax.jit
 def _k_nag(w, g, mom, lr, wd, rescale, clip, momentum):
     return _oo.nag_mom_update(w, g, mom, lr, momentum=momentum, wd=wd,
                               rescale_grad=rescale, clip_gradient=clip)
@@ -409,13 +436,16 @@ class SGD(Optimizer):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        lazy = _is_lazy(self, grad)
         if self.momentum == 0.0:
-            weight._set_data(_k_sgd(weight._data, grad._data, _f(lr), _f(wd),
-                                    _f(self.rescale_grad), _f(clip)))
+            k = _k_sgd_lazy if lazy else _k_sgd
+            weight._set_data(k(weight._data, grad._data, _f(lr), _f(wd),
+                               _f(self.rescale_grad), _f(clip)))
         else:
-            w2, m2 = _k_sgd_mom(weight._data, grad._data, state._data, _f(lr),
-                                _f(wd), _f(self.rescale_grad), _f(clip),
-                                _f(self.momentum))
+            k = _k_sgd_mom_lazy if lazy else _k_sgd_mom
+            w2, m2 = k(weight._data, grad._data, state._data, _f(lr),
+                       _f(wd), _f(self.rescale_grad), _f(clip),
+                       _f(self.momentum))
             weight._set_data(w2)
             state._set_data(m2)
 
@@ -592,6 +622,7 @@ class Adam(Optimizer):
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (zeros(weight.shape, ctx=weight.ctx, dtype=weight.dtype),
@@ -603,10 +634,11 @@ class Adam(Optimizer):
         lr, wd = self._get_lr(index), self._get_wd(index)
         clip = self.clip_gradient if self.clip_gradient is not None else -1.0
         m, v = state
-        w2, m2, v2 = _k_adam(weight._data, grad._data, m._data, v._data, _f(lr),
-                             _f(wd), _f(self.rescale_grad), _f(clip),
-                             _f(self.beta1), _f(self.beta2), _f(self.epsilon),
-                             _f(1 - self.beta1 ** t), _f(1 - self.beta2 ** t))
+        k = _k_adam_lazy if _is_lazy(self, grad) else _k_adam
+        w2, m2, v2 = k(weight._data, grad._data, m._data, v._data, _f(lr),
+                       _f(wd), _f(self.rescale_grad), _f(clip),
+                       _f(self.beta1), _f(self.beta2), _f(self.epsilon),
+                       _f(1 - self.beta1 ** t), _f(1 - self.beta2 ** t))
         weight._set_data(w2); m._set_data(m2); v._set_data(v2)
 
 

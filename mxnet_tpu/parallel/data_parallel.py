@@ -154,6 +154,52 @@ def functional_optimizer(opt: "opt_mod.Optimizer"):
                      f"{type(opt).__name__}; use gluon.Trainer or add one")
 
 
+def functional_lazy_update(opt: "opt_mod.Optimizer"):
+    """Lazy (row-sparse) variant of the functional update — applied per
+    parameter whose grad_stype is row_sparse (reference lazy_update
+    semantics: untouched rows skip wd/momentum decay entirely). Returns
+    None when the optimizer has no lazy form."""
+    from ..optimizer.optimizer import (SGD, NAG, Adam, AdamW, LAMB,
+                                       _k_sgd_lazy, _k_sgd_mom_lazy,
+                                       _k_adam_lazy)
+
+    if not getattr(opt, "lazy_update", False):
+        return None
+
+    def _f(x):
+        return jnp.float32(x)
+
+    clip = opt.clip_gradient if opt.clip_gradient is not None else -1.0
+
+    if isinstance(opt, (AdamW, LAMB, NAG)):
+        return None  # no lazy form in the reference either
+    if isinstance(opt, Adam):
+        def update(g, w, s, t, lr, wd):
+            m, v = s
+            c1 = 1 - opt.beta1 ** t
+            c2 = 1 - opt.beta2 ** t
+            w2, m2, v2 = _k_adam_lazy(w, g, m, v, lr, wd,
+                                      _f(opt.rescale_grad), _f(clip),
+                                      _f(opt.beta1), _f(opt.beta2),
+                                      _f(opt.epsilon), c1, c2)
+            return w2, (m2, v2)
+        return update
+    if type(opt) is SGD:
+        mom = getattr(opt, "momentum", 0.0)
+        if mom == 0.0:
+            def update(g, w, s, t, lr, wd):
+                return _k_sgd_lazy(w, g, lr, wd, _f(opt.rescale_grad),
+                                   _f(clip)), ()
+            return update
+
+        def update(g, w, s, t, lr, wd):
+            w2, s2 = _k_sgd_mom_lazy(w, g, s, lr, wd, _f(opt.rescale_grad),
+                                     _f(clip), _f(mom))
+            return w2, s2
+        return update
+    return None
+
+
 def _make_apply_fn(block: HybridBlock, plist: List[Parameter], train: bool,
                    aux_order_out: Optional[List[Parameter]] = None):
     """Pure fn(key_raw, params_raw_list, *inputs_raw) -> (outputs, aux_list).
@@ -245,6 +291,7 @@ class DataParallelTrainer:
         self.optimizer = optimizer if isinstance(optimizer, opt_mod.Optimizer) \
             else opt_mod.create(optimizer, **(optimizer_params or {}))
         self._init_fn, self._update_fn = functional_optimizer(self.optimizer)
+        self._lazy_update_fn = functional_lazy_update(self.optimizer)
         self.loss = loss
         deferred = [p.name for p in net.collect_params().values()
                     if p._data is None and p._deferred_init is not None]
@@ -256,6 +303,9 @@ class DataParallelTrainer:
         self._plist = [p for p in net.collect_params().values()
                        if p._data is not None]
         self._trainable = [p.grad_req != "null" for p in self._plist]
+        self._lazy = [self._lazy_update_fn is not None and
+                      getattr(p, "grad_stype", "default") == "row_sparse"
+                      for p in self._plist]
         self._params_raw = [p._data._data for p in self._plist]
         self._opt_state = [self._init_fn(w) if t else ()
                            for w, t in zip(self._params_raw, self._trainable)]
@@ -305,6 +355,16 @@ class DataParallelTrainer:
                     "(replicated parameters, data sharded over the batch "
                     f"axis only); offending params={bad[:3]} "
                     f"data_spec={self.data_spec}")
+            sparse = [p.name for p, lz in zip(self._plist, self._lazy) if lz]
+            if sparse:
+                # a {-t,0,+t}-quantized gradient has no meaningful 'absent
+                # rows' — lazy semantics would silently change under
+                # compression (the reference also restricts compression to
+                # dense gradients, src/kvstore/kvstore_dist.h)
+                raise MXNetError(
+                    "gradient compression is incompatible with row_sparse "
+                    f"lazy-update parameters ({sparse[:3]}); use dense "
+                    "gradients or disable compression")
             ndp = self.mesh.shape[self.batch_axis]
             thr_sh = NamedSharding(self.mesh, P(self.batch_axis))
 
@@ -368,6 +428,7 @@ class DataParallelTrainer:
                                   aux_order_out=aux_order)
         plist = self._plist
         update_fn = self._update_fn
+        lazy_fn, lazy = self._lazy_update_fn, self._lazy
         loss_raw = self._loss_raw
         wds = [self.optimizer._get_wd(i) for i in range(len(self._plist))]
         trainable = self._trainable
@@ -412,7 +473,8 @@ class DataParallelTrainer:
             new_params, new_state = [], []
             for i, (g, w, s) in enumerate(zip(grads, params, opt_state)):
                 if trainable[i]:
-                    w2, s2 = update_fn(g, w, s, t, lr, jnp.float32(wds[i]))
+                    fn = lazy_fn if lazy[i] else update_fn
+                    w2, s2 = fn(g, w, s, t, lr, jnp.float32(wds[i]))
                     w2 = w2.astype(w.dtype)
                     if scaled:  # skip the whole update on overflow
                         w2 = jnp.where(finite, w2, w)

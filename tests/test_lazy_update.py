@@ -1,0 +1,214 @@
+"""Row-sparse lazy_update semantics (reference
+python/mxnet/optimizer/optimizer.py:526 docstring and
+src/operator/optimizer_op.cc SGD/Adam *RspRsp* kernels): with a row_sparse
+gradient, rows absent from the gradient receive NO update at all — no weight
+decay, no momentum decay, no m/v drift. Materially different numerics from
+the dense update, so every test here proves lazy != dense on untouched rows
+and lazy == hand-computed reference math on touched rows."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, optimizer as opt_mod
+from mxnet_tpu.ndarray.sparse import row_sparse_array
+
+
+LR, WD, MOM = 0.1, 0.01, 0.9
+ROWS, COLS = 6, 4
+TOUCHED = [1, 3]
+
+
+def _weight_grad():
+    rs = onp.random.RandomState(0)
+    w = rs.uniform(-1, 1, (ROWS, COLS)).astype(onp.float32)
+    g = onp.zeros((ROWS, COLS), onp.float32)
+    g[TOUCHED] = rs.uniform(-1, 1, (len(TOUCHED), COLS))
+    return w, g
+
+
+def _run_optimizer(opt, w_np, g_np, sparse, steps=3):
+    w = nd.array(w_np.copy())
+    g = row_sparse_array(g_np) if sparse else nd.array(g_np)
+    state = opt.create_state(0, w)
+    for _ in range(steps):
+        opt.update(0, w, g, state)
+    return w.asnumpy(), state
+
+
+def test_sgd_momentum_lazy_vs_dense_untouched_rows():
+    w_np, g_np = _weight_grad()
+    untouched = [i for i in range(ROWS) if i not in TOUCHED]
+
+    lazy_w, lazy_state = _run_optimizer(
+        opt_mod.create("sgd", learning_rate=LR, momentum=MOM, wd=WD,
+                       lazy_update=True), w_np, g_np, sparse=True)
+    dense_w, _ = _run_optimizer(
+        opt_mod.create("sgd", learning_rate=LR, momentum=MOM, wd=WD,
+                       lazy_update=True), w_np, g_np, sparse=False)
+    off_w, _ = _run_optimizer(
+        opt_mod.create("sgd", learning_rate=LR, momentum=MOM, wd=WD,
+                       lazy_update=False), w_np, g_np, sparse=True)
+
+    # lazy: untouched rows bit-identical to the initial weights
+    onp.testing.assert_array_equal(lazy_w[untouched], w_np[untouched])
+    onp.testing.assert_array_equal(
+        lazy_state.asnumpy()[untouched], onp.zeros((len(untouched), COLS)))
+    # dense: wd decays untouched rows -> provably different
+    assert not onp.allclose(dense_w[untouched], w_np[untouched])
+    # lazy_update=False must force the dense path even on row_sparse grads
+    onp.testing.assert_allclose(off_w, dense_w, rtol=1e-6)
+    # touched rows follow the reference lazy recurrence exactly
+    w_ref = w_np.copy()
+    mom_ref = onp.zeros_like(w_np)
+    for _ in range(3):
+        for r in TOUCHED:
+            grow = g_np[r] + WD * w_ref[r]
+            mom_ref[r] = MOM * mom_ref[r] - LR * grow
+            w_ref[r] = w_ref[r] + mom_ref[r]
+    onp.testing.assert_allclose(lazy_w[TOUCHED], w_ref[TOUCHED], rtol=1e-5)
+
+
+def test_sgd_plain_lazy_untouched_rows_frozen():
+    w_np, g_np = _weight_grad()
+    untouched = [i for i in range(ROWS) if i not in TOUCHED]
+    lazy_w, _ = _run_optimizer(
+        opt_mod.create("sgd", learning_rate=LR, wd=WD, lazy_update=True),
+        w_np, g_np, sparse=True)
+    dense_w, _ = _run_optimizer(
+        opt_mod.create("sgd", learning_rate=LR, wd=WD),
+        w_np, g_np, sparse=False)
+    onp.testing.assert_array_equal(lazy_w[untouched], w_np[untouched])
+    assert not onp.allclose(dense_w[untouched], w_np[untouched])
+
+
+def test_adam_lazy_untouched_rows_frozen():
+    w_np, g_np = _weight_grad()
+    untouched = [i for i in range(ROWS) if i not in TOUCHED]
+
+    lazy_w, (m, v) = _run_optimizer(
+        opt_mod.create("adam", learning_rate=LR, wd=WD, lazy_update=True),
+        w_np, g_np, sparse=True)
+    dense_w, _ = _run_optimizer(
+        opt_mod.create("adam", learning_rate=LR, wd=WD),
+        w_np, g_np, sparse=False)
+
+    onp.testing.assert_array_equal(lazy_w[untouched], w_np[untouched])
+    onp.testing.assert_array_equal(
+        m.asnumpy()[untouched], onp.zeros((len(untouched), COLS)))
+    onp.testing.assert_array_equal(
+        v.asnumpy()[untouched], onp.zeros((len(untouched), COLS)))
+    # dense adam folds wd*w into g, so untouched rows move
+    assert not onp.allclose(dense_w[untouched], w_np[untouched])
+    # touched rows move
+    assert not onp.allclose(lazy_w[TOUCHED], w_np[TOUCHED])
+
+
+def test_gluon_sparse_embedding_lazy_end_to_end():
+    """Embedding(sparse_grad=True) + gluon.Trainer: untouched embedding rows
+    stay bit-identical under wd+momentum training (Wide&Deep-style)."""
+    mx.random.seed(3)
+    vocab, dim = 10, 4
+    net = gluon.nn.Embedding(vocab, dim, sparse_grad=True)
+    net.initialize()
+    x = nd.array(onp.array([1, 3, 3], onp.int64), dtype="int32")
+    net(x)
+    w0 = net.weight.data().asnumpy().copy()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5, "momentum": 0.9,
+                             "wd": 0.1})
+    from mxnet_tpu import autograd
+    for _ in range(4):
+        with autograd.record():
+            out = net(x)
+            loss = (out * out).sum()
+        loss.backward()
+        trainer.step(1)
+    w1 = net.weight.data().asnumpy()
+    untouched = [i for i in range(vocab) if i not in (1, 3)]
+    onp.testing.assert_array_equal(w1[untouched], w0[untouched])
+    assert not onp.allclose(w1[[1, 3]], w0[[1, 3]])
+
+
+def test_gluon_dense_embedding_decays_all_rows():
+    """Without sparse_grad the same training decays every row via wd —
+    the delta that makes lazy_update semantically observable."""
+    mx.random.seed(3)
+    vocab, dim = 10, 4
+    net = gluon.nn.Embedding(vocab, dim)  # sparse_grad=False
+    net.initialize()
+    x = nd.array(onp.array([1, 3, 3], onp.int64), dtype="int32")
+    net(x)
+    w0 = net.weight.data().asnumpy().copy()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5, "momentum": 0.9,
+                             "wd": 0.1})
+    from mxnet_tpu import autograd
+    for _ in range(4):
+        with autograd.record():
+            out = net(x)
+            loss = (out * out).sum()
+        loss.backward()
+        trainer.step(1)
+    w1 = net.weight.data().asnumpy()
+    untouched = [i for i in range(vocab) if i not in (1, 3)]
+    assert not onp.allclose(w1[untouched], w0[untouched])
+
+
+def test_fused_trainer_honors_lazy_embedding():
+    """The one-jit DataParallelTrainer applies the lazy kernel to
+    row_sparse-grad parameters: untouched embedding rows frozen."""
+    import jax
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+
+    mx.random.seed(7)
+    vocab, dim = 12, 4
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Embedding(vocab, dim, sparse_grad=True),
+            gluon.nn.Dense(3, flatten=False))
+    net.initialize()
+    x = nd.array(onp.array([[2, 5], [5, 7], [2, 7], [5, 5]], onp.int64),
+                 dtype="int32")
+    y = nd.array(onp.array([0, 1, 2, 1], onp.int64), dtype="int32")
+    net(x)
+    emb_p = [p for p in net.collect_params().values()
+             if p.grad_stype == "row_sparse"]
+    assert len(emb_p) == 1
+    w0 = emb_p[0].data().asnumpy().copy()
+
+    def loss_fn(logits, labels):
+        import jax.numpy as jnp
+        logits = jnp.mean(logits.astype(jnp.float32), axis=1)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+        return jnp.mean(logz - gold)
+
+    mesh = make_mesh({"dp": 2}, devices=jax.devices("cpu")[:2])
+    tr = DataParallelTrainer(net, loss_fn, optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.2,
+                                               "momentum": 0.9, "wd": 0.1},
+                             mesh=mesh)
+    for _ in range(3):
+        tr.step(x, y)
+    tr.sync()
+    w1 = emb_p[0].data().asnumpy()
+    touched = sorted({2, 5, 7})
+    untouched = [i for i in range(vocab) if i not in touched]
+    onp.testing.assert_array_equal(w1[untouched], w0[untouched])
+    assert not onp.allclose(w1[touched], w0[touched])
+
+
+def test_compression_rejects_lazy_params():
+    import jax
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+
+    mx.random.seed(9)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Embedding(8, 4, sparse_grad=True),
+            gluon.nn.Dense(2, flatten=False))
+    net.initialize()
+    net(nd.array(onp.zeros((2, 3)), dtype="int32"))
+    mesh = make_mesh({"dp": 2}, devices=jax.devices("cpu")[:2])
+    with pytest.raises(mx.MXNetError):
+        DataParallelTrainer(net, lambda p, y: p.sum(), mesh=mesh,
+                            compression={"type": "2bit", "threshold": 0.5})
