@@ -1,0 +1,277 @@
+"""Threaded TCP parameter server backing `dist_async` (reference
+src/kvstore/kvstore_dist_server.h:325 KVStoreDistServer::DataHandleDefault,
+ps-lite push/pull RPC).
+
+The reference runs dedicated server processes; each key lives on the server
+chosen by `EncodeDefaultKey` (kvstore_dist.h:606) and every worker push is
+applied to that server's state ON ARRIVAL — async workers observe each
+other's updates through the server without any barrier. TPU-native we fold
+the server role into the workers: every process runs one daemon server
+thread owning the keys that hash to its rank, and the jax.distributed
+coordinator's key-value store provides the address rendezvous (the ps-lite
+scheduler analog). The *sync* path never touches this module — lock-step
+aggregation rides XLA collectives (see KVStoreDist._cross).
+
+Wire format: length-prefixed pickles of (op, ...) tuples carrying numpy
+payloads. This is a compatibility/control path, not the tensor fast path —
+bulk training traffic belongs in the fused one-jit trainer whose gradient
+reduction lowers to ICI/DCN collectives.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+
+_HDR = struct.Struct("<Q")
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    data = pickle.dumps(obj, protocol=4)
+    sock.sendall(_HDR.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        c = sock.recv(min(n - got, 1 << 20))
+        if not c:
+            raise ConnectionError("peer closed")
+        chunks.append(c)
+        got += len(c)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket):
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _pack(arr) -> tuple:
+    a = np.asarray(arr)
+    return (str(a.dtype), a.shape, a.tobytes())
+
+
+def _unpack(payload) -> np.ndarray:
+    dtype, shape, raw = payload
+    return np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
+
+
+class PSServer:
+    """One daemon thread per process serving this rank's home keys.
+
+    Requests (all answered synchronously on the caller's connection):
+      ("init", key, payload)     -> ("ok",)      first init wins
+      ("push", key, payload[, stype]) -> ("ok",) apply updater / assign
+      ("pull", key)              -> ("ok", payload) | ("missing",)
+      ("pull_rows", key, ids)    -> ("ok", payload)  gathered rows only
+      ("has", key)               -> ("ok",) | ("missing",)
+
+    Locking is PER KEY (plus a registry guard): arrival order is preserved
+    for each key — the reference server's per-key consistency contract —
+    while pushes/pulls of different keys proceed concurrently even when an
+    updater call compiles.
+    """
+
+    def __init__(self, get_updater: Callable[[], Optional[Callable]]):
+        self._get_updater = get_updater
+        self._store: Dict = {}
+        self._guard = threading.Lock()
+        self._key_locks: Dict = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("", 0))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="mxtpu-ps-server")
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                _send_msg(conn, self._handle(msg))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _key_lock(self, key) -> threading.Lock:
+        with self._guard:
+            lock = self._key_locks.get(key)
+            if lock is None:
+                lock = self._key_locks[key] = threading.Lock()
+            return lock
+
+    def _handle(self, msg):
+        op, key = msg[0], msg[1]
+        if op == "init":
+            with self._key_lock(key):
+                # first init wins (rank 0 is the only sender — reference
+                # InitImpl: only rank 0's push initializes the server)
+                if key not in self._store:
+                    self._store[key] = _unpack(msg[2])
+            return ("ok",)
+        if op == "push":
+            grad = _unpack(msg[2])
+            stype = msg[3] if len(msg) > 3 else "default"
+            with self._key_lock(key):
+                if key not in self._store:
+                    return ("missing",)
+                stored = self._store[key]
+                updater = self._get_updater()
+                if updater is None:
+                    # reference default: pushed value replaces server state
+                    self._store[key] = grad.astype(stored.dtype)
+                else:
+                    # server-side optimizer: the updater mutates the stored
+                    # NDArray in place (kvstore_dist_server.h:155); a
+                    # row_sparse push keeps its stype so lazy_update
+                    # optimizers apply reference lazy semantics
+                    from ..ndarray import NDArray
+                    import jax.numpy as jnp
+                    g_nd = NDArray(jnp.asarray(grad))
+                    if stype == "row_sparse":
+                        from ..ndarray.sparse import RowSparseNDArray
+                        g_nd = RowSparseNDArray(g_nd._data, g_nd.ctx)
+                    s_nd = NDArray(jnp.asarray(stored))
+                    updater(key, g_nd, s_nd)
+                    self._store[key] = np.asarray(s_nd._data)
+            return ("ok",)
+        if op == "pull":
+            with self._key_lock(key):
+                if key not in self._store:
+                    return ("missing",)
+                return ("ok", _pack(self._store[key]))
+        if op == "pull_rows":
+            ids = np.asarray(msg[2], dtype=np.int64)
+            with self._key_lock(key):
+                if key not in self._store:
+                    return ("missing",)
+                return ("ok", _pack(self._store[key][ids]))
+        if op == "has":
+            with self._key_lock(key):
+                return ("ok",) if key in self._store else ("missing",)
+        return ("error", f"unknown op {op!r}")
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class PSClient:
+    """Per-process client: one persistent connection per home rank."""
+
+    def __init__(self, addr_of: Callable[[int], str]):
+        self._addr_of = addr_of
+        self._conns: Dict[int, socket.socket] = {}
+        self._locks: Dict[int, threading.Lock] = {}
+        self._guard = threading.Lock()
+
+    def _conn(self, home: int):
+        with self._guard:
+            lock = self._locks.setdefault(home, threading.Lock())
+        return lock
+
+    def request(self, home: int, msg, retries: int = 1):
+        lock = self._conn(home)
+        with lock:
+            for attempt in range(retries + 1):
+                sock = self._conns.get(home)
+                try:
+                    if sock is None:
+                        host, port = self._addr_of(home).rsplit(":", 1)
+                        sock = socket.create_connection((host, int(port)),
+                                                        timeout=120)
+                        sock.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
+                        self._conns[home] = sock
+                    _send_msg(sock, msg)
+                    return _recv_msg(sock)
+                except (ConnectionError, OSError):
+                    self._conns.pop(home, None)
+                    if attempt == retries:
+                        raise
+        raise MXNetError("unreachable")
+
+    def _wait_until(self, home: int, key, msg, timeout: float):
+        deadline = time.monotonic() + timeout
+        while True:
+            resp = self.request(home, msg)
+            if resp[0] == "ok":
+                return resp
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    f"dist_async: key {key!r} never initialized at its home "
+                    f"server (rank {home}) within {timeout}s")
+            time.sleep(0.02)
+
+    def pull_blocking(self, home: int, key, timeout: float = 120.0):
+        """Pull that waits for the key to be initialized at its home —
+        covers the init race where rank 0's init is still in flight."""
+        return _unpack(self._wait_until(home, key, ("pull", key), timeout)[1])
+
+    def wait_ready(self, home: int, key, timeout: float = 120.0):
+        """Readiness probe without the tensor payload (a few bytes on the
+        wire, not the full table) — used by init on every rank."""
+        self._wait_until(home, key, ("has", key), timeout)
+
+    def close(self):
+        for s in self._conns.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._conns.clear()
+
+
+def coordinator_kv():
+    """The jax.distributed coordinator's key-value store — the rendezvous
+    channel every process can reach (the ps-lite scheduler analog). Returns
+    None when no distributed client is active."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:
+        return None
+
+
+def publish_address(rank: int, port: int) -> None:
+    client = coordinator_kv()
+    if client is None:
+        raise MXNetError(
+            "dist_async needs the jax.distributed coordinator for address "
+            "rendezvous; launch through tools/launch.py or set "
+            "MXNET_TPU_COORDINATOR")
+    import os
+    host = os.environ.get("MXNET_TPU_PS_HOST") or socket.gethostname()
+    client.key_value_set(f"mxtpu_ps/{rank}", f"{host}:{port}")
+
+
+def resolve_address(rank: int, timeout_ms: int = 120_000) -> str:
+    client = coordinator_kv()
+    if client is None:
+        raise MXNetError("no jax.distributed coordinator client")
+    return client.blocking_key_value_get(f"mxtpu_ps/{rank}", timeout_ms)

@@ -184,7 +184,7 @@ def functional_lazy_update(opt: "opt_mod.Optimizer"):
                                       _f(opt.epsilon), c1, c2)
             return w2, (m2, v2)
         return update
-    if type(opt) is SGD:
+    if isinstance(opt, SGD):  # includes LBSGD, which inherits SGD.update
         mom = getattr(opt, "momentum", 0.0)
         if mom == 0.0:
             def update(g, w, s, t, lr, wd):

@@ -24,12 +24,55 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import numpy as np
 
 
+def dist_main(args):
+    """Cross-process transfer comparison (run under tools/launch.py with
+    -n >= 2): the host-mediated full-tensor allgather (round-2 path) vs
+    the jitted XLA all-reduce (reduce-scatter + all-gather wire pattern,
+    the kvstore_dist.h:606 key-sharded analog)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    kv = mx.kv.create("dist_sync")
+    rank, nproc = kv.rank, jax.process_count()
+    for size_s in args.sizes.split(","):
+        size = int(float(size_s))
+        key = f"bw{size}"
+        kv.init(key, nd.zeros((size,)))
+        v = nd.ones((size,))
+        out = nd.zeros((size,))
+        nbytes = size * 4
+        for label, bound in (("allgather-sum", 1 << 60),
+                             ("xla-allreduce", 0)):
+            kv._bigarray_bound = bound
+            kv.push(key, v)
+            kv.pull(key, out=out)
+            out.wait_to_read()
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                kv.push(key, v)
+                kv.pull(key, out=out)
+                out.wait_to_read()
+            dt = (time.perf_counter() - t0) / args.iters
+            if rank == 0:
+                print(f"dist {label:14s} {nbytes / 1e6:8.1f} MB: "
+                      f"{dt * 1e3:8.2f} ms ({nbytes / dt / 1e9:6.2f} GB/s "
+                      f"per-worker payload, {nproc} procs)")
+    kv.barrier()
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--sizes", default="1e5,1e6,1e7",
                     help="comma-separated element counts")
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--dist", action="store_true",
+                    help="measure cross-process kvstore paths (launch with "
+                         "tools/launch.py -n 2)")
     args = ap.parse_args()
+    if args.dist:
+        return dist_main(args)
 
     import jax
     import jax.numpy as jnp
