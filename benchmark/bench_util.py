@@ -2,21 +2,31 @@
 
 The axon terminal runs a freshly loaded executable ~40x slow for its
 first 1-3 invocations before reaching full speed (BENCHMARKS.md timing
-traps) — a single warm call measures the slow mode. `measure_stabilized`
-keeps warming until back-to-back timings stop improving, then returns
-one final measured duration.
+traps) — a single warm call measures the slow mode. Round-2 lesson: a
+loose one-sided stop rule (cur > 0.6 * prev) could stop WHILE STILL
+DECELERATING out of slow mode and hand the driver a ~12%-low number
+(BENCH_r02: 1,917 img/s vs the stabilized 2,160). `measure_stabilized`
+now requires two consecutive timings to agree within a symmetric window
+before measuring, and reports the MINIMUM of several measured reps so a
+one-off host stall (single-core box) cannot become the recorded result.
 """
 from __future__ import annotations
 
+import os
 
-def measure_stabilized(timed_fn, max_warm: int = 6, ratio: float = 0.6):
+
+def measure_stabilized(timed_fn, max_warm: int = 10, ratio: float = 0.92,
+                       measure: int = 3):
     """timed_fn() -> seconds for one full measured unit (must sync).
-    First call may include compilation. Returns the duration of a final
-    run taken after consecutive timings stabilize (dt > ratio * prev)."""
+    First call may include compilation. Warms until two consecutive
+    timings agree within the symmetric window (each > ratio * other),
+    bounded by max_warm; then returns min over `measure` reps."""
+    max_warm = int(os.environ.get("BENCH_MAX_WARM", max_warm))
+    measure = max(int(os.environ.get("BENCH_MEASURE", measure)), 1)
     prev = timed_fn()
     for _ in range(max_warm):
         cur = timed_fn()
-        if cur > ratio * prev:
+        if cur > ratio * prev and prev > ratio * cur:
             break
         prev = cur
-    return timed_fn()
+    return min(timed_fn() for _ in range(measure))

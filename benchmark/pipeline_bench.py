@@ -58,6 +58,10 @@ def main():
             mean_r=123.68, mean_g=116.28, mean_b=103.53,
             std_r=58.4, std_g=57.1, std_b=57.4,
             preprocess_threads=THREADS, prefetch_buffer=4)
+        native = getattr(it, "_native_jpeg", None) is not None
+        if os.environ.get("PIPE_FORCE_PYTHON") == "1":
+            it._native_jpeg = None
+            native = False
 
         def run(steps):
             done = 0
@@ -79,6 +83,7 @@ def main():
             "value": round(img_s, 1),
             "unit": "img/s (host, 224x224 out)",
             "threads": THREADS,
+            "decoder": "native-c++" if native else "python-pil",
         }))
 
 

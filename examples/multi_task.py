@@ -1,0 +1,97 @@
+"""Multi-task training (reference example/multi-task/example_multi_task.py):
+one shared trunk, two heads — digit classification plus a regression head
+(stroke-mass proxy) — optimized jointly with a weighted sum of losses.
+
+Run: python examples/multi_task.py [--epochs N]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd, gluon  # noqa: E402
+from mxnet_tpu.io import MNISTIter  # noqa: E402
+
+
+class MultiTaskNet(gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.trunk = gluon.nn.HybridSequential()
+            self.trunk.add(gluon.nn.Conv2D(8, 5, activation="relu"),
+                           gluon.nn.MaxPool2D(2),
+                           gluon.nn.Conv2D(16, 3, activation="relu"),
+                           gluon.nn.MaxPool2D(2),
+                           gluon.nn.Flatten(),
+                           gluon.nn.Dense(64, activation="relu"))
+            self.cls = gluon.nn.Dense(10)
+            # each task gets its own small adapter head: a single linear
+            # reg head cannot track the trunk features as the cls loss
+            # reshapes them (classic multi-task interference)
+            self.reg = gluon.nn.HybridSequential()
+            self.reg.add(gluon.nn.Dense(32, activation="relu"),
+                         gluon.nn.Dense(1))
+
+    def hybrid_forward(self, F, x):
+        h = self.trunk(x)
+        return self.cls(h), self.reg(h)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=7)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    args = ap.parse_args(argv)
+
+    mx.random.seed(6)
+    net = MultiTaskNet()
+    net.initialize(init=mx.init.Xavier())
+    net(nd.zeros((2, 1, 28, 28)))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    it = MNISTIter(batch_size=args.batch_size, shuffle=True,
+                   synthetic_size=1024, seed=7)
+    for epoch in range(args.epochs):
+        for batch in it:
+            x = batch.data[0]
+            y_cls = batch.label[0].astype("int32")
+            # task 2 target: mean pixel mass (a real function of the input)
+            y_reg = nd.mean(x, axis=(1, 2, 3))
+            with autograd.record():
+                logits, mass = net(x)
+                l_cls = sce(logits, y_cls).mean()
+                l_reg = nd.mean(nd.square(mass[:, 0] - y_reg))
+                loss = l_cls + 10.0 * l_reg
+            loss.backward()
+            trainer.step(1)
+        it.reset()
+        print(f"epoch {epoch}: cls {float(l_cls):.4f} reg {float(l_reg):.5f}")
+
+    correct = total = 0
+    reg_err = 0.0
+    for batch in it:
+        logits, mass = net(batch.data[0])
+        pred = logits.asnumpy().argmax(1)
+        lab = batch.label[0].asnumpy().astype(int)
+        n = len(lab) - batch.pad
+        correct += int((pred[:n] == lab[:n]).sum())
+        y = nd.mean(batch.data[0], axis=(1, 2, 3)).asnumpy()
+        reg_err += float(np.abs(mass.asnumpy()[:n, 0] - y[:n]).sum())
+        total += n
+    acc = correct / total
+    mae = reg_err / total
+    print(f"cls accuracy {acc:.3f}, reg MAE {mae:.5f}")
+    return acc, mae
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""Neural style transfer (reference example/neural-style/neural_style.py):
+optimize the INPUT IMAGE (not network weights) against content features
+and style Gram matrices extracted by a fixed conv feature net, exactly the
+Gatys et al. recipe the reference implements over VGG19. Hermetic: the
+feature extractor is a fixed randomly-initialized conv stack (random
+features are a standard stand-in for CI; swap in model_zoo VGG weights for
+real use) and content/style are synthetic images.
+
+Run: python examples/neural_style.py [--steps N]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd, gluon  # noqa: E402
+
+SIZE = 32
+
+
+class FeatureNet(gluon.HybridBlock):
+    """3-stage conv extractor; returns one content + two style features."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.c1 = gluon.nn.Conv2D(8, 3, padding=1, activation="relu")
+            self.c2 = gluon.nn.Conv2D(16, 3, strides=2, padding=1,
+                                      activation="relu")
+            self.c3 = gluon.nn.Conv2D(32, 3, strides=2, padding=1,
+                                      activation="relu")
+
+    def hybrid_forward(self, F, x):
+        f1 = self.c1(x)
+        f2 = self.c2(f1)
+        f3 = self.c3(f2)
+        return f1, f2, f3
+
+
+def gram(f):
+    b, c, h, w = f.shape
+    m = f.reshape((b, c, h * w))
+    return nd.batch_dot(m, m, transpose_b=True) / float(c * h * w)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=120)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--style-weight", type=float, default=50.0)
+    args = ap.parse_args(argv)
+
+    mx.random.seed(10)
+    feat = FeatureNet()
+    feat.initialize(init=mx.init.Xavier(magnitude=2.0))
+    rng = np.random.RandomState(2)
+    # content: a centered bright square; style: diagonal stripes
+    content = np.zeros((1, 3, SIZE, SIZE), np.float32)
+    content[:, :, 8:24, 8:24] = 1.0
+    style = np.fromfunction(
+        lambda b, c, i, j: ((i + j) % 8 < 4).astype(np.float32),
+        (1, 3, SIZE, SIZE))
+    content_nd, style_nd = nd.array(content), nd.array(style.astype("float32"))
+    feat(content_nd)
+
+    c_feats = feat(content_nd)
+    s_feats = feat(style_nd)
+    c_target = c_feats[2]                      # deepest layer: content
+    s_targets = [gram(s_feats[0]), gram(s_feats[1])]  # shallow: style
+
+    img = nd.array(rng.rand(1, 3, SIZE, SIZE).astype(np.float32))
+    img.attach_grad()
+    first = last = None
+    for step in range(args.steps):
+        with autograd.record():
+            f = feat(img)
+            l_content = nd.mean(nd.square(f[2] - c_target))
+            l_style = sum(nd.mean(nd.square(gram(fi) - gi))
+                          for fi, gi in zip(f[:2], s_targets))
+            loss = l_content + args.style_weight * l_style
+        loss.backward()
+        # normalized gradient step on the IMAGE (the reference's Adam on
+        # 0-255 images plays the same role: step size independent of the
+        # feature-net's gradient scale)
+        g = img.grad
+        scale = float(nd.sqrt(nd.mean(g * g))) + 1e-12
+        img -= (args.lr / scale) * g
+        img.grad[:] = 0
+        img._set_data(img._data.clip(0.0, 1.0))
+        cur = float(loss)
+        if first is None:
+            first = cur
+        last = cur
+        if step % 30 == 0 or step == args.steps - 1:
+            print(f"step {step}: total {cur:.5f} content "
+                  f"{float(l_content):.5f} style {float(l_style):.6f}")
+    print(f"loss {first:.5f} -> {last:.5f}")
+    return first, last
+
+
+if __name__ == "__main__":
+    main()

@@ -55,6 +55,7 @@ def _maybe_init_distributed():
 
 _maybe_init_distributed()
 
+# Eager core: the light modules every entry point needs.
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, num_gpus, num_tpus, current_context, cpu_pinned
@@ -67,53 +68,81 @@ from .ndarray import NDArray
 
 from . import symbol
 from . import symbol as sym
-from . import initializer
-from . import optimizer
-from . import lr_scheduler
-from . import metric
-from . import io
-from . import gluon
-from . import kvstore as kv
-from . import kvstore
-from . import parallel
-from . import profiler
-from . import runtime
 from . import util
-from . import test_utils
-from . import image
-from . import recordio
-from . import contrib
-from . import numpy as np
-from . import numpy_extension as npx
-from . import module
-from . import model
-from . import callback
-from . import monitor
-from . import operator
-from . import visualization
-from . import rtc
-from . import library
 from . import name
 from . import attribute
 from .attribute import AttrScope
-from .model import FeedForward
-from .monitor import Monitor
 
 from .util import is_np_shape, is_np_array, set_np, reset_np
 
 __version__ = "1.0.0.dev0"
 
-init = gluon.init  # alias: mx.init.Xavier() etc.
+# Heavy subsystems load lazily (PEP 562): `mxnet_tpu.predict` — the minimal
+# serving runtime (reference c_predict_api.h analog) — must come up WITHOUT
+# pulling training machinery (optimizer/parallel/gluon/io/...), and every
+# other entry point gets the import-time win for free. Attribute access
+# (`mx.gluon`, `from mxnet_tpu import optimizer`) resolves identically to
+# the old eager imports.
+_LAZY_SUBMODULES = {
+    "initializer": ".initializer",
+    "optimizer": ".optimizer",
+    "lr_scheduler": ".lr_scheduler",
+    "metric": ".metric",
+    "io": ".io",
+    "gluon": ".gluon",
+    "kv": ".kvstore",
+    "kvstore": ".kvstore",
+    "parallel": ".parallel",
+    "profiler": ".profiler",
+    "runtime": ".runtime",
+    "test_utils": ".test_utils",
+    "image": ".image",
+    "recordio": ".recordio",
+    "contrib": ".contrib",
+    "np": ".numpy",
+    "numpy": ".numpy",
+    "npx": ".numpy_extension",
+    "numpy_extension": ".numpy_extension",
+    "module": ".module",
+    "model": ".model",
+    "callback": ".callback",
+    "monitor": ".monitor",
+    "operator": ".operator",
+    "visualization": ".visualization",
+    "rtc": ".rtc",
+    "library": ".library",
+    "checkpoint": ".checkpoint",   # orbax costs ~2.6 s to import
+    "predict": ".predict",
+    "serialization": ".serialization",
+}
+_LAZY_ATTRS = {
+    "FeedForward": (".model", "FeedForward"),
+    "Monitor": (".monitor", "Monitor"),
+}
 
 
 def __getattr__(name):
-    if name == "checkpoint":
-        # lazy: orbax costs ~2.6 s to import; only checkpoint users pay it
-        import importlib
-        mod = importlib.import_module(".checkpoint", __name__)
-        globals()["checkpoint"] = mod
+    import importlib
+    if name in _LAZY_SUBMODULES:
+        mod = importlib.import_module(_LAZY_SUBMODULES[name], __name__)
+        globals()[name] = mod
         return mod
+    if name in _LAZY_ATTRS:
+        modname, attr = _LAZY_ATTRS[name]
+        val = getattr(importlib.import_module(modname, __name__), attr)
+        globals()[name] = val
+        return val
+    if name == "init":
+        # alias: mx.init.Xavier() etc.
+        val = importlib.import_module(".gluon", __name__).init
+        globals()["init"] = val
+        return val
     raise AttributeError(f"module 'mxnet_tpu' has no attribute '{name}'")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY_SUBMODULES)
+                      + list(_LAZY_ATTRS) + ["init"]))
 
 
 def waitall():

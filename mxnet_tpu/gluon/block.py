@@ -68,18 +68,81 @@ class _NameManager:
         return f"{hint}{i}_"
 
 
+class _BlockScope:
+    """Hierarchical naming (reference gluon/block.py _BlockScope): a block
+    created inside a parent's `with self.name_scope():` gets the parent's
+    prefix prepended and draws its counter from the PARENT's per-hint
+    counters, so `Net(prefix='mynet_')` yields `mynet_dense0_weight` —
+    exactly the reference naming contract save/load and symbol export
+    rely on."""
+
+    _tls = threading.local()
+
+    def __init__(self, block: "Block"):
+        self._block = block
+        self._counters: Dict[str, int] = {}
+
+    @classmethod
+    def _stack(cls) -> List["_BlockScope"]:
+        st = getattr(cls._tls, "stack", None)
+        if st is None:
+            st = cls._tls.stack = []
+        return st
+
+    @classmethod
+    def create_prefix(cls, prefix: Optional[str], hint: str) -> str:
+        st = cls._stack()
+        if not st:
+            return prefix if prefix is not None \
+                else _NameManager.fresh(hint)
+        scope = st[-1]
+        if prefix is None:
+            i = scope._counters.get(hint, 0)
+            scope._counters[hint] = i + 1
+            prefix = f"{hint}{i}_"
+        return scope._block.prefix + prefix
+
+    def __enter__(self):
+        self._stack().append(self)
+        return self
+
+    def __exit__(self, *a):
+        self._stack().pop()
+        return False
+
+
+class HookHandle:
+    """Detachable hook registration (reference gluon/utils.py HookHandle)."""
+
+    def __init__(self, hooks_list: List, hook):
+        self._hooks_list = hooks_list
+        self._hook = hook
+
+    def detach(self):
+        if self._hook in self._hooks_list:
+            self._hooks_list.remove(self._hook)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.detach()
+        return False
+
+
 class Block:
     """Base container (reference gluon/block.py:228)."""
 
     def __init__(self, prefix: Optional[str] = None, params: Optional[ParameterDict] = None):
         self._empty_init_guard = True
-        self._prefix = prefix if prefix is not None else \
-            _NameManager.fresh(type(self).__name__.lower())
+        self._prefix = _BlockScope.create_prefix(
+            prefix, type(self).__name__.lower())
         self._params = ParameterDict(self._prefix, shared=params)
         self._children: "OrderedDict[str, Block]" = OrderedDict()
         self._reg_params: "OrderedDict[str, Parameter]" = OrderedDict()
         self._forward_hooks: List = []
         self._forward_pre_hooks: List = []
+        self._scope = _BlockScope(self)
 
     # -- naming / params -----------------------------------------------------
     @property
@@ -95,13 +158,7 @@ class Block:
         return self._params
 
     def name_scope(self):
-        class _Noop:
-            def __enter__(self_inner):
-                return self_inner
-
-            def __exit__(self_inner, *a):
-                return False
-        return _Noop()
+        return self._scope
 
     def __setattr__(self, name, value):
         if isinstance(value, Block):
@@ -119,11 +176,11 @@ class Block:
 
     def register_forward_hook(self, hook):
         self._forward_hooks.append(hook)
-        return hook
+        return HookHandle(self._forward_hooks, hook)
 
     def register_forward_pre_hook(self, hook):
         self._forward_pre_hooks.append(hook)
-        return hook
+        return HookHandle(self._forward_pre_hooks, hook)
 
     def collect_params(self, select: Optional[str] = None) -> ParameterDict:
         ret = ParameterDict(self._params.prefix)

@@ -425,6 +425,27 @@ class ImageRecordIter(DataIter):
             rand_resize=rand_resize, rand_mirror=rand_mirror,
             brightness=brightness, contrast=contrast, saturation=saturation,
             pca_noise=pca_noise)
+        # native C++ JPEG pipeline (src/native/jpegdec.cc — the reference
+        # iter_image_recordio_2.cc threaded decode): decode + resize-short
+        # + crop + mirror + normalize for a whole batch in ONE GIL-free
+        # call. Engaged when the requested augmentations are exactly the
+        # standard geometry (photometric jitter / RandomSizedCrop keep the
+        # Python path); non-JPEG payloads fall back per record.
+        self._native_jpeg = None
+        if c == 3 and not rand_resize and not (brightness or contrast or
+                                               saturation or pca_noise):
+            try:
+                from .. import native as _nat
+                if _nat.jpeg_available():
+                    self._native_jpeg = _nat.NativeJpegDecoder(
+                        h, w, resize_short=resize,
+                        rand_crop=bool(rand_crop),
+                        rand_mirror=bool(rand_mirror), seed=seed,
+                        nthreads=self._nthreads,
+                        mean=[float(m) for m in self._mean.ravel()],
+                        std=[float(s) for s in self._std.ravel()])
+            except Exception:
+                self._native_jpeg = None
         if path_imgrec and not synthetic:
             if not os.path.exists(path_imgrec):
                 raise MXNetError(f"record file not found: {path_imgrec}")
@@ -511,14 +532,16 @@ class ImageRecordIter(DataIter):
         img = (img - self._mean) / self._std
         return _np.ascontiguousarray(img)
 
+    def _label_of(self, header):
+        lab = header.label
+        return float(lab) if _np.isscalar(lab) else _np.asarray(
+            lab, "float32")[:self._label_width]
+
     def _process_one(self, rec):
         from ..recordio import unpack
         header, payload = unpack(rec)
-        lab = header.label
-        lab = float(lab) if _np.isscalar(lab) else _np.asarray(
-            lab, "float32")[:self._label_width]
         img, raw = self._decode(payload)
-        return self._augment(img, raw), lab
+        return self._augment(img, raw), self._label_of(header)
 
     def _produce(self, stop, q):
         """Producer thread: read records serially, decode+augment on a
@@ -557,9 +580,13 @@ class ImageRecordIter(DataIter):
                     if not recs:
                         q.put(None)
                         return
-                    results = list(pool.map(self._process_one, recs))
-                    xs = [r[0] for r in results]
-                    ys = [r[1] for r in results]
+                    xs = ys = None
+                    if self._native_jpeg is not None:
+                        xs, ys = self._native_batch(recs, pool)
+                    if xs is None:
+                        results = list(pool.map(self._process_one, recs))
+                        xs = [r[0] for r in results]
+                        ys = [r[1] for r in results]
                     pad = self.batch_size - len(xs)
                     if pad:
                         xs += [xs[-1]] * pad
@@ -575,6 +602,25 @@ class ImageRecordIter(DataIter):
                             continue
         except Exception as e:  # surface errors at next()
             q.put(e)
+
+    def _native_batch(self, recs, pool):
+        """Decode a record batch through the C++ JPEG pipeline. Returns
+        (xs, ys) or None when the batch is not all-JPEG (caller falls back
+        to the Python pool path). Corrupt JPEGs fall back per record."""
+        from ..recordio import unpack
+        headers, payloads = [], []
+        for rec in recs:
+            h, p = unpack(rec)
+            if not p.startswith(b"\xff\xd8"):
+                return None, None
+            headers.append(h)
+            payloads.append(p)
+        out, ok = self._native_jpeg.decode_batch(payloads)
+        xs = list(out)
+        for i, good in enumerate(ok):
+            if not good:  # corrupt record: Python path raises a clear error
+                xs[i] = self._process_one(recs[i])[0]
+        return xs, [self._label_of(h) for h in headers]
 
     def _ensure_producer(self):
         if self._producer is None or not self._producer.is_alive():

@@ -1,0 +1,143 @@
+"""Minimal standalone predict runtime (reference
+include/mxnet/c_predict_api.h:1-348 + amalgamation/: the load-and-serve
+path that ships without training machinery).
+
+`mxnet_tpu.predict` imports ONLY the symbolic core (symbol graph, ops,
+ndarray) — no gluon, no optimizer, no parallel, no io. Together with the
+lazy package __init__ this keeps a serving process slim:
+
+    from mxnet_tpu.predict import Predictor
+    p = Predictor("model-symbol.json", "model-0000.params",
+                  input_shapes={"data": (1, 3, 224, 224)})
+    out = p.predict(x)          # numpy in, numpy out
+
+Construction binds the graph and runs the single XLA compile for the
+declared input shapes (the c_predict_api contract: shapes fixed at
+MXPredCreate, `reshape` rebinds); `predict` afterwards never compiles.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context, current_context
+from .ndarray import NDArray
+
+
+def load_params(param_file: str) -> Tuple[Dict, Dict]:
+    """Read a `-0000.params` checkpoint (arg:/aux: key format) without
+    importing model/module machinery."""
+    from .serialization import load_ndarrays
+    arg_params, aux_params = {}, {}
+    for k, v in load_ndarrays(param_file).items():
+        tp, name = k.split(":", 1) if ":" in k else ("arg", k)
+        (arg_params if tp == "arg" else aux_params)[name] = v
+    return arg_params, aux_params
+
+
+class Predictor:
+    """Fixed-shape inference executor over an exported symbol graph
+    (reference c_predict_api.h MXPredCreate/MXPredForward/MXPredGetOutput).
+    """
+
+    def __init__(self, symbol_file: str, param_file: Optional[str] = None,
+                 input_shapes: Optional[Dict[str, Sequence[int]]] = None,
+                 ctx: Optional[Context] = None, dtype: str = "float32"):
+        from . import symbol as sym_mod
+        self._sym = sym_mod.load(symbol_file)
+        self._ctx = ctx or current_context()
+        self._dtype = dtype
+        arg_params, aux_params = ({}, {}) if param_file is None \
+            else load_params(param_file)
+        self._params = {**arg_params, **aux_params}
+        known = set(self._params)
+        self._input_names = [n for n in self._sym.list_arguments()
+                             if n not in known]
+        self._ex = None
+        self._shapes: Optional[Dict[str, Tuple[int, ...]]] = None
+        if input_shapes:
+            self.reshape(input_shapes)
+
+    # -- binding -------------------------------------------------------------
+    def reshape(self, input_shapes: Dict[str, Sequence[int]]) -> None:
+        """(Re)bind for new input shapes (c_predict_api.h MXPredReshape).
+        Runs the one XLA compile so `predict` is compile-free."""
+        missing = [n for n in self._input_names if n not in input_shapes]
+        if missing:
+            raise MXNetError(
+                f"input_shapes missing {missing}; the graph's data inputs "
+                f"are {self._input_names}")
+        import jax.numpy as jnp
+        binds = {}
+        for name, shape in input_shapes.items():
+            binds[name] = NDArray(
+                jnp.zeros(tuple(int(s) for s in shape),
+                          jnp.dtype(self._dtype)), self._ctx)
+        for name, v in self._params.items():
+            v = v if isinstance(v, NDArray) else NDArray(v._data)
+            binds[name] = v.as_in_context(self._ctx)
+        self._ex = self._sym.bind(self._ctx, binds)
+        self._shapes = {k: tuple(int(s) for s in v)
+                        for k, v in input_shapes.items()}
+        self._ex.forward(is_train=False)  # the single compile, at load time
+
+    # -- serving -------------------------------------------------------------
+    def predict(self, *args, **kwargs) -> Union[_np.ndarray,
+                                                List[_np.ndarray]]:
+        """Positional args follow the graph's input order; kwargs override
+        by name. Accepts numpy or NDArray; returns numpy."""
+        if self._ex is None:
+            feed0 = {}
+            for name, a in list(zip(self._input_names, args)) + \
+                    list(kwargs.items()):
+                feed0[name] = tuple(_np.shape(a))
+            self.reshape(feed0)
+        feed = {}
+        for name, a in list(zip(self._input_names, args)) + \
+                list(kwargs.items()):
+            if self._shapes and tuple(_np.shape(a)) != self._shapes[name]:
+                raise MXNetError(
+                    f"input {name!r} has shape {tuple(_np.shape(a))}, bound "
+                    f"for {self._shapes[name]}; call reshape() for new "
+                    "shapes (c_predict_api fixed-shape contract)")
+            if not isinstance(a, NDArray):
+                import jax.numpy as jnp
+                a = NDArray(jnp.asarray(_np.asarray(a, self._dtype)),
+                            self._ctx)
+            feed[name] = a
+        outs = self._ex.forward(is_train=False, **feed)
+        res = [o.asnumpy() for o in outs]
+        return res[0] if len(res) == 1 else res
+
+    __call__ = predict
+
+    @property
+    def output_names(self) -> List[str]:
+        return self._sym.list_outputs()
+
+    @property
+    def input_names(self) -> List[str]:
+        return list(self._input_names)
+
+
+def _selftest() -> int:
+    """`python -m mxnet_tpu.predict model-prefix N C H W` smoke entry."""
+    import sys
+    import time
+    prefix = sys.argv[1]
+    shape = tuple(int(s) for s in sys.argv[2:]) or (1, 3, 224, 224)
+    t0 = time.perf_counter()
+    p = Predictor(prefix + "-symbol.json", prefix + "-0000.params",
+                  input_shapes={"data": shape})
+    t1 = time.perf_counter()
+    out = p.predict(_np.zeros(shape, _np.float32))
+    t2 = time.perf_counter()
+    print(f"bind+compile {t1 - t0:.2f}s, predict {t2 - t1 :.4f}s, "
+          f"out shape {getattr(out, 'shape', [o.shape for o in out])}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_selftest())

@@ -1,0 +1,258 @@
+// Native JPEG decode + augment pipeline (reference
+// src/io/iter_image_recordio_2.cc:880 threaded decode + image_aug_default.cc
+// resize/crop/flip/normalize, rebuilt for the TPU host runtime).
+//
+// One C call decodes a BATCH: an internal pthread pool decompresses each
+// JPEG with libjpeg, bilinear-resizes the short side, random/center-crops
+// to the target, optionally mirrors, and writes normalized float32 CHW
+// directly into the caller's output buffer. The GIL is released for the
+// whole batch, so Python-side prefetch overlaps fully.
+//
+// Exposed via ctypes (mxnet_tpu/native/__init__.py); falls back to the
+// Python/PIL path when libjpeg is unavailable at build time.
+
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct ErrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+};
+
+void err_exit(j_common_ptr cinfo) {
+  ErrMgr* err = reinterpret_cast<ErrMgr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+// xorshift64* — deterministic per-image stream, seed mixed with the image
+// index so results are independent of which worker picks the image up
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed ? seed : 0x9e3779b97f4a7c15ull) {}
+  uint64_t next() {
+    s ^= s >> 12;
+    s ^= s << 25;
+    s ^= s >> 27;
+    return s * 0x2545f4914f6cdd1dull;
+  }
+  // uniform in [0, n)
+  int64_t below(int64_t n) { return n > 0 ? (int64_t)(next() % (uint64_t)n) : 0; }
+};
+
+// decode one JPEG -> RGB8; returns false on corrupt input
+bool decode_rgb(const unsigned char* buf, int64_t len,
+                std::vector<unsigned char>* out, int* w, int* h) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = err_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf), (unsigned long)len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  out->resize((size_t)(*w) * (*h) * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = out->data() + (size_t)cinfo.output_scanline * (*w) * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// bilinear resize RGB8 (sw, sh) -> (dw, dh)
+void resize_rgb(const unsigned char* src, int sw, int sh,
+                std::vector<unsigned char>* dst, int dw, int dh) {
+  dst->resize((size_t)dw * dh * 3);
+  const float sx = (float)sw / dw, sy = (float)sh / dh;
+  for (int y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    int y0 = (int)std::floor(fy);
+    float wy = fy - y0;
+    int y1 = y0 + 1;
+    if (y0 < 0) y0 = 0;
+    if (y1 >= sh) y1 = sh - 1;
+    if (y0 >= sh) y0 = sh - 1;
+    for (int x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      int x0 = (int)std::floor(fx);
+      float wx = fx - x0;
+      int x1 = x0 + 1;
+      if (x0 < 0) x0 = 0;
+      if (x1 >= sw) x1 = sw - 1;
+      if (x0 >= sw) x0 = sw - 1;
+      for (int c = 0; c < 3; ++c) {
+        float v00 = src[((size_t)y0 * sw + x0) * 3 + c];
+        float v01 = src[((size_t)y0 * sw + x1) * 3 + c];
+        float v10 = src[((size_t)y1 * sw + x0) * 3 + c];
+        float v11 = src[((size_t)y1 * sw + x1) * 3 + c];
+        float v = (1 - wy) * ((1 - wx) * v00 + wx * v01) +
+                  wy * ((1 - wx) * v10 + wx * v11);
+        (*dst)[((size_t)y * dw + x) * 3 + c] = (unsigned char)(v + 0.5f);
+      }
+    }
+  }
+}
+
+struct Pipeline {
+  int out_h, out_w;
+  int resize_short;     // 0 = only resize when smaller than crop
+  int rand_crop, rand_mirror;
+  uint64_t seed;
+  float mean[3], std[3];
+  int nthreads;
+};
+
+// decode+augment ONE image into out (3*out_h*out_w float32 CHW)
+bool process_one(const Pipeline& p, const unsigned char* buf, int64_t len,
+                 uint64_t img_idx, float* out) {
+  std::vector<unsigned char> rgb;
+  int w = 0, h = 0;
+  if (!decode_rgb(buf, len, &rgb, &w, &h)) return false;
+
+  Rng rng(p.seed * 0x9e3779b97f4a7c15ull + img_idx + 1);
+
+  // final dims BEFORE cropping: resize-short if requested, then clamp
+  // each dim independently so the crop always fits — the clamp must
+  // apply even when the short side already equals the target or no
+  // resize was requested (otherwise the crop reads out of bounds)
+  int short_side = w < h ? w : h;
+  int dw = w, dh = h;
+  if (p.resize_short > 0 && short_side != p.resize_short) {
+    float scale = (float)p.resize_short / short_side;
+    dw = (int)std::lround(w * scale);
+    dh = (int)std::lround(h * scale);
+  }
+  if (dw < p.out_w) dw = p.out_w;
+  if (dh < p.out_h) dh = p.out_h;
+  std::vector<unsigned char> resized;
+  const unsigned char* img = rgb.data();
+  int iw = w, ih = h;
+  if (dw != w || dh != h) {
+    resize_rgb(rgb.data(), w, h, &resized, dw, dh);
+    img = resized.data();
+    iw = dw;
+    ih = dh;
+  }
+  int x0, y0;
+  if (p.rand_crop) {
+    x0 = (int)rng.below(iw - p.out_w + 1);
+    y0 = (int)rng.below(ih - p.out_h + 1);
+  } else {
+    x0 = (iw - p.out_w) / 2;
+    y0 = (ih - p.out_h) / 2;
+  }
+  bool mirror = p.rand_mirror && (rng.next() & 1);
+  const size_t plane = (size_t)p.out_h * p.out_w;
+  for (int y = 0; y < p.out_h; ++y) {
+    const unsigned char* row = img + ((size_t)(y0 + y) * iw + x0) * 3;
+    for (int x = 0; x < p.out_w; ++x) {
+      int sx = mirror ? (p.out_w - 1 - x) : x;
+      const unsigned char* px = row + (size_t)sx * 3;
+      for (int c = 0; c < 3; ++c) {
+        out[c * plane + (size_t)y * p.out_w + x] =
+            ((float)px[c] - p.mean[c]) / p.std[c];
+      }
+    }
+  }
+  return true;
+}
+
+struct Decoder {
+  Pipeline pipe;
+  uint64_t epoch_offset = 0;  // advances per batch so streams don't repeat
+};
+
+}  // namespace
+
+extern "C" {
+
+void* jdec_create(int out_h, int out_w, int resize_short, int rand_crop,
+                  int rand_mirror, uint64_t seed, int nthreads,
+                  const float* mean3, const float* std3) {
+  Decoder* d = new Decoder();
+  d->pipe.out_h = out_h;
+  d->pipe.out_w = out_w;
+  d->pipe.resize_short = resize_short;
+  d->pipe.rand_crop = rand_crop;
+  d->pipe.rand_mirror = rand_mirror;
+  d->pipe.seed = seed;
+  d->pipe.nthreads = nthreads > 0 ? nthreads : 1;
+  for (int c = 0; c < 3; ++c) {
+    d->pipe.mean[c] = mean3 ? mean3[c] : 0.0f;
+    d->pipe.std[c] = (std3 && std3[c] != 0.0f) ? std3[c] : 1.0f;
+  }
+  return d;
+}
+
+// bufs: n concatenated jpeg payloads; lens[i] their sizes.
+// out: n * 3 * out_h * out_w float32. ok[i]=1 decoded, 0 corrupt.
+// Returns number decoded, -1 on bad handle.
+int64_t jdec_decode_batch(void* handle, int64_t n, const char* bufs,
+                          const int64_t* lens, float* out, int8_t* ok) {
+  Decoder* d = static_cast<Decoder*>(handle);
+  if (!d) return -1;
+  std::vector<int64_t> offs(n);
+  int64_t acc = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    offs[i] = acc;
+    acc += lens[i];
+  }
+  const size_t img_f = (size_t)3 * d->pipe.out_h * d->pipe.out_w;
+  std::atomic<int64_t> next(0), done_ok(0);
+  const uint64_t base = d->epoch_offset;
+  auto worker = [&]() {
+    for (;;) {
+      int64_t i = next.fetch_add(1);
+      if (i >= n) return;
+      bool good = process_one(
+          d->pipe, reinterpret_cast<const unsigned char*>(bufs + offs[i]),
+          lens[i], base + (uint64_t)i, out + (size_t)i * img_f);
+      ok[i] = good ? 1 : 0;
+      if (good) done_ok.fetch_add(1);
+      if (!good) memset(out + (size_t)i * img_f, 0, img_f * sizeof(float));
+    }
+  };
+  int nt = d->pipe.nthreads;
+  if (nt > n) nt = (int)n;
+  if (nt <= 1) {
+    worker();
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(nt);
+    for (int t = 0; t < nt; ++t) threads.emplace_back(worker);
+    for (auto& t : threads) t.join();
+  }
+  d->epoch_offset += (uint64_t)n;
+  return done_ok.load();
+}
+
+void jdec_reset(void* handle) {
+  Decoder* d = static_cast<Decoder*>(handle);
+  if (d) d->epoch_offset = 0;
+}
+
+void jdec_destroy(void* handle) { delete static_cast<Decoder*>(handle); }
+
+}  // extern "C"

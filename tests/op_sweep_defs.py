@@ -993,3 +993,386 @@ add("histogram", const(np.array([0.1, 0.4, 0.6, 0.9, 0.4], F32)),
     lambda x: (np.histogram(x, bins=4, range=(0.0, 1.0))[0].astype(np.int64),
                np.histogram(x, bins=4, range=(0.0, 1.0))[1].astype(F32)),
     ns="np", kwargs={"bins": 4, "range": (0.0, 1.0)})
+
+
+# ===========================================================================
+# Optimizer update ops — direct closed-form references (reference
+# src/operator/optimizer_op-inl.h kernel formulas). Promoted from
+# ELSEWHERE to direct sweep coverage in round 3.
+# ===========================================================================
+
+def _opt_clip(g, c):
+    return np.clip(g, -c, c) if c is not None and c >= 0 else g
+
+
+def _np_sgd(w, g, lr=0.1, wd=0.05, rescale=1.0, clip=-1.0):
+    return (w - lr * (_opt_clip(g * rescale, clip) + wd * w)).astype(F32)
+
+
+add("sgd_update", std((4, 3), (4, 3)), lambda w, g: _np_sgd(w, g),
+    kwargs={"lr": 0.1, "wd": 0.05})
+add("sgd_update", std((4, 3), (4, 3)),
+    lambda w, g: _np_sgd(w, g, rescale=2.0, clip=0.5),
+    kwargs={"lr": 0.1, "wd": 0.05, "rescale_grad": 2.0,
+            "clip_gradient": 0.5}, ident="clip")
+
+
+def _np_sgd_mom(w, g, m, lr=0.1, mom=0.9, wd=0.05):
+    m2 = mom * m - lr * (g + wd * w)
+    return ((w + m2).astype(F32),)
+
+
+add("sgd_mom_update", std((4, 3), (4, 3), (4, 3)),
+    lambda w, g, m: _np_sgd_mom(w, g, m),
+    kwargs={"lr": 0.1, "momentum": 0.9, "wd": 0.05})
+
+
+def _mp_inputs(*shapes):
+    """(w_fp16, g_fp16, [states...,] w32): mixed-precision input maker."""
+    def make(rng):
+        arrs = [rng.uniform(-1.5, 1.5, s).astype(F32) for s in shapes]
+        out = [arrs[0].astype(np.float16), arrs[1].astype(np.float16)]
+        out.extend(a.astype(F32) for a in arrs[2:])
+        return out
+    return make
+
+
+add("mp_sgd_update", _mp_inputs((4, 3), (4, 3), (4, 3)),
+    lambda w16, g16, w32: (
+        (w32 - 0.1 * (g16.astype(F32) + 0.05 * w32)).astype(np.float16),),
+    kwargs={"lr": 0.1, "wd": 0.05}, rtol=2e-2, atol=2e-2)
+add("mp_sgd_mom_update", _mp_inputs((4, 3), (4, 3), (4, 3), (4, 3)),
+    lambda w16, g16, m, w32: (
+        (w32 + (0.9 * m - 0.1 * (g16.astype(F32) + 0.05 * w32)))
+        .astype(np.float16),),
+    kwargs={"lr": 0.1, "momentum": 0.9, "wd": 0.05}, rtol=2e-2, atol=2e-2)
+
+
+def _np_nag(w, g, m, lr=0.1, mom=0.9, wd=0.05):
+    gg = g + wd * w
+    m2 = mom * m + gg
+    return ((w - lr * (gg + mom * m2)).astype(F32),)
+
+
+add("nag_mom_update", std((4, 3), (4, 3), (4, 3)),
+    lambda w, g, m: _np_nag(w, g, m),
+    kwargs={"lr": 0.1, "momentum": 0.9, "wd": 0.05})
+add("mp_nag_mom_update", _mp_inputs((4, 3), (4, 3), (4, 3), (4, 3)),
+    lambda w16, g16, m, w32: (
+        _np_nag(w32, g16.astype(F32), m)[0].astype(np.float16),),
+    kwargs={"lr": 0.1, "momentum": 0.9, "wd": 0.05}, rtol=2e-2, atol=2e-2)
+
+add("signsgd_update", far0((4, 3), (4, 3)),
+    lambda w, g: ((1 - 0.1 * 0.05) * w - 0.1 * np.sign(g)).astype(F32),
+    kwargs={"lr": 0.1, "wd": 0.05})
+add("signum_update", far0((4, 3), (4, 3), (4, 3)),
+    lambda w, g, m: (
+        ((1 - 0.1 * 0.02) * w
+         + 0.1 * np.sign(0.9 * m - 0.1 * (g + 0.05 * w))).astype(F32),),
+    kwargs={"lr": 0.1, "momentum": 0.9, "wd": 0.05, "wd_lh": 0.02})
+
+
+def _np_adam(w, g, m, v, lr=0.1, b1=0.9, b2=0.999, eps=1e-8, wd=0.05):
+    gg = g + wd * w
+    m2 = b1 * m + (1 - b1) * gg
+    v2 = b2 * np.abs(v) + (1 - b2) * gg * gg
+    return ((w - lr * m2 / (np.sqrt(v2) + eps)).astype(F32),)
+
+
+def _adam_inputs(rng):
+    w = rng.uniform(-1.5, 1.5, (4, 3)).astype(F32)
+    g = rng.uniform(-1.5, 1.5, (4, 3)).astype(F32)
+    m = rng.uniform(-0.5, 0.5, (4, 3)).astype(F32)
+    v = rng.uniform(0.0, 0.5, (4, 3)).astype(F32)  # variance >= 0
+    return [w, g, m, v]
+
+
+add("adam_update", _adam_inputs, lambda w, g, m, v: _np_adam(w, g, m, v),
+    kwargs={"lr": 0.1, "wd": 0.05}, rtol=1e-4, atol=1e-4)
+
+
+def _adamw_inputs(rng):
+    return _adam_inputs(rng) + [np.float32(1.0)]
+
+
+def _np_adamw(w, g, m, v, rs, lr=0.1, eta=0.9, b1=0.9, b2=0.999,
+              eps=1e-8, wd=0.05):
+    gg = g.astype(F32) * rs
+    m2 = b1 * m + (1 - b1) * gg
+    v2 = b2 * v + (1 - b2) * gg * gg
+    return ((w - eta * (lr * m2 / (np.sqrt(v2) + eps) + wd * w)).astype(F32),)
+
+
+add("adamw_update", _adamw_inputs,
+    lambda w, g, m, v, rs: _np_adamw(w, g, m, v, rs),
+    kwargs={"lr": 0.1, "eta": 0.9, "wd": 0.05}, rtol=1e-4, atol=1e-4)
+
+
+def _mp_adamw_inputs(rng):
+    w, g, m, v = _adam_inputs(rng)
+    return [w.astype(np.float16), g.astype(np.float16), m, v, w,
+            np.float32(1.0)]
+
+
+add("mp_adamw_update", _mp_adamw_inputs,
+    lambda w16, g16, m, v, w32, rs: (
+        _np_adamw(w32, g16, m, v, rs)[0].astype(np.float16),),
+    kwargs={"lr": 0.1, "eta": 0.9, "wd": 0.05}, rtol=2e-2, atol=2e-2)
+
+
+def _np_ftrl(w, g, z, n, lr=0.1, l1=0.01, beta=1.0, wd=0.05):
+    n2 = n + g * g
+    sigma = (np.sqrt(n2) - np.sqrt(n)) / lr
+    z2 = z + g - sigma * w
+    w2 = np.where(np.abs(z2) > l1,
+                  -(z2 - np.sign(z2) * l1) / ((beta + np.sqrt(n2)) / lr + wd),
+                  0.0)
+    return (w2.astype(F32),)
+
+
+def _ftrl_inputs(rng):
+    w = rng.uniform(-1.5, 1.5, (4, 3)).astype(F32)
+    g = rng.uniform(-1.5, 1.5, (4, 3)).astype(F32)
+    z = rng.uniform(-0.5, 0.5, (4, 3)).astype(F32)
+    n = rng.uniform(0.0, 0.5, (4, 3)).astype(F32)
+    return [w, g, z, n]
+
+
+add("ftrl_update", _ftrl_inputs, lambda w, g, z, n: _np_ftrl(w, g, z, n),
+    kwargs={"lr": 0.1, "lamda1": 0.01, "beta": 1.0, "wd": 0.05},
+    rtol=1e-4, atol=1e-4)
+
+
+def _np_ftml(w, g, d, v, z, lr=0.1, t=2, b1=0.6, b2=0.999, eps=1e-8,
+             wd=0.05):
+    gg = g + wd * w
+    v2 = b2 * v + (1 - b2) * gg * gg
+    d2 = (1 - b1 ** t) / lr * (np.sqrt(v2 / (1 - b2 ** t)) + eps)
+    sigma = d2 - b1 * d
+    z2 = b1 * z + (1 - b1) * gg - sigma * w
+    return ((-z2 / d2).astype(F32),)
+
+
+def _ftml_inputs(rng):
+    w, g, z, n = _ftrl_inputs(rng)
+    d = rng.uniform(0.5, 1.5, (4, 3)).astype(F32)
+    return [w, g, d, n, z]
+
+
+add("ftml_update", _ftml_inputs,
+    lambda w, g, d, v, z: _np_ftml(w, g, d, v, z),
+    kwargs={"lr": 0.1, "t": 2, "beta1": 0.6, "wd": 0.05},
+    rtol=1e-4, atol=1e-4)
+
+
+def _np_rmsprop(w, g, n, lr=0.1, rho=0.95, eps=1e-8, wd=0.05):
+    gg = g + wd * w
+    n2 = rho * n + (1 - rho) * gg * gg
+    return ((w - lr * gg / np.sqrt(n2 + eps)).astype(F32),)
+
+
+def _rms_inputs(rng):
+    w = rng.uniform(-1.5, 1.5, (4, 3)).astype(F32)
+    g = rng.uniform(-1.5, 1.5, (4, 3)).astype(F32)
+    n = rng.uniform(0.1, 0.6, (4, 3)).astype(F32)
+    return [w, g, n]
+
+
+add("rmsprop_update", _rms_inputs, lambda w, g, n: _np_rmsprop(w, g, n),
+    kwargs={"lr": 0.1, "rho": 0.95, "wd": 0.05}, rtol=1e-4, atol=1e-4)
+
+
+def _np_rmspropalex(w, g, n, gavg, delta, lr=0.1, rho=0.95, mom=0.9,
+                    eps=1e-8, wd=0.05):
+    gg = g + wd * w
+    n2 = rho * n + (1 - rho) * gg * gg
+    gavg2 = rho * gavg + (1 - rho) * gg
+    d2 = mom * delta - lr * gg / np.sqrt(n2 - gavg2 * gavg2 + eps)
+    return ((w + d2).astype(F32),)
+
+
+def _rmsalex_inputs(rng):
+    w, g, n = _rms_inputs(rng)
+    gavg = rng.uniform(-0.2, 0.2, (4, 3)).astype(F32)
+    delta = rng.uniform(-0.2, 0.2, (4, 3)).astype(F32)
+    return [w, g, n, gavg, delta]
+
+
+add("rmspropalex_update", _rmsalex_inputs,
+    lambda w, g, n, gavg, d: _np_rmspropalex(w, g, n, gavg, d),
+    kwargs={"lr": 0.1, "rho": 0.95, "momentum": 0.9, "wd": 0.05},
+    rtol=1e-4, atol=1e-4)
+
+
+def _np_lamb1(w, g, m, v, b1=0.9, b2=0.999, eps=1e-6, t=2, wd=0.05):
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g * g
+    mhat = m2 / (1 - b1 ** t)
+    vhat = v2 / (1 - b2 ** t)
+    return ((mhat / (np.sqrt(vhat) + eps) + wd * w).astype(F32),)
+
+
+add("lamb_update_phase1", _adam_inputs,
+    lambda w, g, m, v: _np_lamb1(w, g, m, v),
+    kwargs={"t": 2, "wd": 0.05}, rtol=1e-4, atol=1e-4)
+
+
+def _mp_lamb1_inputs(rng):
+    # w16 mirrors the SAME master weight w32 (the mp contract)
+    w, g, m, v = _adam_inputs(rng)
+    return [w.astype(np.float16), g.astype(np.float16), m, v, w]
+
+
+add("mp_lamb_update_phase1", _mp_lamb1_inputs,
+    lambda w16, g16, m, v, w32: _np_lamb1(w32, g16.astype(F32), m, v),
+    kwargs={"t": 2, "wd": 0.05}, rtol=2e-2, atol=2e-2)
+
+
+def _lamb2_inputs(rng):
+    w = rng.uniform(-1.5, 1.5, (4, 3)).astype(F32)
+    g = rng.uniform(-1.5, 1.5, (4, 3)).astype(F32)
+    r1 = np.asarray(np.linalg.norm(w)).astype(F32)
+    r2 = np.asarray(np.linalg.norm(g)).astype(F32)
+    return [w, g, r1, r2]
+
+
+def _np_lamb2(w, g, r1, r2, lr=0.1, lo=-1.0, hi=-1.0):
+    rr1 = np.maximum(r1, lo) if lo > 0 else r1
+    rr1 = np.minimum(rr1, hi) if hi > 0 else rr1
+    ratio = np.where((rr1 > 0) & (r2 > 0), rr1 / r2, 1.0)
+    return ((w - lr * ratio * g).astype(F32),)
+
+
+add("lamb_update_phase2", _lamb2_inputs,
+    lambda w, g, r1, r2: _np_lamb2(w, g, r1, r2), kwargs={"lr": 0.1})
+
+
+def _mp_lamb2_inputs(rng):
+    # r1/r2 are the norms of the SAME w32/g fed to the op
+    w, g, r1, r2 = _lamb2_inputs(rng)
+    return [w.astype(np.float16), g, r1, r2, w]
+
+
+add("mp_lamb_update_phase2", _mp_lamb2_inputs,
+    lambda w16, g, r1, r2, w32: (
+        _np_lamb2(w32, g, r1, r2)[0].astype(np.float16),),
+    kwargs={"lr": 0.1}, rtol=2e-2, atol=2e-2)
+
+add("multi_sum_sq", std((3, 2), (4,)),
+    lambda a, b: np.stack([np.sum(a * a), np.sum(b * b)]).astype(F32),
+    kwargs={"num_arrays": 2})
+
+
+def _np_multi_lars(lrs, wsq, gsq, wds, eta=0.6, eps=1e-6):
+    wn = np.sqrt(wsq)
+    gn = np.sqrt(gsq)
+    trust = np.where((wn > 0) & (gn > 0), eta * wn / (gn + wds * wn + eps),
+                     1.0)
+    return (lrs * trust).astype(F32)
+
+
+def _lars_inputs(rng):
+    lrs = rng.uniform(0.01, 0.2, (3,)).astype(F32)
+    wsq = rng.uniform(0.1, 2.0, (3,)).astype(F32)
+    gsq = rng.uniform(0.1, 2.0, (3,)).astype(F32)
+    wds = rng.uniform(0.0, 0.1, (3,)).astype(F32)
+    return [lrs, wsq, gsq, wds]
+
+
+add("multi_lars", _lars_inputs,
+    lambda lrs, wsq, gsq, wds: _np_multi_lars(lrs, wsq, gsq, wds),
+    kwargs={"eta": 0.6, "eps": 1e-6})
+
+_MS_KW = {"lrs": (0.1, 0.2), "wds": (0.05, 0.0), "num_weights": 2}
+add("multi_sgd_update", std((3, 2), (3, 2), (4,), (4,)),
+    lambda w1, g1, w2, g2: (_np_sgd(w1, g1, lr=0.1, wd=0.05),
+                            _np_sgd(w2, g2, lr=0.2, wd=0.0)),
+    kwargs=_MS_KW)
+add("multi_sgd_mom_update",
+    std((3, 2), (3, 2), (3, 2), (4,), (4,), (4,)),
+    lambda w1, g1, m1, w2, g2, m2: (
+        _np_sgd_mom(w1, g1, m1, lr=0.1, wd=0.05)[0],
+        _np_sgd_mom(w2, g2, m2, lr=0.2, wd=0.0)[0]),
+    kwargs={**_MS_KW, "momentum": 0.9})
+
+
+def _multi_mp_inputs(rng):
+    w1 = rng.uniform(-1.5, 1.5, (3, 2)).astype(F32)
+    g1 = rng.uniform(-1.5, 1.5, (3, 2)).astype(F32)
+    w2 = rng.uniform(-1.5, 1.5, (4,)).astype(F32)
+    g2 = rng.uniform(-1.5, 1.5, (4,)).astype(F32)
+    return [w1.astype(np.float16), g1.astype(np.float16), w1,
+            w2.astype(np.float16), g2.astype(np.float16), w2]
+
+
+add("multi_mp_sgd_update", _multi_mp_inputs,
+    lambda w1h, g1h, w1, w2h, g2h, w2: (
+        _np_sgd(w1, g1h.astype(F32), lr=0.1, wd=0.05).astype(np.float16),
+        _np_sgd(w2, g2h.astype(F32), lr=0.2, wd=0.0).astype(np.float16)),
+    kwargs=_MS_KW, rtol=2e-2, atol=2e-2)
+
+
+def _preloaded_inputs(rng):
+    w1 = rng.uniform(-1.5, 1.5, (3, 2)).astype(F32)
+    g1 = rng.uniform(-1.5, 1.5, (3, 2)).astype(F32)
+    w2 = rng.uniform(-1.5, 1.5, (4,)).astype(F32)
+    g2 = rng.uniform(-1.5, 1.5, (4,)).astype(F32)
+    lrs = np.array([0.1, 0.2], F32)
+    wds = np.array([0.05, 0.0], F32)
+    return [w1, g1, w2, g2, lrs, wds]
+
+
+add("preloaded_multi_sgd_update", _preloaded_inputs,
+    lambda w1, g1, w2, g2, lrs, wds: (
+        _np_sgd(w1, g1, lr=0.1, wd=0.05), _np_sgd(w2, g2, lr=0.2, wd=0.0)),
+    kwargs={"num_weights": 2})
+
+
+def _preloaded_mom_inputs(rng):
+    w1, g1, w2, g2, lrs, wds = _preloaded_inputs(rng)
+    m1 = rng.uniform(-0.3, 0.3, (3, 2)).astype(F32)
+    m2 = rng.uniform(-0.3, 0.3, (4,)).astype(F32)
+    return [w1, g1, m1, w2, g2, m2, lrs, wds]
+
+
+add("preloaded_multi_sgd_mom_update", _preloaded_mom_inputs,
+    lambda w1, g1, m1, w2, g2, m2, lrs, wds: (
+        _np_sgd_mom(w1, g1, m1, lr=0.1, wd=0.05)[0],
+        _np_sgd_mom(w2, g2, m2, lr=0.2, wd=0.0)[0]),
+    kwargs={"num_weights": 2, "momentum": 0.9})
+
+
+def _np_sparse_adagrad(w, g, h, lr=0.1, eps=1e-7, wd=0.0):
+    gg = g + wd * w
+    live = np.any(g != 0, axis=1, keepdims=True)
+    h2 = np.where(live, h + gg * gg, h)
+    w2 = np.where(live, w - lr * gg / (np.sqrt(h2) + eps), w)
+    return (w2.astype(F32),)
+
+
+def _sparse_adagrad_inputs(rng):
+    w = rng.uniform(-1.5, 1.5, (5, 3)).astype(F32)
+    g = rng.uniform(-1.5, 1.5, (5, 3)).astype(F32)
+    g[[0, 2, 4]] = 0.0  # absent rows
+    h = rng.uniform(0.1, 0.6, (5, 3)).astype(F32)
+    return [w, g, h]
+
+
+add("sparse_adagrad_update", _sparse_adagrad_inputs,
+    lambda w, g, h: _np_sparse_adagrad(w, g, h),
+    kwargs={"lr": 0.1}, rtol=1e-4, atol=1e-4)
+def _group_adagrad_inputs(rng):
+    w = rng.uniform(-1.5, 1.5, (4, 3)).astype(F32)
+    g = rng.uniform(-1.5, 1.5, (4, 3)).astype(F32)
+    h = rng.uniform(0.1, 0.6, (4,)).astype(F32)  # one accumulator per row
+    return [w, g, h]
+
+
+def _np_group_adagrad(w, g, h, lr=0.1, eps=1e-5):
+    h2 = h + np.mean(g * g, axis=1)
+    return ((w - lr * g / (np.sqrt(h2)[:, None] + eps)).astype(F32),)
+
+
+add("group_adagrad_update", _group_adagrad_inputs,
+    lambda w, g, h: _np_group_adagrad(w, g, h),
+    kwargs={"lr": 0.1, "epsilon": 1e-5}, rtol=1e-4, atol=1e-4)

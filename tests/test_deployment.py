@@ -134,3 +134,61 @@ def test_symbolblock_set_data_affects_inference(tmp_path):
     # and after the executor cache is warm, too
     out3 = blk(x).asnumpy()
     np.testing.assert_allclose(out3, out2, rtol=1e-6)
+
+
+SLIM_PREDICT_SCRIPT = r"""
+import json, sys, time
+import numpy as np
+
+t0 = time.perf_counter()
+from mxnet_tpu.predict import Predictor
+t_import = time.perf_counter() - t0
+
+prefix, out_path = sys.argv[1], sys.argv[2]
+x = np.load(prefix + "-input.npy")
+p = Predictor(prefix + "-symbol.json", prefix + "-0000.params",
+              input_shapes={"data": x.shape})
+y = p.predict(x)
+np.save(out_path, y)
+
+# the c_predict_api contract: serving must not pull training machinery
+banned = [m for m in sys.modules
+          if m.startswith("mxnet_tpu.") and any(
+              m.startswith("mxnet_tpu." + h)
+              for h in ("parallel", "optimizer", "gluon", "io", "module",
+                        "model", "kvstore", "metric", "image", "contrib"))]
+assert not banned, f"slim predict imported training machinery: {banned}"
+
+# shape contract: a different shape must demand reshape()
+try:
+    p.predict(np.zeros((x.shape[0] + 1,) + x.shape[1:], np.float32))
+    raise SystemExit("expected shape error")
+except Exception as e:
+    assert "reshape" in str(e), e
+
+print(f"SLIM_OK import={t_import:.2f}")
+"""
+
+
+@pytest.mark.slow
+def test_slim_predict_runtime(tmp_path):
+    """mxnet_tpu.predict (reference c_predict_api.h analog): fresh-process
+    serving with NO training imports, bit-close to the training net."""
+    mx.random.seed(12)
+    net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    net.hybridize()
+    x = np.random.RandomState(1).uniform(-1, 1, (2, 3, 32, 32)).astype(np.float32)
+    want = net(nd.array(x)).asnumpy()
+    prefix = str(tmp_path / "slim")
+    net.export(prefix)
+    np.save(prefix + "-input.npy", x)
+    out_path = prefix + "-served.npy"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", SLIM_PREDICT_SCRIPT, prefix, out_path],
+        capture_output=True, text=True, timeout=560, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "SLIM_OK" in proc.stdout
+    np.testing.assert_allclose(np.load(out_path), want, rtol=1e-4, atol=1e-5)

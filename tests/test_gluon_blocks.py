@@ -1,0 +1,384 @@
+"""Gluon block-layer depth suite (VERDICT r2 item 3: the 351-line
+test_gluon.py missed the paths real models break on — reference
+tests/python/unittest/test_gluon.py:1 is 3,187 lines). Covers: shared
+parameters, reshape/rebind under hybridize, grad_req mutation, deferred
+init corners, cast, save/load strictness, prefixes/scopes, hooks,
+Sequential surgery, and constant parameters."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, gluon
+from mxnet_tpu.gluon import nn
+
+
+# ---------------------------------------------------------------------------
+# shared parameters
+# ---------------------------------------------------------------------------
+
+def test_shared_params_via_params_kwarg():
+    """Two Dense layers sharing one weight (reference test_gluon.py
+    test_parameter_sharing): gradients accumulate through BOTH paths."""
+    mx.random.seed(1)
+    d1 = nn.Dense(4, in_units=4, use_bias=False, prefix="shared_")
+    d2 = nn.Dense(4, in_units=4, use_bias=False, prefix="shared_",
+                  params=d1.params)
+    d1.initialize()
+    x = nd.ones((2, 4))
+    o1, o2 = d1(x), d2(x)
+    np.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(), rtol=1e-6)
+    assert d1.weight is d2.weight
+
+    with autograd.record():
+        y = (d1(x) + d2(x)).sum()
+    y.backward()
+    g_shared = d1.weight.grad().asnumpy().copy()
+
+    # single-path gradient for comparison
+    d1.weight.zero_grad()
+    with autograd.record():
+        y = d1(x).sum()
+    y.backward()
+    np.testing.assert_allclose(g_shared, 2 * d1.weight.grad().asnumpy(),
+                               rtol=1e-5)
+
+
+def test_shared_params_update_affects_both():
+    d1 = nn.Dense(3, in_units=3, use_bias=False, prefix="sh2_")
+    d2 = nn.Dense(3, in_units=3, use_bias=False, prefix="sh2_",
+                  params=d1.params)
+    d1.initialize()
+    x = nd.ones((1, 3))
+    before = d2(x).asnumpy()
+    d1.weight.set_data(d1.weight.data() * 2)
+    np.testing.assert_allclose(d2(x).asnumpy(), before * 2, rtol=1e-6)
+
+
+def test_tied_embedding_output_weights():
+    """Weight tying (reference word-LM tied softmax): embedding and the
+    output projection share one matrix."""
+    vocab, dim = 11, 6
+    emb = nn.Embedding(vocab, dim)
+    emb.initialize()
+    x = nd.array(np.array([1, 4]), dtype="int32")
+    h = emb(x)
+    # (2, dim) x (vocab, dim)^T -> (2, vocab)
+    logits = nd.dot(h, emb.weight.data(), transpose_b=True)
+    assert logits.shape == (2, vocab)
+    # the tied logit of the input token equals its embedding norm^2
+    np.testing.assert_allclose(
+        float(logits[0, 1]),
+        float((h[0] * h[0]).sum()), rtol=1e-5)
+
+
+def test_shared_block_instance_reused_twice():
+    """The SAME block instance called twice in a graph: both calls trace
+    with the same parameters and gradients accumulate."""
+    d = nn.Dense(4, in_units=4, use_bias=False)
+    d.initialize()
+    x = nd.ones((1, 4))
+    with autograd.record():
+        y = (d(d(x))).sum()
+    y.backward()
+    g = d.weight.grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# reshape / rebind under hybridize
+# ---------------------------------------------------------------------------
+
+def test_hybridized_block_new_input_shape_retraces():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(5, flatten=False))
+    net.initialize()
+    net.hybridize()
+    o1 = net(nd.ones((2, 3, 7)))
+    o2 = net(nd.ones((4, 6, 7)))   # new shape -> new trace, same weights
+    assert o1.shape == (2, 3, 5) and o2.shape == (4, 6, 5)
+    np.testing.assert_allclose(o2.asnumpy()[0, 0], o1.asnumpy()[0, 0],
+                               rtol=1e-5)
+
+
+def test_hybridized_dtype_change_retraces():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(3))
+    net.initialize()
+    net.hybridize()
+    net(nd.ones((2, 4)))
+    out = net(nd.ones((2, 4), dtype="float16"))
+    assert out.asnumpy().dtype in (np.float16, np.float32)
+
+
+def test_conv_reshape_input_spatial_change():
+    net = nn.Conv2D(4, 3, padding=1)
+    net.initialize()
+    net(nd.ones((1, 2, 8, 8)))
+    out = net(nd.ones((1, 2, 16, 16)))  # same channels, new spatial dims
+    assert out.shape == (1, 4, 16, 16)
+
+
+# ---------------------------------------------------------------------------
+# deferred init corners
+# ---------------------------------------------------------------------------
+
+def test_deferred_init_error_before_forward():
+    net = nn.Dense(3)
+    net.initialize()
+    with pytest.raises(Exception) as ei:
+        net.weight.data()
+    assert "deferred" in str(ei.value).lower() or \
+        "initialized" in str(ei.value).lower()
+
+
+def test_deferred_init_resolves_on_first_forward():
+    net = nn.Dense(3)
+    net.initialize()
+    net(nd.ones((2, 7)))
+    assert net.weight.shape == (3, 7)
+    assert net.weight.data().shape == (3, 7)
+
+
+def test_uninitialized_forward_raises():
+    net = nn.Dense(3)
+    with pytest.raises(Exception):
+        net(nd.ones((1, 2)))
+
+
+def test_force_reinit_changes_values():
+    mx.random.seed(5)
+    net = nn.Dense(4, in_units=4)
+    net.initialize(init=mx.init.Uniform(1.0))
+    w1 = net.weight.data().asnumpy().copy()
+    net.initialize(init=mx.init.Uniform(1.0), force_reinit=True)
+    w2 = net.weight.data().asnumpy()
+    assert not np.allclose(w1, w2)
+    # without force_reinit, initialize() is a no-op on initialized params
+    net.initialize(init=mx.init.Uniform(1.0))
+    np.testing.assert_allclose(net.weight.data().asnumpy(), w2)
+
+
+def test_in_units_mismatch_raises():
+    net = nn.Dense(3, in_units=5)
+    net.initialize()
+    with pytest.raises(Exception):
+        net(nd.ones((1, 4)))
+
+
+# ---------------------------------------------------------------------------
+# grad_req mutation
+# ---------------------------------------------------------------------------
+
+def test_grad_req_mutation_freezes_layer():
+    """setattr grad_req='null' after init freezes a layer (the fine-tune
+    recipe); setting back to 'write' re-enables it."""
+    mx.random.seed(42)  # unseeded init can produce all-dead relu units
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize(init=mx.init.Xavier())
+    x = nd.ones((2, 3))
+    net(x)
+    for p in net[0].collect_params().values():
+        p.grad_req = "null"
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    frozen_before = net[0].weight.data().asnumpy().copy()
+    live_before = net[1].weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(1)
+    np.testing.assert_allclose(net[0].weight.data().asnumpy(), frozen_before)
+    assert not np.allclose(net[1].weight.data().asnumpy(), live_before)
+
+
+def test_setattr_grad_req_recursive():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    net(nd.ones((1, 3)))
+    net.collect_params().setattr("grad_req", "null")
+    assert all(p.grad_req == "null"
+               for p in net.collect_params().values())
+
+
+# ---------------------------------------------------------------------------
+# cast
+# ---------------------------------------------------------------------------
+
+def test_block_cast_fp16_weights_and_output():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.cast("float16")
+    assert net.weight.data().dtype == np.float16
+    out = net(nd.ones((2, 3), dtype="float16"))
+    assert out.dtype == np.float16
+
+
+def test_block_cast_back_to_fp32_preserves_values():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    w = net.weight.data().asnumpy().copy()
+    net.cast("float16")
+    net.cast("float32")
+    np.testing.assert_allclose(net.weight.data().asnumpy(),
+                               w.astype(np.float16).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# save/load strictness
+# ---------------------------------------------------------------------------
+
+def test_load_parameters_missing_raises_and_allow_missing(tmp_path):
+    src = nn.Dense(3, in_units=2)
+    src.initialize()
+    f = str(tmp_path / "w.params")
+    src.save_parameters(f)
+
+    tgt = nn.HybridSequential()
+    tgt.add(nn.Dense(3, in_units=2), nn.Dense(1, in_units=3))
+    tgt.initialize()
+    with pytest.raises(mx.MXNetError):
+        tgt.load_parameters(f)
+    tgt.load_parameters(f, allow_missing=True, ignore_extra=True)
+
+
+def test_load_parameters_extra_raises_and_ignore_extra(tmp_path):
+    src = nn.HybridSequential()
+    src.add(nn.Dense(3, in_units=2), nn.Dense(1, in_units=3))
+    src.initialize()
+    f = str(tmp_path / "w2.params")
+    src.save_parameters(f)
+
+    tgt = nn.HybridSequential()
+    tgt.add(nn.Dense(3, in_units=2))
+    tgt.initialize()
+    with pytest.raises(mx.MXNetError):
+        tgt.load_parameters(f)
+    tgt.load_parameters(f, ignore_extra=True)
+    np.testing.assert_allclose(tgt[0].weight.data().asnumpy(),
+                               src[0].weight.data().asnumpy())
+
+
+def test_save_load_roundtrip_structural_names(tmp_path):
+    """Structural keys make checkpoints instance-independent (two nets
+    with different global name counters load each other's files)."""
+    mx.random.seed(3)
+    a = nn.HybridSequential()
+    a.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    a.initialize()
+    f = str(tmp_path / "m.params")
+    a.save_parameters(f)
+    _ = nn.Dense(9)  # bump global name counters
+    b = nn.HybridSequential()
+    b.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    b.initialize()
+    b.load_parameters(f)
+    x = nd.ones((1, 3))
+    np.testing.assert_allclose(b(x).asnumpy(), a(x).asnumpy(), rtol=1e-6)
+
+
+def test_load_parameters_shape_mismatch_raises(tmp_path):
+    src = nn.Dense(3, in_units=2)
+    src.initialize()
+    f = str(tmp_path / "w3.params")
+    src.save_parameters(f)
+    tgt = nn.Dense(3, in_units=4)
+    tgt.initialize()
+    with pytest.raises(Exception):
+        tgt.load_parameters(f)
+
+
+# ---------------------------------------------------------------------------
+# prefixes / scopes / dict plumbing
+# ---------------------------------------------------------------------------
+
+def test_name_scope_prefixes_parameters():
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.fc = nn.Dense(2)
+
+        def hybrid_forward(self, F, x):
+            return self.fc(x)
+
+    net = Net(prefix="mynet_")
+    names = list(net.collect_params().keys())
+    assert all(n.startswith("mynet_") for n in names), names
+
+
+def test_collect_params_select_regex():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(3), nn.Dense(2))
+    net.initialize()
+    net(nd.ones((1, 4)))
+    weights = net.collect_params(".*weight")
+    assert len(weights.keys()) == 2
+    assert all(k.endswith("weight") for k in weights.keys())
+
+
+def test_parameterdict_shared_conflicting_grad_stype_raises():
+    d1 = nn.Embedding(5, 3, sparse_grad=True, prefix="emb_")
+    with pytest.raises(mx.MXNetError):
+        nn.Embedding(5, 3, sparse_grad=False, prefix="emb_",
+                     params=d1.params)
+
+
+# ---------------------------------------------------------------------------
+# hooks + Sequential surgery + constants
+# ---------------------------------------------------------------------------
+
+def test_forward_hooks_fire():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    calls = []
+    h1 = net.register_forward_pre_hook(
+        lambda blk, inp: calls.append("pre"))
+    h2 = net.register_forward_hook(
+        lambda blk, inp, out: calls.append("post"))
+    net(nd.ones((1, 2)))
+    assert calls == ["pre", "post"]
+    h1.detach()
+    h2.detach()
+    calls.clear()
+    net(nd.ones((1, 2)))
+    assert calls == []
+
+
+def test_sequential_getitem_len_insert():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    sliced_out_units = [net[i]._units if hasattr(net[i], "_units") else None
+                        for i in range(3)]
+    assert sliced_out_units[2] == 2 or sliced_out_units[2] is None
+
+
+def test_constant_parameter_not_trained():
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.const = self.params.get_constant(
+                    "c", nd.array(np.array([2.0], np.float32)))
+                self.fc = nn.Dense(1, in_units=1)
+
+        def hybrid_forward(self, F, x, const):
+            return self.fc(x) * const
+
+    net = Net()
+    net.initialize()
+    x = nd.ones((1, 1))
+    out1 = float(net(x))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(1)
+    np.testing.assert_allclose(net.const.data().asnumpy(), [2.0])
+    assert float(net(x)) != out1  # fc trained, constant untouched

@@ -117,3 +117,88 @@ def test_indexed_recordio_autoindex_via_native(tmp_path):
     assert len(rd.keys) == 10
     assert rd.read_idx(3) == recs[3]
     rd.close()
+
+
+def test_native_jpeg_pipeline_matches_python_path(tmp_path):
+    """The C++ JPEG decode pipeline (src/native/jpegdec.cc) must produce
+    images statistically identical to the Python/PIL path for the
+    deterministic (center-crop, no-mirror) configuration."""
+    import io as _io
+    import numpy as np
+    import pytest
+    from PIL import Image
+    from mxnet_tpu import native as nat
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageRecordIter
+
+    if not nat.jpeg_available():
+        pytest.skip("libjpeg build unavailable")
+
+    rng = np.random.RandomState(0)
+    path = str(tmp_path / "imgs.rec")
+    w = recordio.MXRecordIO(path, "w")
+    # smooth gradients: decode/resize implementation deltas stay tiny
+    for i in range(6):
+        yy, xx = np.mgrid[0:40, 0:48]
+        img = np.stack([(yy * (3 + i)) % 256, (xx * 4) % 256,
+                        ((yy + xx) * 2) % 256], -1).astype(np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=95)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                              buf.getvalue()))
+    w.close()
+
+    def read_all(force_python):
+        it = ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                             batch_size=3, resize=36,
+                             mean_r=10, mean_g=20, mean_b=30,
+                             std_r=2, std_g=2, std_b=2,
+                             preprocess_threads=2, seed=1)
+        assert it._native_jpeg is not None
+        if force_python:
+            it._native_jpeg = None
+        out = []
+        for b in it:
+            out.append(b.data[0].asnumpy().copy())
+            lab = b.label[0].asnumpy().copy()
+        return np.concatenate(out), lab
+
+    nat_out, nat_lab = read_all(False)
+    py_out, py_lab = read_all(True)
+    np.testing.assert_array_equal(nat_lab, py_lab)
+    assert nat_out.shape == py_out.shape == (6, 3, 32, 32)
+    # implementations differ in resampling details; mean delta must be
+    # sub-LSB after normalization (std 2 -> 0.5 units per pixel value)
+    assert np.abs(nat_out - py_out).mean() < 1.0, \
+        np.abs(nat_out - py_out).mean()
+
+
+def test_native_jpeg_disengages_for_photometric_augs(tmp_path):
+    import numpy as np
+    from mxnet_tpu.io import ImageRecordIter
+    it = ImageRecordIter(path_imgrec=None, synthetic=True, synthetic_size=8,
+                         data_shape=(3, 16, 16), batch_size=4, brightness=0.3)
+    assert getattr(it, "_native_jpeg", None) is None
+
+
+def test_native_jpeg_crop_larger_than_resized_image(tmp_path):
+    """Crop window larger than the post-resize image must upscale, never
+    read out of bounds (r3 review finding: short_side == resize target
+    skipped the clamp)."""
+    import io as _io
+    import numpy as np
+    import pytest
+    from PIL import Image
+    from mxnet_tpu import native as nat
+    if not nat.jpeg_available():
+        pytest.skip("libjpeg build unavailable")
+    a = np.full((36, 100, 3), 128, np.uint8)
+    a[:, :50] = 250
+    b = _io.BytesIO()
+    Image.fromarray(a).save(b, format="JPEG", quality=95)
+    dec = nat.NativeJpegDecoder(64, 64, resize_short=36)
+    out, ok = dec.decode_batch([b.getvalue()])
+    assert ok.all() and out.shape == (1, 3, 64, 64)
+    # pixel values must come from the image, not stray heap memory
+    assert 0.0 <= out.min() and out.max() <= 255.5
+    assert out[0, :, :, :16].mean() > 200  # bright left present

@@ -9,7 +9,7 @@ per-op, the depth tests/python/unittest/test_operator.py provides).
 test_sweep_accounting is the coverage gate: every user-facing reference op
 name (tools/op_parity.py) must be swept here, numerically tested in a named
 other test file, or exempted with a reason — and the directly-tested count
-must stay >= 250.
+must stay >= 280 (>= 215 in-table).
 """
 import os
 import sys
@@ -317,8 +317,13 @@ def test_sweep_accounting():
     assert not unaccounted, (
         f"{len(unaccounted)} reference ops have neither a sweep case, an "
         f"ELSEWHERE pointer, nor an EXEMPT reason: {unaccounted}")
+    # r3: optimizer update family promoted into the sweep table
+    # (closed-form numpy refs) — swept 188 -> 218; keep both floors
+    assert len(swept) >= 215, (
+        f"in-table sweep coverage regressed: swept={len(swept)} "
+        f"elsewhere={len(elsewhere)} exempt={len(exempt)} of {len(refs)}")
     direct = len(swept) + len(elsewhere)
-    assert direct >= 250, (
+    assert direct >= 280, (
         f"direct numeric coverage regressed: swept={len(swept)} "
         f"elsewhere={len(elsewhere)} exempt={len(exempt)} of {len(refs)}")
 
