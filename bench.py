@@ -131,16 +131,16 @@ def bench_resnet(batch, image, steps, warmup):
     }
 
 
-def bench_bert(batch, seq, steps, warmup):
+def bench_bert(batch, seq, steps, warmup, large=False):
     import jax
     import mxnet_tpu as mx
     from mxnet_tpu import nd
-    from mxnet_tpu.models import bert_base
+    from mxnet_tpu.models import bert_base, bert_large
     from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
 
     vocab = int(os.environ.get("BERT_VOCAB", 8192))
     mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
-    net = bert_base(vocab_size=vocab)
+    net = (bert_large if large else bert_base)(vocab_size=vocab)
     with mx.cpu():
         net.initialize(ctx=mx.cpu())
         net(nd.zeros((1, seq), ctx=mx.cpu(), dtype="int32"))
@@ -153,9 +153,52 @@ def bench_bert(batch, seq, steps, warmup):
     y = nd.array(rs.randint(0, vocab, (batch, seq)), dtype="int32")
     dt = _timed_steps(trainer, x, y, steps, warmup)
     tok_s = batch * seq * steps / dt
-    flops = tok_s * bert_train_flops_per_token(12, 768, 4.0, seq, vocab)
+    layers, hidden = (24, 1024) if large else (12, 768)
+    flops = tok_s * bert_train_flops_per_token(layers, hidden, 4.0, seq,
+                                               vocab)
     return {
         "tokens_s": round(tok_s, 1),
+        "tflops": round(flops / 1e12, 2),
+        "mfu": round(flops / PEAK_BF16, 4),
+        "mfu_vs_measured_peak": round(flops / MEASURED_PEAK, 4),
+    }
+
+
+def bench_wide_conv(batch, steps, warmup, ch=768, hw=28):
+    """Chip-friendly conv shapes (N=768 output channels): the proof that
+    the framework's conv lowering reaches >=50% nominal MFU when the
+    SHAPES tile well — ResNet-50 bs32's small-N shapes are the chip's
+    limit, not ours (benchmark/conv_kernel_probe.py)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(ch, 3, padding=1, activation="relu"),
+            gluon.nn.Conv2D(ch, 3, padding=1, activation="relu"),
+            gluon.nn.Conv2D(ch, 3, padding=1, activation="relu"),
+            gluon.nn.Conv2D(ch, 3, padding=1, activation="relu"),
+            gluon.nn.GlobalAvgPool2D(), gluon.nn.Flatten(),
+            gluon.nn.Dense(1000))
+    with mx.cpu():
+        net.initialize(ctx=mx.cpu())
+        net(nd.zeros((1, 3, hw, hw), ctx=mx.cpu()))
+    trainer = DataParallelTrainer(
+        net, _loss_tokens, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05}, mesh=mesh,
+        dtype=os.environ.get("BENCH_DTYPE", "bfloat16"))
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.uniform(-1, 1, (batch, 3, hw, hw)).astype(np.float32))
+    y = nd.array(rs.randint(0, 1000, (batch,)), dtype="int32")
+    dt = _timed_steps(trainer, x, y, steps, warmup)
+    per_img = 2 * 9 * hw * hw * (3 * ch + 3 * ch * ch) + 2 * ch * 1000
+    flops = 3 * per_img * batch * steps / dt
+    return {
+        "img_s": round(batch * steps / dt, 1),
         "tflops": round(flops / 1e12, 2),
         "mfu": round(flops / PEAK_BF16, 4),
         "mfu_vs_measured_peak": round(flops / MEASURED_PEAK, 4),
@@ -185,6 +228,12 @@ def main():
              lambda: bench_bert(int(os.environ.get("BERT_BATCH", 16)),
                                 int(os.environ.get("BERT_SEQ", 512)),
                                 max(STEPS // 3, 3), 1)),
+            ("bert_large_mlm",
+             lambda: bench_bert(int(os.environ.get("BERT_LARGE_BATCH", 8)),
+                                int(os.environ.get("BERT_SEQ", 512)),
+                                max(STEPS // 6, 3), 1, large=True)),
+            ("wide_conv_768",
+             lambda: bench_wide_conv(BATCH, max(STEPS // 3, 3), 1)),
         ):
             try:
                 extra[name] = fn()

@@ -1,0 +1,79 @@
+"""Sustained TF/s of the exact BERT-base GEMM shapes (bs16 x T512) —
+establishes the chip's realistic ceiling for the BERT bench the same way
+roofline.py does for ResNet. Carry-dependent chain inside one jit so XLA
+cannot hoist; scalar result; stabilized warmup."""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+STEPS = int(os.environ.get("GP_STEPS", 30))
+
+# (M, K, N): qkv, proj, ffn1, ffn2, vocab head (bs16 x 512 tokens)
+SHAPES = [
+    (8192, 768, 2304),
+    (8192, 768, 768),
+    (8192, 768, 3072),
+    (8192, 3072, 768),
+    (8192, 768, 8192),
+    # reference big-matmul ceiling for comparison
+    (8192, 8192, 8192),
+]
+
+
+def probe(m, k, n):
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(m, k), jnp.bfloat16)
+    b = jnp.asarray(rng.randn(k, n), jnp.bfloat16)
+    c = jnp.asarray(rng.randn(n, k), jnp.bfloat16)
+
+    def step(carry, _, b, c):
+        a_c = carry
+        x = lax.dot_general(a_c, b, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        # chain back to (m, k) so the loop is carry-dependent
+        a2 = lax.dot_general(x.astype(jnp.bfloat16), c,
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        a2 = (a2 * 1e-4).astype(jnp.bfloat16)
+        return a2, jnp.float32(0)
+
+    @functools.partial(jax.jit, static_argnums=(3,))
+    def run(a0, b, c, steps):
+        # b/c are call arguments, NOT closure constants: constants get
+        # baked into the compile payload and overflow the tunnel's limit
+        out, _ = lax.scan(functools.partial(step, b=b, c=c), a0, None,
+                          length=steps)
+        return jnp.sum(out.astype(jnp.float32))
+
+    def once():
+        t0 = time.perf_counter()
+        float(run(a, b, c, STEPS))
+        return time.perf_counter() - t0
+
+    from bench_util import measure_stabilized
+    dt = measure_stabilized(once, max_warm=8)
+    # two matmuls per step: m*k*n and m*n*k
+    flops = 2.0 * (m * k * n + m * n * k) * STEPS
+    return flops / dt / 1e12
+
+
+def main():
+    for m, k, n in SHAPES:
+        tf = probe(m, k, n)
+        print(json.dumps({"shape": f"({m},{k})x({k},{n})",
+                          "tflops": round(tf, 1)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -163,15 +163,22 @@ def calib_entropy(samples: _np.ndarray, num_bins=8001) -> Tuple[float, float]:
 # Model-level driver (reference quantize_model:429)
 # ---------------------------------------------------------------------------
 
+def _quantize_weight(weight):
+    """Symmetric int8 weight quantization -> (w_q int8, w_scale)."""
+    w = _np.asarray(_raw(weight), dtype=_np.float32)
+    amax = float(_np.abs(w).max()) or 1.0
+    scale = 127.0 / amax
+    w_q = jnp.asarray(_np.clip(_np.round(w * scale), -127, 127)
+                      .astype(_np.int8))
+    return w_q, scale
+
+
 class QuantizedDense:
     """Int8 inference wrapper for a Dense layer's weight."""
 
     def __init__(self, weight, bias=None, calib_range=None):
-        w = _np.asarray(_raw(weight), dtype=_np.float32)
-        self.w_amax = float(_np.abs(w).max()) or 1.0
-        self.w_scale = 127.0 / self.w_amax
-        self.w_q = jnp.asarray(_np.clip(_np.round(w * self.w_scale), -127, 127),
-                               dtype=jnp.int8)
+        self.w_q, self.w_scale = _quantize_weight(weight)
+        self.w_amax = 127.0 / self.w_scale
         self.bias = _raw(bias) if bias is not None else None
         self.calib_range = calib_range
 
@@ -213,3 +220,137 @@ def quantize_model(sym=None, arg_params=None, aux_params=None, *,
         out_args[k] = NDArray(jnp.asarray(q))
         out_args[k + "_scale"] = NDArray(jnp.float32(scale))
     return sym, out_args, dict(aux_params or {})
+
+
+# ---------------------------------------------------------------------------
+# End-to-end gluon INT8 inference (reference quantize_net:791 — graph
+# rewrite to quantized ops + calibrated requantize ranges; here the
+# rewrite swaps each Conv2D/Dense forward for an int8 MXU kernel)
+# ---------------------------------------------------------------------------
+
+def _iter_blocks(block, out):
+    out.append(block)
+    for child in block._children.values():
+        _iter_blocks(child, out)
+    return out
+
+
+def quantize_net(net, calib_data=None, calib_mode="entropy",
+                 num_calib_batches=None, exclude=(), logger=None):
+    """Quantize a trained gluon net IN PLACE for int8 inference.
+
+    Walks the block tree; every Conv2D (NCHW, groups=1, no dilation) and
+    Dense layer gets its weight pre-quantized to int8 and its forward
+    replaced by an int8xint8->int32 MXU kernel with a calibrated input
+    scale. Calibration runs `calib_data` (iterable of input batches)
+    through the fp32 net, collecting each target layer's input
+    distribution: 'entropy' uses the reference KL-threshold search
+    (calib_entropy), 'minmax' the observed range, 'naive' calibrates per
+    batch at inference time. Returns the list of quantized layer names.
+    """
+    from ..gluon import nn as _nn
+
+    # the int8 path is eager per layer: deactivate every HybridBlock and
+    # drop any cached fp32 graphs — a hybridized parent would otherwise
+    # replay its cached fp32 trace, skipping calibration hooks AND the
+    # quantized forwards entirely
+    for blk in _iter_blocks(net, []):
+        if hasattr(blk, "_active"):
+            blk._active = False
+        if hasattr(blk, "_cached_graphs"):
+            blk._cached_graphs.clear()
+
+    targets = []
+    for blk in _iter_blocks(net, []):
+        if blk.name in exclude or getattr(blk, "weight", None) is None:
+            continue
+        if isinstance(blk, _nn.Conv2D):
+            kw = blk._kwargs
+            if kw["num_group"] == 1 and tuple(kw["dilate"]) == (1, 1) \
+                    and kw["layout"] == "NCHW":
+                targets.append(blk)
+        elif isinstance(blk, _nn.Dense):
+            targets.append(blk)
+    if not targets:
+        return []
+
+    ranges: Dict[int, Tuple[float, float]] = {}
+    if calib_mode in ("entropy", "minmax"):
+        if calib_data is None:
+            raise MXNetError(
+                f"calib_mode={calib_mode!r} needs calib_data batches")
+        samples: Dict[int, List[_np.ndarray]] = {id(b): [] for b in targets}
+
+        def _collector(blk):
+            def hook(b, inputs):
+                raw = _np.asarray(_raw(inputs[0]), _np.float32)
+                # bounded reservoir per layer: enough for the histogram
+                if sum(s.size for s in samples[id(blk)]) < 2_000_000:
+                    samples[id(blk)].append(raw.ravel())
+            return hook
+
+        handles = [b.register_forward_pre_hook(_collector(b))
+                   for b in targets]
+        n = 0
+        for batch in calib_data:
+            net(batch)
+            n += 1
+            if num_calib_batches is not None and n >= num_calib_batches:
+                break
+        for h in handles:
+            h.detach()
+        for blk in targets:
+            data = _np.concatenate(samples[id(blk)])
+            if calib_mode == "entropy":
+                ranges[id(blk)] = calib_entropy(data)
+            else:
+                ranges[id(blk)] = (float(data.min()), float(data.max()))
+
+    quantized = []
+    for blk in targets:
+        w_q, w_scale = _quantize_weight(blk.weight.data())
+        lohi = ranges.get(id(blk))
+        a_amax = None
+        if lohi is not None:
+            a_amax = max(abs(lohi[0]), abs(lohi[1])) or 1.0
+        act = blk._activation
+
+        if isinstance(blk, _nn.Dense):
+            flatten = blk._flatten
+
+            def fwd(F, x, weight, bias=None, _wq=w_q, _ws=w_scale,
+                    _am=a_amax, _act=act, _flat=flatten):
+                xr = _raw(x)
+                if _flat and xr.ndim > 2:
+                    xr = xr.reshape(xr.shape[0], -1)
+                am = _am if _am is not None else \
+                    float(jnp.max(jnp.abs(xr))) or 1.0
+                xs = 127.0 / am
+                x_q = jnp.clip(jnp.round(xr * xs), -127, 127).astype(jnp.int8)
+                out = quantized_matmul(x_q, _wq, xs, _ws)
+                if bias is not None:
+                    out = out + _raw(bias)
+                res = NDArray(out)
+                return F.Activation(res, act_type=_act) if _act else res
+        else:
+            kw = blk._kwargs
+            stride = tuple(kw["stride"])
+            pad = tuple(kw["pad"])
+            padding = [(pad[0], pad[0]), (pad[1], pad[1])]
+
+            def fwd(F, x, weight, bias=None, _wq=w_q, _ws=w_scale,
+                    _am=a_amax, _act=act, _st=stride, _pd=padding):
+                xr = _raw(x)
+                am = _am if _am is not None else \
+                    float(jnp.max(jnp.abs(xr))) or 1.0
+                xs = 127.0 / am
+                x_q = jnp.clip(jnp.round(xr * xs), -127, 127).astype(jnp.int8)
+                out = quantized_conv2d(x_q, _wq, xs, _ws, _st, _pd)
+                if bias is not None:
+                    out = out + _raw(bias).reshape(1, -1, 1, 1)
+                res = NDArray(out)
+                return F.Activation(res, act_type=_act) if _act else res
+
+        blk.hybrid_forward = fwd  # instance attr: forward passes F first
+        quantized.append(blk.name)
+    return quantized

@@ -582,7 +582,7 @@ class ImageRecordIter(DataIter):
                         return
                     xs = ys = None
                     if self._native_jpeg is not None:
-                        xs, ys = self._native_batch(recs, pool)
+                        xs, ys = self._native_batch(recs)
                     if xs is None:
                         results = list(pool.map(self._process_one, recs))
                         xs = [r[0] for r in results]
@@ -603,10 +603,11 @@ class ImageRecordIter(DataIter):
         except Exception as e:  # surface errors at next()
             q.put(e)
 
-    def _native_batch(self, recs, pool):
+    def _native_batch(self, recs):
         """Decode a record batch through the C++ JPEG pipeline. Returns
-        (xs, ys) or None when the batch is not all-JPEG (caller falls back
-        to the Python pool path). Corrupt JPEGs fall back per record."""
+        (xs, ys) or (None, None) when the batch is not all-JPEG (caller
+        falls back to the Python pool path). Corrupt JPEGs fall back per
+        record on the already-unpacked payload."""
         from ..recordio import unpack
         headers, payloads = [], []
         for rec in recs:
@@ -619,7 +620,8 @@ class ImageRecordIter(DataIter):
         xs = list(out)
         for i, good in enumerate(ok):
             if not good:  # corrupt record: Python path raises a clear error
-                xs[i] = self._process_one(recs[i])[0]
+                img, raw = self._decode(payloads[i])
+                xs[i] = self._augment(img, raw)
         return xs, [self._label_of(h) for h in headers]
 
     def _ensure_producer(self):

@@ -26,26 +26,35 @@ _lib_lock = threading.Lock()
 _build_error: Optional[str] = None
 
 
+_jpeg_build_error: Optional[str] = None
+
+
 def _build() -> Optional[str]:
     """Build the native library; tries recordio + libjpeg decode first,
     falls back to recordio-only when libjpeg headers are absent (jpeg
-    support is then detected via hasattr on the loaded library)."""
+    support is then detected via hasattr on the loaded library; the jpeg
+    attempt's compiler error is kept in _jpeg_build_error for
+    diagnostics)."""
+    global _jpeg_build_error
     base = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread"]
     attempts = []
     if os.path.exists(_SRC_JPEG):
-        attempts.append(base + [_SRC, _SRC_JPEG, "-o", _LIB_PATH, "-ljpeg"])
-    attempts.append(base + [_SRC, "-o", _LIB_PATH])
+        attempts.append((base + [_SRC, _SRC_JPEG, "-o", _LIB_PATH, "-ljpeg"],
+                         True))
+    attempts.append((base + [_SRC, "-o", _LIB_PATH], False))
     err = "no build attempted"
-    for cmd in attempts:
+    for cmd, with_jpeg in attempts:
         try:
             res = subprocess.run(cmd, capture_output=True, text=True,
                                  timeout=120)
         except (OSError, subprocess.TimeoutExpired) as e:
             err = str(e)
-            continue
-        if res.returncode == 0:
-            return None
-        err = res.stderr[-2000:]
+        else:
+            if res.returncode == 0:
+                return None
+            err = res.stderr[-2000:]
+        if with_jpeg:
+            _jpeg_build_error = err
     return err
 
 
@@ -274,7 +283,8 @@ class NativeJpegDecoder:
         lib = get_lib()
         if lib is None or not hasattr(lib, "jdec_create"):
             raise RuntimeError(
-                f"native JPEG decode unavailable: {_build_error}")
+                "native JPEG decode unavailable: "
+                f"{_build_error or _jpeg_build_error}")
         self._lib = lib
         self._hw = (out_h, out_w)
         m = (ctypes.c_float * 3)(*[float(x) for x in mean])

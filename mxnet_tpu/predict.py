@@ -88,15 +88,21 @@ class Predictor:
                                                 List[_np.ndarray]]:
         """Positional args follow the graph's input order; kwargs override
         by name. Accepts numpy or NDArray; returns numpy."""
+        if len(args) > len(self._input_names):
+            raise MXNetError(
+                f"predict: {len(args)} positional inputs but the graph has "
+                f"only {self._input_names}")
+        named = dict(zip(self._input_names, args))
+        named.update(kwargs)
+        missing = [n for n in self._input_names if n not in named]
+        if missing:
+            raise MXNetError(
+                f"predict: missing inputs {missing}; the graph's data "
+                f"inputs are {self._input_names}")
         if self._ex is None:
-            feed0 = {}
-            for name, a in list(zip(self._input_names, args)) + \
-                    list(kwargs.items()):
-                feed0[name] = tuple(_np.shape(a))
-            self.reshape(feed0)
+            self.reshape({n: tuple(_np.shape(a)) for n, a in named.items()})
         feed = {}
-        for name, a in list(zip(self._input_names, args)) + \
-                list(kwargs.items()):
+        for name, a in named.items():
             if self._shapes and tuple(_np.shape(a)) != self._shapes[name]:
                 raise MXNetError(
                     f"input {name!r} has shape {tuple(_np.shape(a))}, bound "

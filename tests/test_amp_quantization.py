@@ -145,3 +145,112 @@ def test_quantize_model_params():
     assert str(qargs["fc_weight"].dtype) == "int8"
     assert "fc_weight_scale" in qargs
     assert str(qargs["fc_bias"].dtype) == "float32"
+
+
+@pytest.mark.slow
+def test_int8_end_to_end_accuracy_parity():
+    """Reference quantize_net accuracy table (example/ssd/README.md:46
+    fp32-vs-int8 parity): a TRAINED convnet quantized with entropy
+    calibration must keep accuracy within 2% of fp32."""
+    import jax
+    from mxnet_tpu.io import MNISTIter
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+    from mxnet_tpu.contrib.quantization import quantize_net
+
+    def ce(logits, labels):
+        import jax.numpy as jnp
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return jnp.mean(logz - gold)
+
+    mx.random.seed(99)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 5, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Conv2D(16, 3, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize()
+    net(nd.zeros((2, 1, 28, 28)))
+    mesh = make_mesh({"dp": 1}, devices=jax.devices("cpu")[:1])
+    tr = DataParallelTrainer(net, ce, optimizer="adam",
+                             optimizer_params={"learning_rate": 2e-3},
+                             mesh=mesh)
+    it = MNISTIter(batch_size=64, shuffle=True, synthetic_size=1024, seed=3)
+    for _ in range(3):
+        for batch in it:
+            tr.step(batch.data[0], batch.label[0].astype("int32"))
+        it.reset()
+    tr.sync()
+
+    def accuracy():
+        it.reset()
+        correct = total = 0
+        for batch in it:
+            pred = net(batch.data[0]).asnumpy().argmax(axis=1)
+            lab = batch.label[0].asnumpy().astype(int)
+            n = len(lab) - batch.pad
+            correct += int((pred[:n] == lab[:n]).sum())
+            total += n
+        return correct / total
+
+    fp32_acc = accuracy()
+    assert fp32_acc >= 0.9, f"fp32 net failed to train: {fp32_acc}"
+
+    it.reset()
+    calib = [b.data[0] for b in it][:4]
+    it.reset()
+    qlayers = quantize_net(net, calib_data=calib, calib_mode="entropy")
+    assert len(qlayers) == 4  # 2 convs + 2 denses
+    int8_acc = accuracy()
+    print(f"fp32 {fp32_acc:.4f} vs int8 {int8_acc:.4f}")
+    assert int8_acc >= fp32_acc - 0.02, (fp32_acc, int8_acc)
+
+
+def test_quantize_net_minmax_and_naive_modes():
+    """minmax calibration and naive (per-batch) mode both serve."""
+    from mxnet_tpu.contrib.quantization import quantize_net
+    mx.random.seed(5)
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.uniform(-1, 1, (4, 3, 8, 8)).astype(np.float32))
+    for mode in ("minmax", "naive"):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(4, 3, padding=1, activation="relu"),
+                gluon.nn.Flatten(), gluon.nn.Dense(5))
+        net.initialize()
+        want = net(x).asnumpy()
+        quantize_net(net, calib_data=[x] if mode != "naive" else None,
+                     calib_mode=mode)
+        got = net(x).asnumpy()
+        # int8 path tracks fp32 within quantization noise
+        scale = np.abs(want).max() or 1.0
+        assert np.abs(got - want).max() / scale < 0.1, mode
+
+
+def test_quantize_net_handles_hybridized_net():
+    """quantize_net must neutralize cached fp32 graphs on ANCESTOR blocks
+    too — a hybridized parent would otherwise replay the fp32 trace and
+    skip both calibration and the int8 forwards (r3 review finding)."""
+    from mxnet_tpu.contrib.quantization import quantize_net
+    mx.random.seed(8)
+    rs = np.random.RandomState(2)
+    x = nd.array(rs.uniform(-1, 1, (2, 3, 8, 8)).astype(np.float32))
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(4, 3, padding=1, activation="relu"),
+            gluon.nn.Flatten(), gluon.nn.Dense(5))
+    net.initialize()
+    net.hybridize()
+    want = net(x).asnumpy()  # warm the fp32 cached graph
+    qlayers = quantize_net(net, calib_data=[x], calib_mode="entropy")
+    assert len(qlayers) == 2
+    got = net(x).asnumpy()
+    scale = np.abs(want).max() or 1.0
+    diff = np.abs(got - want).max() / scale
+    # int8 result: close to fp32 but NOT bit-identical (a bit-identical
+    # result would mean the cached fp32 graph was replayed)
+    assert diff < 0.1, diff
+    assert diff > 0.0, "quantized net replayed the cached fp32 graph"
