@@ -79,8 +79,6 @@ def main():
     fwd_flops = 4.0 * B * H * T * T * D
     total_flops = 3.5 * fwd_flops  # fwd + standard flash bwd recompute
 
-    from mxnet_tpu.ops.pallas.flash_attention import _flash
-
     variants = {"plain_xla": plain_attn}
     for blk in (128, 256, 512):
         if blk <= T:

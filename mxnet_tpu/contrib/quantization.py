@@ -274,6 +274,9 @@ def quantize_net(net, calib_data=None, calib_mode="entropy",
     if not targets:
         return []
 
+    if calib_mode not in ("entropy", "minmax", "naive"):
+        raise MXNetError(
+            f"unknown calib_mode {calib_mode!r}; use entropy/minmax/naive")
     ranges: Dict[int, Tuple[float, float]] = {}
     if calib_mode in ("entropy", "minmax"):
         if calib_data is None:
@@ -299,6 +302,12 @@ def quantize_net(net, calib_data=None, calib_mode="entropy",
                 break
         for h in handles:
             h.detach()
+        uncalibrated = [b.name for b in targets if not samples[id(b)]]
+        if uncalibrated:
+            raise MXNetError(
+                "calibration never reached layers "
+                f"{uncalibrated[:5]} — they are not on the forward path of "
+                "the calib_data batches (exclude them or fix calib_data)")
         for blk in targets:
             data = _np.concatenate(samples[id(blk)])
             if calib_mode == "entropy":

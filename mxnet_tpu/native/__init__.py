@@ -284,7 +284,7 @@ class NativeJpegDecoder:
         if lib is None or not hasattr(lib, "jdec_create"):
             raise RuntimeError(
                 "native JPEG decode unavailable: "
-                f"{_build_error or _jpeg_build_error}")
+                f"{_build_error or _jpeg_build_error or 'libjpeg build not attempted (jpegdec.cc missing)'}")
         self._lib = lib
         self._hw = (out_h, out_w)
         m = (ctypes.c_float * 3)(*[float(x) for x in mean])

@@ -148,7 +148,13 @@ class NDArray:
         self._data.block_until_ready()
 
     def asnumpy(self) -> _np.ndarray:
-        return _np.asarray(self._data)
+        # a writable COPY, reference semantics: on the CPU backend
+        # np.asarray would alias the (immutable) device buffer and
+        # surprise callers that mutate the result
+        out = _np.asarray(self._data)
+        if not out.flags.writeable:
+            out = out.copy()
+        return out
 
     def asscalar(self):
         if self.size != 1:
@@ -310,9 +316,14 @@ class NDArray:
     def __getitem__(self, key):
         key = self._norm_index(key)
         if isinstance(key, (int, _np.integer)):
-            out_raw = self._data[key]
-        else:
-            out_raw = self._data[key]
+            # jnp CLAMPS out-of-range indices; python iteration relies on
+            # IndexError to terminate (`for row in arr`), so check here
+            n = self.shape[0] if self.ndim else 0
+            if not -n <= key < n:
+                raise IndexError(
+                    f"index {int(key)} is out of bounds for axis 0 with "
+                    f"size {n}")
+        out_raw = self._data[key]
         out = NDArray(out_raw, self._ctx)
         # record slice on tape if needed
         from .. import autograd

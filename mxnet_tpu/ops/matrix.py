@@ -373,9 +373,9 @@ def topk(x, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"
     vals = jnp.moveaxis(vals, -1, axis)
     idx = jnp.moveaxis(idx, -1, axis).astype(jnp.dtype(dtype))
     if ret_typ == "indices":
-        return (idx,)
+        return idx      # single NDArray, reference ordering_op.cc contract
     if ret_typ == "value":
-        return (vals,)
+        return vals
     if ret_typ == "both":
         return (vals, idx)
     if ret_typ == "mask":

@@ -94,6 +94,11 @@ class Predictor:
                 f"only {self._input_names}")
         named = dict(zip(self._input_names, args))
         named.update(kwargs)
+        unknown = [n for n in named if n not in self._input_names]
+        if unknown:
+            raise MXNetError(
+                f"predict: unknown inputs {unknown}; the graph's data "
+                f"inputs are {self._input_names}")
         missing = [n for n in self._input_names if n not in named]
         if missing:
             raise MXNetError(

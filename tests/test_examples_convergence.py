@@ -35,7 +35,7 @@ def test_seq2seq_attention_learns_reverse():
 
 @pytest.mark.slow
 def test_multi_task_learns_both_heads():
-    acc, mae = _load("multi_task").main(["--epochs", "7"])
+    acc, mae = _load("multi_task").main(["--epochs", "12"])
     assert acc >= 0.95, f"multi-task classification failed: acc {acc}"
     assert mae < 0.06, f"multi-task regression failed: MAE {mae}"
 

@@ -1,0 +1,267 @@
+"""Operator-surface depth via parametrized sweeps (reference
+tests/python/unittest/test_operator.py:1, 9,850 lines — the axis/keepdims/
+broadcast/gradient matrices it covers one function at a time are covered
+here as product sweeps)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+RS = np.random.RandomState(7)
+
+REDUCE_OPS = {
+    "sum": np.sum, "mean": np.mean, "max": np.max, "min": np.min,
+    "prod": np.prod, "nansum": np.nansum, "nanprod": np.nanprod,
+}
+AXES = [None, 0, 1, 2, (0, 1), (1, 2), (0, 2), -1]
+KEEPDIMS = [False, True]
+
+
+@pytest.mark.parametrize("op", sorted(REDUCE_OPS))
+@pytest.mark.parametrize("axis", AXES, ids=[str(a) for a in AXES])
+@pytest.mark.parametrize("keepdims", KEEPDIMS)
+def test_reduction_matrix(op, axis, keepdims):
+    src = RS.uniform(0.5, 1.5, (2, 3, 4)).astype(np.float32)
+    want = REDUCE_OPS[op](src, axis=axis, keepdims=keepdims)
+    fn = getattr(nd, op)
+    got = fn(nd.array(src), axis=axis, keepdims=keepdims).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5)
+    assert tuple(np.shape(got)) == tuple(np.shape(want))
+
+
+BCAST_SHAPES = [
+    ((2, 3), (2, 3)), ((2, 3), (1, 3)), ((2, 3), (2, 1)),
+    ((2, 3), (3,)), ((2, 1, 4), (1, 3, 1)), ((1,), (2, 3)),
+    ((4, 1, 5), (4, 2, 1)),
+]
+BINARY_OPS = {
+    "broadcast_add": np.add, "broadcast_sub": np.subtract,
+    "broadcast_mul": np.multiply, "broadcast_div": np.divide,
+    "broadcast_maximum": np.maximum, "broadcast_minimum": np.minimum,
+    "broadcast_power": np.power,
+    "broadcast_hypot": np.hypot,
+}
+
+
+@pytest.mark.parametrize("op", sorted(BINARY_OPS))
+@pytest.mark.parametrize("sa,sb", BCAST_SHAPES,
+                         ids=[f"{a}x{b}" for a, b in BCAST_SHAPES])
+def test_broadcast_binary_matrix(op, sa, sb):
+    a = RS.uniform(0.5, 2.0, sa).astype(np.float32)
+    b = RS.uniform(0.5, 2.0, sb).astype(np.float32)
+    want = BINARY_OPS[op](a, b)
+    got = getattr(nd, op)(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5)
+
+
+CMP_OPS = {
+    "broadcast_equal": np.equal, "broadcast_not_equal": np.not_equal,
+    "broadcast_greater": np.greater, "broadcast_lesser": np.less,
+    "broadcast_greater_equal": np.greater_equal,
+    "broadcast_lesser_equal": np.less_equal,
+    "broadcast_logical_and": np.logical_and,
+    "broadcast_logical_or": np.logical_or,
+    "broadcast_logical_xor": np.logical_xor,
+}
+
+
+@pytest.mark.parametrize("op", sorted(CMP_OPS))
+def test_comparison_broadcast(op):
+    a = RS.randint(0, 3, (3, 4)).astype(np.float32)
+    b = RS.randint(0, 3, (1, 4)).astype(np.float32)
+    want = CMP_OPS[op](a, b).astype(np.float32)
+    got = getattr(nd, op)(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(got.astype(np.float32), want)
+
+
+UNARY_GRADS = {
+    # op -> (domain_lo, domain_hi, d/dx as numpy fn)
+    "exp": (-1.0, 1.0, lambda x: np.exp(x)),
+    "log": (0.4, 2.0, lambda x: 1 / x),
+    "sqrt": (0.4, 2.0, lambda x: 0.5 / np.sqrt(x)),
+    "sin": (-1.0, 1.0, lambda x: np.cos(x)),
+    "cos": (-1.0, 1.0, lambda x: -np.sin(x)),
+    "tanh": (-1.0, 1.0, lambda x: 1 - np.tanh(x) ** 2),
+    "sigmoid": (-2.0, 2.0,
+                lambda x: (1 / (1 + np.exp(-x))) * (1 - 1 / (1 + np.exp(-x)))),
+    "square": (-2.0, 2.0, lambda x: 2 * x),
+    "rsqrt": (0.4, 2.0, lambda x: -0.5 * x ** -1.5),
+    "cbrt": (0.4, 2.0, lambda x: x ** (-2.0 / 3) / 3),
+    "expm1": (-1.0, 1.0, lambda x: np.exp(x)),
+    "log1p": (-0.5, 1.0, lambda x: 1 / (1 + x)),
+    "arctan": (-1.0, 1.0, lambda x: 1 / (1 + x * x)),
+    "arcsinh": (-1.0, 1.0, lambda x: 1 / np.sqrt(1 + x * x)),
+    "erf": (-1.0, 1.0,
+            lambda x: 2 / np.sqrt(np.pi) * np.exp(-x * x)),
+}
+
+
+@pytest.mark.parametrize("op", sorted(UNARY_GRADS))
+def test_unary_gradient_closed_form(op):
+    lo, hi, dref = UNARY_GRADS[op]
+    src = RS.uniform(lo, hi, (3, 4)).astype(np.float32)
+    x = nd.array(src)
+    x.attach_grad()
+    with autograd.record():
+        y = getattr(nd, op)(x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), dref(src.astype(np.float64)),
+                               rtol=2e-3, atol=2e-4)
+
+
+@pytest.mark.parametrize("axis", [0, 1, -1])
+@pytest.mark.parametrize("op", ["softmax", "log_softmax"])
+def test_softmax_axis_matrix(op, axis):
+    src = RS.randn(3, 4, 5).astype(np.float32)
+    got = getattr(nd, op)(nd.array(src), axis=axis).asnumpy()
+    m = src - src.max(axis=axis, keepdims=True)
+    sm = np.exp(m) / np.exp(m).sum(axis=axis, keepdims=True)
+    want = sm if op == "softmax" else np.log(sm)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("begin,end,step", [
+    ((0, 0), (2, 3), None), ((1, 1), (3, 4), None),
+    ((0, 0), (4, 4), (2, 2)), ((3, None), (0, None), (-1, None)),
+])
+def test_slice_op_matrix(begin, end, step):
+    src = np.arange(16, dtype=np.float32).reshape(4, 4)
+    got = nd.slice(nd.array(src), begin=begin, end=end,
+                   step=step).asnumpy() if step else \
+        nd.slice(nd.array(src), begin=begin, end=end).asnumpy()
+    sl = tuple(slice(b, e, (step[i] if step else None))
+               for i, (b, e) in enumerate(zip(begin, end)))
+    np.testing.assert_allclose(got, src[sl])
+
+
+@pytest.mark.parametrize("mode", ["clip", "wrap"])
+def test_take_modes(mode):
+    src = RS.randn(5, 3).astype(np.float32)
+    idx = np.array([0, 4, 6, -1], np.int64)  # 6 is out of bounds
+    got = nd.take(nd.array(src), nd.array(idx, dtype="int64"),
+                  mode=mode).asnumpy()
+    want = np.take(src, idx, axis=0, mode=mode)
+    np.testing.assert_allclose(got, want)
+
+
+@pytest.mark.parametrize("k", [1, 2, 3])
+@pytest.mark.parametrize("ret_typ", ["value", "indices"])
+def test_topk_matrix(k, ret_typ):
+    src = RS.randn(4, 6).astype(np.float32)
+    got = nd.topk(nd.array(src), k=k, ret_typ=ret_typ, axis=-1).asnumpy()
+    order = np.argsort(-src, axis=-1)[:, :k]
+    if ret_typ == "indices":
+        np.testing.assert_allclose(got.astype(np.int64), order)
+    else:
+        np.testing.assert_allclose(got, np.take_along_axis(src, order, -1),
+                                   rtol=1e-6)
+
+
+@pytest.mark.parametrize("transpose_a", [False, True])
+@pytest.mark.parametrize("transpose_b", [False, True])
+def test_dot_transpose_matrix(transpose_a, transpose_b):
+    a = RS.randn(3, 4).astype(np.float32)
+    b = RS.randn(4, 5).astype(np.float32)
+    an = a.T if transpose_a else a
+    bn = b.T if transpose_b else b
+    got = nd.dot(nd.array(an), nd.array(bn), transpose_a=transpose_a,
+                 transpose_b=transpose_b).asnumpy()
+    np.testing.assert_allclose(got, a @ b, rtol=1e-5)
+
+
+@pytest.mark.parametrize("shape,reps", [((2, 3), (2, 1)), ((2, 3), (1, 3)),
+                                        ((2,), (4,)), ((1, 2), (3, 2))])
+def test_tile_matrix(shape, reps):
+    src = RS.randn(*shape).astype(np.float32)
+    np.testing.assert_allclose(nd.tile(nd.array(src), reps=reps).asnumpy(),
+                               np.tile(src, reps))
+
+
+@pytest.mark.parametrize("axis", [0, 1, None])
+def test_argmax_argmin_matrix(axis):
+    src = RS.randn(4, 5).astype(np.float32)
+    for op, ref in (("argmax", np.argmax), ("argmin", np.argmin)):
+        got = getattr(nd, op)(nd.array(src), axis=axis).asnumpy()
+        np.testing.assert_allclose(got.astype(np.int64).ravel(),
+                                   np.atleast_1d(ref(src, axis=axis)))
+
+
+def test_where_broadcasting():
+    cond = np.array([[1, 0, 1]], np.float32)
+    a = RS.randn(2, 3).astype(np.float32)
+    b = RS.randn(2, 3).astype(np.float32)
+    got = nd.where(nd.array(np.broadcast_to(cond, (2, 3)).copy()),
+                   nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(got, np.where(cond.astype(bool), a, b))
+
+
+@pytest.mark.parametrize("p", [0.0, 0.3, 0.7])
+def test_dropout_scaling_statistics(p):
+    src = np.ones((200, 200), np.float32)
+    x = nd.array(src)
+    with autograd.record(train_mode=True):
+        out = nd.Dropout(x, p=p)
+    o = out.asnumpy()
+    if p == 0.0:
+        np.testing.assert_allclose(o, src)
+    else:
+        zeros = (o == 0).mean()
+        assert abs(zeros - p) < 0.02
+        survivors = o[o != 0]
+        np.testing.assert_allclose(survivors, 1.0 / (1 - p), rtol=1e-5)
+
+
+@pytest.mark.parametrize("act", ["relu", "sigmoid", "tanh", "softrelu",
+                                 "softsign"])
+def test_activation_variants(act):
+    src = RS.randn(3, 4).astype(np.float32)
+    got = nd.Activation(nd.array(src), act_type=act).asnumpy()
+    ref = {
+        "relu": lambda x: np.maximum(x, 0),
+        "sigmoid": lambda x: 1 / (1 + np.exp(-x)),
+        "tanh": np.tanh,
+        "softrelu": lambda x: np.log1p(np.exp(x)),
+        "softsign": lambda x: x / (1 + np.abs(x)),
+    }[act]
+    np.testing.assert_allclose(got, ref(src.astype(np.float64)), rtol=1e-4,
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("pool,stride,pad", [
+    ((2, 2), (2, 2), (0, 0)), ((3, 3), (1, 1), (1, 1)),
+    ((2, 2), (1, 1), (0, 0)),
+])
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_pooling_matrix(pool, stride, pad, ptype):
+    import torch
+    import torch.nn.functional as tF
+    src = RS.randn(2, 3, 8, 8).astype(np.float32)
+    got = nd.Pooling(nd.array(src), kernel=pool, stride=stride, pad=pad,
+                     pool_type=ptype).asnumpy()
+    t = torch.from_numpy(src)
+    if ptype == "max":
+        want = tF.max_pool2d(t, pool, stride, pad).numpy()
+    else:
+        want = tF.avg_pool2d(t, pool, stride, pad,
+                             count_include_pad=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("num_filter,kernel,stride,pad", [
+    (4, (3, 3), (1, 1), (1, 1)), (8, (1, 1), (1, 1), (0, 0)),
+    (4, (3, 3), (2, 2), (1, 1)), (6, (5, 5), (1, 1), (2, 2)),
+])
+def test_convolution_matrix_vs_torch(num_filter, kernel, stride, pad):
+    import torch
+    import torch.nn.functional as tF
+    src = RS.randn(2, 3, 9, 9).astype(np.float32)
+    w = (RS.randn(num_filter, 3, *kernel) * 0.2).astype(np.float32)
+    b = RS.randn(num_filter).astype(np.float32)
+    got = nd.Convolution(nd.array(src), nd.array(w), nd.array(b),
+                         kernel=kernel, num_filter=num_filter,
+                         stride=stride, pad=pad).asnumpy()
+    want = tF.conv2d(torch.from_numpy(src), torch.from_numpy(w),
+                     torch.from_numpy(b), stride, pad).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
