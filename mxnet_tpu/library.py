@@ -1,16 +1,24 @@
 """Runtime operator-library loading (reference python/mxnet/library.py:28
 `mx.library.load` -> MXLoadLib, include/mxnet/lib_api.h).
 
-The reference loads a compiled .so exporting the C operator ABI. Here custom
-operators are pure-jax functions registered through the same registry the
-built-ins use, so an "operator library" is a Python module (or package
-directory) that calls `mxnet_tpu.ops.register(...)` at import time. `load`
-imports it by file path and reports the newly registered operators — after
-which they are live in `mx.nd`, `mx.sym` and hybridized blocks exactly like
-MXLoadLib-loaded ops were.
+Two library flavors, both landing in the SAME op registry the built-ins
+use (so loaded ops are live in `mx.nd`, `mx.sym`, and hybridized blocks):
+
+1. **Python libraries** — a module/package calling
+   `mxnet_tpu.ops.register(...)` at import time; `load` imports it by
+   path and reports the new ops.
+2. **Compiled `.so` libraries** — the TPU-native analog of the
+   reference's binary custom-op ABI (include/mxnet/lib_api.h:1-1023).
+   The .so exports the `mxtpu_oplib_*` C symbols (see
+   src/native/oplib_example.cc); each exported op is registered with a
+   `jax.pure_callback` implementation, so the compiled host kernel runs
+   under jit/XLA exactly where the reference's CustomOp ran on the
+   engine. ABI v1 is float32, single-output, forward-only — custom
+   gradients go through the Python `operator.CustomOp` path.
 """
 from __future__ import annotations
 
+import ctypes
 import importlib.util
 import os
 import sys
@@ -18,20 +26,135 @@ import sys
 from .base import MXNetError
 from .ops.registry import all_ops
 
+_MAX_NDIM = 8
+
+
+def _load_binary(path, verbose=True):
+    """Load a compiled operator library exporting the mxtpu_oplib ABI."""
+    import numpy as _np
+    import jax
+    import jax.numpy as jnp
+    from .ops.registry import register
+
+    lib = ctypes.CDLL(os.path.abspath(path))
+    try:
+        lib.mxtpu_oplib_abi_version.restype = ctypes.c_int
+        lib.mxtpu_oplib_count.restype = ctypes.c_int
+        lib.mxtpu_oplib_name.restype = ctypes.c_char_p
+        lib.mxtpu_oplib_name.argtypes = [ctypes.c_int]
+        P64 = ctypes.POINTER(ctypes.c_int64)
+        PF = ctypes.POINTER(ctypes.c_float)
+        lib.mxtpu_oplib_infer.restype = ctypes.c_int
+        lib.mxtpu_oplib_infer.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(P64),
+            ctypes.POINTER(ctypes.c_int), P64, ctypes.POINTER(ctypes.c_int)]
+        lib.mxtpu_oplib_forward.restype = ctypes.c_int
+        lib.mxtpu_oplib_forward.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(PF),
+            ctypes.POINTER(P64), ctypes.POINTER(ctypes.c_int),
+            PF, P64, ctypes.c_int]
+    except AttributeError as e:
+        raise MXNetError(
+            f"{path} does not export the mxtpu_oplib ABI "
+            f"(src/native/oplib_example.cc documents it): {e}")
+    ver = lib.mxtpu_oplib_abi_version()
+    if ver != 1:
+        raise MXNetError(f"unsupported oplib ABI version {ver} (want 1)")
+
+    def _shape_args(arrs):
+        shapes = [_np.asarray(a.shape, _np.int64) for a in arrs]
+        shape_ptrs = (P64 * len(arrs))(
+            *[s.ctypes.data_as(P64) for s in shapes])
+        ndims = (ctypes.c_int * len(arrs))(*[a.ndim for a in arrs])
+        return shapes, shape_ptrs, ndims
+
+    def _infer(idx, arrs):
+        for a in arrs:
+            if a.ndim > _MAX_NDIM:
+                raise MXNetError(
+                    f"oplib ABI v1 supports at most {_MAX_NDIM} dims, "
+                    f"got input with {a.ndim}")
+        _, shape_ptrs, ndims = _shape_args(arrs)
+        # the ABI caps outputs at the max input rank <= _MAX_NDIM, so the
+        # buffer cannot be overrun by a conforming library; out_ndim is
+        # validated regardless
+        out_shape = _np.zeros(_MAX_NDIM, _np.int64)
+        out_ndim = ctypes.c_int(0)
+        rc = lib.mxtpu_oplib_infer(idx, len(arrs), shape_ptrs, ndims,
+                                   out_shape.ctypes.data_as(P64),
+                                   ctypes.byref(out_ndim))
+        if rc != 0:
+            raise MXNetError(
+                f"oplib infer failed (op #{idx}, shapes "
+                f"{[a.shape for a in arrs]})")
+        if not 0 <= out_ndim.value <= _MAX_NDIM:
+            raise MXNetError(
+                f"oplib infer returned out_ndim={out_ndim.value} "
+                f"(ABI v1 max {_MAX_NDIM})")
+        return tuple(int(s) for s in out_shape[:out_ndim.value])
+
+    def _make_impl(idx, opname):
+        def host_fn(out_shape, *arrs):
+            # out_shape was computed ONCE at trace time; the callback
+            # only runs the compiled forward
+            arrs = [_np.ascontiguousarray(_np.asarray(a, _np.float32))
+                    for a in arrs]
+            out = _np.zeros(out_shape, _np.float32)
+            shapes, shape_ptrs, ndims = _shape_args(arrs)
+            in_ptrs = (PF * len(arrs))(
+                *[a.ctypes.data_as(PF) for a in arrs])
+            oshape = _np.asarray(out_shape, _np.int64)
+            rc = lib.mxtpu_oplib_forward(
+                idx, len(arrs), in_ptrs, shape_ptrs, ndims,
+                out.ctypes.data_as(PF), oshape.ctypes.data_as(P64),
+                len(out_shape))
+            if rc != 0:
+                raise MXNetError(f"oplib forward failed for {opname!r}")
+            return out
+
+        def impl(*raw):
+            # the compiled host kernel runs as a callback under jit/XLA —
+            # the portable XLA-FFI-style hook for external binaries.
+            # shapes are static under trace, so infer runs at trace time
+            import functools
+            out_shape = _infer(idx, [jnp.asarray(r) for r in raw])
+            res = jax.ShapeDtypeStruct(out_shape, jnp.float32)
+            return jax.pure_callback(functools.partial(host_fn, out_shape),
+                                     res, *raw)
+
+        impl.__name__ = opname
+        return impl
+
+    n = lib.mxtpu_oplib_count()
+    existing = set(all_ops())
+    names = []
+    for i in range(n):
+        raw_name = lib.mxtpu_oplib_name(i)
+        if not raw_name:
+            continue
+        opname = raw_name.decode()
+        if opname in existing:
+            raise MXNetError(
+                f"operator library {os.path.basename(path)} exports "
+                f"{opname!r}, which would overwrite an existing operator — "
+                "rename it in the library")
+        register(opname, differentiable=False)(_make_impl(i, opname))
+        names.append(opname)
+        if verbose:
+            print(f"loaded op: {opname} (binary, {os.path.basename(path)})")
+    return names
+
 
 def load(path, verbose=True):
-    """Load an operator library (a Python module registering ops).
+    """Load an operator library — a compiled `.so` exporting the
+    mxtpu_oplib ABI, or a Python module registering ops.
 
     Returns the list of operator names the library registered.
     """
     if not os.path.exists(path):
         raise MXNetError(f"library not found: {path}")
     if path.endswith(".so"):
-        raise MXNetError(
-            "compiled operator libraries use the reference's C ABI; here an "
-            "operator library is a Python module calling "
-            "mxnet_tpu.ops.register — see mxnet_tpu/operator.py for the "
-            "CustomOp alternative")
+        return _load_binary(path, verbose=verbose)
     if os.path.isdir(path):
         init = os.path.join(path, "__init__.py")
         if not os.path.exists(init):

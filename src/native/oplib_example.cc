@@ -1,0 +1,78 @@
+// Example compiled operator library for the mxtpu external-op ABI —
+// the TPU-native analog of the reference's lib_api.h custom-op .so
+// (include/mxnet/lib_api.h:1-1023, loaded by MXLoadLib).
+//
+// ABI v1 (all float32, single output; see mxnet_tpu/library.py):
+//   int         mxtpu_oplib_abi_version(void)           -> 1
+//   int         mxtpu_oplib_count(void)
+//   const char* mxtpu_oplib_name(int idx)
+//   int mxtpu_oplib_infer(idx, n_in, shapes, ndims, out_shape, out_ndim)
+//   int mxtpu_oplib_forward(idx, n_in, inputs, shapes, ndims,
+//                           out, out_shape, out_ndim)
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC oplib_example.cc -o libmyops.so
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+int64_t numel(const int64_t* shape, int ndim) {
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+int mxtpu_oplib_abi_version(void) { return 1; }
+
+int mxtpu_oplib_count(void) { return 2; }
+
+const char* mxtpu_oplib_name(int idx) {
+  switch (idx) {
+    case 0: return "scaled_sqrt";   // y = 2 * sqrt(|x|)
+    case 1: return "pairwise_add";  // y = a + b (same shape)
+    default: return nullptr;
+  }
+}
+
+int mxtpu_oplib_infer(int idx, int n_in, const int64_t* const* shapes,
+                      const int* ndims, int64_t* out_shape, int* out_ndim) {
+  if (idx == 0 && n_in == 1) {
+    *out_ndim = ndims[0];
+    std::memcpy(out_shape, shapes[0], sizeof(int64_t) * ndims[0]);
+    return 0;
+  }
+  if (idx == 1 && n_in == 2) {
+    if (ndims[0] != ndims[1]) return -1;
+    for (int i = 0; i < ndims[0]; ++i)
+      if (shapes[0][i] != shapes[1][i]) return -1;
+    *out_ndim = ndims[0];
+    std::memcpy(out_shape, shapes[0], sizeof(int64_t) * ndims[0]);
+    return 0;
+  }
+  return -1;
+}
+
+int mxtpu_oplib_forward(int idx, int n_in, const float* const* inputs,
+                        const int64_t* const* shapes, const int* ndims,
+                        float* out, const int64_t* out_shape, int out_ndim) {
+  (void)shapes;
+  const int64_t n = numel(out_shape, out_ndim);
+  if (idx == 0 && n_in == 1) {
+    for (int64_t i = 0; i < n; ++i)
+      out[i] = 2.0f * std::sqrt(std::fabs(inputs[0][i]));
+    return 0;
+  }
+  if (idx == 1 && n_in == 2) {
+    for (int64_t i = 0; i < n; ++i) out[i] = inputs[0][i] + inputs[1][i];
+    return 0;
+  }
+  return -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,85 @@
+"""Binary custom-op library loading (reference include/mxnet/lib_api.h +
+MXLoadLib, c_api.cc:103): compile the example .so with g++, load it at
+runtime with mx.library.load, and use its ops from nd, inside jit, and in
+a symbol graph — no rebuild of the framework."""
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "src", "native", "oplib_example.cc")
+
+
+@pytest.fixture(scope="module")
+def oplib(tmp_path_factory):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    so = str(tmp_path_factory.mktemp("oplib") / "libmyops.so")
+    r = subprocess.run(["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                        SRC, "-o", so], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    names = mx.library.load(so, verbose=False)
+    assert names == ["scaled_sqrt", "pairwise_add"]
+    return so
+
+
+def test_binary_op_eager(oplib):
+    rs = np.random.RandomState(0)
+    x = rs.uniform(-2, 2, (3, 4)).astype(np.float32)
+    got = nd.scaled_sqrt(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, 2 * np.sqrt(np.abs(x)), rtol=1e-6)
+
+    a = rs.randn(2, 3).astype(np.float32)
+    b = rs.randn(2, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.pairwise_add(nd.array(a), nd.array(b)).asnumpy(), a + b,
+        rtol=1e-6)
+
+
+def test_binary_op_under_jit(oplib):
+    """The compiled kernel runs as a host callback inside a jitted
+    computation — the external binary composes with XLA."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import get_op
+
+    op = get_op("scaled_sqrt")
+
+    @jax.jit
+    def f(x):
+        return op(x) + 1.0
+
+    x = np.array([[4.0, 9.0]], np.float32)
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray(x))),
+                               2 * np.sqrt(x) + 1.0, rtol=1e-6)
+
+
+def test_binary_op_in_symbol_graph(oplib):
+    from mxnet_tpu import sym
+    x = sym.Variable("x")
+    y = sym.scaled_sqrt(x)
+    ex = y.bind(mx.cpu(), {"x": nd.array(np.array([16.0], np.float32))})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [8.0], rtol=1e-6)
+
+
+def test_binary_op_shape_mismatch_raises(oplib):
+    with pytest.raises(mx.MXNetError):
+        nd.pairwise_add(nd.ones((2, 3)), nd.ones((3, 2)))
+
+
+def test_bad_so_rejected(tmp_path):
+    bad = str(tmp_path / "notanoplib.so")
+    # the recordio library exists but exports a different ABI
+    src = os.path.join(REPO, "src", "native", "libmxtpu_io.so")
+    if not os.path.exists(src):
+        pytest.skip("native io lib not built")
+    shutil.copy(src, bad)
+    with pytest.raises(mx.MXNetError):
+        mx.library.load(bad, verbose=False)
