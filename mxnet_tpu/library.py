@@ -109,6 +109,9 @@ def _load_binary(path, verbose=True):
                 out.ctypes.data_as(PF), oshape.ctypes.data_as(P64),
                 len(out_shape))
             if rc != 0:
+                # NB: raised inside the XLA host callback — JAX surfaces
+                # it as XlaRuntimeError at the sync point; the message
+                # below stays visible in that error's cause chain
                 raise MXNetError(f"oplib forward failed for {opname!r}")
             return out
 
@@ -126,8 +129,10 @@ def _load_binary(path, verbose=True):
         return impl
 
     n = lib.mxtpu_oplib_count()
+    # validate the whole export list BEFORE registering anything: a
+    # mid-loop failure must not leave half the library registered
     existing = set(all_ops())
-    names = []
+    exported = []
     for i in range(n):
         raw_name = lib.mxtpu_oplib_name(i)
         if not raw_name:
@@ -138,6 +143,10 @@ def _load_binary(path, verbose=True):
                 f"operator library {os.path.basename(path)} exports "
                 f"{opname!r}, which would overwrite an existing operator — "
                 "rename it in the library")
+        existing.add(opname)  # catches duplicate exports within the .so
+        exported.append((i, opname))
+    names = []
+    for i, opname in exported:
         register(opname, differentiable=False)(_make_impl(i, opname))
         names.append(opname)
         if verbose:
