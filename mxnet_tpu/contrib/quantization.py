@@ -18,7 +18,6 @@ from jax import lax
 
 from ..base import MXNetError
 from ..ndarray import NDArray
-from ..ops.registry import register
 
 
 def _raw(x):
@@ -26,57 +25,12 @@ def _raw(x):
 
 
 # ---------------------------------------------------------------------------
-# Core quantize/dequantize/requantize ops (reference quantize.cc,
-# dequantize.cc, requantize.cc)
+# Core quantize/dequantize/requantize ops live in ops/quantized.py so they
+# register at package import (reference registers at library load —
+# quantize.cc:51, quantize_v2.cc:66). Re-exported here for compatibility.
 # ---------------------------------------------------------------------------
-
-@register("_contrib_quantize", multi_output=True)
-def quantize(data, min_range, max_range, *, out_type="int8"):
-    """Affine/symmetric quantize: f32 -> int8 with recorded range."""
-    if out_type not in ("int8", "uint8"):
-        raise MXNetError("out_type must be int8/uint8")
-    lo = jnp.minimum(min_range, 0.0)
-    hi = jnp.maximum(max_range, 0.0)
-    if out_type == "int8":
-        amax = jnp.maximum(jnp.abs(lo), jnp.abs(hi))
-        scale = 127.0 / jnp.maximum(amax, 1e-30)
-        q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
-        return q, -amax, amax
-    scale = 255.0 / jnp.maximum(hi - lo, 1e-30)
-    q = jnp.clip(jnp.round((data - lo) * scale), 0, 255).astype(jnp.uint8)
-    return q, lo, hi
-
-
-@register("_contrib_quantize_v2", multi_output=True)
-def quantize_v2(data, *, out_type="int8", min_calib_range=None,
-                max_calib_range=None):
-    if min_calib_range is None or max_calib_range is None:
-        lo, hi = jnp.min(data), jnp.max(data)
-    else:
-        lo, hi = jnp.float32(min_calib_range), jnp.float32(max_calib_range)
-    return quantize(data, lo, hi, out_type=out_type)
-
-
-@register("_contrib_dequantize")
-def dequantize(data, min_range, max_range, *, out_type="float32"):
-    if data.dtype == jnp.int8:
-        amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
-        return data.astype(jnp.float32) * (amax / 127.0)
-    scale = (max_range - min_range) / 255.0
-    return data.astype(jnp.float32) * scale + min_range
-
-
-@register("_contrib_requantize", multi_output=True)
-def requantize(data, min_range, max_range, *, out_type="int8",
-               min_calib_range=None, max_calib_range=None):
-    """int32 accumulator -> int8 with a new scale."""
-    real = data.astype(jnp.float32) * (
-        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / (127.0 * 127.0))
-    if min_calib_range is not None:
-        lo, hi = jnp.float32(min_calib_range), jnp.float32(max_calib_range)
-    else:
-        lo, hi = jnp.min(real), jnp.max(real)
-    return quantize(real, lo, hi, out_type=out_type)
+from ..ops.quantized import (  # noqa: F401
+    quantize, quantize_v2, dequantize, requantize)
 
 
 # ---------------------------------------------------------------------------
