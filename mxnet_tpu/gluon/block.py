@@ -574,7 +574,7 @@ class SymbolBlock(HybridBlock):
         self._arg_params = dict(params or {})
         self._exec_cache = {}
         self._param_objs = None
-        self._feed_cache = None
+        self._feed_cache = {}
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
@@ -610,15 +610,14 @@ class SymbolBlock(HybridBlock):
         from ..context import current_context
         ctx = getattr(self, "_ctx", None) or \
             (args[0].ctx if isinstance(args[0], NDArray) else current_context())
-        key = tuple((tuple(a.shape), str(a.dtype)) for a in args)
+        # ctx is part of the key: each device gets its own bound executor,
+        # so a ctx-B call never reuses the ctx-A binding with ctx-B feeds
+        key = (str(ctx),) + tuple((tuple(a.shape), str(a.dtype)) for a in args)
         feed = dict(zip(self._input_names, args))
-        # params follow the bind ctx; the device copy is cached per
-        # (array identity, version) so serving pays it once, not per call
-        cache = getattr(self, "_feed_cache", None)
-        if cache is None or cache[0] is not ctx:
-            cache = (ctx, {})
-            self._feed_cache = cache
-        conv = cache[1]
+        # params follow the bind ctx; the device copy is cached per ctx and
+        # per (array identity, version) so serving pays it once per device,
+        # not per call — even when calls alternate between devices
+        conv = self._feed_cache.setdefault(ctx, {})
         for k, p in self._live_params()._params.items():
             d = p.data()
             ent = conv.get(k)

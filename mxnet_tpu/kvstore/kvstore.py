@@ -387,9 +387,13 @@ class KVStoreDist(KVStore):
                 from .ps import _pack
                 for k in keys:
                     if self.rank == 0:
-                        self._ps_client.request(
+                        resp = self._ps_client.request(
                             self._home(k),
                             ("init", k, _pack(self._store[k].asnumpy())))
+                        if resp[0] != "ok":
+                            raise MXNetError(
+                                f"dist_async init of key {k} failed at its "
+                                f"home server: {resp}")
                     # every rank blocks until the home server has the key,
                     # so a pull immediately after init can't race the seed
                     self._ps_client.wait_ready(self._home(k), k)
@@ -444,13 +448,74 @@ class KVStoreDist(KVStore):
             resp = self._ps_client.request(self._home(k),
                                            ("pull_rows", k, ids))
             if resp[0] != "ok":
-                raise MXNetError(f"row_sparse_pull: key {k} not initialized")
+                # "missing" means uninitialized; "error" carries the real
+                # server-side failure (e.g. out-of-range row ids)
+                raise MXNetError(
+                    f"row_sparse_pull of key {k} failed: "
+                    + ("not initialized at its home server"
+                       if resp[0] == "missing" else repr(resp)))
             from .ps import _unpack
             rows = jnp.asarray(_unpack(resp[1]))
             olist = o if isinstance(o, (list, tuple)) else [o]
             for t in olist:
                 self._fill_rows_out(t, rows, jnp.asarray(ids),
                                     self._store[k].shape)
+
+    # -- server-side optimizer installation ---------------------------------
+    def set_optimizer(self, optimizer: "opt_mod.Optimizer"):
+        super().set_optimizer(optimizer)
+        self._updater_installed_barrier()
+
+    def set_updater(self, updater):
+        super().set_updater(updater)
+        self._updater_installed_barrier()
+
+    def _updater_installed_barrier(self):
+        """dist_async: no rank may push before EVERY home server has its
+        updater installed. Without this, rank 0 can init a key and push
+        while the home process has not yet executed set_optimizer — the
+        push is then applied with assignment semantics instead of the
+        server-side optimizer, silently corrupting server state. The
+        reference ships the optimizer to every server before training
+        (kvstore_dist_server.h:155 CommandHandle/set optimizer); here the
+        installation is local to each process's server thread, so a
+        cross-process barrier after it gives the same ordering guarantee:
+        any rank that returns from set_optimizer/set_updater (and can
+        therefore push) knows every home already has its updater.
+
+        CONTRACT: under dist_async EVERY rank must call
+        set_optimizer/set_updater (the symmetric pattern Module/Trainer
+        use) — each process hosts a server thread, so each needs its own
+        updater anyway. The handshake rides the coordinator KV with a
+        TIMEOUT, so an asymmetric call fails loudly after 120s naming the
+        missing rank instead of deadlocking a collective forever."""
+        if self._ps_client is None or jax.process_count() <= 1:
+            return
+        from .ps import coordinator_kv
+        client = coordinator_kv()
+        if client is None:
+            return
+        # gen advances ONLY on success, and publication is idempotent per
+        # gen — so a rank that caught a timeout and retries re-runs the SAME
+        # generation instead of desyncing one ahead of everyone forever
+        gen = getattr(self, "_updater_gen", 0) + 1
+        published = getattr(self, "_updater_pub", None)
+        if published is None:
+            published = self._updater_pub = set()
+        if gen not in published:
+            client.key_value_set(f"mxtpu_ps_updater/{gen}/{self.rank}", "1")
+            published.add(gen)
+        for r in range(self.num_workers):
+            try:
+                client.blocking_key_value_get(
+                    f"mxtpu_ps_updater/{gen}/{r}", 120_000)
+            except Exception as e:
+                raise MXNetError(
+                    f"dist_async set_optimizer/set_updater must run on "
+                    f"EVERY rank (each process hosts a server needing its "
+                    f"updater); rank {r} did not install call #{gen} "
+                    f"within 120s") from e
+        self._updater_gen = gen
 
     # -- sync collective path ------------------------------------------------
     def _proc_mesh(self):

@@ -32,6 +32,12 @@ from ..base import MXNetError
 
 _HDR = struct.Struct("<Q")
 
+# a duplicate's server-side wait for the in-flight original MUST stay under
+# the client's recv timeout, or the waiter's reply can never reach a live
+# client and a fresh-seq re-push double-applies
+_CLIENT_RECV_TIMEOUT = 600.0
+_INFLIGHT_WAIT = _CLIENT_RECV_TIMEOUT - 10.0
+
 
 def _send_msg(sock: socket.socket, obj) -> None:
     data = pickle.dumps(obj, protocol=4)
@@ -75,6 +81,13 @@ class PSServer:
       ("pull_rows", key, ids)    -> ("ok", payload)  gathered rows only
       ("has", key)               -> ("ok",) | ("missing",)
 
+    Requests may arrive wrapped in an exactly-once envelope
+    ("req", client_id, seq, inner): the server remembers the last (seq,
+    response) per client and REPLAYS the response for a duplicate seq
+    instead of re-applying it — so a client retry after a lost reply cannot
+    apply the same gradient twice (the ps-lite message-seq dedupe,
+    reference ps-lite van.cc resender).
+
     Locking is PER KEY (plus a registry guard): arrival order is preserved
     for each key — the reference server's per-key consistency contract —
     while pushes/pulls of different keys proceed concurrently even when an
@@ -86,6 +99,8 @@ class PSServer:
         self._store: Dict = {}
         self._guard = threading.Lock()
         self._key_locks: Dict = {}
+        # exactly-once dedupe: client_id -> (last seq, cached response)
+        self._dedup: Dict[str, tuple] = {}
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("", 0))
@@ -109,7 +124,15 @@ class PSServer:
         try:
             while True:
                 msg = _recv_msg(conn)
-                _send_msg(conn, self._handle(msg))
+                try:
+                    # _handle does NO socket I/O, so ANY exception here is a
+                    # handler failure (including OSError from a user updater
+                    # touching the filesystem) and must reach the CLIENT as
+                    # an error reply — never kill the connection replyless
+                    resp = self._handle(msg)
+                except Exception as e:  # noqa: BLE001 - surface to client
+                    resp = ("error", f"{type(e).__name__}: {e}"[:500])
+                _send_msg(conn, resp)
         except (ConnectionError, OSError):
             pass
         finally:
@@ -123,6 +146,69 @@ class PSServer:
             return lock
 
     def _handle(self, msg):
+        if msg[0] == "req":
+            # exactly-once envelope: dedupe MUTATING ops by (client, seq) —
+            # a retry whose original was applied (or is STILL APPLYING) gets
+            # the original's reply, never a second application. The in-flight
+            # marker (an Event) closes the check-then-act window where a
+            # retry races a slow original: the retry waits for the original
+            # to finish instead of re-running the updater. Idempotent ops
+            # (pull/has/pull_rows) just re-execute.
+            _, cid, seq, inner = msg
+            if inner[0] in ("push", "init"):
+                with self._guard:
+                    last = self._dedup.get(cid)
+                    if last is not None and last[0] == seq:
+                        pending = last[1]
+                    elif last is not None and last[0] > seq:
+                        # a duplicate older than the newest cached entry is
+                        # unreachable through PSClient (the per-home lock
+                        # serializes retries before any newer send); never
+                        # fabricate success for an unknown outcome
+                        return ("error", "superseded duplicate seq")
+                    else:
+                        pending = None
+                        self._dedup[cid] = (seq, threading.Event())
+                if pending is not None:
+                    if isinstance(pending, threading.Event):
+                        # just under the client's recv timeout, so the
+                        # waiter's reply still reaches a live client; an
+                        # updater slower than client patience (two full
+                        # attempts) is out of contract and surfaces as an
+                        # error below rather than hanging forever
+                        pending.wait(timeout=_INFLIGHT_WAIT)
+                        with self._guard:
+                            last = self._dedup.get(cid)
+                            if last is not None and last[0] == seq and \
+                                    not isinstance(last[1], threading.Event):
+                                return last[1]
+                        # never fabricate success: the original did not
+                        # complete, so the client must see a failure
+                        return ("error", "in-flight duplicate never completed")
+                    return pending
+                resp = err = None
+                try:
+                    resp = self._handle(inner)
+                except Exception as e:  # noqa: BLE001 - cache then re-raise
+                    err = f"{type(e).__name__}: {e}"[:500]
+                    raise
+                finally:
+                    # ALWAYS release waiters — an updater exception must not
+                    # leave the Event unset (a retry would block the full
+                    # in-flight wait and report a lost gradient as applied). Cache the REAL
+                    # error text so a retry replays the diagnosable message.
+                    # Replace only our own entry: a slow original must not
+                    # clobber a newer request's cache with its older seq.
+                    with self._guard:
+                        cur = self._dedup.get(cid)
+                        if cur is not None and cur[0] == seq:
+                            final = resp if resp is not None else \
+                                ("error", err or "apply raised at the server")
+                            self._dedup[cid] = (seq, final)
+                            if isinstance(cur[1], threading.Event):
+                                cur[1].set()
+                return resp
+            return self._handle(inner)
         op, key = msg[0], msg[1]
         if op == "init":
             with self._key_lock(key):
@@ -185,10 +271,13 @@ class PSClient:
     """Per-process client: one persistent connection per home rank."""
 
     def __init__(self, addr_of: Callable[[int], str]):
+        import uuid
         self._addr_of = addr_of
         self._conns: Dict[int, socket.socket] = {}
         self._locks: Dict[int, threading.Lock] = {}
         self._guard = threading.Lock()
+        self._id = uuid.uuid4().hex
+        self._seq = 0
 
     def _conn(self, home: int):
         with self._guard:
@@ -198,6 +287,13 @@ class PSClient:
     def request(self, home: int, msg, retries: int = 1):
         lock = self._conn(home)
         with lock:
+            # one seq per LOGICAL request (assigned before the retry loop):
+            # a resend after a dropped connection carries the same seq, so
+            # the server replays instead of re-applying a mutating op
+            with self._guard:
+                self._seq += 1
+                seq = self._seq
+            wire = ("req", self._id, seq, msg)
             for attempt in range(retries + 1):
                 sock = self._conns.get(home)
                 try:
@@ -205,10 +301,16 @@ class PSClient:
                         host, port = self._addr_of(home).rsplit(":", 1)
                         sock = socket.create_connection((host, int(port)),
                                                         timeout=120)
+                        # recv timeout must EXCEED the server's in-flight
+                        # duplicate wait (see _INFLIGHT_WAIT), or a slow but
+                        # successful push times out client-side and a fresh
+                        # seq re-push double-applies — the exact failure
+                        # dedupe prevents
+                        sock.settimeout(_CLIENT_RECV_TIMEOUT)
                         sock.setsockopt(socket.IPPROTO_TCP,
                                         socket.TCP_NODELAY, 1)
                         self._conns[home] = sock
-                    _send_msg(sock, msg)
+                    _send_msg(sock, wire)
                     return _recv_msg(sock)
                 except (ConnectionError, OSError):
                     self._conns.pop(home, None)
@@ -222,6 +324,12 @@ class PSClient:
             resp = self.request(home, msg)
             if resp[0] == "ok":
                 return resp
+            if resp[0] == "error":
+                # a server-side failure is terminal — don't spin on it for
+                # the whole timeout and then misreport 'never initialized'
+                raise MXNetError(
+                    f"dist_async: server error for key {key!r} at rank "
+                    f"{home}: {resp[1] if len(resp) > 1 else resp}")
             if time.monotonic() > deadline:
                 raise MXNetError(
                     f"dist_async: key {key!r} never initialized at its home "

@@ -126,7 +126,26 @@ o2 = nd.zeros((3,))
 kv.pull("u", out=o2)
 np.testing.assert_allclose(o2.asnumpy(), np.full((3,), 1.5))
 
-# 3) row_sparse_pull fetches ONLY the requested rows from the home server
+# 3) set_updater is a cross-process installation barrier (advisor r3
+# medium): "v" homes at rank 0; rank 0 delays its set_updater while rank 1
+# installs and pushes IMMEDIATELY. Without the barrier rank 0's server
+# would still hold the old 0.5x updater when the push arrives (0.5, not
+# 2.0); with it, no rank returns from set_updater until every home has
+# the new updater installed.
+kv.init("v", nd.zeros((2,)))
+def upd2(key, merged, stored):
+    stored._set_data(stored._data + 2.0 * merged._data)
+if rank == 0:
+    time.sleep(1.0)
+kv.set_updater(upd2)
+if rank == 1:
+    kv.push("v", nd.ones((2,)))
+kv.barrier()
+o3 = nd.zeros((2,))
+kv.pull("v", out=o3)
+np.testing.assert_allclose(o3.asnumpy(), np.full((2,), 2.0))
+
+# 4) row_sparse_pull fetches ONLY the requested rows from the home server
 kv.init("emb", nd.array(np.arange(12, dtype=np.float32).reshape(6, 2)))
 rows = nd.zeros((2, 2))
 kv.row_sparse_pull("emb", out=rows,

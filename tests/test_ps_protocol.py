@@ -1,0 +1,193 @@
+"""Parameter-server protocol correctness (advisor r3 findings).
+
+Exercises PSServer/PSClient directly over localhost TCP — no
+jax.distributed coordinator needed — pinning:
+
+1. exactly-once pushes: a retry after a lost reply (same envelope seq)
+   REPLAYS the cached response instead of re-applying the gradient
+   (the ps-lite message-seq dedupe, reference ps-lite van.cc resender);
+2. idempotent ops re-execute (a pull after new pushes sees fresh state
+   even with a reused envelope path);
+3. SymbolBlock executor cache is ctx-keyed (advisor low finding 3).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.kvstore.ps import PSServer, PSClient, _pack, _unpack
+
+
+def _server_with_sgd(lr=0.5):
+    state = {"updater_calls": 0}
+
+    def updater(key, grad, stored):
+        state["updater_calls"] += 1
+        stored -= lr * grad
+
+    srv = PSServer(lambda: updater)
+    return srv, state
+
+
+def _client_for(srv):
+    return PSClient(lambda rank: f"127.0.0.1:{srv.port}")
+
+
+def test_push_applied_once_per_seq():
+    srv, state = _server_with_sgd()
+    try:
+        cli = _client_for(srv)
+        assert cli.request(0, ("init", "w", _pack(np.ones(4, np.float32))))[0] == "ok"
+        # normal push: w <- 1 - 0.5*2 = 0
+        assert cli.request(0, ("push", "w", _pack(np.full(4, 2.0, np.float32))))[0] == "ok"
+        push_seq = cli._seq
+        got = _unpack(cli.request(0, ("pull", "w"))[1])
+        np.testing.assert_allclose(got, 0.0)
+        assert state["updater_calls"] == 1
+        # duplicate delivery of the SAME envelope (retry after lost reply):
+        # server must replay, not re-apply
+        dup = ("req", cli._id, push_seq, ("push", "w",
+                                          _pack(np.full(4, 2.0, np.float32))))
+        resp = srv._handle(dup)
+        assert resp[0] == "ok"
+        assert state["updater_calls"] == 1, "duplicate push was re-applied"
+        got = _unpack(cli.request(0, ("pull", "w"))[1])
+        np.testing.assert_allclose(got, 0.0)
+    finally:
+        srv.close()
+
+
+def test_fresh_seq_applies_again_and_pulls_reexecute():
+    srv, state = _server_with_sgd()
+    try:
+        cli = _client_for(srv)
+        cli.request(0, ("init", "w", _pack(np.ones(4, np.float32))))
+        cli.request(0, ("push", "w", _pack(np.full(4, 2.0, np.float32))))
+        cli.request(0, ("push", "w", _pack(np.full(4, 2.0, np.float32))))
+        assert state["updater_calls"] == 2
+        got = _unpack(cli.request(0, ("pull", "w"))[1])
+        np.testing.assert_allclose(got, -1.0)  # 1 - 0.5*2 - 0.5*2
+    finally:
+        srv.close()
+
+
+def test_duplicate_init_is_idempotent_anyway():
+    """init is first-wins by design; the envelope dedupe also covers it."""
+    srv, _ = _server_with_sgd()
+    try:
+        cli = _client_for(srv)
+        cli.request(0, ("init", "w", _pack(np.zeros(2, np.float32))))
+        dup = ("req", cli._id, cli._seq, ("init", "w",
+                                         _pack(np.ones(2, np.float32))))
+        assert srv._handle(dup)[0] == "ok"
+        got = _unpack(cli.request(0, ("pull", "w"))[1])
+        np.testing.assert_allclose(got, 0.0)
+    finally:
+        srv.close()
+
+
+def test_retry_racing_slow_original_applies_once():
+    """The in-flight marker: a retry arriving while the ORIGINAL push is
+    still inside the updater must wait for it and replay its response —
+    not run the updater a second time."""
+    gate = threading.Event()
+    calls = {"n": 0}
+
+    def slow_updater(key, grad, stored):
+        calls["n"] += 1
+        gate.wait(5)  # simulate a long jit compile inside the updater
+        stored -= grad
+
+    srv = PSServer(lambda: slow_updater)
+    try:
+        cli = _client_for(srv)
+        cli.request(0, ("init", "w", _pack(np.ones(2, np.float32))))
+        push = ("push", "w", _pack(np.ones(2, np.float32)))
+        seq = cli._seq + 1
+        env = ("req", cli._id, seq, push)
+        results = []
+
+        def original():
+            results.append(srv._handle(env))
+
+        t1 = threading.Thread(target=original)
+        t1.start()
+        time.sleep(0.1)          # original is now blocked inside updater
+        t2 = threading.Thread(target=original)  # the "retry"
+        t2.start()
+        time.sleep(0.1)
+        gate.set()
+        t1.join(10)
+        t2.join(10)
+        assert [r[0] for r in results] == ["ok", "ok"]
+        assert calls["n"] == 1, "retry re-ran the updater"
+        got = _unpack(srv._handle(("pull", "w"))[1])
+        np.testing.assert_allclose(got, 0.0)
+    finally:
+        srv.close()
+
+
+def test_updater_exception_releases_waiters_with_error():
+    """An updater that raises must not leave the in-flight Event unset: the
+    duplicate must get an ERROR (never a fabricated ok for a lost update)."""
+    def bad_updater(key, grad, stored):
+        raise RuntimeError("boom")
+
+    srv = PSServer(lambda: bad_updater)
+    try:
+        cli = _client_for(srv)
+        cli.request(0, ("init", "w", _pack(np.ones(2, np.float32))))
+        env = ("req", cli._id, cli._seq + 1,
+               ("push", "w", _pack(np.ones(2, np.float32))))
+        with pytest.raises(RuntimeError):
+            srv._handle(env)
+        resp = srv._handle(env)  # the retry
+        assert resp[0] == "error", resp
+    finally:
+        srv.close()
+
+
+def test_concurrent_clients_unique_seq_streams():
+    """Two clients pushing concurrently: every push applies exactly once."""
+    srv, state = _server_with_sgd(lr=1.0)
+    try:
+        clients = [_client_for(srv) for _ in range(2)]
+        clients[0].request(0, ("init", "w", _pack(np.zeros(1, np.float32))))
+
+        def work(cli):
+            for _ in range(10):
+                cli.request(0, ("push", "w", _pack(np.full(1, -1.0, np.float32))))
+
+        ts = [threading.Thread(target=work, args=(c,)) for c in clients]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert state["updater_calls"] == 20
+        got = _unpack(clients[0].request(0, ("pull", "w"))[1])
+        np.testing.assert_allclose(got, 20.0)  # w -= 1.0 * (-1) twenty times
+    finally:
+        srv.close()
+
+
+def test_symbolblock_executor_cache_is_ctx_keyed():
+    """advisor r3 low finding: _exec_cache must key on ctx so a later call
+    on another device binds its own executor rather than reusing the first
+    ctx's binding."""
+    from mxnet_tpu import sym
+    from mxnet_tpu.gluon import SymbolBlock
+
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    out = sym.FullyConnected(data, w, num_hidden=3, no_bias=True)
+    blk = SymbolBlock(out, [data], None)
+    blk._arg_params = {"w": nd.ones((3, 4))}
+    blk._param_objs = None
+    x = nd.ones((2, 4), ctx=mx.cpu())
+    y = blk(x)
+    assert y.shape == (2, 3)
+    keys = list(blk._exec_cache.keys())
+    assert keys and isinstance(keys[0][0], str) and "cpu" in keys[0][0], keys
