@@ -8,7 +8,9 @@ entries hold the *compiled transpose* produced by `jax.vjp` at record time —
 forward runs once, backward replays XLA-compiled VJPs in reverse order.
 `grad(create_graph=True)` records the backward walk itself (re-deriving each
 op's VJP from its pure forward at the recorded primals), giving arbitrary-
-order derivatives for registered-op graphs.
+order derivatives for registered-op graphs; custom autograd.Function joins
+the walk by re-running its user backward under recording (r4), so
+double-backward flows through it when the backward uses framework ops.
 """
 from __future__ import annotations
 
@@ -46,10 +48,10 @@ class Node:
 
 class TapeEntry:
     __slots__ = ("vjp_fn", "in_nodes", "out_nodes", "out_is_tuple", "out_avals",
-                 "refn", "in_raws")
+                 "refn", "in_raws", "recordable_bwd")
 
     def __init__(self, vjp_fn, in_nodes, out_nodes, out_is_tuple, out_avals,
-                 refn=None, in_raws=None):
+                 refn=None, in_raws=None, recordable_bwd=None):
         self.vjp_fn = vjp_fn
         self.in_nodes = in_nodes    # list[Node|None] aligned with op inputs
         self.out_nodes = out_nodes  # list[Node] aligned with op outputs
@@ -61,6 +63,10 @@ class TapeEntry:
         # to re-derive the backward from `refn` at the recorded primals)
         self.refn = refn
         self.in_raws = in_raws
+        # custom autograd.Function path: a callable running the USER's
+        # backward through the NDArray layer (no pause) so a create_graph
+        # walk can record it and differentiate the returned grads again
+        self.recordable_bwd = recordable_bwd
 
 
 # ---------------------------------------------------------------------------
@@ -141,10 +147,13 @@ def _participates(arr) -> bool:
     return getattr(arr, "_ag_node", None) is not None
 
 
-def record_op(vjp_fn, inputs, outputs, out_is_tuple: bool, refn=None):
+def record_op(vjp_fn, inputs, outputs, out_is_tuple: bool, refn=None,
+              recordable_bwd=None):
     """Called by the NDArray dispatch layer after a recorded forward.
     `refn`, when given, is the pure raw-array forward used to re-derive the
-    backward under create_graph (higher-order autograd)."""
+    backward under create_graph (higher-order autograd). `recordable_bwd`
+    is the custom-Function alternative: the user's explicit backward run
+    through the recording NDArray layer (see Function.__call__)."""
     in_nodes = [getattr(x, "_ag_node", None) for x in inputs]
     out_nodes = []
     for o in outputs:
@@ -158,7 +167,8 @@ def record_op(vjp_fn, inputs, outputs, out_is_tuple: bool, refn=None):
     in_raws = [getattr(x, "_data", x) for x in inputs] if refn is not None \
         else None
     _STATE.tape.append(TapeEntry(vjp_fn, in_nodes, out_nodes, out_is_tuple,
-                                 avals, refn=refn, in_raws=in_raws))
+                                 avals, refn=refn, in_raws=in_raws,
+                                 recordable_bwd=recordable_bwd))
 
 
 def _zeros_like_raw(arr):
@@ -324,11 +334,25 @@ def _run_backward_create_graph(heads, head_grads) -> Dict[Node, Any]:
             if not any_out:
                 continue
             if entry.refn is None:
+                if entry.recordable_bwd is not None:
+                    # custom autograd.Function (reference imperative.cc:280
+                    # differentiates through Function backward nodes): run
+                    # the USER's backward with recording ON — its NDArray
+                    # ops land on the tape, so the returned grads are
+                    # themselves differentiable w.r.t. the original inputs
+                    # (requires the backward to be written with framework
+                    # ops, the same contract torch double-backward has)
+                    cot = tuple(outs_g) if entry.out_is_tuple else outs_g[0]
+                    in_gs = entry.recordable_bwd(cot)
+                    for node, g_nd in zip(entry.in_nodes, in_gs):
+                        if node is not None:
+                            add_grad(node, g_nd)
+                    continue
                 raise MXNetError(
                     "create_graph=True: an op on the path has no "
                     "re-differentiable form (hybridized-block forwards, "
-                    "custom autograd.Function, Custom ops); run the net "
-                    "un-hybridized / restructure with registered ops")
+                    "Custom ops); run the net un-hybridized / restructure "
+                    "with registered ops")
             refn = entry.refn
             n_in = len(entry.in_raws)
             out_is_tuple = entry.out_is_tuple
@@ -409,7 +433,21 @@ class Function:
                     in_grads = (in_grads,)
                 return tuple(g._data if hasattr(g, "_data") else g for g in in_grads)
 
-            record_op(vjp_fn, list(inputs), list(outs_t), out_is_tuple=not single)
+            def recordable_bwd(cotangents):
+                cots = cotangents if isinstance(cotangents, tuple) \
+                    else (cotangents,)
+                cot_nd = [c if isinstance(c, NDArray) else _wrap_like(c, o)
+                          for c, o in zip(cots, outs_t)]
+                in_grads = fn_self.backward(*cot_nd)
+                if not isinstance(in_grads, (list, tuple)):
+                    in_grads = (in_grads,)
+                # normalize like vjp_fn: a backward may return raw jax
+                # arrays; the create-graph walk must always see NDArrays
+                return tuple(g if isinstance(g, NDArray) else _wrap_like(g, i)
+                             for g, i in zip(in_grads, inputs))
+
+            record_op(vjp_fn, list(inputs), list(outs_t),
+                      out_is_tuple=not single, recordable_bwd=recordable_bwd)
         return outs
 
 

@@ -177,7 +177,10 @@ def test_grad_create_graph_through_np_and_exp():
                                 rtol=1e-5)
 
 
-def test_grad_create_graph_rejects_custom_function():
+def test_grad_create_graph_through_custom_function():
+    # r4: create_graph now flows THROUGH Function by re-running the user's
+    # backward under recording (see tests/test_function_higher_order.py for
+    # the full matrix); the old rejection is gone
     import numpy as onp
 
     class Square(autograd.Function):
@@ -193,8 +196,12 @@ def test_grad_create_graph_rejects_custom_function():
     x.attach_grad()
     with autograd.record():
         y = Square()(x)
-        with pytest.raises(Exception, match="re-differentiable"):
-            autograd.grad(y, x, create_graph=True)
+    g = autograd.grad([y], [x], create_graph=True, retain_graph=True)[0]
+    onp.testing.assert_allclose(g.asnumpy(), [4.0])
+    with autograd.record():
+        gs = g.sum()
+    g2 = autograd.grad([gs], [x])[0]
+    onp.testing.assert_allclose(g2.asnumpy(), [2.0])
 
 
 def test_create_graph_immune_to_inplace_mutation():
