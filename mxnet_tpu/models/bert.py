@@ -23,13 +23,27 @@ __all__ = ["TransformerEncoderCell", "BertEncoder", "BertModel", "bert_base",
 
 
 class SelfAttention(HybridBlock):
-    def __init__(self, units, num_heads, dropout=0.0, use_blockwise=True, **kwargs):
+    """Q/K/V ride ONE (C -> 3C) projection by default — the shape-widening
+    fusion the reference hand-writes for GPUs in its interleaved-QKV kernels
+    (reference src/operator/contrib/transformer.cc:650-819); on TPU it turns
+    three K=768 MXU-unfriendly matmuls into one N=2304 matmul. fused_qkv=False
+    keeps the three separate projections for A/B measurement
+    (benchmark/qkv_fusion_probe.py)."""
+
+    def __init__(self, units, num_heads, dropout=0.0, use_blockwise=True,
+                 fused_qkv=True, **kwargs):
         super().__init__(**kwargs)
         assert units % num_heads == 0
         self._units = units
         self._heads = num_heads
         self._use_blockwise = use_blockwise
-        self.qkv = nn.Dense(3 * units, flatten=False, in_units=units)
+        self._fused_qkv = fused_qkv
+        if fused_qkv:
+            self.qkv = nn.Dense(3 * units, flatten=False, in_units=units)
+        else:
+            self.q_proj = nn.Dense(units, flatten=False, in_units=units)
+            self.k_proj = nn.Dense(units, flatten=False, in_units=units)
+            self.v_proj = nn.Dense(units, flatten=False, in_units=units)
         self.proj = nn.Dense(units, flatten=False, in_units=units)
         self.dropout = nn.Dropout(dropout) if dropout else None
 
@@ -38,9 +52,14 @@ class SelfAttention(HybridBlock):
         B, T, C = x.shape
         H = self._heads
         d = C // H
-        qkv = self.qkv(x)  # (B, T, 3C)
-        qkv = qkv.reshape((B, T, 3, H, d)).transpose((2, 0, 3, 1, 4))  # (3,B,H,T,d)
-        q, k, v = qkv[0], qkv[1], qkv[2]
+        if self._fused_qkv:
+            qkv = self.qkv(x)  # (B, T, 3C)
+            qkv = qkv.reshape((B, T, 3, H, d)).transpose((2, 0, 3, 1, 4))  # (3,B,H,T,d)
+            q, k, v = qkv[0], qkv[1], qkv[2]
+        else:
+            q = self.q_proj(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))
+            k = self.k_proj(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))
+            v = self.v_proj(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))
         # Length-adaptive: at short T the O(T^2) scores tensor is cheap and
         # XLA fuses the plain path onto the MXU far better than the tiled
         # flash kernel (measured on v5e, BERT-base T=512: 151k tok/s plain
@@ -89,10 +108,12 @@ class PositionwiseFFN(HybridBlock):
 class TransformerEncoderCell(HybridBlock):
     """Pre-LN encoder block."""
 
-    def __init__(self, units, hidden_size, num_heads, dropout=0.0, **kwargs):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 fused_qkv=True, **kwargs):
         super().__init__(**kwargs)
         self.ln1 = nn.LayerNorm(in_channels=units)
-        self.attn = SelfAttention(units, num_heads, dropout)
+        self.attn = SelfAttention(units, num_heads, dropout,
+                                  fused_qkv=fused_qkv)
         self.ln2 = nn.LayerNorm(in_channels=units)
         self.ffn = PositionwiseFFN(units, hidden_size, dropout)
 
@@ -104,12 +125,13 @@ class TransformerEncoderCell(HybridBlock):
 
 class BertEncoder(HybridBlock):
     def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.0,
-                 **kwargs):
+                 fused_qkv=True, **kwargs):
         super().__init__(**kwargs)
         self.layers = nn.HybridSequential()
         for _ in range(num_layers):
             self.layers.add(TransformerEncoderCell(units, hidden_size,
-                                                   num_heads, dropout))
+                                                   num_heads, dropout,
+                                                   fused_qkv=fused_qkv))
         self.ln = nn.LayerNorm(in_channels=units)
 
     def hybrid_forward(self, F, x):
@@ -121,7 +143,7 @@ class BertModel(HybridBlock):
 
     def __init__(self, vocab_size=30522, num_layers=12, units=768,
                  hidden_size=3072, num_heads=12, max_length=512,
-                 dropout=0.0, **kwargs):
+                 dropout=0.0, fused_qkv=True, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self.word_embed = nn.Embedding(vocab_size, units)
@@ -130,7 +152,7 @@ class BertModel(HybridBlock):
         self.embed_ln = nn.LayerNorm(in_channels=units)
         self.embed_drop = nn.Dropout(dropout) if dropout else None
         self.encoder = BertEncoder(num_layers, units, hidden_size, num_heads,
-                                   dropout)
+                                   dropout, fused_qkv=fused_qkv)
         self.mlm_dense = nn.Dense(units, flatten=False, activation="gelu",
                                   in_units=units)
         self.mlm_ln = nn.LayerNorm(in_channels=units)
