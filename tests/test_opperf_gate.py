@@ -1,0 +1,91 @@
+"""CI gate for the per-operator benchmark harness.
+
+Reference analog: benchmark/opperf/ (reference benchmark/opperf/opperf.py:1
+sweeps every registered op with latency tables). Two guarantees:
+
+1. The committed results table stays in sync with the op surface: it must
+   exist, cover >= 280 ops, and have no unexplained failures — so a future
+   op addition without an opperf row (or a sweep-breaking change) fails CI.
+2. A live smoke subset runs here, each op under a generous per-op latency
+   budget — a pathological lowering regression (e.g. an O(n^2) topk) blows
+   the budget and surfaces in CI rather than only in the nightly table.
+
+Budgets are deliberately loose (shared CI boxes): they catch order-of-
+magnitude blowups, not percent-level drift. Percent-level drift is what
+the committed benchmark/opperf/results/opperf_full.json diff is for.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+RESULTS = os.path.join(ROOT, "benchmark", "opperf", "results",
+                       "opperf_full.json")
+
+# Live-smoke subset: one representative per op family.
+SMOKE_OPS = [
+    "exp", "relu", "softmax",            # elementwise / activation
+    "broadcast_add", "elemwise_add",     # binary
+    "sum", "topk", "argsort",            # reduction / ordering
+    "dot", "batch_dot", "FullyConnected",  # matmul family
+    "Convolution", "Pooling", "BatchNorm", "LayerNorm",  # NN
+    "transpose", "Reshape", "Concat", "take", "one_hot",  # movement
+]
+# ms, eager CPU path incl. dispatch; ~100x the measured numbers so only
+# algorithmic blowups trip it.
+PER_OP_BUDGET_MS = 250.0
+
+
+def test_results_table_committed_and_complete():
+    assert os.path.exists(RESULTS), (
+        "benchmark/opperf/results/opperf_full.json missing — run "
+        "`python benchmark/opperf/opperf.py --full --emit` and commit")
+    with open(RESULTS) as f:
+        data = json.load(f)
+    rows = data["results"]
+    assert len(rows) >= 280, f"only {len(rows)} ops in committed table"
+    assert data["meta"]["n_ops"] == len(rows)
+    # every row has a usable forward number
+    bad = [r["op"] for r in rows if not (r["fwd_ms"] and r["fwd_ms"] > 0)]
+    assert not bad, f"rows without fwd latency: {bad[:5]}"
+    # failures must be explained (empty is the expectation)
+    assert len(data["failures"]) == 0, (
+        f"sweep failures committed: {[f['op'] for f in data['failures']]}")
+    md = RESULTS.replace(".json", ".md")
+    assert os.path.exists(md), "markdown table missing"
+
+
+def test_results_cover_bwd_for_grad_ops():
+    with open(RESULTS) as f:
+        rows = json.load(f)["results"]
+    n_bwd = sum(1 for r in rows if r["fwd_bwd_ms"])
+    assert n_bwd >= 150, f"only {n_bwd} ops have fwd+bwd timings"
+
+
+@pytest.mark.parametrize("op", SMOKE_OPS)
+def test_smoke_latency_budget(op):
+    sys.path.insert(0, os.path.join(ROOT, "benchmark", "opperf"))
+    from opperf import full_sweep
+    rows, failures = full_sweep(runs=2, ops_filter={op})
+    assert not failures, failures
+    assert rows, f"{op} not in sweep table"
+    assert rows[0]["fwd_ms"] < PER_OP_BUDGET_MS, (
+        f"{op} fwd latency {rows[0]['fwd_ms']:.1f} ms blew the "
+        f"{PER_OP_BUDGET_MS} ms budget — lowering regression?")
+
+
+def test_full_sweep_runs_in_fresh_process():
+    """The harness itself must work from a bare checkout (no test imports
+    leaked): run a 3-op sweep in a subprocess."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmark", "opperf",
+                                      "opperf.py"),
+         "--full", "--ops", "exp,dot,take"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": ""})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "3 ops measured, 0 failed" in out.stdout
