@@ -1,11 +1,19 @@
 """ONNX interop (reference python/mxnet/contrib/onnx/ — mx2onnx export +
-onnx2mx import).
+onnx2mx import, 4,209 lines across the two translator sets).
 
 Self-contained: when the `onnx` pip package is installed it is used
 directly; otherwise serialization falls back to the vendored protobuf
 subset in `onnx_proto/` (same wire format — files interchange with stock
 onnx/onnxruntime). Both `export_model` and `import_model` therefore always
 work, unlike the reference which hard-requires the pip package.
+
+Coverage: ~95 MXNet op names on the export side and ~85 ONNX op types on
+the import side (see `export_op_names()` / `import_op_names()`), enough
+for the vision model zoo (resnet/vgg/alexnet/mobilenet/squeezenet/densenet)
+to roundtrip with numerical equality — tests/test_onnx_zoo.py.
+Target opset: 11-13 semantics (Slice/Clip/Pad bounds as inputs, Reshape
+shape as input; Squeeze/Unsqueeze/ReduceSum accept either attr or input
+axes on import).
 """
 from __future__ import annotations
 
@@ -26,36 +34,434 @@ except ImportError:
         _shim.numpy_helper
 
 
-_OP_MAP = {
-    # mxnet op -> (onnx op, attr translator)
-    "FullyConnected": "Gemm",
-    "Convolution": "Conv",
-    "Activation": None,  # dispatched on act_type
-    "relu": "Relu",
-    "sigmoid": "Sigmoid",
-    "tanh": "Tanh",
-    "softmax": "Softmax",
-    "Pooling": None,     # Max/AveragePool
-    "BatchNorm": "BatchNormalization",
-    "Flatten": "Flatten",
-    "Reshape": "Reshape",
-    "Concat": "Concat",
-    "elemwise_add": "Add",
-    "broadcast_add": "Add",
-    "elemwise_mul": "Mul",
-    "broadcast_mul": "Mul",
-    "Dropout": "Dropout",
-    "LayerNorm": "LayerNormalization",
-    "Embedding": "Gather",
-    "transpose": "Transpose",
+_NP2TP = {"float32": _TP.FLOAT, "float64": _TP.DOUBLE, "float16": _TP.FLOAT16,
+          "int32": _TP.INT32, "int64": _TP.INT64, "int8": _TP.INT8,
+          "uint8": _TP.UINT8, "bool": _TP.BOOL}
+_TP2NP = {v: k for k, v in _NP2TP.items()}
+
+
+def _tp_of(np_dtype) -> int:
+    return _NP2TP.get(_np.dtype(np_dtype).name, _TP.FLOAT)
+
+
+# ===========================================================================
+# Export (mx2onnx)
+# ===========================================================================
+
+class _Exporter:
+    """Per-export state: node list, initializer list, fresh-name counter.
+    Handlers emit one or more ONNX nodes and may register constant
+    initializer inputs (opset-11 style Reshape/Slice/Clip bounds)."""
+
+    def __init__(self, dtype_elem):
+        self.nodes: List = []
+        self.initializers: List = []
+        self.elem = dtype_elem
+        self._n = 0
+
+    def fresh(self, hint: str) -> str:
+        self._n += 1
+        return f"_{hint}_{self._n}"
+
+    def const(self, hint: str, arr: _np.ndarray) -> str:
+        name = self.fresh(hint)
+        arr = _np.asarray(arr)
+        self.initializers.append(_oh.make_tensor(
+            name, _tp_of(arr.dtype), arr.shape, arr.flatten().tolist()))
+        return name
+
+    def emit(self, op: str, ins: List[str], outs: List[str], **attrs):
+        self.nodes.append(_oh.make_node(
+            op, ins, outs, name=self.fresh(op.lower()), **attrs))
+        return outs[0]
+
+
+# -- 1:1 tables --------------------------------------------------------------
+
+_UNARY_EXPORT = {
+    "relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+    "softsign": "Softsign", "softrelu": "Softplus", "exp": "Exp",
+    "log": "Log", "sqrt": "Sqrt", "abs": "Abs", "negative": "Neg",
+    "floor": "Floor", "ceil": "Ceil", "round": "Round", "sign": "Sign",
+    "sin": "Sin", "cos": "Cos", "tan": "Tan", "arcsin": "Asin",
+    "arccos": "Acos", "arctan": "Atan", "sinh": "Sinh", "cosh": "Cosh",
+    "arcsinh": "Asinh", "arccosh": "Acosh", "arctanh": "Atanh",
+    "erf": "Erf", "reciprocal": "Reciprocal", "identity": "Identity",
+    "_copy": "Identity", "Flatten": "Flatten", "shape_array": "Shape",
 }
+
+_BINARY_EXPORT = {
+    "elemwise_add": "Add", "broadcast_add": "Add",
+    "elemwise_sub": "Sub", "broadcast_sub": "Sub",
+    "elemwise_mul": "Mul", "broadcast_mul": "Mul",
+    "elemwise_div": "Div", "broadcast_div": "Div",
+    "broadcast_power": "Pow",
+    "broadcast_maximum": "Max", "broadcast_minimum": "Min",
+    "dot": "MatMul",
+}
+
+# mxnet scalar-op name -> (onnx op, scalar-side): "r" = scalar is lhs
+_SCALAR_EXPORT = {
+    "_plus_scalar": ("Add", "l"), "_minus_scalar": ("Sub", "l"),
+    "_rminus_scalar": ("Sub", "r"), "_mul_scalar": ("Mul", "l"),
+    "_div_scalar": ("Div", "l"), "_rdiv_scalar": ("Div", "r"),
+    "_power_scalar": ("Pow", "l"), "_rpower_scalar": ("Pow", "r"),
+    "_maximum_scalar": ("Max", "l"), "_minimum_scalar": ("Min", "l"),
+}
+
+# comparisons: ONNX result is bool; MXNet contract is float32 0/1
+_COMPARE_EXPORT = {
+    "broadcast_equal": "Equal", "broadcast_greater": "Greater",
+    "broadcast_lesser": "Less", "broadcast_greater_equal": "GreaterOrEqual",
+    "broadcast_lesser_equal": "LessOrEqual",
+}
+
+_LOGICAL_EXPORT = {"broadcast_logical_and": "And",
+                   "broadcast_logical_or": "Or",
+                   "broadcast_logical_xor": "Xor"}
+
+_REDUCE_EXPORT = {"sum": "ReduceSum", "mean": "ReduceMean",
+                  "max": "ReduceMax", "min": "ReduceMin",
+                  "prod": "ReduceProd"}
+
+
+def _axes_list(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (tuple, list)):
+        return [int(a) for a in axis]
+    return [int(axis)]
+
+
+def _export_node(ex: _Exporter, op_name: str, p: Dict, ins: List[str],
+                 out: str):
+    """Translate one mxnet graph node into ONNX node(s). Raises MXNetError
+    for unsupported ops (reference mx2onnx raises AttributeError alike)."""
+    if op_name in _UNARY_EXPORT:
+        return ex.emit(_UNARY_EXPORT[op_name], ins, [out])
+    if op_name in _BINARY_EXPORT:
+        return ex.emit(_BINARY_EXPORT[op_name], ins, [out])
+    if op_name == "add_n":
+        return ex.emit("Sum", ins, [out])
+
+    if op_name in _SCALAR_EXPORT:
+        onnx_op, side = _SCALAR_EXPORT[op_name]
+        c = ex.const("scalar", _np.float32(p.get("scalar", 0.0)))
+        pair = [c, ins[0]] if side == "r" else [ins[0], c]
+        return ex.emit(onnx_op, pair, [out])
+
+    if op_name in _COMPARE_EXPORT:
+        b = ex.emit(_COMPARE_EXPORT[op_name], ins, [ex.fresh("cmp")])
+        return ex.emit("Cast", [b], [out], to=_TP.FLOAT)
+    if op_name == "broadcast_not_equal":
+        e = ex.emit("Equal", ins, [ex.fresh("eq")])
+        n = ex.emit("Not", [e], [ex.fresh("ne")])
+        return ex.emit("Cast", [n], [out], to=_TP.FLOAT)
+    if op_name in _LOGICAL_EXPORT:
+        bs = [ex.emit("Cast", [i], [ex.fresh("b")], to=_TP.BOOL) for i in ins]
+        r = ex.emit(_LOGICAL_EXPORT[op_name], bs, [ex.fresh("lg")])
+        return ex.emit("Cast", [r], [out], to=_TP.FLOAT)
+    if op_name == "logical_not":
+        b = ex.emit("Cast", ins, [ex.fresh("b")], to=_TP.BOOL)
+        n = ex.emit("Not", [b], [ex.fresh("nt")])
+        return ex.emit("Cast", [n], [out], to=_TP.FLOAT)
+
+    if op_name in _REDUCE_EXPORT:
+        onnx_op = _REDUCE_EXPORT[op_name]
+        attrs = {"keepdims": int(bool(p.get("keepdims", False)))}
+        axes = _axes_list(p.get("axis"))
+        if onnx_op == "ReduceSum":
+            # opset 13 moved ReduceSum's axes to an input (the other
+            # Reduce* ops keep the attribute until opset 18)
+            rs_ins = [ins[0]]
+            if axes is not None:
+                rs_ins.append(ex.const(
+                    "axes", _np.asarray(axes, _np.int64)))
+            return ex.emit("ReduceSum", rs_ins, [out], **attrs)
+        if axes is not None:
+            attrs["axes"] = axes
+        return ex.emit(onnx_op, ins, [out], **attrs)
+    if op_name == "norm":
+        if int(p.get("ord", 2)) != 2:
+            raise MXNetError("ONNX export: norm supports ord=2 only")
+        attrs = {"keepdims": int(bool(p.get("keepdims", False)))}
+        axes = _axes_list(p.get("axis"))
+        if axes is not None:
+            attrs["axes"] = axes
+        return ex.emit("ReduceL2", ins, [out], **attrs)
+    if op_name in ("argmax", "argmin"):
+        if p.get("axis") is None:
+            raise MXNetError(f"ONNX export: {op_name} needs an explicit axis")
+        a = ex.emit("ArgMax" if op_name == "argmax" else "ArgMin", ins,
+                    [ex.fresh("arg")], axis=int(p["axis"]),
+                    keepdims=int(bool(p.get("keepdims", False))))
+        # MXNet returns float32 indices
+        return ex.emit("Cast", [a], [out], to=_TP.FLOAT)
+
+    # -- shape / movement ---------------------------------------------------
+    if op_name == "Reshape":
+        shape = p.get("shape")
+        if shape is None:
+            raise MXNetError("ONNX export: Reshape without static shape")
+        c = ex.const("shape", _np.asarray(shape, _np.int64))
+        return ex.emit("Reshape", [ins[0], c], [out])
+    if op_name == "transpose":
+        axes = p.get("axes")
+        attrs = {"perm": [int(a) for a in axes]} if axes else {}
+        return ex.emit("Transpose", ins, [out], **attrs)
+    if op_name == "expand_dims":
+        # opset 13+: Unsqueeze axes is an input, not an attribute
+        ax = ex.const("axes", _np.asarray([int(p["axis"])], _np.int64))
+        return ex.emit("Unsqueeze", [ins[0], ax], [out])
+    if op_name == "squeeze":
+        sq_ins = [ins[0]]
+        if p.get("axis") is not None:
+            sq_ins.append(ex.const(
+                "axes", _np.asarray(_axes_list(p["axis"]), _np.int64)))
+        return ex.emit("Squeeze", sq_ins, [out])
+    if op_name == "Concat":
+        return ex.emit("Concat", ins, [out], axis=int(p.get("dim", 1)))
+    if op_name == "stack":
+        axis = int(p.get("axis", 0))
+        ax = ex.const("axes", _np.asarray([axis], _np.int64))
+        us = [ex.emit("Unsqueeze", [i, ax], [ex.fresh("us")]) for i in ins]
+        return ex.emit("Concat", us, [out], axis=axis)
+    if op_name == "slice":
+        begin = list(p.get("begin", ()))
+        end = list(p.get("end", ()))
+        step = list(p.get("step") or ())
+        n = len(begin)
+        starts = [int(b) if b is not None else 0 for b in begin]
+        ends = [int(e) if e is not None else (1 << 62) for e in end]
+        steps = [int(step[i]) if i < len(step) and step[i] else 1
+                 for i in range(n)]
+        return ex.emit(
+            "Slice",
+            [ins[0], ex.const("starts", _np.asarray(starts, _np.int64)),
+             ex.const("ends", _np.asarray(ends, _np.int64)),
+             ex.const("axes", _np.arange(n, dtype=_np.int64)),
+             ex.const("steps", _np.asarray(steps, _np.int64))], [out])
+    if op_name == "slice_axis":
+        end = p.get("end")
+        return ex.emit(
+            "Slice",
+            [ins[0],
+             ex.const("starts", _np.asarray([int(p["begin"])], _np.int64)),
+             ex.const("ends", _np.asarray(
+                 [int(end) if end is not None else (1 << 62)], _np.int64)),
+             ex.const("axes", _np.asarray([int(p["axis"])], _np.int64))],
+            [out])
+    if op_name in ("SliceChannel", "split"):
+        num = int(p.get("num_outputs", 2))
+        outs = [out if i == 0 else f"{out}__{i}" for i in range(num)]
+        ex.emit("Split", ins, outs, axis=int(p.get("axis", 1)))
+        if p.get("squeeze_axis"):
+            raise MXNetError("ONNX export: SliceChannel squeeze_axis "
+                             "unsupported")
+        return outs
+    if op_name == "tile":
+        reps = p.get("reps")
+        c = ex.const("reps", _np.asarray(reps, _np.int64))
+        return ex.emit("Tile", [ins[0], c], [out])
+    if op_name == "pad":
+        pw = list(p.get("pad_width", ()))
+        n = len(pw) // 2
+        begins = [int(pw[2 * i]) for i in range(n)]
+        ends = [int(pw[2 * i + 1]) for i in range(n)]
+        mode = {"constant": "constant", "edge": "edge",
+                "reflect": "reflect"}[p.get("mode", "constant")]
+        c = ex.const("pads", _np.asarray(begins + ends, _np.int64))
+        v = ex.const("padv", _np.float32(p.get("constant_value", 0.0)))
+        return ex.emit("Pad", [ins[0], c, v], [out], mode=mode)
+    if op_name == "clip":
+        lo = ex.const("clip_min", _np.float32(p.get("a_min", -3.4e38)))
+        hi = ex.const("clip_max", _np.float32(p.get("a_max", 3.4e38)))
+        return ex.emit("Clip", [ins[0], lo, hi], [out])
+    if op_name == "Cast":
+        to = _NP2TP.get(str(p.get("dtype", "float32")), _TP.FLOAT)
+        return ex.emit("Cast", ins, [out], to=to)
+    if op_name == "where":
+        b = ex.emit("Cast", [ins[0]], [ex.fresh("cond")], to=_TP.BOOL)
+        return ex.emit("Where", [b, ins[1], ins[2]], [out])
+    if op_name == "broadcast_to":
+        shape = [int(s) if s != 0 else 1 for s in p.get("shape", ())]
+        c = ex.const("shape", _np.asarray(shape, _np.int64))
+        return ex.emit("Expand", [ins[0], c], [out])
+    if op_name == "depth_to_space":
+        return ex.emit("DepthToSpace", ins, [out],
+                       blocksize=int(p["block_size"]))
+    if op_name == "space_to_depth":
+        return ex.emit("SpaceToDepth", ins, [out],
+                       blocksize=int(p["block_size"]))
+    if op_name in ("zeros_like", "ones_like"):
+        # ConstantOfShape(Shape(x)): type-correct for any input dtype and
+        # immune to inf/nan in x (a Mul-by-0 encoding is neither)
+        shp = ex.emit("Shape", ins, [ex.fresh("shape")])
+        fill = 0.0 if op_name == "zeros_like" else 1.0
+        val = _oh.make_tensor(ex.fresh("fill"), ex.elem, [1], [fill])
+        return ex.emit("ConstantOfShape", [shp], [out], value=val)
+
+    # -- NN -----------------------------------------------------------------
+    if op_name == "Activation":
+        act = p.get("act_type", "relu")
+        m = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus", "softsign": "Softsign"}
+        if act not in m:
+            raise MXNetError(f"ONNX export: Activation {act}")
+        return ex.emit(m[act], ins, [out])
+    if op_name == "LeakyReLU":
+        act = p.get("act_type", "leaky")
+        if act == "leaky":
+            return ex.emit("LeakyRelu", ins, [out],
+                           alpha=float(p.get("slope", 0.25)))
+        if act == "elu":
+            return ex.emit("Elu", ins, [out],
+                           alpha=float(p.get("slope", 0.25)))
+        if act == "selu":
+            return ex.emit("Selu", ins, [out])
+        if act == "gelu":
+            # exact gelu via Erf: 0.5 x (1 + erf(x / sqrt(2)))
+            c = ex.const("sqrt2", _np.float32(_np.sqrt(2.0)))
+            d = ex.emit("Div", [ins[0], c], [ex.fresh("g")])
+            e = ex.emit("Erf", [d], [ex.fresh("g")])
+            one = ex.const("one", _np.float32(1.0))
+            a = ex.emit("Add", [e, one], [ex.fresh("g")])
+            m_ = ex.emit("Mul", [ins[0], a], [ex.fresh("g")])
+            half = ex.const("half", _np.float32(0.5))
+            return ex.emit("Mul", [m_, half], [out])
+        raise MXNetError(f"ONNX export: LeakyReLU {act}")
+    if op_name == "gelu":
+        return _export_node(ex, "LeakyReLU", {"act_type": "gelu"}, ins, out)
+    if op_name == "silu":
+        s = ex.emit("Sigmoid", ins, [ex.fresh("sg")])
+        return ex.emit("Mul", [ins[0], s], [out])
+    if op_name == "hard_sigmoid":
+        return ex.emit("HardSigmoid", ins, [out],
+                       alpha=float(p.get("alpha", 0.2)),
+                       beta=float(p.get("beta", 0.5)))
+    if op_name == "softmax":
+        return ex.emit("Softmax", ins, [out], axis=int(p.get("axis", -1)))
+    if op_name == "log_softmax":
+        return ex.emit("LogSoftmax", ins, [out], axis=int(p.get("axis", -1)))
+    if op_name == "FullyConnected":
+        return ex.emit("Gemm", ins, [out], transB=1)
+    if op_name == "Convolution":
+        k = tuple(p.get("kernel", ()))
+        attrs = {"kernel_shape": list(k)}
+        if p.get("stride"):
+            attrs["strides"] = [int(s) for s in p["stride"]]
+        if p.get("pad"):
+            attrs["pads"] = [int(v) for v in p["pad"]] * 2
+        if p.get("dilate"):
+            attrs["dilations"] = [int(v) for v in p["dilate"]]
+        if p.get("num_group", 1) != 1:
+            attrs["group"] = int(p["num_group"])
+        return ex.emit("Conv", ins, [out], **attrs)
+    if op_name == "Deconvolution":
+        k = tuple(p.get("kernel", ()))
+        attrs = {"kernel_shape": list(k)}
+        if p.get("stride"):
+            attrs["strides"] = [int(s) for s in p["stride"]]
+        if p.get("pad"):
+            attrs["pads"] = [int(v) for v in p["pad"]] * 2
+        if p.get("dilate"):
+            attrs["dilations"] = [int(v) for v in p["dilate"]]
+        if p.get("num_group", 1) != 1:
+            attrs["group"] = int(p["num_group"])
+        if p.get("adj"):
+            attrs["output_padding"] = [int(v) for v in p["adj"]]
+        return ex.emit("ConvTranspose", ins, [out], **attrs)
+    if op_name == "Pooling":
+        pool = p.get("pool_type", "max")
+        if p.get("global_pool"):
+            return ex.emit(
+                "GlobalMaxPool" if pool == "max" else "GlobalAveragePool",
+                ins, [out])
+        attrs = {"kernel_shape": list(p.get("kernel", (1, 1)))}
+        if p.get("stride"):
+            attrs["strides"] = [int(s) for s in p["stride"]]
+        if p.get("pad"):
+            attrs["pads"] = [int(v) for v in p["pad"]] * 2
+        if pool == "avg":
+            attrs["count_include_pad"] = \
+                int(bool(p.get("count_include_pad", True)))
+        return ex.emit("MaxPool" if pool == "max" else "AveragePool",
+                       ins, [out], **attrs)
+    if op_name == "BatchNorm":
+        return ex.emit("BatchNormalization", ins, [out],
+                       epsilon=float(p.get("eps", 1e-3)),
+                       momentum=float(p.get("momentum", 0.9)))
+    if op_name == "LayerNorm":
+        return ex.emit("LayerNormalization", ins, [out],
+                       epsilon=float(p.get("eps", 1e-5)),
+                       axis=int(p.get("axis", -1)))
+    if op_name == "InstanceNorm":
+        return ex.emit("InstanceNormalization", ins, [out],
+                       epsilon=float(p.get("eps", 1e-3)))
+    if op_name == "L2Normalization":
+        if p.get("mode", "instance") != "channel":
+            raise MXNetError("ONNX export: L2Normalization mode=channel only")
+        return ex.emit("LpNormalization", ins, [out], axis=1, p=2)
+    if op_name == "Embedding":
+        # ONNX Gather(weight, indices); mxnet Embedding(indices, weight)
+        return ex.emit("Gather", [ins[1], ins[0]], [out], axis=0)
+    if op_name == "take":
+        return ex.emit("Gather", ins, [out], axis=int(p.get("axis", 0)))
+    if op_name == "Dropout":
+        # opset 12+ takes ratio as an input, not an attribute
+        r = ex.const("ratio", _np.float32(p.get("p", 0.5)))
+        return ex.emit("Dropout", [ins[0], r], [out])
+    if op_name == "UpSampling":
+        s = int(p.get("scale", 2))
+        scales = ex.const("scales", _np.asarray([1, 1, s, s], _np.float32))
+        roi = ex.const("roi", _np.asarray([], _np.float32))
+        return ex.emit("Resize", [ins[0], roi, scales], [out],
+                       mode="nearest")
+    if op_name == "batch_dot":
+        a, b = ins
+        if p.get("transpose_a"):
+            a = ex.emit("Transpose", [a], [ex.fresh("bt")], perm=[0, 2, 1])
+        if p.get("transpose_b"):
+            b = ex.emit("Transpose", [b], [ex.fresh("bt")], perm=[0, 2, 1])
+        return ex.emit("MatMul", [a, b], [out])
+    if op_name == "topk":
+        if p.get("ret_typ", "indices") != "both":
+            raise MXNetError("ONNX export: topk needs ret_typ='both'")
+        kc = ex.const("k", _np.asarray([int(p.get("k", 1))], _np.int64))
+        outs = [out, f"{out}__1"]
+        ex.emit("TopK", [ins[0], kc], outs, axis=int(p.get("axis", -1)),
+                largest=0 if p.get("is_ascend") else 1)
+        return outs
+
+    raise MXNetError(f"ONNX export: unsupported op {op_name}")
+
+
+def export_op_names() -> List[str]:
+    """MXNet op names the exporter understands (reference mx2onnx
+    MXNetGraph.registered convert funcs)."""
+    names = (set(_UNARY_EXPORT) | set(_BINARY_EXPORT) | set(_SCALAR_EXPORT)
+             | set(_COMPARE_EXPORT) | set(_LOGICAL_EXPORT)
+             | set(_REDUCE_EXPORT))
+    names |= {
+        "add_n", "broadcast_not_equal", "logical_not", "norm", "argmax",
+        "argmin", "Reshape", "transpose", "expand_dims", "squeeze", "Concat",
+        "stack", "slice", "slice_axis", "SliceChannel", "split", "tile",
+        "pad", "clip", "Cast", "where", "broadcast_to", "depth_to_space",
+        "space_to_depth", "zeros_like", "ones_like", "Activation",
+        "LeakyReLU", "gelu", "silu", "hard_sigmoid", "softmax",
+        "log_softmax", "FullyConnected", "Convolution", "Deconvolution",
+        "Pooling", "BatchNorm", "LayerNorm", "InstanceNorm",
+        "L2Normalization", "Embedding", "take", "Dropout", "UpSampling",
+        "batch_dot", "topk",
+    }
+    return sorted(names)
 
 
 def export_model(sym, params, input_shape: List[Tuple[int, ...]],
                  input_type=_np.float32, onnx_file_path: str = "model.onnx",
                  verbose: bool = False):
     """Export a Symbol + params to ONNX (reference
-    contrib/onnx/mx2onnx/export_model.py). Requires the onnx package."""
+    contrib/onnx/mx2onnx/export_model.py export_model:31)."""
     from .. import symbol as sym_mod
     if isinstance(sym, str):
         sym = sym_mod.load(sym)
@@ -64,23 +470,20 @@ def export_model(sym, params, input_shape: List[Tuple[int, ...]],
         arg, aux = load_params(params)
         params = {**arg, **aux}
 
-    nodes, initializers, value_infos = [], [], []
-    topo = sym._topo()
-    names = {}
-    dtype_map = {_np.float32: _TP.FLOAT, _np.float64: _TP.DOUBLE,
-                 _np.int32: _TP.INT32, _np.int64: _TP.INT64}
-    elem = dtype_map.get(_np.dtype(input_type).type, _TP.FLOAT)
+    elem = _tp_of(input_type)
+    ex = _Exporter(elem)
+    value_names = {}           # id(node) -> onnx tensor name(s)
     inputs = []
     input_idx = 0
-    for node in topo:
+    for node in sym._topo():
         if node.kind == "var":
-            names[id(node)] = node.name
+            value_names[id(node)] = node.name
             if node.name in params:
                 arr = params[node.name]
                 np_arr = arr.asnumpy() if hasattr(arr, "asnumpy") else \
                     _np.asarray(arr)
-                initializers.append(_oh.make_tensor(
-                    node.name, dtype_map.get(np_arr.dtype.type, _TP.FLOAT),
+                ex.initializers.append(_oh.make_tensor(
+                    node.name, _tp_of(np_arr.dtype),
                     np_arr.shape, np_arr.flatten().tolist()))
             else:
                 shape = input_shape[input_idx] \
@@ -89,63 +492,38 @@ def export_model(sym, params, input_shape: List[Tuple[int, ...]],
                 inputs.append(_oh.make_tensor_value_info(
                     node.name, elem, list(shape) if shape else None))
             continue
-        op_name = node.op.name
-        onnx_op = _OP_MAP.get(op_name)
-        if op_name == "Activation":
-            onnx_op = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
-                       "softrelu": "Softplus"}.get(
-                           node.params.get("act_type", "relu"), "Relu")
-        elif op_name == "Pooling":
-            onnx_op = "MaxPool" if node.params.get("pool_type", "max") == "max" \
-                else "AveragePool"
-        if onnx_op is None:
-            raise MXNetError(f"ONNX export: unsupported op {op_name}")
-        out_name = node.name
-        names[id(node)] = out_name
-        in_names = [names[id(i)] for i, _ in node.inputs]
-        attrs = _attrs_for(op_name, node.params)
-        nodes.append(_oh.make_node(onnx_op, in_names, [out_name],
-                                   name=node.name, **attrs))
-    out_infos = [_oh.make_tensor_value_info(names[id(n)], elem, None)
-                 for n, _ in sym._heads]
-    graph = _oh.make_graph(nodes, "mxnet_tpu_model", inputs, out_infos,
-                           initializer=initializers)
-    model = _oh.make_model(graph, producer_name="mxnet_tpu")
+        in_names = []
+        for i, out_idx in node.inputs:
+            v = value_names[id(i)]
+            in_names.append(v[out_idx] if isinstance(v, (list, tuple)) else v)
+        res = _export_node(ex, node.op.name, node.params, in_names, node.name)
+        value_names[id(node)] = res
+
+    def _head_name(n, out_idx):
+        v = value_names[id(n)]
+        return v[out_idx] if isinstance(v, (list, tuple)) else v
+
+    out_infos = [_oh.make_tensor_value_info(_head_name(n, oi), elem, None)
+                 for n, oi in sym._heads]
+    graph = _oh.make_graph(ex.nodes, "mxnet_tpu_model", inputs, out_infos,
+                           initializer=ex.initializers)
+    # opset 17: Squeeze/Unsqueeze/ReduceSum axes and Dropout ratio are
+    # inputs (13+), GreaterOrEqual/LessOrEqual exist (12+), and
+    # LayerNormalization is official (17) — the emitted node set is
+    # conformant at exactly this version
+    if _onnx is _shim:
+        model = _oh.make_model(graph, producer_name="mxnet_tpu", opset=17)
+    else:
+        model = _oh.make_model(
+            graph, producer_name="mxnet_tpu",
+            opset_imports=[_oh.make_opsetid("", 17)])
     _onnx.save(model, onnx_file_path)
     return onnx_file_path
 
 
-def _attrs_for(op_name: str, p: Dict) -> Dict:
-    if op_name == "Convolution":
-        k = tuple(p.get("kernel", ()))
-        out = {"kernel_shape": list(k)}
-        if p.get("stride"):
-            out["strides"] = list(p["stride"])
-        if p.get("pad"):
-            out["pads"] = list(p["pad"]) * 2
-        if p.get("num_group", 1) != 1:
-            out["group"] = int(p["num_group"])
-        return out
-    if op_name == "Pooling":
-        out = {"kernel_shape": list(p.get("kernel", (1, 1)))}
-        if p.get("stride"):
-            out["strides"] = list(p["stride"])
-        if p.get("pad"):
-            out["pads"] = list(p["pad"]) * 2
-        return out
-    if op_name == "Concat":
-        return {"axis": int(p.get("dim", 1))}
-    if op_name == "softmax":
-        return {"axis": int(p.get("axis", -1))}
-    if op_name == "BatchNorm":
-        return {"epsilon": float(p.get("eps", 1e-3)),
-                "momentum": float(p.get("momentum", 0.9))}
-    if op_name == "transpose":
-        return {"perm": list(p.get("axes", ()))} if p.get("axes") else {}
-    if op_name == "FullyConnected":
-        return {"transB": 1}
-    return {}
-
+# ===========================================================================
+# Import (onnx2mx)
+# ===========================================================================
 
 def _split_pads(at, ndim):
     """ONNX pads = [d1_begin..dn_begin, d1_end..dn_end]. Returns
@@ -185,6 +563,57 @@ def _node_attrs(node) -> Dict:
     return out
 
 
+# ONNX op -> mxnet sym unary function name
+_UNARY_IMPORT = {
+    "Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+    "Softplus": "softrelu", "Softsign": "softsign", "Exp": "exp",
+    "Log": "log", "Sqrt": "sqrt", "Abs": "abs", "Neg": "negative",
+    "Floor": "floor", "Ceil": "ceil", "Round": "round", "Sign": "sign",
+    "Sin": "sin", "Cos": "cos", "Tan": "tan", "Asin": "arcsin",
+    "Acos": "arccos", "Atan": "arctan", "Sinh": "sinh", "Cosh": "cosh",
+    "Asinh": "arcsinh", "Acosh": "arccosh", "Atanh": "arctanh",
+    "Erf": "erf", "Reciprocal": "reciprocal", "Identity": "identity",
+    "Not": "logical_not",
+}
+
+_BINARY_IMPORT = {
+    "Add": "broadcast_add", "Sub": "broadcast_sub", "Mul": "broadcast_mul",
+    "Div": "broadcast_div", "Pow": "broadcast_power",
+    "Equal": "broadcast_equal", "Greater": "broadcast_greater",
+    "Less": "broadcast_lesser", "GreaterOrEqual": "broadcast_greater_equal",
+    "LessOrEqual": "broadcast_lesser_equal",
+    "And": "broadcast_logical_and", "Or": "broadcast_logical_or",
+    "Xor": "broadcast_logical_xor",
+}
+
+# n-ary elementwise folds
+_NARY_IMPORT = {"Max": "broadcast_maximum", "Min": "broadcast_minimum"}
+
+_REDUCE_IMPORT = {"ReduceSum": "sum", "ReduceMean": "mean",
+                  "ReduceMax": "max", "ReduceMin": "min",
+                  "ReduceProd": "prod"}
+
+
+def import_op_names() -> List[str]:
+    """ONNX op types the importer understands (reference onnx2mx
+    _convert_map in import_onnx.py)."""
+    names = set(_UNARY_IMPORT) | set(_BINARY_IMPORT) | set(_NARY_IMPORT) \
+        | set(_REDUCE_IMPORT)
+    names |= {
+        "Conv", "ConvTranspose", "Gemm", "MatMul", "LeakyRelu", "Elu",
+        "Selu", "PRelu", "HardSigmoid", "Gelu", "MaxPool", "AveragePool",
+        "GlobalAveragePool", "GlobalMaxPool", "BatchNormalization",
+        "LayerNormalization", "InstanceNormalization", "LpNormalization",
+        "Concat", "Sum", "Mean", "Reshape", "Flatten", "Softmax",
+        "LogSoftmax", "Transpose", "Dropout", "Gather", "Clip", "Constant",
+        "ConstantOfShape", "Range", "Squeeze", "Unsqueeze", "Slice",
+        "Split", "Tile", "Pad", "Cast", "Where", "Expand", "Shape",
+        "ArgMax", "ArgMin", "ReduceL2", "TopK", "Resize", "Upsample",
+        "DepthToSpace", "SpaceToDepth",
+    }
+    return sorted(names)
+
+
 def import_model(model_file: str):
     """ONNX -> (sym, arg_params, aux_params) (reference
     contrib/onnx/onnx2mx/import_model.py import_model:29). Covers the op set
@@ -212,6 +641,7 @@ def import_model(model_file: str):
 
     const_only = set()   # initializers consumed as shapes/axes/bounds
     tensor_used = set()  # initializers consumed as actual graph tensors
+    shape_of: Dict[str, object] = {}  # Shape-node output -> source symbol
 
     def const_of(name):
         """Compile-time constant (shape/axes inputs must be initializers).
@@ -222,12 +652,51 @@ def import_model(model_file: str):
             return params[name]
         raise MXNetError(f"ONNX import: input '{name}' must be a constant")
 
+    def axes_of(node, at, idx=1):
+        """Squeeze/Unsqueeze/ReduceSum axes: attr (opset <= 12) or
+        constant input (opset 13)."""
+        if "axes" in at:
+            return [int(a) for a in at["axes"]]
+        if len(node.input) > idx and node.input[idx]:
+            return [int(a) for a in const_of(node.input[idx]).flatten()]
+        return None
+
+    def add_const_output(node, arr):
+        pname = node.output[0]
+        params[pname] = _np.asarray(arr)
+        env[pname] = sym_mod.Variable(pname)
+
     for node in graph.node:
         ins = [env.get(i) for i in node.input]
         at = A(node)
         op = node.op_type
         out = None
-        if op == "Conv":
+        if op in _UNARY_IMPORT:
+            out = getattr(sym_mod, _UNARY_IMPORT[op])(ins[0])
+        elif op in _BINARY_IMPORT:
+            out = getattr(sym_mod, _BINARY_IMPORT[op])(ins[0], ins[1])
+        elif op in _NARY_IMPORT:
+            fn = getattr(sym_mod, _NARY_IMPORT[op])
+            out = ins[0]
+            for nxt in ins[1:]:
+                out = fn(out, nxt)
+        elif op in _REDUCE_IMPORT:
+            axes = axes_of(node, at)
+            kw = {"keepdims": bool(at.get("keepdims", 1))}
+            if axes is not None:
+                kw["axis"] = tuple(axes)
+            out = getattr(sym_mod, _REDUCE_IMPORT[op])(ins[0], **kw)
+        elif op == "ReduceL2":
+            axes = axes_of(node, at)
+            kw = {"keepdims": bool(at.get("keepdims", 1)), "ord": 2}
+            if axes is not None:
+                kw["axis"] = tuple(axes)
+            out = sym_mod.norm(ins[0], **kw)
+        elif op in ("ArgMax", "ArgMin"):
+            fn = sym_mod.argmax if op == "ArgMax" else sym_mod.argmin
+            out = fn(ins[0], axis=int(at.get("axis", 0)),
+                     keepdims=bool(at.get("keepdims", 1)))
+        elif op == "Conv":
             k = at.get("kernel_shape", (3, 3))
             no_bias = len(node.input) < 3
             w = params.get(node.input[1])
@@ -235,17 +704,38 @@ def import_model(model_file: str):
             out = sym_mod.Convolution(
                 data_in, env[node.input[1]],
                 None if no_bias else env[node.input[2]],
-                kernel=tuple(k), num_filter=int(w.shape[0]) if w is not None else 0,
+                kernel=tuple(k),
+                num_filter=int(w.shape[0]) if w is not None else 0,
                 stride=tuple(at.get("strides", (1,) * len(k))),
                 pad=sym_pad,
                 dilate=tuple(at.get("dilations", (1,) * len(k))),
                 num_group=int(at.get("group", 1)), no_bias=no_bias)
+        elif op == "ConvTranspose":
+            k = at.get("kernel_shape", (3, 3))
+            no_bias = len(node.input) < 3
+            w = params.get(node.input[1])
+            sym_pad, asym = _split_pads(at, len(k))
+            if asym is not None:
+                raise MXNetError("ONNX import: asymmetric ConvTranspose pads")
+            group = int(at.get("group", 1))
+            out = sym_mod.Deconvolution(
+                ins[0], env[node.input[1]],
+                None if no_bias else env[node.input[2]],
+                kernel=tuple(k),
+                num_filter=int(w.shape[1]) * group if w is not None else 0,
+                stride=tuple(at.get("strides", (1,) * len(k))),
+                pad=sym_pad,
+                dilate=tuple(at.get("dilations", (1,) * len(k))),
+                adj=tuple(at["output_padding"]) if at.get("output_padding")
+                else None,
+                num_group=group, no_bias=no_bias)
         elif op == "Gemm":
             w = params.get(node.input[1])
             if w is None:
                 num_hidden = 0
             else:
-                num_hidden = int(w.shape[0] if at.get("transB") else w.shape[1])
+                num_hidden = int(w.shape[0] if at.get("transB")
+                                 else w.shape[1])
             alpha = float(at.get("alpha", 1.0))
             beta = float(at.get("beta", 1.0))
             a_in = ins[0]
@@ -277,14 +767,29 @@ def import_model(model_file: str):
                     out = sym_mod.broadcast_add(
                         out, env[node.input[2]] * beta)
         elif op == "MatMul":
-            out = sym_mod.dot(ins[0], ins[1])
-        elif op in ("Relu", "Sigmoid", "Tanh", "Softplus"):
-            act = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
-                   "Softplus": "softrelu"}[op]
-            out = sym_mod.Activation(ins[0], act_type=act)
+            # ONNX MatMul is np.matmul (batched for rank > 2)
+            out = sym_mod._npi_matmul(ins[0], ins[1])
         elif op == "LeakyRelu":
             out = sym_mod.LeakyReLU(ins[0], act_type="leaky",
                                     slope=float(at.get("alpha", 0.01)))
+        elif op == "Elu":
+            out = sym_mod.LeakyReLU(ins[0], act_type="elu",
+                                    slope=float(at.get("alpha", 1.0)))
+        elif op == "Selu":
+            out = sym_mod.LeakyReLU(ins[0], act_type="selu")
+        elif op == "PRelu":
+            # where(x > 0, x, slope * x) via relu(x) + slope * min(x, 0)
+            neg = sym_mod.broadcast_minimum(ins[0],
+                                            sym_mod.zeros_like(ins[0]))
+            out = sym_mod.broadcast_add(
+                sym_mod.relu(ins[0]), sym_mod.broadcast_mul(ins[1], neg))
+        elif op == "HardSigmoid":
+            out = sym_mod.hard_sigmoid(ins[0],
+                                       alpha=float(at.get("alpha", 0.2)),
+                                       beta=float(at.get("beta", 0.5)))
+        elif op == "Gelu":
+            out = sym_mod.gelu(
+                ins[0], approximate=at.get("approximate", "none") == "tanh")
         elif op in ("MaxPool", "AveragePool"):
             k = at.get("kernel_shape", (2, 2))
             strides = tuple(at.get("strides", (1,) * len(k)))
@@ -321,6 +826,9 @@ def import_model(model_file: str):
         elif op == "GlobalAveragePool":
             out = sym_mod.Pooling(ins[0], kernel=(1, 1), pool_type="avg",
                                   global_pool=True)
+        elif op == "GlobalMaxPool":
+            out = sym_mod.Pooling(ins[0], kernel=(1, 1), pool_type="max",
+                                  global_pool=True)
         elif op == "BatchNormalization":
             out = sym_mod.BatchNorm(
                 ins[0], env[node.input[1]], env[node.input[2]],
@@ -335,16 +843,23 @@ def import_model(model_file: str):
                                     env[node.input[2]],
                                     eps=float(at.get("epsilon", 1e-5)),
                                     axis=int(at.get("axis", -1)))
+        elif op == "InstanceNormalization":
+            out = sym_mod.InstanceNorm(ins[0], env[node.input[1]],
+                                       env[node.input[2]],
+                                       eps=float(at.get("epsilon", 1e-5)))
+        elif op == "LpNormalization":
+            if int(at.get("p", 2)) != 2 or int(at.get("axis", -1)) != 1:
+                raise MXNetError("ONNX import: LpNormalization p=2 axis=1 "
+                                 "only")
+            out = sym_mod.L2Normalization(ins[0], mode="channel")
         elif op == "Concat":
             out = sym_mod.Concat(*[env[i] for i in node.input],
-                                 num_args=len(node.input),
                                  dim=int(at.get("axis", 1)))
-        elif op in ("Add", "Sub", "Mul", "Div"):
-            fn = {"Add": sym_mod.broadcast_add, "Sub": sym_mod.broadcast_sub,
-                  "Mul": sym_mod.broadcast_mul, "Div": sym_mod.broadcast_div}
-            out = fn[op](ins[0], ins[1])
         elif op == "Sum":
             out = sym_mod.add_n(*[env[i] for i in node.input])
+        elif op == "Mean":
+            out = sym_mod.add_n(*[env[i] for i in node.input]) \
+                * (1.0 / len(node.input))
         elif op == "Reshape":
             shape = const_of(node.input[1]).astype(int).tolist()
             out = sym_mod.Reshape(ins[0], shape=tuple(shape))
@@ -352,23 +867,30 @@ def import_model(model_file: str):
             out = sym_mod.Flatten(ins[0])
         elif op == "Softmax":
             out = sym_mod.softmax(ins[0], axis=int(at.get("axis", -1)))
+        elif op == "LogSoftmax":
+            out = sym_mod.log_softmax(ins[0], axis=int(at.get("axis", -1)))
         elif op == "Transpose":
             perm = at.get("perm")
             out = sym_mod.transpose(ins[0],
                                     axes=tuple(perm) if perm else None)
         elif op == "Dropout":
-            out = sym_mod.Dropout(ins[0], p=float(at.get("ratio", 0.5)))
-        elif op == "Identity":
-            out = sym_mod.identity(ins[0])
+            if len(node.input) > 1 and node.input[1]:   # opset 12+ input
+                ratio = float(const_of(node.input[1]))
+            else:
+                ratio = float(at.get("ratio", 0.5))
+            out = sym_mod.Dropout(ins[0], p=ratio)
         elif op == "Gather":
-            if int(at.get("axis", 0)) != 0:
-                raise MXNetError("ONNX import: Gather supports axis=0 only "
-                                 "(Embedding-style lookup)")
+            axis = int(at.get("axis", 0))
             w = params.get(node.input[0])
-            out = sym_mod.Embedding(
-                ins[1], env[node.input[0]],
-                input_dim=int(w.shape[0]) if w is not None else 0,
-                output_dim=int(w.shape[1]) if w is not None else 0)
+            if axis == 0 and w is not None and w.ndim == 2:
+                out = sym_mod.Embedding(
+                    ins[1], env[node.input[0]],
+                    input_dim=int(w.shape[0]), output_dim=int(w.shape[1]))
+            else:
+                # mode="wrap": ONNX Gather allows negative indices
+                # (count from the end) — wrap is exactly that for the
+                # valid [-n, n-1] range; clip would clamp -1 to 0
+                out = sym_mod.take(ins[0], ins[1], axis=axis, mode="wrap")
         elif op == "Clip":
             # opset >= 11 passes bounds as inputs; opset <= 10 as the
             # 'min'/'max' node attributes (e.g. ReLU6 exports)
@@ -378,18 +900,146 @@ def import_model(model_file: str):
                   and node.input[2] else at.get("max"))
             lo = float(lo) if lo is not None else None
             hi = float(hi) if hi is not None else None
-            out = sym_mod.clip(ins[0], a_min=lo if lo is not None else -3.4e38,
+            out = sym_mod.clip(ins[0],
+                               a_min=lo if lo is not None else -3.4e38,
                                a_max=hi if hi is not None else 3.4e38)
-        elif op in ("Exp", "Log", "Sqrt", "Abs", "Neg", "Floor", "Ceil"):
-            out = getattr(sym_mod, op.lower())(ins[0])
+        elif op == "Squeeze":
+            axes = axes_of(node, at)
+            out = sym_mod.squeeze(
+                ins[0], axis=tuple(axes) if axes is not None else None)
+        elif op == "Unsqueeze":
+            axes = axes_of(node, at)
+            if not axes:
+                raise MXNetError("ONNX import: Unsqueeze without axes")
+            out = ins[0]
+            for ax in sorted(axes):
+                out = sym_mod.expand_dims(out, axis=ax)
+        elif op == "Slice":
+            if "starts" in at:  # opset <= 9: attribute form
+                starts = [int(v) for v in at["starts"]]
+                ends = [int(v) for v in at["ends"]]
+                axes = [int(v) for v in at.get(
+                    "axes", range(len(starts)))]
+                steps = [1] * len(starts)
+            else:
+                starts = [int(v) for v in const_of(node.input[1]).flatten()]
+                ends = [int(v) for v in const_of(node.input[2]).flatten()]
+                axes = ([int(v) for v in const_of(node.input[3]).flatten()]
+                        if len(node.input) > 3 and node.input[3]
+                        else list(range(len(starts))))
+                steps = ([int(v) for v in const_of(node.input[4]).flatten()]
+                         if len(node.input) > 4 and node.input[4]
+                         else [1] * len(starts))
+            if any(s != 1 for s in steps):
+                raise MXNetError("ONNX import: Slice steps != 1 unsupported")
+            out = ins[0]
+            for ax, st, en in zip(axes, starts, ends):
+                out = sym_mod.slice_axis(
+                    out, axis=ax, begin=st,
+                    end=None if en >= (1 << 60) else en)
+        elif op == "Split":
+            axis = int(at.get("axis", 0))
+            n_out = len(node.output)
+            sizes = at.get("split")
+            if sizes is None and len(node.input) > 1 and node.input[1]:
+                sizes = [int(v) for v in const_of(node.input[1]).flatten()]
+            if sizes is None or len(set(int(s) for s in sizes)) == 1:
+                parts = sym_mod.SliceChannel(ins[0], num_outputs=n_out,
+                                             axis=axis)
+                out = list(parts) if isinstance(parts, (list, tuple)) \
+                    else [parts[i] for i in range(n_out)]
+            else:
+                out, off = [], 0
+                for s in sizes:
+                    out.append(sym_mod.slice_axis(ins[0], axis=axis,
+                                                  begin=off, end=off + int(s)))
+                    off += int(s)
+        elif op == "Tile":
+            reps = [int(v) for v in const_of(node.input[1]).flatten()]
+            out = sym_mod.tile(ins[0], reps=tuple(reps))
+        elif op == "Pad":
+            if "pads" in at:  # opset <= 10 attribute form
+                pads = [int(v) for v in at["pads"]]
+                value = float(at.get("value", 0.0))
+            else:
+                pads = [int(v) for v in const_of(node.input[1]).flatten()]
+                value = (float(const_of(node.input[2]))
+                         if len(node.input) > 2 and node.input[2] else 0.0)
+            n = len(pads) // 2
+            pw = sum(((pads[i], pads[n + i]) for i in range(n)), ())
+            mode = at.get("mode", "constant")
+            kw = {"constant_value": value} if mode == "constant" else {}
+            out = sym_mod.pad(ins[0], mode=mode, pad_width=pw, **kw)
+        elif op == "Cast":
+            to = int(at.get("to", _TP.FLOAT))
+            out = sym_mod.Cast(ins[0], dtype=_TP2NP.get(to, "float32"))
+        elif op == "Where":
+            out = sym_mod.where(ins[0], ins[1], ins[2])
+        elif op == "Expand":
+            shape = [int(v) for v in const_of(node.input[1]).flatten()]
+            out = sym_mod.broadcast_to(ins[0], shape=tuple(shape))
+        elif op == "Shape":
+            out = sym_mod.shape_array(ins[0])
+            shape_of[node.output[0]] = ins[0]
+        elif op == "TopK":
+            k = int(const_of(node.input[1]).flatten()[0]) \
+                if len(node.input) > 1 else int(at.get("k", 1))
+            out = sym_mod.topk(ins[0], k=k, axis=int(at.get("axis", -1)),
+                               ret_typ="both",
+                               is_ascend=not int(at.get("largest", 1)))
+        elif op in ("Resize", "Upsample"):
+            if op == "Resize" and len(node.input) >= 3 and node.input[2]:
+                scales = const_of(node.input[2]).flatten()
+            elif op == "Upsample" and len(node.input) >= 2 \
+                    and node.input[1]:
+                # opset-9 Upsample: scales is the 2nd input
+                scales = const_of(node.input[1]).flatten()
+            elif "scales" in at:   # opset-7 attribute form
+                scales = _np.asarray(at["scales"], _np.float32)
+            else:
+                raise MXNetError("ONNX import: Resize needs scales")
+            mode = at.get("mode", "nearest")
+            if mode != "nearest":
+                raise MXNetError("ONNX import: Resize mode=nearest only")
+            s = float(scales[2])
+            if scales[2] != scales[3] or s != int(s):
+                raise MXNetError("ONNX import: Resize needs equal integer "
+                                 "H/W scales")
+            out = sym_mod.UpSampling(ins[0], scale=int(s),
+                                     sample_type="nearest")
+        elif op == "DepthToSpace":
+            out = sym_mod.depth_to_space(ins[0],
+                                         block_size=int(at["blocksize"]))
+        elif op == "SpaceToDepth":
+            out = sym_mod.space_to_depth(ins[0],
+                                         block_size=int(at["blocksize"]))
         elif op == "Constant":
             val = at.get("value")
             # with pip onnx, get_attribute_value returns the TensorProto
             if not isinstance(val, _np.ndarray):
                 val = _to_array(val)
-            pname = node.output[0]
-            params[pname] = _np.asarray(val)
-            env[pname] = sym_mod.Variable(pname)
+            add_const_output(node, val)
+            continue
+        elif op == "ConstantOfShape":
+            val = at.get("value")
+            if val is not None and not isinstance(val, _np.ndarray):
+                val = _to_array(val)
+            fill = float(val.flatten()[0]) if val is not None else 0.0
+            src = shape_of.get(node.input[0])
+            if src is not None:
+                # dynamic shape from a Shape node: this is the exporter's
+                # zeros_like/ones_like encoding — lower back to it
+                out = sym_mod.zeros_like(src) if fill == 0.0 \
+                    else sym_mod.ones_like(src) * fill
+            else:
+                shape = [int(v) for v in const_of(node.input[0]).flatten()]
+                dt = val.dtype if val is not None else _np.float32
+                add_const_output(node, _np.full(shape, fill, dt))
+                continue
+        elif op == "Range":
+            start, limit, delta = (const_of(n).flatten()[0]
+                                   for n in node.input[:3])
+            add_const_output(node, _np.arange(start, limit, delta))
             continue
         else:
             raise MXNetError(f"ONNX import: unsupported op {op}")

@@ -408,6 +408,14 @@ def batch_dot(a, b, *, transpose_a=False, transpose_b=False):
     return jnp.matmul(a, b)
 
 
+@register("_npi_matmul")
+def _npi_matmul(a, b):
+    """np.matmul semantics: 2D dot, batched for rank > 2 with broadcast
+    (reference src/operator/numpy/np_matmul_op.cc). Rank-polymorphic —
+    the ONNX importer maps MatMul here since ONNX MatMul is batched."""
+    return jnp.matmul(a, b)
+
+
 @register("khatri_rao")
 def khatri_rao(*mats):
     out = mats[0]
