@@ -93,6 +93,31 @@ def rand_ndarray(shape, dtype="float32", ctx=None, low=-1.0, high=1.0):
     return array(a, ctx=ctx, dtype=dtype)
 
 
+def synthetic_cifar10(n=2048, seed=0, label_noise=0.08):
+    """Deterministic CIFAR-class synthetic classification set with a
+    built-in Bayes ceiling (reference tests use real CIFAR for the same
+    purpose, e.g. example/image-classification/train_cifar10.py).
+
+    Low-frequency per-class color templates (8x8 upsampled to 32x32, the
+    spatial structure a conv net needs) + strong pixel noise, and
+    `label_noise` of the labels re-rolled uniformly — so a perfectly
+    trained model tops out around 1 - 0.9*label_noise, never 1.0. That
+    headroom is what makes an int8-vs-fp32 accuracy-parity gate
+    non-vacuous: on a saturated task both read 1.0 and any quantization
+    bug passes.
+
+    Returns (x, y): float32 (n, 3, 32, 32) in [0, ~2), float32 labels.
+    """
+    rng = _np.random.RandomState(seed)
+    labs = rng.randint(0, 10, size=(n,))
+    base8 = rng.rand(10, 3, 8, 8).astype("float32")
+    base = _np.kron(base8, _np.ones((4, 4), "float32"))  # (10, 3, 32, 32)
+    x = base[labs] * 0.9 + rng.rand(n, 3, 32, 32).astype("float32") * 1.1
+    flip = rng.rand(n) < label_noise
+    labs[flip] = rng.randint(0, 10, size=int(flip.sum()))
+    return x.astype("float32"), labs.astype("float32")
+
+
 def rand_shape_2d(dim0=10, dim1=10):
     return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1))
 
