@@ -58,3 +58,22 @@ def test_rcnn_lite_both_stages_learn():
     rpn_acc, cls_acc = _load("rcnn_lite").main(["--epochs", "60"])
     assert rpn_acc > 0.7, f"RPN failed to localize: acc {rpn_acc}"
     assert cls_acc > 0.8, f"ROI head failed to classify: acc {cls_acc}"
+
+
+@pytest.mark.slow
+def test_speech_ctc_learns_alignment_free_decoding():
+    """CTC end-to-end (reference example/speech_recognition): loss through
+    the lax.scan forward algorithm, greedy decode exact-match + TER."""
+    exact, ter = _load("speech_ctc").main(["--epochs", "30"])
+    assert exact >= 0.8, f"CTC decode failed: exact-match {exact}"
+    assert ter <= 0.10, f"CTC token error rate too high: {ter}"
+
+
+@pytest.mark.slow
+def test_faster_rcnn_two_stage_training_converges():
+    """Full two-stage detection training (reference example/rcnn): anchor
+    targets, NMS'd proposals, sampled proposal targets, jointly trained
+    ROIAlign head. Gates both the RPN and the final detections."""
+    rpn_recall, f1 = _load("faster_rcnn_train").main(["--epochs", "25"])
+    assert rpn_recall >= 0.8, f"RPN failed to localize: recall {rpn_recall}"
+    assert f1 >= 0.6, f"detection head failed: F1 {f1}"
