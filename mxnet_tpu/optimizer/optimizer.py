@@ -173,7 +173,11 @@ class Updater:
             if i not in self.states:
                 self.states[i] = self.optimizer.create_state_multi_precision(i, w)
                 self.states_synced[i] = True
-            self.optimizer._update_count(i)
+            # no _update_count here: every concrete update() counts for
+            # itself (reference optimizer.py:2018 Updater likewise leaves
+            # counting to the optimizer) — counting in both places made
+            # num_update advance 2x per step through the Trainer path,
+            # so lr schedulers decayed at twice the configured rate
             self.optimizer.update_multi_precision(i, w, g, self.states[i])
 
     def get_states(self, dump_optimizer=False):

@@ -1,0 +1,258 @@
+"""Optimizer-class trajectory depth: multi-step simulation vs pure-numpy
+reference implementations.
+
+Reference analog: tests/python/unittest/test_optimizer.py (~1,700 lines —
+each optimizer class compared against a python reimplementation across
+wd/rescale/clip configurations over several steps). The op-level math is
+already pinned in test_optimizer_ops.py; THIS file pins the class-level
+contracts the ops can't see: state threading across steps, num_update
+bookkeeping, lr scheduling over a trajectory, per-parameter lr_mult/
+wd_mult, rescale_grad/clip_gradient ordering, and Trainer integration.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, gluon
+from mxnet_tpu import optimizer as opt
+
+
+def _traj(optimizer, w0, grads, **create_kw):
+    """Run a gradient trajectory through Optimizer.create_state/update."""
+    o = opt.create(optimizer, **create_kw)
+    w = nd.array(w0.copy())
+    state = o.create_state(0, w)
+    for g in grads:
+        o.update(0, w, nd.array(g), state)
+    return w.asnumpy()
+
+
+RS = np.random.RandomState(0)
+W0 = RS.uniform(-1, 1, (6,)).astype(np.float32)
+GRADS = [RS.uniform(-1, 1, (6,)).astype(np.float32) for _ in range(8)]
+
+
+def test_sgd_momentum_trajectory_vs_numpy():
+    lr, mom, wd = 0.1, 0.9, 0.01
+    w = W0.copy()
+    m = np.zeros_like(w)
+    for g in GRADS:
+        gg = g + wd * w
+        m = mom * m - lr * gg
+        w = w + m
+    got = _traj("sgd", W0, GRADS, learning_rate=lr, momentum=mom, wd=wd)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_rescale_and_clip_ordering():
+    """Reference semantics: grad = clip(rescale_grad * grad) BEFORE wd."""
+    lr, wd, rescale, clip = 0.1, 0.01, 0.5, 0.2
+    w = W0.copy()
+    for g in GRADS:
+        gg = np.clip(g * rescale, -clip, clip) + wd * w
+        w = w - lr * gg
+    got = _traj("sgd", W0, GRADS, learning_rate=lr, wd=wd,
+                rescale_grad=rescale, clip_gradient=clip)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_trajectory_vs_numpy():
+    lr, b1, b2, eps, wd = 1e-2, 0.9, 0.999, 1e-8, 0.0
+    w = W0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t, g in enumerate(GRADS, 1):
+        gg = g + wd * w
+        m = b1 * m + (1 - b1) * gg
+        v = b2 * v + (1 - b2) * gg * gg
+        lr_t = lr * math.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    got = _traj("adam", W0, GRADS, learning_rate=lr, beta1=b1, beta2=b2,
+                epsilon=eps, wd=wd)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_nag_trajectory_vs_numpy():
+    lr, mom, wd = 0.05, 0.9, 0.0
+    w = W0.copy()
+    m = np.zeros_like(w)
+    for g in GRADS:
+        gg = g + wd * w
+        m = mom * m + gg
+        w = w - lr * (gg + mom * m)
+    got = _traj("nag", W0, GRADS, learning_rate=lr, momentum=mom, wd=wd)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adagrad_trajectory_vs_numpy():
+    lr, eps = 0.1, 1e-7
+    w = W0.copy()
+    h = np.zeros_like(w)
+    for g in GRADS:
+        h = h + g * g
+        w = w - lr * g / (np.sqrt(h) + eps)
+    got = _traj("adagrad", W0, GRADS, learning_rate=lr, eps=eps, wd=0.0)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_rmsprop_centered_trajectory_vs_numpy():
+    # MXNet naming: gamma1 = decay, gamma2 = momentum
+    lr, g1, g2, eps = 0.01, 0.95, 0.9, 1e-8
+    w = W0.copy()
+    n = np.zeros_like(w)
+    gbar = np.zeros_like(w)
+    delta = np.zeros_like(w)
+    for g in GRADS:
+        n = g1 * n + (1 - g1) * g * g
+        gbar = g1 * gbar + (1 - g1) * g
+        delta = g2 * delta - lr * g / np.sqrt(n - gbar * gbar + eps)
+        w = w + delta
+    got = _traj("rmsprop", W0, GRADS, learning_rate=lr, gamma1=g1,
+                gamma2=g2, epsilon=eps, centered=True, wd=0.0)
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-5)
+
+
+def test_adadelta_trajectory_vs_numpy():
+    rho, eps = 0.9, 1e-5
+    w = W0.copy()
+    acc_g = np.zeros_like(w)
+    acc_d = np.zeros_like(w)
+    for g in GRADS:
+        acc_g = rho * acc_g + (1 - rho) * g * g
+        d = np.sqrt(acc_d + eps) / np.sqrt(acc_g + eps) * g
+        acc_d = rho * acc_d + (1 - rho) * d * d
+        w = w - d
+    got = _traj("adadelta", W0, GRADS, rho=rho, epsilon=eps, wd=0.0)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adamax_trajectory_vs_numpy():
+    lr, b1, b2 = 0.002, 0.9, 0.999
+    w = W0.copy()
+    m = np.zeros_like(w)
+    u = np.zeros_like(w)
+    for t, g in enumerate(GRADS, 1):
+        m = b1 * m + (1 - b1) * g
+        u = np.maximum(b2 * u, np.abs(g))
+        w = w - (lr / (1 - b1 ** t)) * m / (u + 1e-8)
+    got = _traj("adamax", W0, GRADS, learning_rate=lr, beta1=b1, beta2=b2,
+                wd=0.0)
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-5)
+
+
+def test_signum_trajectory_vs_numpy():
+    lr, mom, wd_lh = 0.01, 0.9, 0.0
+    w = W0.copy()
+    m = np.zeros_like(w)
+    for g in GRADS:
+        m = mom * m - (1 - mom) * g
+        w = w + lr * np.sign(m)
+    got = _traj("signum", W0, GRADS, learning_rate=lr, momentum=mom,
+                wd_lh=wd_lh, wd=0.0)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# num_update / lr scheduling over a trajectory
+# ---------------------------------------------------------------------------
+
+def test_num_update_counts_max_over_indices():
+    """Reference contract: num_update advances with the max per-index
+    update count (each index tracks its own count)."""
+    o = opt.create("sgd", learning_rate=0.1)
+    w0, w1 = nd.array([1.0]), nd.array([1.0])
+    s0, s1 = o.create_state(0, w0), o.create_state(1, w1)
+    g = nd.array([0.1])
+    o.update(0, w0, g, s0)
+    o.update(1, w1, g, s1)
+    assert o.num_update == 1
+    o.update(0, w0, g, s0)
+    assert o.num_update == 2
+
+
+def test_factor_scheduler_steps_lr_during_updates():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    sched = FactorScheduler(step=3, factor=0.5)
+    o = opt.create("sgd", learning_rate=0.8, lr_scheduler=sched)
+    w = nd.array([0.0])
+    s = o.create_state(0, w)
+    deltas = []
+    prev = 0.0
+    for _ in range(7):
+        o.update(0, w, nd.array([1.0]), s)  # dw = -lr * 1
+        cur = float(w.asnumpy()[0])
+        deltas.append(round(prev - cur, 6))
+        prev = cur
+    # lr 0.8 for first 3 updates, then 0.4 for next 3, then 0.2
+    np.testing.assert_allclose(deltas, [0.8, 0.8, 0.8, 0.4, 0.4, 0.4, 0.2],
+                               rtol=1e-5)
+
+
+def test_lr_mult_wd_mult_per_parameter():
+    o = opt.create("sgd", learning_rate=0.1, wd=0.1)
+    o.set_lr_mult({0: 0.5})
+    o.set_wd_mult({1: 0.0})
+    w0, w1 = nd.array([1.0]), nd.array([1.0])
+    s0, s1 = o.create_state(0, w0), o.create_state(1, w1)
+    g = nd.array([0.0])
+    o.update(0, w0, g, s0)   # only wd: w -= lr*0.5 * wd * w
+    o.update(1, w1, g, s1)   # wd_mult 0: unchanged
+    np.testing.assert_allclose(w0.asnumpy(), [1.0 - 0.1 * 0.5 * 0.1],
+                               rtol=1e-6)
+    np.testing.assert_allclose(w1.asnumpy(), [1.0], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Trainer integration
+# ---------------------------------------------------------------------------
+
+def test_trainer_applies_schedule_and_clip():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    net = gluon.nn.Dense(1, use_bias=False)
+    net.initialize()
+    net(nd.zeros((1, 1)))
+    net.weight.set_data(nd.array([[1.0]]))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.5, "clip_gradient": 0.1,
+                        "lr_scheduler": FactorScheduler(step=1,
+                                                        factor=0.5)})
+    x = nd.array([[1.0]])
+    w_hist = []
+    for _ in range(3):
+        with autograd.record():
+            y = net(x).sum() * 100  # huge grad, must clip to 0.1
+        y.backward()
+        tr.step(1)
+        w_hist.append(float(net.weight.data().asnumpy()))
+    # deltas: lr_t * 0.1 with lr 0.5, 0.25, 0.125
+    deltas = [1.0 - w_hist[0], w_hist[0] - w_hist[1],
+              w_hist[1] - w_hist[2]]
+    np.testing.assert_allclose(deltas, [0.05, 0.025, 0.0125], rtol=1e-5)
+
+
+def test_trainer_learning_rate_property_and_set():
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    net(nd.zeros((1, 2)))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.3})
+    assert abs(tr.learning_rate - 0.3) < 1e-9
+    tr.set_learning_rate(0.05)
+    assert abs(tr.learning_rate - 0.05) < 1e-9
+
+
+@pytest.mark.parametrize("name", ["sgd", "adam", "adamw", "nag", "adagrad",
+                                  "rmsprop", "adadelta", "adamax", "nadam",
+                                  "ftrl", "ftml", "signum", "lamb"])
+def test_every_optimizer_reduces_quadratic(name):
+    """Every optimizer must make progress on min ||w||^2 from w0=2."""
+    o = opt.create(name)
+    w = nd.array([2.0])
+    s = o.create_state(0, w)
+    for _ in range(50):
+        g = 2 * w.asnumpy()
+        o.update(0, w, nd.array(g.astype(np.float32)), s)
+    assert abs(float(w.asnumpy())) < 2.0, \
+        f"{name} made no progress: {float(w.asnumpy())}"
