@@ -247,32 +247,36 @@ def train_step(net, batch_imgs, batch_gts, anchors, trainer, rng):
     B = len(batch_imgs)
     x = nd.array(np.stack(batch_imgs))
 
-    # pass 1 (no grad): RPN outputs for proposal/target generation
-    with autograd.pause():
-        _, obj_p, reg_p = net.features_rpn(x)
-    obj_np = obj_p.asnumpy()
-    reg_np = reg_p.asnumpy()
-
-    lab_list, adelta_list, rois, roi_cls, roi_delta = [], [], [], [], []
-    for b in range(B):
-        labels, adeltas = assign_anchor_targets(anchors, batch_gts[b], rng)
-        lab_list.append(labels)
-        adelta_list.append(adeltas)
-        props = gen_proposals(anchors, obj_np[b], reg_np[b], batch_gts[b])
-        sel, cls, deltas = assign_proposal_targets(props, batch_gts[b], rng)
-        for s, c, d in zip(sel, cls, deltas):
-            rois.append([b, *props[s]])
-            roi_cls.append(c)
-            roi_delta.append(d)
-
-    labels = nd.array(np.stack(lab_list))            # (B, N_anchor)
-    adeltas = nd.array(np.stack(adelta_list))        # (B, N_anchor, 4)
-    rois_nd = nd.array(np.asarray(rois, np.float32))
-    roi_cls_nd = nd.array(np.asarray(roi_cls, np.float32))
-    roi_delta_nd = nd.array(np.stack(roi_delta))
-
     with autograd.record():
+        # ONE forward: the recorded RPN outputs are read to the host
+        # (asnumpy does not break the tape) for anchor-target and
+        # proposal generation, then the same tensors feed the losses
         feat, obj, reg = net.features_rpn(x)
+        obj_np = obj.asnumpy()
+        reg_np = reg.asnumpy()
+
+        lab_list, adelta_list = [], []
+        rois, roi_cls, roi_delta = [], [], []
+        for b in range(B):
+            labels_b, adeltas_b = assign_anchor_targets(
+                anchors, batch_gts[b], rng)
+            lab_list.append(labels_b)
+            adelta_list.append(adeltas_b)
+            props = gen_proposals(anchors, obj_np[b], reg_np[b],
+                                  batch_gts[b])
+            sel, cls, deltas = assign_proposal_targets(
+                props, batch_gts[b], rng)
+            for s, c, d in zip(sel, cls, deltas):
+                rois.append([b, *props[s]])
+                roi_cls.append(c)
+                roi_delta.append(d)
+
+        labels = nd.array(np.stack(lab_list))          # (B, N_anchor)
+        adeltas = nd.array(np.stack(adelta_list))      # (B, N_anchor, 4)
+        rois_nd = nd.array(np.asarray(rois, np.float32))
+        roi_cls_nd = nd.array(np.asarray(roi_cls, np.float32))
+        roi_delta_nd = nd.array(np.stack(roi_delta))
+
         # RPN objectness BCE over sampled anchors
         mask = labels >= 0
         tgt = nd.broadcast_maximum(labels, nd.zeros_like(labels))

@@ -156,8 +156,11 @@ def main(argv=None):
             with autograd.record():
                 logits = net(x)                       # (B, T, K+1)
                 # CTCLoss wants (T, B, C); blank is label 0 ('first')
+                # label_lengths MUST be a keyword: the nd wrapper drops
+                # positional Nones, which would shift lab_len into the
+                # data_lengths slot
                 loss = nd.CTCLoss(logits.transpose((1, 0, 2)), lab,
-                                  None, lab_len,
+                                  label_lengths=lab_len,
                                   use_label_lengths=True,
                                   blank_label="first")
                 loss = loss.mean()

@@ -57,6 +57,32 @@ def load(fname):
 
 _SPECIAL_KEY_OPS = {"Dropout"}
 
+# Derived ops for tensor-valued KEYWORD arguments (e.g.
+# nd.CTCLoss(..., label_lengths=arr)): the reference treats these as
+# tensor inputs, so they must ride the traced-input path — leaving them
+# in params would hand the op an NDArray as a static argument (unhashable
+# for the jit cache, invisible to autograd). Cached per (op, kw-names).
+_KW_TENSOR_OPS = {}
+
+
+def _kw_tensor_op(op, kw_names):
+    key = (op.name, kw_names)
+    cached = _KW_TENSOR_OPS.get(key)
+    if cached is None:
+        from ..ops.registry import Op
+        base = op.fn
+        n = len(kw_names)
+
+        def fn(*arrs, **params):
+            main, extra = arrs[:-n], arrs[-n:]
+            return base(*main, **dict(zip(kw_names, extra)), **params)
+
+        cached = Op(f"{op.name}<{','.join(kw_names)}>", fn,
+                    differentiable=op.differentiable,
+                    multi_output=op.multi_output)
+        _KW_TENSOR_OPS[key] = cached
+    return cached
+
 
 def _make_wrapper(op_name: str):
     op = get_op(op_name)
@@ -80,6 +106,13 @@ def _make_wrapper(op_name: str):
             elif len(inputs) == 1:
                 import jax.numpy as jnp
                 inputs.append(NDArray(jnp.zeros((2,), jnp.uint32)))
+        nd_kw = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
+        if nd_kw:
+            names = tuple(sorted(nd_kw))
+            for k in names:
+                kwargs.pop(k)
+            inputs.extend(nd_kw[k] for k in names)
+            return invoke(_kw_tensor_op(op, names), inputs, kwargs, out=out)
         return invoke(op, inputs, kwargs, out=out)
 
     wrapper.__name__ = op_name

@@ -68,14 +68,18 @@ def norm(x, *, ord=2, axis=None, keepdims=False):
 
 
 @register("argmax", differentiable=False)
-def argmax(x, *, axis=None, keepdims=False):
+def argmax(x, *, axis=None, keepdims=False, dtype="float32"):
+    """MXNet contract returns float32 indices — exact only below 2^24.
+    Pass dtype='int32'/'int64' for exact indices on larger axes (the
+    reference's int64-everywhere large-tensor mode)."""
     out = jnp.argmax(x, axis=axis, keepdims=keepdims)
-    return out.astype(jnp.float32)  # MXNet returns float indices
+    return out.astype(jnp.dtype(dtype))
 
 
 @register("argmin", differentiable=False)
-def argmin(x, *, axis=None, keepdims=False):
-    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.float32)
+def argmin(x, *, axis=None, keepdims=False, dtype="float32"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.dtype(dtype))
 
 
 @register("argmax_channel", differentiable=False)

@@ -333,3 +333,34 @@ def test_add_through_deep_chain():
     with autograd.record():
         f(x).backward()
     np.testing.assert_allclose(x.grad.asnumpy(), 2 * g1, rtol=1e-5)
+
+
+def test_tensor_keyword_argument_rides_input_path():
+    """nd ops must accept tensor-valued KEYWORD args as traced inputs
+    (reference treats e.g. CTCLoss label_lengths as a tensor input).
+    Regression: they previously leaked into the static-params path, so
+    the op saw an NDArray (and positional None dropped the slot)."""
+    import numpy as np
+    T, B, C, L = 6, 2, 4, 2
+    rng = np.random.RandomState(0)
+    logits = nd.array(rng.randn(T, B, C).astype(np.float32))
+    # second row: true length 1, padded with a VALID label id (2) that
+    # only explicit label_lengths can exclude
+    labels = nd.array(np.array([[1, 2], [3, 2]], np.float32))
+    lens = nd.array(np.array([2.0, 1.0], np.float32))
+    with_len = nd.CTCLoss(logits, labels, label_lengths=lens,
+                          use_label_lengths=True,
+                          blank_label="first").asnumpy()
+    ref_row1 = nd.CTCLoss(logits[:, 1:2], nd.array([[3.0]]),
+                          label_lengths=nd.array([1.0]),
+                          use_label_lengths=True,
+                          blank_label="first").asnumpy()
+    np.testing.assert_allclose(with_len[1], ref_row1[0], rtol=1e-5)
+    # and gradients flow through the tensor-kwarg op
+    logits.attach_grad()
+    with autograd.record():
+        loss = nd.CTCLoss(logits, labels, label_lengths=lens,
+                          use_label_lengths=True,
+                          blank_label="first").sum()
+    loss.backward()
+    assert float(np.abs(logits.grad.asnumpy()).sum()) > 0

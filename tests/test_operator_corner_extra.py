@@ -1,0 +1,319 @@
+"""Operator corner cases, round 4: the reference test_operator.py families
+not yet covered by the sweep or the depth suites.
+
+Covered here: Reshape special codes (0/-1/-2/-3/-4 — reference
+src/operator/tensor/matrix_op.cc InferReshapeShape), pooling variant
+matrix vs torch (ceil/include-pad/stride/global), BatchNorm train-vs-eval
+statistics semantics, sequence ops vs explicit numpy loops, sort/argsort
+order contracts at ties, broadcasting shape-error contracts, Embedding
+padding/grad edge, Activation/LeakyReLU full act_type matrix, Dropout
+train/eval mask statistics, and scatter-style setitem aliasing edges.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, gluon
+
+
+# ---------------------------------------------------------------------------
+# Reshape special codes (reference matrix_op.cc InferReshapeShape)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("in_shape,code,want", [
+    ((2, 3, 4), (-1,), (24,)),
+    ((2, 3, 4), (0, -1), (2, 12)),
+    ((2, 3, 4), (0, 0, 4), (2, 3, 4)),
+    ((2, 3, 4), (-2,), (2, 3, 4)),
+    ((2, 3, 4), (-3, 4), (6, 4)),
+    ((2, 3, 4), (2, -3), (2, 12)),
+    ((2, 3, 4), (2, -4, 1, 3, 4), (2, 1, 3, 4)),
+    ((6, 4), (-4, 2, 3, 0), (2, 3, 4)),
+    ((2, 3, 4), (4, -1), (4, 6)),
+    ((1, 1, 5), (0, 5), (1, 5)),
+], ids=lambda v: str(v))
+def test_reshape_special_codes(in_shape, code, want):
+    a = nd.array(np.arange(np.prod(in_shape), dtype=np.float32)
+                 .reshape(in_shape))
+    out = nd.Reshape(a, shape=code)
+    assert out.shape == want
+    np.testing.assert_array_equal(out.asnumpy().ravel(),
+                                  a.asnumpy().ravel())
+
+
+def test_reshape_size_mismatch_raises():
+    a = nd.zeros((2, 3))
+    with pytest.raises(Exception):
+        nd.Reshape(a, shape=(4, 4))
+
+
+# ---------------------------------------------------------------------------
+# Pooling variants vs torch
+# ---------------------------------------------------------------------------
+
+_POOL_CASES = [
+    dict(kernel=(2, 2), stride=(2, 2), pad=(0, 0), pool_type="max"),
+    dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="max"),
+    dict(kernel=(2, 2), stride=(1, 1), pad=(0, 0), pool_type="avg"),
+    dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="avg",
+         count_include_pad=True),
+    dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="avg",
+         count_include_pad=False),
+    dict(kernel=(2, 2), stride=(2, 2), pad=(0, 0), pool_type="max",
+         hw=7),  # non-divisible extent
+]
+
+
+@pytest.mark.parametrize("case", _POOL_CASES,
+                         ids=[f"pool{i}" for i in range(len(_POOL_CASES))])
+def test_pooling_matrix_vs_torch(case):
+    import torch
+    import torch.nn.functional as F
+    case = dict(case)
+    hw = case.pop("hw", 8)
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-2, 2, (2, 3, hw, hw)).astype(np.float32)
+    out = nd.Pooling(nd.array(x), **case).asnumpy()
+    t = torch.from_numpy(x)
+    if case["pool_type"] == "max":
+        want = F.max_pool2d(t, case["kernel"], case["stride"],
+                            case["pad"]).numpy()
+    else:
+        want = F.avg_pool2d(
+            t, case["kernel"], case["stride"], case["pad"],
+            count_include_pad=case.get("count_include_pad", True)).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_global_pooling_matches_mean_max():
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-1, 1, (2, 4, 5, 7)).astype(np.float32)
+    gavg = nd.Pooling(nd.array(x), kernel=(1, 1), pool_type="avg",
+                      global_pool=True).asnumpy()
+    np.testing.assert_allclose(gavg[..., 0, 0], x.mean(axis=(2, 3)),
+                               rtol=1e-5)
+    gmax = nd.Pooling(nd.array(x), kernel=(1, 1), pool_type="max",
+                      global_pool=True).asnumpy()
+    np.testing.assert_allclose(gmax[..., 0, 0], x.max(axis=(2, 3)))
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm train/eval statistics semantics
+# ---------------------------------------------------------------------------
+
+def test_batchnorm_train_uses_batch_stats_eval_uses_running():
+    bn = gluon.nn.BatchNorm(momentum=0.9)
+    bn.initialize()
+    rng = np.random.RandomState(2)
+    x = rng.uniform(1.0, 3.0, (8, 4, 2, 2)).astype(np.float32)
+    xd = nd.array(x)
+    with autograd.record(train_mode=True):
+        out_tr = bn(xd)
+    # train mode normalizes with the BATCH stats: output mean ~0, var ~1
+    o = out_tr.asnumpy()
+    np.testing.assert_allclose(o.mean(axis=(0, 2, 3)), 0.0, atol=1e-4)
+    np.testing.assert_allclose(o.var(axis=(0, 2, 3)), 1.0, atol=1e-2)
+    # running stats moved toward the batch stats from their 0/1 init
+    rm = bn.running_mean.data().asnumpy()
+    assert (rm > 0.0).all(), rm  # batch mean ~2 pulled them up
+    # eval mode uses running stats, NOT batch stats: a shifted input is
+    # not re-centered to zero
+    out_ev = bn(nd.array(x + 10.0)).asnumpy()
+    assert out_ev.mean() > 5.0
+
+
+def test_batchnorm_fix_gamma_forces_scale_one():
+    bn = gluon.nn.BatchNorm(scale=False)  # fix_gamma analogue
+    bn.initialize()
+    x = nd.array(np.random.RandomState(3).randn(4, 2, 3, 3)
+                 .astype(np.float32))
+    with autograd.record(train_mode=True):
+        bn(x)
+    np.testing.assert_allclose(bn.gamma.data().asnumpy(), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Sequence ops vs explicit loops (reference sequence_*.cc)
+# ---------------------------------------------------------------------------
+
+def test_sequence_mask_lengths():
+    # data (T, B, C)
+    rng = np.random.RandomState(4)
+    x = rng.uniform(-1, 1, (5, 3, 2)).astype(np.float32)
+    lens = np.array([2, 5, 3], np.float32)
+    out = nd.SequenceMask(nd.array(x), nd.array(lens),
+                          use_sequence_length=True, value=-7.0).asnumpy()
+    want = x.copy()
+    for b, L in enumerate(lens.astype(int)):
+        want[L:, b, :] = -7.0
+    np.testing.assert_allclose(out, want)
+
+
+def test_sequence_last_lengths():
+    rng = np.random.RandomState(5)
+    x = rng.uniform(-1, 1, (6, 2, 3)).astype(np.float32)
+    lens = np.array([4, 6], np.float32)
+    out = nd.SequenceLast(nd.array(x), nd.array(lens),
+                          use_sequence_length=True).asnumpy()
+    want = np.stack([x[3, 0], x[5, 1]])
+    np.testing.assert_allclose(out, want)
+
+
+def test_sequence_reverse_lengths():
+    rng = np.random.RandomState(6)
+    x = rng.uniform(-1, 1, (4, 2, 2)).astype(np.float32)
+    lens = np.array([3, 4], np.float32)
+    out = nd.SequenceReverse(nd.array(x), nd.array(lens),
+                             use_sequence_length=True).asnumpy()
+    want = x.copy()
+    for b, L in enumerate(lens.astype(int)):
+        want[:L, b] = x[:L, b][::-1]
+    np.testing.assert_allclose(out, want)
+
+
+# ---------------------------------------------------------------------------
+# sort / argsort contracts
+# ---------------------------------------------------------------------------
+
+def test_sort_descending_and_axis():
+    rng = np.random.RandomState(7)
+    x = rng.uniform(-5, 5, (3, 6)).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.sort(nd.array(x), axis=1, is_ascend=False).asnumpy(),
+        -np.sort(-x, axis=1))
+    np.testing.assert_allclose(
+        nd.sort(nd.array(x), axis=0, is_ascend=True).asnumpy(),
+        np.sort(x, axis=0))
+
+
+def test_argsort_is_stable_at_ties():
+    x = np.array([1.0, 0.5, 1.0, 0.5, 1.0], np.float32)
+    idx = nd.argsort(nd.array(x), is_ascend=True).asnumpy().astype(int)
+    # stable: equal keys keep original order
+    np.testing.assert_array_equal(idx, [1, 3, 0, 2, 4])
+
+
+def test_topk_ret_typ_matrix():
+    x = nd.array(np.array([[3.0, 1.0, 2.0]], np.float32))
+    v = nd.topk(x, k=2, ret_typ="value", axis=-1).asnumpy()
+    np.testing.assert_allclose(v, [[3.0, 2.0]])
+    i = nd.topk(x, k=2, ret_typ="indices", axis=-1).asnumpy()
+    np.testing.assert_allclose(i, [[0.0, 2.0]])
+    both = nd.topk(x, k=1, ret_typ="both", axis=-1)
+    np.testing.assert_allclose(both[0].asnumpy(), [[3.0]])
+    np.testing.assert_allclose(both[1].asnumpy(), [[0.0]])
+
+
+# ---------------------------------------------------------------------------
+# broadcasting error contracts
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sa,sb", [((2, 3), (2, 4)), ((3,), (4,)),
+                                   ((2, 3, 4), (2, 2, 4))],
+                         ids=lambda v: str(v))
+def test_incompatible_broadcast_raises(sa, sb):
+    a, b = nd.zeros(sa), nd.zeros(sb)
+    with pytest.raises(Exception):
+        nd.broadcast_add(a, b).asnumpy()
+
+
+def test_broadcast_against_scalar_shapes():
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    one = nd.array(np.array([2.0], np.float32))
+    np.testing.assert_allclose(
+        nd.broadcast_mul(a, one).asnumpy(), a.asnumpy() * 2)
+    col = nd.array(np.array([[1.0], [2.0]], np.float32))
+    np.testing.assert_allclose(
+        nd.broadcast_add(a, col).asnumpy(), a.asnumpy() + [[1.0], [2.0]])
+
+
+# ---------------------------------------------------------------------------
+# Activation / LeakyReLU matrix vs closed forms
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("act,fn", [
+    ("relu", lambda x: np.maximum(x, 0)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("tanh", np.tanh),
+    ("softrelu", lambda x: np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0)),
+    ("softsign", lambda x: x / (1 + np.abs(x))),
+])
+def test_activation_matrix(act, fn):
+    x = np.linspace(-3, 3, 13, dtype=np.float32)
+    out = nd.Activation(nd.array(x), act_type=act).asnumpy()
+    np.testing.assert_allclose(out, fn(x), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("act,ref", [
+    ("leaky", lambda x, s: np.where(x > 0, x, s * x)),
+    ("elu", lambda x, s: np.where(x > 0, x, s * np.expm1(x))),
+    ("selu", lambda x, s: 1.0507009873554805 * np.where(
+        x > 0, x, 1.6732632423543772 * np.expm1(x))),
+])
+def test_leaky_family_matrix(act, ref):
+    x = np.linspace(-2, 2, 9, dtype=np.float32)
+    out = nd.LeakyReLU(nd.array(x), act_type=act, slope=0.3).asnumpy()
+    np.testing.assert_allclose(out, ref(x, 0.3), rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Dropout semantics
+# ---------------------------------------------------------------------------
+
+def test_dropout_eval_identity_train_scales():
+    x = nd.array(np.full((200, 50), 2.0, np.float32))
+    # eval: identity
+    np.testing.assert_allclose(nd.Dropout(x, p=0.5).asnumpy(),
+                               x.asnumpy())
+    # train: inverted dropout — surviving values scaled by 1/(1-p),
+    # zero fraction ~p
+    mx.random.seed(0)
+    with autograd.record(train_mode=True):
+        out = nd.Dropout(x, p=0.5)
+    o = out.asnumpy()
+    zero_frac = (o == 0).mean()
+    assert 0.4 < zero_frac < 0.6, zero_frac
+    surv = o[o != 0]
+    np.testing.assert_allclose(surv, 4.0, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# setitem / aliasing edges
+# ---------------------------------------------------------------------------
+
+def test_setitem_slice_then_read_back():
+    a = nd.zeros((4, 4))
+    a[1:3, 1:3] = 5.0
+    want = np.zeros((4, 4), np.float32)
+    want[1:3, 1:3] = 5.0
+    np.testing.assert_array_equal(a.asnumpy(), want)
+
+
+def test_setitem_from_own_slice():
+    a = nd.array(np.arange(6, dtype=np.float32))
+    a[0:3] = a[3:6]
+    np.testing.assert_array_equal(a.asnumpy(), [3, 4, 5, 3, 4, 5])
+
+
+def test_setitem_advanced_rows():
+    a = nd.array(np.zeros((4, 2), np.float32))
+    a[nd.array(np.array([0, 2], np.int32), dtype="int32")] = 1.0
+    np.testing.assert_array_equal(a.asnumpy(),
+                                  [[1, 1], [0, 0], [1, 1], [0, 0]])
+
+
+# ---------------------------------------------------------------------------
+# Embedding edge semantics
+# ---------------------------------------------------------------------------
+
+def test_embedding_grad_accumulates_duplicate_indices():
+    w = nd.array(np.zeros((5, 2), np.float32) + 1.0)
+    w.attach_grad()
+    idx = nd.array(np.array([1, 1, 3], np.float32))
+    with autograd.record():
+        out = nd.Embedding(idx, w, input_dim=5, output_dim=2).sum()
+    out.backward()
+    g = w.grad.asnumpy()
+    np.testing.assert_allclose(g[1], [2.0, 2.0])   # duplicate row summed
+    np.testing.assert_allclose(g[3], [1.0, 1.0])
+    np.testing.assert_allclose(g[0], [0.0, 0.0])
