@@ -195,8 +195,14 @@ def _export_node(ex: _Exporter, op_name: str, p: Dict, ins: List[str],
         a = ex.emit("ArgMax" if op_name == "argmax" else "ArgMin", ins,
                     [ex.fresh("arg")], axis=int(p["axis"]),
                     keepdims=int(bool(p.get("keepdims", False))))
-        # MXNet returns float32 indices
-        return ex.emit("Cast", [a], [out], to=_TP.FLOAT)
+        # honor the op's dtype: float32 is the MXNet default contract,
+        # int32/int64 is the exact-indices mode — casting that to float
+        # would reintroduce the 2^24 rounding the override exists to avoid
+        dt = str(p.get("dtype", "float32"))
+        if dt == "int64":
+            return ex.emit("Identity", [a], [out])  # ArgMax is int64
+        return ex.emit("Cast", [a], [out],
+                       to=_NP2TP.get(dt, _TP.FLOAT))
 
     # -- shape / movement ---------------------------------------------------
     if op_name == "Reshape":
