@@ -1,0 +1,146 @@
+"""Noise-contrastive estimation language model (reference
+example/nce-loss/ — wordvec.py/lstm_word.py train word embeddings with
+NCE instead of a full-vocab softmax).
+
+Hermetic synthetic corpus: a first-order Markov chain over a V-word
+vocabulary with a sparse, structured transition table — the model must
+learn which ~8 successors each word allows. The skip-gram-style net
+embeds the context word and scores candidates against an output
+embedding; NCE reduces the V-way softmax to K+1 binary
+discriminations against noise samples drawn from the unigram
+distribution (the reference's sampling strategy). Evaluation computes
+full-softmax perplexity on held-out text and next-word top-1 accuracy —
+so the NCE-trained scores must globally rank the true successors first,
+not just win their local noise contests.
+
+Run: python examples/nce_language_model.py [--epochs N]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd, gluon  # noqa: E402
+
+V = 200          # vocabulary
+SUCC = 8         # successors per word
+DIM = 32         # embedding dim
+K = 16           # noise samples per positive
+
+
+def make_chain(rng):
+    """Transition table: each word allows SUCC successors with random
+    (but fixed) probabilities."""
+    succ = np.stack([rng.choice(V, SUCC, replace=False) for _ in range(V)])
+    probs = rng.dirichlet(np.ones(SUCC), size=V).astype(np.float32)
+    return succ, probs
+
+
+def sample_text(succ, probs, n, rng):
+    words = np.zeros(n, np.int64)
+    w = rng.randint(V)
+    for i in range(n):
+        words[i] = w
+        j = rng.choice(SUCC, p=probs[w])
+        w = succ[w, j]
+    return words
+
+
+class NCEModel(gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.in_embed = gluon.nn.Embedding(V, DIM)
+            self.out_embed = gluon.nn.Embedding(V, DIM)
+            self.out_bias = gluon.nn.Embedding(V, 1)
+
+    def hybrid_forward(self, F, ctx_words, cand_words):
+        """Scores s(ctx, cand) for (B,) contexts x (B, C) candidates."""
+        h = self.in_embed(ctx_words)                    # (B, D)
+        w = self.out_embed(cand_words)                  # (B, C, D)
+        b = self.out_bias(cand_words).reshape((0, -1))  # (B, C)
+        return (w * h.expand_dims(axis=1)).sum(axis=-1) + b
+
+    def full_scores(self, ctx_words):
+        h = self.in_embed(ctx_words)                    # (B, D)
+        all_w = self.out_embed.weight.data()            # (V, D)
+        all_b = self.out_bias.weight.data().reshape((-1,))
+        return nd.dot(h, all_w.T) + all_b               # (B, V)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=12)
+    ap.add_argument("--n-train", type=int, default=20000)
+    ap.add_argument("--n-test", type=int, default=2000)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=5e-2)
+    args = ap.parse_args(argv)
+
+    rng = np.random.RandomState(0)
+    succ, probs = make_chain(rng)
+    train = sample_text(succ, probs, args.n_train + 1, rng)
+    test = sample_text(succ, probs, args.n_test + 1, rng)
+    ctx_tr, nxt_tr = train[:-1], train[1:]
+    ctx_te, nxt_te = test[:-1], test[1:]
+
+    # unigram noise distribution from the training text (reference
+    # wordvec.py builds the sampler the same way)
+    unigram = np.bincount(nxt_tr, minlength=V).astype(np.float64)
+    unigram = (unigram + 1) / (unigram.sum() + V)
+
+    mx.random.seed(0)
+    net = NCEModel()
+    net.initialize()
+    net(nd.zeros((2,), dtype="int32"), nd.zeros((2, K + 1), dtype="int32"))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    bs = args.batch_size
+    logq = np.log(unigram).astype(np.float32)
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(ctx_tr))
+        tot = 0.0
+        for i in range(0, len(ctx_tr) - bs + 1, bs):
+            idx = perm[i:i + bs]
+            noise = rng.choice(V, size=(bs, K), p=unigram)
+            cands = np.concatenate([nxt_tr[idx][:, None], noise], axis=1)
+            # NCE targets: column 0 true, rest noise; correct scores by
+            # log(K * q(w)) so the optimum is the true conditional
+            correction = logq[cands] + np.log(K)
+            y = np.zeros((bs, K + 1), np.float32)
+            y[:, 0] = 1.0
+            with autograd.record():
+                s = net(nd.array(ctx_tr[idx], dtype="int32"),
+                        nd.array(cands, dtype="int32"))
+                logit = s - nd.array(correction)
+                p = nd.sigmoid(logit)
+                loss = -(nd.array(y) * nd.log(p + 1e-7) +
+                         (1 - nd.array(y)) * nd.log(1 - p + 1e-7)).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        if epoch % 4 == 0 or epoch == args.epochs - 1:
+            print(f"epoch {epoch}: nce loss {tot / (len(ctx_tr) // bs):.4f}")
+
+    # full-softmax evaluation: perplexity + top-1 next-word accuracy
+    scores = net.full_scores(nd.array(ctx_te, dtype="int32")).asnumpy()
+    scores = scores - scores.max(axis=1, keepdims=True)
+    logz = np.log(np.exp(scores).sum(axis=1))
+    ll = scores[np.arange(len(nxt_te)), nxt_te] - logz
+    ppl = float(np.exp(-ll.mean()))
+    top1 = float((scores.argmax(axis=1) == nxt_te).mean())
+    # chance: ppl ~V=200, top1 ~1/200; learnable floor: ~SUCC successors
+    print(f"test perplexity {ppl:.2f} (chance {V}), top-1 acc {top1:.3f}")
+    return ppl, top1
+
+
+if __name__ == "__main__":
+    main()

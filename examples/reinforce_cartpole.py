@@ -1,0 +1,134 @@
+"""Policy-gradient reinforcement learning, REINFORCE (reference
+example/reinforcement-learning/ — a2c/ddpg/parallel_actor_critic).
+
+Hermetic: a self-contained CartPole-class environment (pole-on-cart
+physics integrated with explicit Euler, same dynamics constants as the
+classic control task) so no gym dependency. The agent is a 2-layer MLP
+policy trained with REINFORCE + a moving-average baseline: sample
+episodes, compute discounted returns, maximize sum(log pi(a|s) * (G - b)).
+Exercises the stack end to end: sampling from a categorical produced by
+the net, autograd through log-softmax over trajectories, and optimizer
+updates from a score-function estimator.
+
+Run: python examples/reinforce_cartpole.py [--episodes N]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd, gluon  # noqa: E402
+
+
+class CartPole:
+    """Classic control dynamics (Barto-Sutton-Anderson constants)."""
+
+    GRAV, MC, MP, LEN, F, DT = 9.8, 1.0, 0.1, 0.5, 10.0, 0.02
+    THETA_LIM = 12 * np.pi / 180
+    X_LIM = 2.4
+
+    def __init__(self, rng):
+        self.rng = rng
+        self.reset()
+
+    def reset(self):
+        self.s = self.rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        return self.s.copy()
+
+    def step(self, action):
+        x, xd, th, thd = self.s
+        f = self.F if action == 1 else -self.F
+        mt = self.MC + self.MP
+        pml = self.MP * self.LEN
+        ct, st = np.cos(th), np.sin(th)
+        tmp = (f + pml * thd * thd * st) / mt
+        tha = (self.GRAV * st - ct * tmp) / (
+            self.LEN * (4.0 / 3.0 - self.MP * ct * ct / mt))
+        xa = tmp - pml * tha * ct / mt
+        x, xd = x + self.DT * xd, xd + self.DT * xa
+        th, thd = th + self.DT * thd, thd + self.DT * tha
+        self.s = np.array([x, xd, th, thd], np.float32)
+        done = bool(abs(x) > self.X_LIM or abs(th) > self.THETA_LIM)
+        return self.s.copy(), 1.0, done
+
+
+def run_episode(env, net, rng, max_steps=200):
+    states, actions, rewards = [], [], []
+    s = env.reset()
+    for _ in range(max_steps):
+        logits = net(nd.array(s[None])).asnumpy()[0]
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        a = int(rng.choice(2, p=p))
+        states.append(s)
+        actions.append(a)
+        s, r, done = env.step(a)
+        rewards.append(r)
+        if done:
+            break
+    return np.asarray(states, np.float32), np.asarray(actions), rewards
+
+
+def discounted_returns(rewards, gamma=0.99):
+    out = np.zeros(len(rewards), np.float32)
+    g = 0.0
+    for t in reversed(range(len(rewards))):
+        g = rewards[t] + gamma * g
+        out[t] = g
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=300)
+    ap.add_argument("--lr", type=float, default=1e-2)
+    ap.add_argument("--batch-episodes", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(2))
+    net.initialize()
+    net(nd.zeros((1, 4)))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    env = CartPole(rng)
+    baseline = 0.0
+    lengths = []
+    for ep in range(0, args.episodes, args.batch_episodes):
+        batch = [run_episode(env, net, rng)
+                 for _ in range(args.batch_episodes)]
+        lengths.extend(len(b[2]) for b in batch)
+        all_s = np.concatenate([b[0] for b in batch])
+        all_a = np.concatenate([b[1] for b in batch])
+        all_g = np.concatenate([discounted_returns(b[2]) for b in batch])
+        baseline = 0.9 * baseline + 0.1 * all_g.mean()
+        adv = (all_g - baseline).astype(np.float32)
+        adv = adv / (np.abs(adv).max() + 1e-6)
+        with autograd.record():
+            logp = nd.log_softmax(net(nd.array(all_s)), axis=-1)
+            chosen = nd.pick(logp, nd.array(all_a.astype(np.float32)),
+                             axis=-1)
+            loss = -(chosen * nd.array(adv)).sum() / len(batch)
+        loss.backward()
+        trainer.step(1)
+        if ep % 50 == 0:
+            recent = np.mean(lengths[-20:])
+            print(f"episode {ep}: mean length (last 20) {recent:.1f}")
+
+    final = float(np.mean(lengths[-20:]))
+    print(f"final mean episode length (last 20): {final:.1f}")
+    return final
+
+
+if __name__ == "__main__":
+    main()

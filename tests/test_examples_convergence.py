@@ -77,3 +77,20 @@ def test_faster_rcnn_two_stage_training_converges():
     rpn_recall, f1 = _load("faster_rcnn_train").main(["--epochs", "25"])
     assert rpn_recall >= 0.8, f"RPN failed to localize: recall {rpn_recall}"
     assert f1 >= 0.6, f"detection head failed: F1 {f1}"
+
+
+@pytest.mark.slow
+def test_nce_language_model_beats_chance_by_an_order():
+    """NCE-trained scores must rank globally (full-softmax perplexity on
+    held-out text), not just win local noise contests."""
+    ppl, top1 = _load("nce_language_model").main(["--epochs", "12"])
+    assert ppl <= 20.0, f"NCE LM perplexity {ppl} (chance 200)"
+    assert top1 >= 0.10, f"NCE LM top-1 {top1} (chance 0.005)"
+
+
+@pytest.mark.slow
+def test_reinforce_cartpole_improves_policy():
+    """Score-function gradients through sampled trajectories must
+    lengthen episodes well past the untrained ~20 steps."""
+    final = _load("reinforce_cartpole").main(["--episodes", "300"])
+    assert final >= 55.0, f"REINFORCE did not improve: {final}"
