@@ -7,7 +7,7 @@ subset in `onnx_proto/` (same wire format — files interchange with stock
 onnx/onnxruntime). Both `export_model` and `import_model` therefore always
 work, unlike the reference which hard-requires the pip package.
 
-Coverage: ~95 MXNet op names on the export side and ~85 ONNX op types on
+Coverage: 113 MXNet op names on the export side and 99 ONNX op types on
 the import side (see `export_op_names()` / `import_op_names()`), enough
 for the vision model zoo (resnet/vgg/alexnet/mobilenet/squeezenet/densenet)
 to roundtrip with numerical equality — tests/test_onnx_zoo.py.
@@ -57,6 +57,10 @@ class _Exporter:
         self.nodes: List = []
         self.initializers: List = []
         self.elem = dtype_elem
+        # tensor name -> TensorProto dtype, for outputs that are NOT the
+        # graph element type (int argmax indices, Shape results) so the
+        # graph's value_infos declare the true type
+        self.value_dtypes: Dict[str, int] = {}
         self._n = 0
 
     def fresh(self, hint: str) -> str:
@@ -87,7 +91,7 @@ _UNARY_EXPORT = {
     "arccos": "Acos", "arctan": "Atan", "sinh": "Sinh", "cosh": "Cosh",
     "arcsinh": "Asinh", "arccosh": "Acosh", "arctanh": "Atanh",
     "erf": "Erf", "reciprocal": "Reciprocal", "identity": "Identity",
-    "_copy": "Identity", "Flatten": "Flatten", "shape_array": "Shape",
+    "_copy": "Identity", "Flatten": "Flatten",
 }
 
 _BINARY_EXPORT = {
@@ -199,10 +203,10 @@ def _export_node(ex: _Exporter, op_name: str, p: Dict, ins: List[str],
         # int32/int64 is the exact-indices mode — casting that to float
         # would reintroduce the 2^24 rounding the override exists to avoid
         dt = str(p.get("dtype", "float32"))
-        if dt == "int64":
-            return ex.emit("Identity", [a], [out])  # ArgMax is int64
-        return ex.emit("Cast", [a], [out],
-                       to=_NP2TP.get(dt, _TP.FLOAT))
+        tp = _NP2TP.get(dt, _TP.FLOAT)
+        if tp != ex.elem:
+            ex.value_dtypes[out] = tp
+        return ex.emit("Cast", [a], [out], to=tp)
 
     # -- shape / movement ---------------------------------------------------
     if op_name == "Reshape":
@@ -430,11 +434,15 @@ def _export_node(ex: _Exporter, op_name: str, p: Dict, ins: List[str],
         if p.get("transpose_b"):
             b = ex.emit("Transpose", [b], [ex.fresh("bt")], perm=[0, 2, 1])
         return ex.emit("MatMul", [a, b], [out])
+    if op_name == "shape_array":
+        ex.value_dtypes[out] = _TP.INT64
+        return ex.emit("Shape", ins, [out])
     if op_name == "topk":
         if p.get("ret_typ", "indices") != "both":
             raise MXNetError("ONNX export: topk needs ret_typ='both'")
         kc = ex.const("k", _np.asarray([int(p.get("k", 1))], _np.int64))
         outs = [out, f"{out}__1"]
+        ex.value_dtypes[outs[1]] = _TP.INT64  # TopK indices are int64
         ex.emit("TopK", [ins[0], kc], outs, axis=int(p.get("axis", -1)),
                 largest=0 if p.get("is_ascend") else 1)
         return outs
@@ -509,8 +517,11 @@ def export_model(sym, params, input_shape: List[Tuple[int, ...]],
         v = value_names[id(n)]
         return v[out_idx] if isinstance(v, (list, tuple)) else v
 
-    out_infos = [_oh.make_tensor_value_info(_head_name(n, oi), elem, None)
-                 for n, oi in sym._heads]
+    out_infos = [
+        _oh.make_tensor_value_info(
+            _head_name(n, oi),
+            ex.value_dtypes.get(_head_name(n, oi), elem), None)
+        for n, oi in sym._heads]
     graph = _oh.make_graph(ex.nodes, "mxnet_tpu_model", inputs, out_infos,
                            initializer=ex.initializers)
     # opset 17: Squeeze/Unsqueeze/ReduceSum axes and Dropout ratio are
@@ -700,8 +711,11 @@ def import_model(model_file: str):
             out = sym_mod.norm(ins[0], **kw)
         elif op in ("ArgMax", "ArgMin"):
             fn = sym_mod.argmax if op == "ArgMax" else sym_mod.argmin
+            # ONNX ArgMax returns int64 — import with exact int indices
+            # (int32 under the x32 policy); an exporter-appended Cast
+            # restores the MXNet float contract on roundtrip
             out = fn(ins[0], axis=int(at.get("axis", 0)),
-                     keepdims=bool(at.get("keepdims", 1)))
+                     keepdims=bool(at.get("keepdims", 1)), dtype="int32")
         elif op == "Conv":
             k = at.get("kernel_shape", (3, 3))
             no_bias = len(node.input) < 3
