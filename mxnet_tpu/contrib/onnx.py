@@ -461,7 +461,8 @@ def export_op_names() -> List[str]:
         "argmin", "Reshape", "transpose", "expand_dims", "squeeze", "Concat",
         "stack", "slice", "slice_axis", "SliceChannel", "split", "tile",
         "pad", "clip", "Cast", "where", "broadcast_to", "depth_to_space",
-        "space_to_depth", "zeros_like", "ones_like", "Activation",
+        "space_to_depth", "zeros_like", "ones_like", "shape_array",
+        "Activation",
         "LeakyReLU", "gelu", "silu", "hard_sigmoid", "softmax",
         "log_softmax", "FullyConnected", "Convolution", "Deconvolution",
         "Pooling", "BatchNorm", "LayerNorm", "InstanceNorm",

@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import numpy as _np
 
+from .... import random as _mxrand
 from ....ndarray import NDArray, array
 from ...block import Block, HybridBlock
 from ...nn import Sequential
@@ -102,13 +103,13 @@ class RandomResizedCrop(Block):
         h, w = a.shape[:2]
         area = h * w
         for _ in range(10):
-            target_area = _np.random.uniform(*self._scale) * area
-            ar = _np.exp(_np.random.uniform(_np.log(self._ratio[0]), _np.log(self._ratio[1])))
+            target_area = _mxrand.host_rng().uniform(*self._scale) * area
+            ar = _np.exp(_mxrand.host_rng().uniform(_np.log(self._ratio[0]), _np.log(self._ratio[1])))
             nw = int(round(_np.sqrt(target_area * ar)))
             nh = int(round(_np.sqrt(target_area / ar)))
             if nw <= w and nh <= h:
-                x0 = _np.random.randint(0, w - nw + 1)
-                y0 = _np.random.randint(0, h - nh + 1)
+                x0 = _mxrand.host_rng().randint(0, w - nw + 1)
+                y0 = _mxrand.host_rng().randint(0, h - nh + 1)
                 crop = a[y0:y0 + nh, x0:x0 + nw]
                 return _resize_np(crop, self._size)
         return _resize_np(a, self._size)
@@ -127,15 +128,15 @@ class RandomCrop(Block):
             a = _np.pad(a, ((p, p), (p, p), (0, 0)), mode="constant")
         h, w = a.shape[:2]
         ow, oh = self._size
-        y0 = _np.random.randint(0, max(h - oh, 0) + 1)
-        x0 = _np.random.randint(0, max(w - ow, 0) + 1)
+        y0 = _mxrand.host_rng().randint(0, max(h - oh, 0) + 1)
+        x0 = _mxrand.host_rng().randint(0, max(w - ow, 0) + 1)
         return a[y0:y0 + oh, x0:x0 + ow]
 
 
 class RandomFlipLeftRight(Block):
     def forward(self, x):
         a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
-        if _np.random.rand() < 0.5:
+        if _mxrand.host_rng().rand() < 0.5:
             a = a[:, ::-1].copy()
         return a
 
@@ -143,7 +144,7 @@ class RandomFlipLeftRight(Block):
 class RandomFlipTopBottom(Block):
     def forward(self, x):
         a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
-        if _np.random.rand() < 0.5:
+        if _mxrand.host_rng().rand() < 0.5:
             a = a[::-1].copy()
         return a
 
@@ -155,7 +156,7 @@ class RandomBrightness(Block):
 
     def forward(self, x):
         a = _np.asarray(x, dtype=_np.float32)
-        f = 1.0 + _np.random.uniform(-self._b, self._b)
+        f = 1.0 + _mxrand.host_rng().uniform(-self._b, self._b)
         return _np.clip(a * f, 0, 255)
 
 
@@ -166,7 +167,7 @@ class RandomContrast(Block):
 
     def forward(self, x):
         a = _np.asarray(x, dtype=_np.float32)
-        f = 1.0 + _np.random.uniform(-self._c, self._c)
+        f = 1.0 + _mxrand.host_rng().uniform(-self._c, self._c)
         mean = a.mean()
         return _np.clip((a - mean) * f + mean, 0, 255)
 
@@ -178,7 +179,7 @@ class RandomSaturation(Block):
 
     def forward(self, x):
         a = _np.asarray(x, dtype=_np.float32)
-        f = 1.0 + _np.random.uniform(-self._s, self._s)
+        f = 1.0 + _mxrand.host_rng().uniform(-self._s, self._s)
         gray = a.mean(axis=-1, keepdims=True)
         return _np.clip(gray + (a - gray) * f, 0, 255)
 
@@ -212,7 +213,7 @@ class RandomHue(Block):
 
     def forward(self, x):
         a = _np.asarray(x, dtype=_np.float32)
-        f = _np.random.uniform(-self._h, self._h)
+        f = _mxrand.host_rng().uniform(-self._h, self._h)
         theta = f * _np.pi
         u, w = _np.cos(theta), _np.sin(theta)
         t_yiq = _np.array([[0.299, 0.587, 0.114],
@@ -243,7 +244,7 @@ class RandomLighting(Block):
 
     def forward(self, x):
         a = _np.asarray(x, dtype=_np.float32)
-        alpha = _np.random.normal(0, self._alpha, 3).astype(_np.float32)
+        alpha = _mxrand.host_rng().normal(0, self._alpha, 3).astype(_np.float32)
         shift = self._EIGVEC @ (alpha * self._EIGVAL)
         return _np.clip(a + shift, 0, 255)
 

@@ -10,6 +10,7 @@ import threading
 
 import jax
 import jax.numpy as jnp
+import numpy as _numpy
 
 _lock = threading.Lock()
 # Created lazily on first use: importing mxnet_tpu must not initialize any
@@ -42,11 +43,49 @@ def pop_trace_key():
     _trace_keys.pop()
 
 
+# Host-side pipeline RNG: the gluon vision transforms run as numpy on
+# DataLoader worker THREADS, so a single shared RandomState would race
+# (numpy RandomState is not thread-safe — same reason io.py keeps one per
+# worker thread). Each thread lazily gets its own RandomState derived
+# from (seed, thread-order-index): fully deterministic single-threaded,
+# per-thread-deterministic under num_workers>0 (cross-thread work
+# assignment is scheduling-dependent there, as in the reference).
+_host_state = {"seed": None, "epoch": 0, "next_idx": 0}
+_host_tls = threading.local()
+
+
+def host_rng() -> "_numpy.random.RandomState":
+    st = _host_state
+    if getattr(_host_tls, "epoch", None) != st["epoch"]:
+        with _lock:
+            idx = st["next_idx"]
+            st["next_idx"] += 1
+        base = st["seed"]
+        if base is None:
+            _host_tls.rng = _numpy.random.RandomState()
+        else:
+            _host_tls.rng = _numpy.random.RandomState(
+                (int(base) + 0x9E3779B9 * (idx + 1)) % (2 ** 32))
+        _host_tls.epoch = st["epoch"]
+    return _host_tls.rng
+
+
 def seed(seed_state: int, ctx="all"):
-    """mx.random.seed parity (ctx arg accepted and ignored — keys are global)."""
+    """mx.random.seed parity (ctx arg accepted and ignored — keys are
+    global). Besides the device key, this seeds every host-side RNG the
+    data pipeline draws from, so augmentations are reproducible like the
+    reference's: the per-thread transform RNGs (host_rng), python's
+    `random` (image.py augmenters), and numpy's global RNG (sampler
+    shuffles)."""
     global _key
+    import random as _pyrandom
     with _lock:
         _key = jax.random.key(int(seed_state))
+        _host_state["seed"] = int(seed_state)
+        _host_state["epoch"] += 1
+        _host_state["next_idx"] = 0
+    _pyrandom.seed(int(seed_state))
+    _numpy.random.seed(int(seed_state) % (2 ** 32))
 
 
 def next_key():

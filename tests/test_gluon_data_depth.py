@@ -1,0 +1,236 @@
+"""Gluon data pipeline depth: DataLoader/Dataset/Sampler/transforms.
+
+Reference analog: tests/python/unittest/test_gluon_data.py +
+test_gluon_data_vision.py (loader batching/last_batch modes, dataset
+composition, every vision transform checked for shape/range/semantics).
+Existing suites cover samplers (test_samplers.py) and the io iterators;
+this file pins the gluon-side pipeline: batchify shapes and dtypes,
+last_batch contracts, dataset transforms and laziness, transform
+determinism under mx.random.seed, and the numeric semantics of the
+deterministic vision transforms (ToTensor/Normalize/Center-crop/Resize
+pixel math vs explicit numpy).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.gluon.data import ArrayDataset, DataLoader, SimpleDataset
+from mxnet_tpu.gluon.data.vision import transforms
+
+
+def _n(x):
+    """Transforms may return NDArray or numpy depending on the stage."""
+    return x.asnumpy() if hasattr(x, "asnumpy") else np.asarray(x)
+
+
+def _dataset(n=10, shape=(3, 8, 8)):
+    rng = np.random.RandomState(0)
+    x = rng.uniform(0, 1, (n,) + shape).astype(np.float32)
+    y = np.arange(n, dtype=np.float32)
+    return ArrayDataset(x, y), x, y
+
+
+# ---------------------------------------------------------------------------
+# DataLoader batching
+# ---------------------------------------------------------------------------
+
+def test_loader_batches_in_order_unshuffled():
+    ds, x, y = _dataset(10)
+    loader = DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 3                 # default last_batch='keep'
+    bx, by = batches[0]
+    assert bx.shape == (4, 3, 8, 8)
+    np.testing.assert_allclose(bx.asnumpy(), x[:4], rtol=1e-6)
+    np.testing.assert_allclose(by.asnumpy(), y[:4])
+    assert batches[2][0].shape[0] == 2       # 10 = 4+4+2
+
+
+def test_loader_last_batch_discard():
+    ds, _, _ = _dataset(10)
+    loader = DataLoader(ds, batch_size=4, shuffle=False,
+                        last_batch="discard")
+    batches = list(loader)
+    assert len(batches) == 2
+    assert all(b[0].shape[0] == 4 for b in batches)
+    assert len(loader) == 2
+
+
+def test_loader_last_batch_rollover_carries_remainder():
+    ds, _, _ = _dataset(10)
+    loader = DataLoader(ds, batch_size=4, shuffle=False,
+                        last_batch="rollover")
+    first_epoch = list(loader)
+    assert all(b[0].shape[0] == 4 for b in first_epoch)
+    n_first = sum(b[0].shape[0] for b in first_epoch)
+    assert n_first == 8                       # 2 rolled to next epoch
+    second_epoch = list(loader)
+    n_second = sum(b[0].shape[0] for b in second_epoch)
+    assert n_second == 12                     # 2 carried + 10 new
+
+
+def test_loader_shuffle_is_a_permutation():
+    ds, _, y = _dataset(20)
+    mx.random.seed(0)
+    loader = DataLoader(ds, batch_size=5, shuffle=True)
+    seen = np.concatenate([b[1].asnumpy() for b in loader])
+    assert sorted(seen.tolist()) == y.tolist()
+    # and not the identity order (probability 1/20! of false failure)
+    assert not np.array_equal(seen, y)
+
+
+def test_loader_custom_batchify():
+    ds, x, _ = _dataset(6)
+
+    def batchify(samples):
+        xs = [s[0] for s in samples]
+        return nd.stack(*[nd.array(a) for a in xs], axis=0).sum(axis=0)
+
+    loader = DataLoader(ds, batch_size=3, shuffle=False,
+                        batchify_fn=batchify)
+    out = list(loader)
+    np.testing.assert_allclose(out[0].asnumpy(), x[:3].sum(axis=0),
+                               rtol=1e-5)
+
+
+def test_loader_with_explicit_sampler():
+    from mxnet_tpu.gluon.data.sampler import SequentialSampler
+    ds, _, y = _dataset(8)
+    loader = DataLoader(ds, batch_size=4,
+                        sampler=SequentialSampler(8))
+    seen = np.concatenate([b[1].asnumpy() for b in loader])
+    np.testing.assert_array_equal(seen, y)
+
+
+# ---------------------------------------------------------------------------
+# Dataset composition
+# ---------------------------------------------------------------------------
+
+def test_array_dataset_getitem_and_len():
+    ds, x, y = _dataset(7)
+    assert len(ds) == 7
+    xi, yi = ds[3]
+    np.testing.assert_allclose(np.asarray(xi), x[3])
+    assert float(yi) == 3.0
+
+
+def test_simple_dataset_transform_lazy_and_first():
+    calls = []
+
+    def f(a):
+        calls.append(1)
+        return a * 2
+
+    ds = SimpleDataset(list(range(5))).transform(f, lazy=True)
+    assert not calls            # lazy: nothing ran yet
+    assert ds[2] == 4
+    assert len(calls) == 1
+
+    ds2, x, y = _dataset(4)
+    tf = ds2.transform_first(lambda a: a + 1.0)
+    xi, yi = tf[1]
+    np.testing.assert_allclose(np.asarray(xi), x[1] + 1.0, rtol=1e-6)
+    assert float(yi) == 1.0     # label untouched
+
+
+# ---------------------------------------------------------------------------
+# deterministic vision transforms: exact pixel math
+# ---------------------------------------------------------------------------
+
+def test_totensor_hwc_uint8_to_chw_float():
+    rng = np.random.RandomState(1)
+    img = rng.randint(0, 256, (8, 6, 3)).astype(np.uint8)
+    out = transforms.ToTensor()(nd.array(img, dtype="uint8"))
+    assert out.shape == (3, 8, 6)
+    np.testing.assert_allclose(_n(out),
+                               img.transpose(2, 0, 1) / 255.0,
+                               rtol=1e-6)
+
+
+def test_normalize_per_channel():
+    rng = np.random.RandomState(2)
+    img = rng.uniform(0, 1, (3, 4, 4)).astype(np.float32)
+    mean, std = (0.5, 0.4, 0.3), (0.2, 0.25, 0.3)
+    out = _n(transforms.Normalize(mean, std)(nd.array(img)))
+    want = (img - np.array(mean).reshape(3, 1, 1)) / \
+        np.array(std).reshape(3, 1, 1)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_center_crop_exact_window():
+    img = np.arange(10 * 8 * 3, dtype=np.float32).reshape(10, 8, 3)
+    out = _n(transforms.CenterCrop((4, 6))(nd.array(img)))
+    # output size (w=4, h=6): rows 2..8, cols 2..6
+    assert out.shape == (6, 4, 3)
+    np.testing.assert_allclose(out, img[2:8, 2:6, :])
+
+
+def test_resize_preserves_constant_images():
+    img = np.full((8, 8, 3), 0.25, np.float32)
+    out = _n(transforms.Resize((4, 4))(nd.array(img)))
+    assert out.shape == (4, 4, 3)
+    np.testing.assert_allclose(out, 0.25, rtol=1e-5)
+
+
+def test_compose_applies_in_order():
+    # ToTensor is the reference contract: [0,255] HWC -> [0,1] CHW
+    # (divides by 255 regardless of input dtype)
+    img = np.full((4, 4, 3), 127.5, np.float32)
+    pipe = transforms.Compose([
+        transforms.ToTensor(),           # -> 0.5 CHW
+        transforms.Normalize(0.5, 0.5),  # -> 0
+    ])
+    out = _n(pipe(nd.array(img)))
+    assert out.shape == (3, 4, 4)
+    np.testing.assert_allclose(out, 0.0, atol=1e-6)
+
+
+def test_random_crop_shape_and_content_subset():
+    rng = np.random.RandomState(3)
+    img = rng.uniform(0, 1, (10, 10, 3)).astype(np.float32)
+    mx.random.seed(7)
+    out = _n(transforms.RandomCrop((6, 6))(nd.array(img)))
+    assert out.shape == (6, 6, 3)
+    # the crop window must appear somewhere in the source
+    found = any(
+        np.allclose(out, img[i:i + 6, j:j + 6, :])
+        for i in range(5) for j in range(5))
+    assert found
+
+
+def test_random_flip_is_identity_or_mirror():
+    rng = np.random.RandomState(4)
+    img = rng.uniform(0, 1, (5, 7, 3)).astype(np.float32)
+    for _ in range(8):
+        out = _n(transforms.RandomFlipLeftRight()(nd.array(img)))
+        assert (np.allclose(out, img)
+                or np.allclose(out, img[:, ::-1, :]))
+
+
+def test_random_transforms_deterministic_under_seed():
+    rng = np.random.RandomState(5)
+    img = nd.array(rng.uniform(0, 1, (8, 8, 3)).astype(np.float32))
+    t = transforms.RandomColorJitter(brightness=0.4, contrast=0.4,
+                                     saturation=0.4)
+    mx.random.seed(11)
+    a = _n(t(img))
+    mx.random.seed(11)
+    b = _n(t(img))
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_transforms_in_dataloader_pipeline():
+    """The reference's canonical usage: dataset.transform_first with a
+    Compose, consumed through a DataLoader."""
+    rng = np.random.RandomState(6)
+    x = rng.randint(0, 256, (8, 8, 8, 3)).astype(np.uint8)
+    y = np.arange(8, dtype=np.float32)
+    ds = ArrayDataset(x, y).transform_first(
+        transforms.Compose([transforms.ToTensor(),
+                            transforms.Normalize(0.5, 0.25)]))
+    loader = DataLoader(ds, batch_size=4, shuffle=False)
+    bx, by = next(iter(loader))
+    assert bx.shape == (4, 3, 8, 8)
+    want = (x[:4].transpose(0, 3, 1, 2) / 255.0 - 0.5) / 0.25
+    np.testing.assert_allclose(bx.asnumpy(), want, rtol=1e-4, atol=1e-5)

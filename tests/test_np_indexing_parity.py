@@ -1,0 +1,157 @@
+"""mx.np indexing parity vs numpy ground truth.
+
+Reference analog: tests/python/unittest/test_numpy_ndarray.py
+(test_getitem/test_setitem sweeps — the reference enumerates basic,
+advanced, boolean, and mixed indexing against numpy). Every case here
+evaluates the SAME index expression on a numpy array and the mx.np
+array and requires elementwise equality — getitem, setitem, and the
+gradient of getitem (scatter-add transpose).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def _pair(shape=(4, 5, 6), seed=0):
+    a = np.arange(int(np.prod(shape)), dtype=np.float32).reshape(shape)
+    return a, mx.np.array(a)
+
+
+# every entry: (name, index expression as a lambda over module namespace)
+GET_CASES = [
+    ("int", lambda np_: 2),
+    ("neg-int", lambda np_: -1),
+    ("slice", lambda np_: slice(1, 3)),
+    ("slice-step", lambda np_: slice(None, None, 2)),
+    ("slice-neg-step", lambda np_: slice(None, None, -1)),
+    ("tuple-int-slice", lambda np_: (1, slice(2, 5))),
+    ("tuple-slices", lambda np_: (slice(0, 3), slice(1, 4))),
+    ("ellipsis-tail", lambda np_: (Ellipsis, 2)),
+    ("ellipsis-mid", lambda np_: (1, Ellipsis, 3)),
+    ("newaxis", lambda np_: (np_.newaxis, slice(None))),
+    ("newaxis-mid", lambda np_: (slice(None), np_.newaxis, 2)),
+    ("int-array", lambda np_: np_.array([0, 2, 3])),
+    ("int-array-neg", lambda np_: np_.array([-1, 0, -2])),
+    ("two-arrays", lambda np_: (np_.array([0, 1]), np_.array([2, 3]))),
+    ("array-and-slice", lambda np_: (np_.array([0, 2]), slice(1, 4))),
+    ("slice-and-array", lambda np_: (slice(1, 3), np_.array([0, 4]))),
+    ("bool-full", lambda np_: None),   # handled specially below
+    ("bool-1d", lambda np_: None),     # handled specially below
+]
+
+
+@pytest.mark.parametrize("name,mk", GET_CASES,
+                         ids=[n for n, _ in GET_CASES])
+def test_getitem_matches_numpy(name, mk):
+    a_np, a_mx = _pair()
+    if name == "bool-full":
+        idx_np = a_np > 40
+        idx_mx = mx.np.array(idx_np)
+    elif name == "bool-1d":
+        idx_np = np.array([True, False, True, False])
+        idx_mx = mx.np.array(idx_np)
+    else:
+        idx_np = mk(np)
+        idx_mx = mk(mx.np)
+        # unwrap lambdas that return plain python objects
+        if isinstance(idx_np, tuple):
+            idx_mx = tuple(
+                mx.np.array(np.asarray(i)) if isinstance(i, np.ndarray)
+                else i for i in idx_np)
+        elif isinstance(idx_np, np.ndarray):
+            idx_mx = mx.np.array(idx_np)
+        else:
+            idx_mx = idx_np
+    want = a_np[idx_np]
+    got = a_mx[idx_mx].asnumpy()
+    assert got.shape == want.shape, (name, got.shape, want.shape)
+    np.testing.assert_array_equal(got, want, err_msg=name)
+
+
+SET_CASES = [
+    ("int", 2, 7.0),
+    ("slice", slice(1, 3), -1.0),
+    ("tuple", (1, slice(2, 5)), 3.5),
+    ("neg-step", slice(None, None, -2), 9.0),
+]
+
+
+@pytest.mark.parametrize("name,idx,val", SET_CASES,
+                         ids=[c[0] for c in SET_CASES])
+def test_setitem_scalar_matches_numpy(name, idx, val):
+    a_np, a_mx = _pair()
+    a_np[idx] = val
+    a_mx[idx] = val
+    np.testing.assert_array_equal(a_mx.asnumpy(), a_np, err_msg=name)
+
+
+def test_setitem_array_value_broadcast():
+    a_np, a_mx = _pair()
+    v = np.arange(6, dtype=np.float32)
+    a_np[1, 2] = v
+    a_mx[1, 2] = mx.np.array(v)
+    np.testing.assert_array_equal(a_mx.asnumpy(), a_np)
+    a_np[:, 0] = v
+    a_mx[:, 0] = mx.np.array(v)
+    np.testing.assert_array_equal(a_mx.asnumpy(), a_np)
+
+
+def test_setitem_int_array_rows():
+    a_np, a_mx = _pair((5, 3))
+    idx = np.array([0, 3])
+    a_np[idx] = 2.0
+    a_mx[mx.np.array(idx)] = 2.0
+    np.testing.assert_array_equal(a_mx.asnumpy(), a_np)
+
+
+def test_setitem_boolean_mask():
+    a_np, a_mx = _pair((4, 5))
+    m = a_np > 10
+    a_np[m] = 0.0
+    a_mx[mx.np.array(m)] = 0.0
+    np.testing.assert_array_equal(a_mx.asnumpy(), a_np)
+
+
+def test_chained_views_read_like_numpy():
+    a_np, a_mx = _pair((6, 6))
+    np.testing.assert_array_equal(
+        a_mx[1:5][::2].asnumpy(), a_np[1:5][::2])
+    np.testing.assert_array_equal(
+        a_mx[:, 2][1:4].asnumpy(), a_np[:, 2][1:4])
+
+
+def test_getitem_gradient_is_scatter():
+    """d/dx of x[idx].sum(): ones scattered to the gathered positions,
+    accumulated over duplicates."""
+    x = nd.array(np.zeros((5,), np.float32))
+    x.attach_grad()
+    idx = nd.array(np.array([1, 3, 1], np.int32), dtype="int32")
+    with autograd.record():
+        y = nd.take(x, idx).sum()
+    y.backward()
+    np.testing.assert_array_equal(x.grad.asnumpy(), [0, 2, 0, 1, 0])
+
+
+def test_getitem_slice_gradient():
+    x = nd.array(np.arange(6, dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x[1:4] * 2).sum()
+    y.backward()
+    np.testing.assert_array_equal(x.grad.asnumpy(), [0, 2, 2, 2, 0, 0])
+
+
+def test_out_of_range_basic_index_raises():
+    _, a_mx = _pair((3, 3))
+    with pytest.raises(Exception):
+        _ = a_mx[5]
+
+
+def test_zero_length_slice_roundtrip():
+    a_np, a_mx = _pair((4, 2))
+    np.testing.assert_array_equal(a_mx[2:2].asnumpy(), a_np[2:2])
+    a_np[2:2] = 5.0  # no-op
+    a_mx[2:2] = 5.0
+    np.testing.assert_array_equal(a_mx.asnumpy(), a_np)

@@ -1,0 +1,201 @@
+"""LR-scheduler closed forms + callback/monitor/profiler contracts.
+
+Reference analogs: tests/python/unittest/test_lr_scheduler.py (every
+scheduler vs its formula incl. warmup) and the callback/monitor behavior
+exercised by test_module.py fit loops. Schedulers are checked pointwise
+against the published formulas; callbacks are driven with synthetic
+BatchEndParams; the profiler's chrome-trace output is parsed back as
+JSON and structurally validated.
+"""
+import json
+import logging
+import math
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.lr_scheduler import (CosineScheduler, FactorScheduler,
+                                    MultiFactorScheduler, PolyScheduler)
+
+
+# ---------------------------------------------------------------------------
+# schedulers vs closed forms
+# ---------------------------------------------------------------------------
+
+def test_factor_scheduler_decays_every_step_updates():
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    lrs = [s(i) for i in (1, 5, 10, 11, 20, 21, 31, 45)]
+    # decays fire when num_update crosses count+step: at 11, 21, 31, 41
+    np.testing.assert_allclose(
+        lrs, [1.0, 1.0, 1.0, 0.5, 0.5, 0.25, 0.125, 0.0625], rtol=1e-9)
+
+
+def test_factor_scheduler_stop_floor():
+    s = FactorScheduler(step=1, factor=0.1, base_lr=1.0,
+                        stop_factor_lr=1e-3)
+    for i in range(2, 30):
+        s(i)
+    assert s(31) == pytest.approx(1e-3)
+
+
+def test_multifactor_scheduler_steps_at_milestones():
+    s = MultiFactorScheduler(step=[5, 9], factor=0.1, base_lr=1.0)
+    lrs = [s(i) for i in (1, 4, 5, 8, 9, 20)]
+    np.testing.assert_allclose(lrs, [1.0, 1.0, 0.1, 0.1, 0.01, 0.01],
+                               rtol=1e-9)
+
+
+def test_poly_scheduler_formula():
+    base, final, maxu, pwr = 0.4, 0.02, 100, 2
+    s = PolyScheduler(max_update=maxu, base_lr=base, pwr=pwr,
+                      final_lr=final)
+    for n in (0, 10, 50, 99, 100):
+        want = final + (base - final) * (1 - n / maxu) ** pwr
+        assert s(n) == pytest.approx(want), n
+    assert s(150) == pytest.approx(final)  # clamped past max_update
+
+
+def test_cosine_scheduler_formula_and_endpoints():
+    base, final, maxu = 1.0, 0.1, 80
+    s = CosineScheduler(max_update=maxu, base_lr=base, final_lr=final)
+    assert s(0) == pytest.approx(base)
+    assert s(maxu) == pytest.approx(final)
+    assert s(maxu * 2) == pytest.approx(final)
+    n = 20
+    want = final + (base - final) * (1 + math.cos(math.pi * n / maxu)) / 2
+    assert s(n) == pytest.approx(want)
+    # midpoint is the arithmetic mean of base and final
+    assert s(40) == pytest.approx((base + final) / 2)
+
+
+def test_linear_warmup_then_schedule():
+    s = CosineScheduler(max_update=110, base_lr=1.0, final_lr=0.0,
+                        warmup_steps=10, warmup_begin_lr=0.2)
+    # linear ramp 0.2 -> 1.0 over 10 updates
+    assert s(0) == pytest.approx(0.2)
+    assert s(5) == pytest.approx(0.2 + 0.8 * 0.5)
+    # at warmup end, the cosine part starts from base_lr
+    assert s(10) == pytest.approx(1.0)
+    assert s(110) == pytest.approx(0.0)
+
+
+def test_constant_warmup_mode():
+    s = FactorScheduler(step=1000, factor=1.0, base_lr=0.5,
+                        warmup_steps=4, warmup_begin_lr=0.05,
+                        warmup_mode="constant")
+    assert s(2) == pytest.approx(0.05)
+    assert s(4) == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# callbacks
+# ---------------------------------------------------------------------------
+
+class _BatchEndParams:
+    def __init__(self, epoch, nbatch, eval_metric=None, locals=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals
+
+
+def test_speedometer_logs_at_frequency(caplog):
+    from mxnet_tpu.callback import Speedometer
+    from mxnet_tpu import metric as mmetric
+    sp = Speedometer(batch_size=32, frequent=2, auto_reset=False)
+    m = mmetric.Accuracy()
+    m.update([nd.array([1.0])], [nd.array([[0.1, 0.9]])])
+    with caplog.at_level(logging.INFO):
+        for nb in range(1, 5):
+            sp(_BatchEndParams(epoch=0, nbatch=nb, eval_metric=m))
+    msgs = [r.message for r in caplog.records if "Speed" in r.message
+            or "samples/sec" in r.message]
+    assert len(msgs) == 2  # nbatch 2 and 4
+    assert "accuracy" in msgs[0]
+
+
+def test_speedometer_auto_reset_clears_metric():
+    from mxnet_tpu.callback import Speedometer
+    from mxnet_tpu import metric as mmetric
+    sp = Speedometer(batch_size=4, frequent=1, auto_reset=True)
+    m = mmetric.Accuracy()
+    m.update([nd.array([1.0])], [nd.array([[0.1, 0.9]])])
+    # first call only initializes the timer (reference Speedometer.init)
+    sp(_BatchEndParams(epoch=0, nbatch=1, eval_metric=m))
+    sp(_BatchEndParams(epoch=0, nbatch=2, eval_metric=m))
+    assert m.num_inst == 0  # reset after the logging call
+
+
+def test_do_checkpoint_saves_on_period(tmp_path):
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.callback import do_checkpoint
+    prefix = str(tmp_path / "model")
+    cb = do_checkpoint(prefix, period=2)
+    x = sym.Variable("data")
+    net = sym.FullyConnected(x, sym.Variable("w"), sym.Variable("b"),
+                             num_hidden=2)
+    arg = {"w": nd.array(np.ones((2, 3), np.float32)),
+           "b": nd.zeros(2)}
+    cb(0, net, arg, {})   # epoch 0 -> period 1 -> no save? (1 % 2)
+    cb(1, net, arg, {})   # epoch 1 -> save
+    saved = sorted(os.listdir(tmp_path))
+    assert f"model-symbol.json".split("/")[-1] in saved
+    assert any(s.endswith("0002.params") for s in saved)
+
+
+def test_log_train_metric_resets_when_asked():
+    from mxnet_tpu.callback import log_train_metric
+    from mxnet_tpu import metric as mmetric
+    cb = log_train_metric(period=1, auto_reset=True)
+    m = mmetric.Accuracy()
+    m.update([nd.array([1.0])], [nd.array([[0.1, 0.9]])])
+    cb(_BatchEndParams(epoch=0, nbatch=1, eval_metric=m))
+    assert m.num_inst == 0
+
+
+# ---------------------------------------------------------------------------
+# monitor
+# ---------------------------------------------------------------------------
+
+def test_monitor_collects_stats_from_forward():
+    """Monitor installs on Executors (reference monitor.py:79)."""
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.monitor import Monitor
+    x = sym.Variable("data")
+    y = sym.relu(sym.FullyConnected(x, sym.Variable("w"),
+                                    sym.Variable("b"), num_hidden=3))
+    exe = y.bind(mx.cpu(), {"data": nd.zeros((2, 4)),
+                            "w": nd.array(np.ones((3, 4), np.float32)),
+                            "b": nd.zeros(3)})
+    mon = Monitor(interval=1)
+    mon.install(exe)
+    mon.tic()
+    exe.forward()
+    rows = mon.toc()
+    assert rows, "monitor collected nothing"
+
+
+# ---------------------------------------------------------------------------
+# profiler chrome trace
+# ---------------------------------------------------------------------------
+
+def test_profiler_chrome_trace_is_valid_json(tmp_path):
+    from mxnet_tpu import profiler
+    path = str(tmp_path / "trace.json")
+    profiler.set_config(profile_all=True, filename=path)
+    profiler.set_state("run")
+    with profiler.scope("work"):
+        nd.dot(nd.ones((64, 64)), nd.ones((64, 64))).asnumpy()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(path) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    assert isinstance(events, list) and events
+    named = [e for e in events if e.get("name")]
+    assert named, "no named trace events"
+    for e in named[:5]:
+        assert "ph" in e
