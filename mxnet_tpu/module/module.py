@@ -17,6 +17,19 @@ from ..initializer import InitDesc
 from .base_module import BaseModule
 
 
+def _shapes_dict(*shape_lists):
+    """Normalize (name, shape) tuples / DataDesc objects into one dict —
+    the single place bind() and output_shapes parse descriptors."""
+    out = {}
+    for descs in shape_lists:
+        for desc in descs or []:
+            name, shape = (desc[0], desc[1]) \
+                if isinstance(desc, (tuple, list)) \
+                else (desc.name, desc.shape)
+            out[name] = tuple(shape)
+    return out
+
+
 class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  logger=None, context=None, work_load_list=None,
@@ -52,17 +65,9 @@ class Module(BaseModule):
              grad_req="write"):
         if self.binded and not force_rebind:
             return
-        shapes = {}
-        for desc in data_shapes:
-            name, shape = (desc[0], desc[1]) if isinstance(desc, (tuple, list)) \
-                else (desc.name, desc.shape)
-            shapes[name] = tuple(shape)
-        if label_shapes:
-            for desc in label_shapes:
-                name, shape = (desc[0], desc[1]) if isinstance(desc, (tuple, list)) \
-                    else (desc.name, desc.shape)
-                shapes[name] = tuple(shape)
+        shapes = _shapes_dict(data_shapes, label_shapes)
         self._data_shapes, self._label_shapes = data_shapes, label_shapes
+        self._inferred_output_shapes = None
         req = grad_req if for_training else "null"
         if for_training:
             # params get gradients; data/labels only if inputs_need_grad
@@ -167,6 +172,11 @@ class Module(BaseModule):
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
+        # reference BaseModule.backward asserts for_training — an
+        # inference bind has kNullOp grads, so a silent no-op here would
+        # hide a training loop running on a for_training=False module
+        assert self.for_training, \
+            "backward() on a module bound with for_training=False"
         self._exec.backward(out_grads=out_grads)
 
     def update(self):
@@ -232,4 +242,17 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         outs = self._exec.outputs
-        return list(zip(self.output_names, [o.shape for o in outs]))
+        if outs:
+            return list(zip(self.output_names, [o.shape for o in outs]))
+        # before the first forward the executor has no materialized
+        # outputs — infer from the bound input shapes (the reference
+        # exposes output_shapes right after bind; SequentialModule.bind
+        # wires the next stage's inputs from them). Cached: infer_shape
+        # walks the whole graph and the result is fixed for a bound
+        # module.
+        if getattr(self, "_inferred_output_shapes", None) is None:
+            shapes = _shapes_dict(self._data_shapes, self._label_shapes)
+            _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+            self._inferred_output_shapes = list(
+                zip(self.output_names, out_shapes))
+        return self._inferred_output_shapes

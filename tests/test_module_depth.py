@@ -1,0 +1,307 @@
+"""Module-stack depth: bind contracts, param/optimizer plumbing,
+SequentialModule wiring, BucketingModule sharing, score/predict/fit.
+
+Reference analog: tests/python/unittest/test_module.py (~900 lines over
+the same surface). test_module.py here covers the fit/checkpoint basics;
+this file pins the contracts around them: inference-mode binds carry no
+gradients, inputs_need_grad exposes input grads, shared_module copies
+parameters, init_params allow_missing/force_init semantics, per-bucket
+executor sharing, sequential inter-module shape wiring with backward
+through the chain, and score()/predict() aggregation.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import metric as mmetric
+import mxnet_tpu.symbol as sym
+from mxnet_tpu.io import DataBatch, NDArrayIter
+from mxnet_tpu.module import BucketingModule, Module, SequentialModule
+
+
+def _mlp_symbol(hidden=6, classes=3):
+    x = sym.Variable("data")
+    y = sym.Variable("softmax_label")
+    h = sym.Activation(sym.FullyConnected(x, sym.Variable("w1"),
+                                          sym.Variable("b1"),
+                                          num_hidden=hidden, name="fc1"),
+                       act_type="relu")
+    out = sym.FullyConnected(h, sym.Variable("w2"), sym.Variable("b2"),
+                             num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(out, y, name="softmax")
+
+
+def _batch(rng, n=8, d=4, classes=3):
+    return DataBatch(data=[nd.array(rng.uniform(-1, 1, (n, d))
+                                    .astype(np.float32))],
+                     label=[nd.array(rng.randint(0, classes, n)
+                                     .astype(np.float32))])
+
+
+def test_inference_bind_has_no_grads():
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 4))], for_training=False)
+    mod.init_params()
+    rng = np.random.RandomState(0)
+    mod.forward(_batch(rng, n=4), is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (4, 3)
+    # probabilities: softmax output sums to 1
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), 1.0, rtol=1e-5)
+    with pytest.raises(Exception):
+        mod.backward()
+
+
+def test_inputs_need_grad_exposes_input_grads():
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 4))],
+             label_shapes=[("softmax_label", (4,))],
+             for_training=True, inputs_need_grad=True)
+    mod.init_params()
+    rng = np.random.RandomState(1)
+    mod.forward(_batch(rng, n=4), is_train=True)
+    mod.backward()
+    g = mod.get_input_grads()[0]
+    assert g is not None and g.shape == (4, 4)
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+
+
+def test_shared_module_copies_params():
+    rng = np.random.RandomState(2)
+    a = Module(_mlp_symbol(), context=mx.cpu())
+    a.bind(data_shapes=[("data", (8, 4))],
+           label_shapes=[("softmax_label", (8,))])
+    a.init_params()
+    ap, _ = a.get_params()
+
+    b = Module(_mlp_symbol(), context=mx.cpu())
+    b.bind(data_shapes=[("data", (2, 4))],
+           label_shapes=[("softmax_label", (2,))], shared_module=a)
+    bp, _ = b.get_params()
+    for k in ap:
+        np.testing.assert_array_equal(ap[k].asnumpy(), bp[k].asnumpy())
+
+
+def test_init_params_allow_missing_and_force():
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 4))],
+             label_shapes=[("softmax_label", (4,))])
+    rng = np.random.RandomState(3)
+    partial = {"w1": nd.array(rng.randn(6, 4).astype(np.float32))}
+    mod.init_params(arg_params=partial, allow_missing=True)
+    ap, _ = mod.get_params()
+    np.testing.assert_array_equal(ap["w1"].asnumpy(),
+                                  partial["w1"].asnumpy())
+    # without force_init a second init is a no-op
+    before = ap["w2"].asnumpy().copy()
+    mod.init_params()
+    np.testing.assert_array_equal(mod.get_params()[0]["w2"].asnumpy(),
+                                  before)
+    # force_init rerolls
+    mx.random.seed(99)
+    mod.init_params(force_init=True,
+                    initializer=mx.initializer.Uniform(1.0))
+    after = mod.get_params()[0]["w2"].asnumpy()
+    assert not np.allclose(after, before)
+
+
+def test_update_moves_params_with_configured_lr():
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 4))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.5),))
+    rng = np.random.RandomState(4)
+    before = mod.get_params()[0]["w2"].asnumpy().copy()
+    mod.forward(_batch(rng), is_train=True)
+    mod.backward()
+    mod.update()
+    after = mod.get_params()[0]["w2"].asnumpy()
+    assert not np.allclose(after, before)
+
+
+def test_score_matches_manual_accuracy():
+    rng = np.random.RandomState(5)
+    x = rng.uniform(-1, 1, (32, 4)).astype(np.float32)
+    y = rng.randint(0, 3, 32).astype(np.float32)
+    it = NDArrayIter(x, y, batch_size=8, label_name="softmax_label")
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 4))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    res = dict(mod.score(it, mmetric.Accuracy()))
+    it.reset()
+    correct = total = 0
+    for b in it:
+        mod.forward(b, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+        lab = b.label[0].asnumpy().astype(int)
+        n = len(lab) - b.pad
+        correct += int((pred[:n] == lab[:n]).sum())
+        total += n
+    np.testing.assert_allclose(res["accuracy"], correct / total, rtol=1e-6)
+
+
+def test_predict_concatenates_batches():
+    rng = np.random.RandomState(6)
+    x = rng.uniform(-1, 1, (20, 4)).astype(np.float32)
+    it = NDArrayIter(x, None, batch_size=8)
+    mod = Module(_mlp_symbol(), label_names=(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 4))], for_training=False)
+    mod.init_params()
+    out = mod.predict(it)
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    assert out.shape[0] == 20  # pad stripped, batches concatenated
+
+
+def test_bucketing_module_shares_params_across_buckets():
+    def gen(key):
+        x = sym.Variable("data")
+        y = sym.Variable("softmax_label")
+        # same weights regardless of unrolled length `key`
+        out = sym.FullyConnected(x, sym.Variable("w"), sym.Variable("b"),
+                                 num_hidden=3, name="fc")
+        return sym.SoftmaxOutput(out, y, name="softmax"), ("data",), \
+            ("softmax_label",)
+
+    mod = BucketingModule(gen, default_bucket_key=10, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    rng = np.random.RandomState(7)
+
+    def step(key, d):
+        b = DataBatch(
+            data=[nd.array(rng.uniform(-1, 1, (4, d)).astype(np.float32))],
+            label=[nd.array(rng.randint(0, 3, 4).astype(np.float32))],
+            bucket_key=key, provide_data=[("data", (4, d))],
+            provide_label=[("softmax_label", (4,))])
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+
+    # FC over the last axis works for any d = in-dim? no — widths must
+    # match the weight: use the same feature dim, different batch-time
+    # packing is the usual bucketing axis. Keep d fixed; switch keys.
+    step(10, 10)
+    w_after_10 = mod.get_params()[0]["w"].asnumpy().copy()
+    step(5, 10)
+    w_after_5 = mod.get_params()[0]["w"].asnumpy()
+    # the second step (different bucket) kept training the SAME weights
+    assert not np.allclose(w_after_10, w_after_5)
+    assert mod._curr_bucket_key == 5 if hasattr(mod, "_curr_bucket_key") \
+        else True
+
+
+def test_sequential_module_chains_and_trains():
+    # stage 1: feature extractor; stage 2: classifier taking labels
+    x = sym.Variable("data")
+    feat = sym.Activation(sym.FullyConnected(
+        x, sym.Variable("w1"), sym.Variable("b1"), num_hidden=5,
+        name="fc1"), act_type="relu")
+    m1 = Module(feat, label_names=(), context=mx.cpu())
+
+    x2 = sym.Variable("data")
+    y2 = sym.Variable("softmax_label")
+    logits = sym.FullyConnected(x2, sym.Variable("w2"), sym.Variable("b2"),
+                                num_hidden=3, name="fc2")
+    m2 = Module(sym.SoftmaxOutput(logits, y2, name="softmax"),
+                context=mx.cpu())
+
+    seq = SequentialModule()
+    seq.add(m1).add(m2, take_labels=True)
+    seq.bind(data_shapes=[("data", (8, 4))],
+             label_shapes=[("softmax_label", (8,))])
+    seq.init_params()
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.2),))
+    rng = np.random.RandomState(8)
+    w1_before = m1.get_params()[0]["w1"].asnumpy().copy()
+    for _ in range(3):
+        b = _batch(rng)
+        seq.forward(b, is_train=True)
+        seq.backward()
+        seq.update()
+    out = seq.get_outputs()[0]
+    assert out.shape == (8, 3)
+    # gradients flowed through the chain into stage 1
+    w1_after = m1.get_params()[0]["w1"].asnumpy()
+    assert not np.allclose(w1_after, w1_before)
+
+
+def test_sequential_module_metric_update():
+    x = sym.Variable("data")
+    y = sym.Variable("softmax_label")
+    s = sym.SoftmaxOutput(
+        sym.FullyConnected(x, sym.Variable("w"), sym.Variable("b"),
+                           num_hidden=3), y, name="softmax")
+    seq = SequentialModule()
+    seq.add(Module(s, context=mx.cpu()), take_labels=True)
+    seq.bind(data_shapes=[("data", (8, 4))],
+             label_shapes=[("softmax_label", (8,))])
+    seq.init_params()
+    rng = np.random.RandomState(9)
+    b = _batch(rng)
+    seq.forward(b, is_train=False)
+    m = mmetric.Accuracy()
+    seq.update_metric(m, b.label)
+    assert m.num_inst == 8
+
+
+def test_fit_with_eval_data_and_callbacks():
+    rng = np.random.RandomState(10)
+    # learnable synthetic task: class = argmax of 3 feature groups
+    x = rng.uniform(0, 1, (96, 6)).astype(np.float32)
+    y = x.reshape(96, 3, 2).sum(axis=2).argmax(axis=1).astype(np.float32)
+    train = NDArrayIter(x[:64], y[:64], batch_size=16,
+                        label_name="softmax_label")
+    val = NDArrayIter(x[64:], y[64:], batch_size=16,
+                      label_name="softmax_label")
+    mod = Module(_mlp_symbol(hidden=16), context=mx.cpu())
+    epochs_seen = []
+    mod.fit(train, eval_data=val, num_epoch=6,
+            optimizer="adam", optimizer_params=(("learning_rate", 5e-2),),
+            epoch_end_callback=lambda e, *a: epochs_seen.append(e),
+            batch_end_callback=None)
+    assert epochs_seen == list(range(6))
+    res = dict(mod.score(val, mmetric.Accuracy()))
+    assert res["accuracy"] >= 0.6, res
+
+
+def test_module_output_shapes_and_names():
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 4))],
+             label_shapes=[("softmax_label", (4,))])
+    assert mod.data_names == ["data"] or tuple(mod.data_names) == ("data",)
+    outs = mod.output_shapes
+    assert outs and tuple(outs[0][1]) == (4, 3)
+
+
+def test_python_module_protocol():
+    """PythonModule: a host-side module participating in the Module
+    protocol without an executor (reference python_module.py — the hook
+    for loss layers computed outside the graph)."""
+    from mxnet_tpu.module import PythonModule
+
+    class Doubler(PythonModule):
+        def forward(self, data_batch, is_train=None):
+            self._outputs = [d * 2 for d in data_batch.data]
+
+        def backward(self, out_grads=None):
+            pass
+
+    mod = Doubler(data_names=["data"], label_names=[],
+                  output_names=["out"])
+    mod.bind(data_shapes=[("data", (2, 3))], for_training=False)
+    mod.init_params()
+    b = DataBatch(data=[nd.array(np.ones((2, 3), np.float32))],
+                  label=None)
+    mod.forward(b, is_train=False)
+    np.testing.assert_array_equal(mod.get_outputs()[0].asnumpy(), 2.0)
+    m = mmetric.MAE()
+    mod.update_metric(m, [nd.array(np.full((2, 3), 2.0, np.float32))])
+    assert m.get()[1] == 0.0
