@@ -1,0 +1,75 @@
+"""Pipeline-parallel schedule probe.
+
+Measures the fused PipelineTrainer step on a pp (x dp) CPU mesh and reports
+the microbatch scaling against the GPipe bubble model: with n stages and M
+microbatches the schedule runs M+n-1 ticks for M microbatches of work, so
+ideal efficiency is M/(M+n-1). Run on real multi-chip hardware this probe
+times the same jitted computation over ICI.
+
+Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+       python benchmark/pp_schedule_bench.py
+"""
+import os
+import sys
+import time
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+
+def loss_fn(logits, labels):
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.models.bert import BertModel
+    from mxnet_tpu.parallel import make_mesh, PipelineTrainer
+
+    devs = jax.devices("cpu")[:4]
+    V, B, T = 512, 32, 64
+    rs = onp.random.RandomState(0)
+    x = nd.array(rs.randint(0, V, (B, T)), dtype="int32")
+    y = nd.array(rs.randint(0, V, (B, T)), dtype="int32")
+
+    rows = []
+    for M in (4, 8, 16):
+        mx.random.seed(0)
+        net = BertModel(vocab_size=V, num_layers=4, units=64, hidden_size=256,
+                        num_heads=4, max_length=T, dropout=0.0)
+        net.initialize()
+        net(x)
+        tr = PipelineTrainer(net, loss_fn, optimizer="adam",
+                             optimizer_params={"learning_rate": 1e-3},
+                             mesh=make_mesh({"pp": 4}, devices=devs),
+                             num_microbatch=M)
+        tr.step(x, y).block_until_ready()  # compile + drain
+        t0 = time.perf_counter()
+        iters = 10
+        for _ in range(iters):
+            lossv = tr.step(x, y)
+        lossv.block_until_ready()
+        dt = (time.perf_counter() - t0) / iters
+        ideal = M / (M + 4 - 1)
+        rows.append((M, dt * 1e3, ideal))
+        print(f"pp=4 M={M:3d}: {dt*1e3:8.2f} ms/step  "
+              f"gpipe-ideal-efficiency={ideal:.2f}")
+    # larger M should not be slower per step (amortizes the bubble)
+    print("bubble-model check:",
+          "ok" if rows[-1][1] <= rows[0][1] * 1.5 else "regressed")
+
+
+if __name__ == "__main__":
+    main()

@@ -158,6 +158,13 @@ class BertModel(HybridBlock):
         self.mlm_ln = nn.LayerNorm(in_channels=units)
         self.mlm_decoder = nn.Dense(vocab_size, flatten=False, in_units=units)
 
+    def pipeline_split(self):
+        """(embed, cells, head) for parallel.PipelineTrainer. The wrappers
+        re-register this model's own child blocks, so parameters are shared
+        and sync() writes straight back into this model."""
+        cells = [self.encoder.layers[i] for i in range(len(self.encoder.layers))]
+        return _BertEmbedStage(self), cells, _BertHeadStage(self)
+
     def hybrid_forward(self, F, token_ids, segment_ids=None):
         B, T = token_ids.shape
         from .. import ndarray as nd
@@ -170,6 +177,44 @@ class BertModel(HybridBlock):
             x = self.embed_drop(x)
         x = self.encoder(x)
         h = self.mlm_ln(self.mlm_dense(x))
+        return self.mlm_decoder(h)
+
+
+class _BertEmbedStage(HybridBlock):
+    """Pipeline stage 0 body: the embedding section of BertModel's forward.
+    Shares the parent model's child blocks (no new parameters)."""
+
+    def __init__(self, bert, **kwargs):
+        super().__init__(**kwargs)
+        self.word_embed = bert.word_embed
+        self.pos_embed = bert.pos_embed
+        self.seg_embed = bert.seg_embed
+        self.embed_ln = bert.embed_ln
+        self.drop = bert.embed_drop
+
+    def hybrid_forward(self, F, token_ids):
+        B, T = token_ids.shape
+        from .. import ndarray as nd
+        pos = nd.arange(0, T, dtype="int32", ctx=token_ids.ctx)
+        x = self.word_embed(token_ids) + self.pos_embed(pos).expand_dims(0)
+        x = self.embed_ln(x)
+        if self.drop:
+            x = self.drop(x)
+        return x
+
+
+class _BertHeadStage(HybridBlock):
+    """Pipeline last-stage tail: final LN + MLM head of BertModel."""
+
+    def __init__(self, bert, **kwargs):
+        super().__init__(**kwargs)
+        self.ln = bert.encoder.ln
+        self.mlm_dense = bert.mlm_dense
+        self.mlm_ln = bert.mlm_ln
+        self.mlm_decoder = bert.mlm_decoder
+
+    def hybrid_forward(self, F, x):
+        h = self.mlm_ln(self.mlm_dense(self.ln(x)))
         return self.mlm_decoder(h)
 
 

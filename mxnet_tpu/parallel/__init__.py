@@ -18,7 +18,8 @@ from .data_parallel import DataParallelTrainer, functional_optimizer
 from .ring_attention import ring_attention, blockwise_attention
 from .tensor_parallel import (column_parallel_spec, row_parallel_spec,
                               shard_params_megatron)
-from .pipeline import pipeline_spec
+from .pipeline import (pipeline_spec, pipeline_apply, gpipe_schedule,
+                       PipelineTrainer)
 from .moe import (moe_ffn, expert_parallel_moe, topk_gating,
                   load_balancing_loss)
 
@@ -26,5 +27,7 @@ __all__ = ["make_mesh", "local_mesh", "replicate", "shard_batch", "P",
            "current_mesh", "set_default_mesh", "DataParallelTrainer",
            "functional_optimizer", "ring_attention", "blockwise_attention",
            "column_parallel_spec", "row_parallel_spec", "shard_params_megatron",
-           "pipeline_spec", "moe_ffn", "expert_parallel_moe", "topk_gating",
+           "pipeline_spec", "pipeline_apply", "gpipe_schedule",
+           "PipelineTrainer",
+           "moe_ffn", "expert_parallel_moe", "topk_gating",
            "load_balancing_loss"]

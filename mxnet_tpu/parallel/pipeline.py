@@ -1,49 +1,366 @@
-"""Pipeline parallelism scaffolding (SURVEY.md §2.4: PP "No" in reference).
+"""Pipeline parallelism — circular GPipe schedule with full backward.
 
-Round-1 surface: stage specs + a microbatched GPipe-style schedule helper
-usable inside shard_map over a 'pp' axis. The full pipeline trainer (1F1B
-schedule fused with dp/tp) lands in a later round.
+Capability uplift over the reference (SURVEY.md §2.4: the reference has no
+pipeline parallelism; its model-parallel story stops at per-layer ctx
+placement, reference example/model-parallel-lstm). TPU-native design:
+
+  - the schedule is ONE `lax.scan` inside `shard_map` over the 'pp' mesh
+    axis; activations hop stages with `lax.ppermute` (ICI neighbor traffic);
+  - backward is NOT hand-written: differentiating through the scheduled scan
+    runs the transposed schedule — scan's transpose replays the steps in
+    reverse and ppermute's transpose carries activation cotangents
+    last→first stage, while the loop-invariant stage parameters accumulate
+    their microbatch-summed weight gradients through scan's cotangent
+    accumulation. Forward GPipe + reverse-schedule backward + weight-grad
+    accumulation all land in a single XLA computation;
+  - per-stage calls run under `jax.checkpoint` by default, so the stashed
+    residuals are one activation per (stage, microbatch) — GPipe's memory
+    profile — instead of every intermediate inside the stage.
+
+`PipelineTrainer` fuses embed -> pipeline -> head -> loss -> backward ->
+optimizer update into one jit over a mesh with a 'pp' axis (optionally
+composed with a 'dp' axis for pipeline+data parallelism).
 """
 from __future__ import annotations
 
-from typing import Callable, List, Sequence
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 from jax import lax
+from jax.sharding import Mesh, NamedSharding
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .. import optimizer as opt_mod
+from .. import random as _rng
+from .mesh import current_mesh, P
+
+__all__ = ["pipeline_spec", "pipeline_apply", "gpipe_schedule",
+           "PipelineTrainer"]
 
 
 def pipeline_spec(num_stages: int, axis: str = "pp"):
     return {"num_stages": num_stages, "axis": axis}
 
 
-def gpipe_schedule(stage_fn: Callable, n_microbatch: int, axis_name: str):
-    """Run stage_fn over microbatches inside shard_map over `axis_name`.
+def pipeline_apply(stage_fn: Callable, stage_params, x_stack,
+                   axis_name: str = "pp", remat: bool = True):
+    """Differentiable circular pipeline schedule. Call INSIDE shard_map over
+    `axis_name`.
 
-    stage_fn(carry, x_mb) -> y_mb for the local stage; activations move to the
-    next stage with ppermute each tick. Returns a function mapping the local
-    microbatch stack (M, ...) -> output stack for the last stage.
+    stage_fn(stage_params, x_mb) -> y_mb must be shape-preserving;
+    stage_params is THIS device's stage pytree; x_stack is the (M, ...)
+    microbatch stack (only stage 0's copy is consumed — other stages receive
+    activations over ppermute). Returns the (M, ...) output stack, valid on
+    the LAST stage (finite zeros elsewhere — inactive ticks compute on
+    zeros and are masked, so no NaNs leak and no gradient flows from them).
+
+    Reverse-mode differentiation through this function yields the reverse
+    pipeline schedule with weight-gradient accumulation (see module
+    docstring) — callers get pipeline backward for free from jax.grad.
     """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = x_stack.shape[0]
+    steps = M + n - 1
+    f = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def body(inflight, t):
+        x_in = jnp.where(idx == 0, x_stack[jnp.clip(t, 0, M - 1)], inflight)
+        y = f(stage_params, x_in)
+        active = jnp.logical_and(t - idx >= 0, t - idx < M)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return lax.ppermute(y, axis_name, perm), y
+
+    _, ys = lax.scan(body, jnp.zeros_like(x_stack[0]), jnp.arange(steps))
+    # microbatch m leaves the last stage at tick m + n - 1
+    return ys[n - 1:]
+
+
+def gpipe_schedule(stage_fn: Callable, n_microbatch: int, axis_name: str):
+    """Back-compat shim over pipeline_apply for parameterless stage fns."""
     def run(x_stack):
-        n = lax.axis_size(axis_name)
-        idx = lax.axis_index(axis_name)
-        M = x_stack.shape[0]
-        steps = M + n - 1
-        buf = jnp.zeros_like(x_stack)
-
-        def body(carry, t):
-            buf, inflight = carry
-            mb = jnp.clip(t - idx, 0, M - 1)
-            x_in = jnp.where(idx == 0, x_stack[jnp.clip(t, 0, M - 1)], inflight)
-            y = stage_fn(x_in)
-            active = jnp.logical_and(t - idx >= 0, t - idx < M)
-            buf = jnp.where(active & (idx == n - 1),
-                            buf.at[mb].set(y), buf)
-            perm = [(i, (i + 1) % n) for i in range(n)]
-            inflight = lax.ppermute(y, axis_name, perm)
-            return (buf, inflight), None
-
-        inflight0 = jnp.zeros_like(stage_fn(x_stack[0]))
-        (buf, _), _ = lax.scan(body, (buf, inflight0), jnp.arange(steps))
-        return buf
+        return pipeline_apply(lambda _, x: stage_fn(x), (), x_stack,
+                              axis_name=axis_name, remat=False)
     return run
+
+
+class PipelineTrainer:
+    """Fused pipeline-parallel trainer (optionally composed with data
+    parallelism over a 'dp' mesh axis).
+
+    `net` must expose `pipeline_split() -> (embed, cells, head)` where
+    `cells` are structurally identical stateless HybridBlocks (transformer
+    encoder layers — models/bert.py grows this method). Cell parameters are
+    stacked layerwise into (n_layers, ...) arrays sharded over 'pp'
+    (layers_per_stage = n_layers / pp); embed and head stay replicated, with
+    their gradients psum'd over 'pp' (only stage 0 / the last stage produce
+    nonzero contributions — the psum is the sync that keeps the replicas
+    identical).
+
+    One jit computes: embed -> circular GPipe schedule (pipeline_apply) ->
+    head -> loss -> reverse-schedule backward -> optimizer update, with the
+    cross-'dp' gradient pmean inserted explicitly when dp > 1. `loss` must be
+    a mean-reduction callable (pred_raw, label_raw) -> scalar so microbatch
+    splitting leaves the math identical to a full-batch step.
+    """
+
+    def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
+                 mesh: Optional[Mesh] = None, num_microbatch: Optional[int] = None,
+                 pp_axis: str = "pp", dp_axis: Optional[str] = None,
+                 dtype=None, remat: bool = True):
+        from .data_parallel import functional_optimizer, _make_apply_fn
+        self.net = net
+        self.loss = loss
+        self.mesh = mesh if mesh is not None else current_mesh()
+        if pp_axis not in self.mesh.shape:
+            raise MXNetError(f"mesh has no {pp_axis!r} axis: {self.mesh.shape}")
+        if dp_axis is not None and dp_axis not in self.mesh.shape:
+            raise MXNetError(f"mesh has no {dp_axis!r} axis: {self.mesh.shape}")
+        self.pp_axis, self.dp_axis = pp_axis, dp_axis
+        self.n_stages = self.mesh.shape[pp_axis]
+        self.n_dp = self.mesh.shape[dp_axis] if dp_axis else 1
+        self.remat = remat
+
+        if not hasattr(net, "pipeline_split"):
+            raise MXNetError(
+                f"{type(net).__name__} has no pipeline_split(); implement it "
+                "returning (embed_block, identical_cells, head_block)")
+        embed, cells, head = net.pipeline_split()
+        if len(cells) % self.n_stages != 0:
+            raise MXNetError(
+                f"{len(cells)} layers do not divide into {self.n_stages} "
+                "pipeline stages")
+        self.n_layers = len(cells)
+        self.layers_per_stage = self.n_layers // self.n_stages
+
+        def _plist(block):
+            ps = list(block.collect_params().values())
+            if any(p._data is None for p in ps):
+                raise MXNetError("net has uninitialized parameters; run one "
+                                 "eager forward before PipelineTrainer")
+            return ps
+
+        self._embed_plist = _plist(embed)
+        self._head_plist = _plist(head)
+        self._cell_plists = [_plist(c) for c in cells]
+        ref = self._cell_plists[0]
+        for j, cp in enumerate(self._cell_plists[1:], 1):
+            if len(cp) != len(ref) or any(
+                    a._data._data.shape != b._data._data.shape or
+                    a._data._data.dtype != b._data._data.dtype
+                    for a, b in zip(cp, ref)):
+                raise MXNetError(f"cell {j} is not structurally identical to "
+                                 "cell 0; pipeline stages must be homogeneous")
+        all_cell_params = [p for cp in self._cell_plists for p in cp]
+        for p in self._embed_plist + self._head_plist + all_cell_params:
+            if p.grad_req == "null":
+                raise MXNetError("frozen (grad_req='null') parameters are not "
+                                 "supported in PipelineTrainer yet")
+
+        self._embed_apply = _make_apply_fn(embed, self._embed_plist, train=True)
+        self._cell_apply = _make_apply_fn(cells[0], ref, train=True)
+        self._head_apply = _make_apply_fn(head, self._head_plist, train=True)
+
+        self.compute_dtype = None
+        if dtype is not None and jnp.dtype(dtype) != jnp.dtype(jnp.float32):
+            self.compute_dtype = jnp.dtype(dtype)
+            if self.compute_dtype != jnp.dtype(jnp.bfloat16):
+                raise MXNetError("PipelineTrainer supports float32/bfloat16, "
+                                 f"got {dtype!r}")
+
+        self.optimizer = optimizer if isinstance(optimizer, opt_mod.Optimizer) \
+            else opt_mod.create(optimizer, **(optimizer_params or {}))
+        self._init_fn, self._update_fn = functional_optimizer(self.optimizer)
+
+        if num_microbatch is None:
+            num_microbatch = self.n_stages
+        self.num_microbatch = num_microbatch
+
+        rep = NamedSharding(self.mesh, P())
+        stk = NamedSharding(self.mesh, P(pp_axis))
+        self._e_raw = [jax.device_put(jnp.array(p._data._data, copy=True), rep)
+                       for p in self._embed_plist]
+        self._h_raw = [jax.device_put(jnp.array(p._data._data, copy=True), rep)
+                       for p in self._head_plist]
+        # layerwise stack: leaf i -> (n_layers, ...) sharded over pp
+        self._s_raw = [
+            jax.device_put(jnp.stack([cp[i]._data._data
+                                      for cp in self._cell_plists]), stk)
+            for i in range(len(ref))]
+        self._opt_e = [jax.device_put(self._init_fn(w), rep)
+                       for w in self._e_raw]
+        self._opt_h = [jax.device_put(self._init_fn(w), rep)
+                       for w in self._h_raw]
+        self._opt_s = [jax.tree_util.tree_map(
+            lambda l: jax.device_put(l, stk), self._init_fn(w))
+            for w in self._s_raw]
+        # weight-decay indices follow the optimizer's param-idx convention:
+        # embed params first, then the stacked cell leaves, then head
+        nE, nS = len(self._e_raw), len(self._s_raw)
+        self._wd_e = [self.optimizer._get_wd(i) for i in range(nE)]
+        self._wd_s = [self.optimizer._get_wd(nE + i) for i in range(nS)]
+        self._wd_h = [self.optimizer._get_wd(nE + nS + i)
+                      for i in range(len(self._h_raw))]
+        self._t = 0
+        self._step_jit = {}
+
+    # ------------------------------------------------------------------
+    def _loss_raw(self, pred_raw, label_raw):
+        from .data_parallel import DataParallelTrainer
+        return DataParallelTrainer._loss_raw(self, pred_raw, label_raw)
+
+    def _build_step(self):
+        embed_apply = self._embed_apply
+        cell_apply = self._cell_apply
+        head_apply = self._head_apply
+        update_fn = self._update_fn
+        loss_raw = self._loss_raw
+        mesh, ppax, dpax = self.mesh, self.pp_axis, self.dp_axis
+        n_stages, L, M = self.n_stages, self.layers_per_stage, self.num_microbatch
+        wd_e, wd_s, wd_h = self._wd_e, self._wd_s, self._wd_h
+        remat = self.remat
+        cdt = self.compute_dtype
+
+        def _low(a):
+            if cdt is not None and jnp.issubdtype(a.dtype, jnp.floating):
+                return a.astype(cdt)
+            return a
+
+        def _no_aux(out_aux, what):
+            out, aux = out_aux
+            if aux:
+                raise MXNetError(
+                    f"pipeline {what} emits mutable aux state (BN running "
+                    "stats); pipeline stages must be stateless")
+            return out
+
+        def body(eparams, sparams, hparams, opt_e, opt_s, opt_h,
+                 key, x, y, lr, t):
+            # x/y: (M, mb_local, T...) — microbatch stack, batch dim already
+            # dp-sliced by shard_map. sparams leaves: (L, ...) local layers.
+            idx = lax.axis_index(ppax)
+            kk = jax.random.wrap_key_data(key.astype(jnp.uint32),
+                                          impl="threefry2x32")
+            kk = jax.random.fold_in(kk, idx)
+            if dpax is not None:
+                kk = jax.random.fold_in(kk, lax.axis_index(dpax))
+
+            def stage_fn(params_local, h):
+                def cell_body(hc, xs):
+                    lp, li = xs
+                    klayer = jax.random.key_data(jax.random.fold_in(kk, li))
+                    return _no_aux(cell_apply(klayer, lp, hc), "cell"), None
+                out, _ = lax.scan(cell_body, h, (params_local, jnp.arange(L)))
+                return out
+
+            def lossf(ep, sp, hp):
+                k_e = jax.random.key_data(jax.random.fold_in(kk, 10_000))
+                k_h = jax.random.key_data(jax.random.fold_in(kk, 10_001))
+                xf = x.reshape((-1,) + x.shape[2:])
+                h = _no_aux(embed_apply(k_e, [_low(p) for p in ep], xf),
+                            "embed block")
+                h = h.reshape((M, -1) + h.shape[1:])
+                out = pipeline_apply(
+                    lambda p, hx: stage_fn([_low(q) for q in p], hx),
+                    sp, h, axis_name=ppax, remat=remat)
+                of = out.reshape((-1,) + out.shape[2:])
+                logits = _no_aux(head_apply(k_h, [_low(p) for p in hp], of),
+                                 "head block")
+                lossv = loss_raw(logits, y.reshape((-1,) + y.shape[2:]))
+                # only the last stage saw real activations. The mask must be
+                # a plain where — NOT a psum: collectives inside the
+                # differentiated scalar would re-psum the per-device
+                # cotangent seeds and inflate every gradient by n_stages.
+                return jnp.where(idx == n_stages - 1, lossv, 0.0)
+
+            lossv, (ge, gs, gh) = jax.value_and_grad(
+                lossf, argnums=(0, 1, 2))(eparams, sparams, hparams)
+            # loss reporting + replica sync happen OUTSIDE the grad: psum
+            # selects the last stage's loss and broadcasts it; embed grads
+            # live on stage 0 and head grads on the last stage, so psum over
+            # pp is the sync that keeps the replicated copies identical.
+            lossv = lax.psum(lossv, ppax)
+            if dpax is not None:
+                lossv = lax.pmean(lossv, dpax)
+            ge = [lax.psum(g, ppax) for g in ge]
+            gh = [lax.psum(g, ppax) for g in gh]
+            if dpax is not None:
+                ge = [lax.pmean(g, dpax) for g in ge]
+                gs = [lax.pmean(g, dpax) for g in gs]
+                gh = [lax.pmean(g, dpax) for g in gh]
+
+            def upd(grads, params, states, wds):
+                new_p, new_s = [], []
+                for g, w, s, wd in zip(grads, params, states, wds):
+                    w2, s2 = update_fn(g, w, s, t, lr, jnp.float32(wd))
+                    new_p.append(w2.astype(w.dtype))
+                    new_s.append(s2)
+                return new_p, new_s
+
+            eparams, opt_e = upd(ge, eparams, opt_e, wd_e)
+            sparams, opt_s = upd(gs, sparams, opt_s, wd_s)
+            hparams, opt_h = upd(gh, hparams, opt_h, wd_h)
+            return eparams, sparams, hparams, opt_e, opt_s, opt_h, lossv
+
+        rep, stk = P(), P(ppax)
+        data = P(None, dpax) if dpax is not None else P(None)
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(rep, stk, rep, rep, stk, rep, rep, data, data, rep, rep),
+            out_specs=(rep, stk, rep, rep, stk, rep, rep),
+            check_vma=False)
+
+    def step(self, x, y):
+        """One fused pipeline-parallel training step on a global batch."""
+        xr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        yr = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+        M = self.num_microbatch
+        B = xr.shape[0]
+        # the loss is a mean: grads are already batch-normalized (same
+        # contract as DataParallelTrainer.step, data_parallel.py)
+        self.optimizer.rescale_grad = 1.0
+        if B % (M * self.n_dp) != 0:
+            raise MXNetError(
+                f"batch {B} must divide by num_microbatch*dp = {M}*{self.n_dp}")
+        xr = xr.reshape((M, B // M) + xr.shape[1:])
+        yr = yr.reshape((M, B // M) + yr.shape[1:])
+        sig = (xr.shape, str(xr.dtype), yr.shape, str(yr.dtype))
+        fn = self._step_jit.get(sig)
+        if fn is None:
+            fn = jax.jit(self._build_step(),
+                         donate_argnums=(0, 1, 2, 3, 4, 5))
+            self._step_jit[sig] = fn
+        self._t += 1
+        self.optimizer.num_update = self._t
+        lr = _np.float32(self.optimizer.learning_rate)
+        key = _np.asarray(_rng.next_key_raw())
+        data = P(None, self.dp_axis) if self.dp_axis else P(None)
+        xr = jax.device_put(xr, NamedSharding(
+            self.mesh, P(*data, *([None] * (xr.ndim - 2)))))
+        yr = jax.device_put(yr, NamedSharding(
+            self.mesh, P(*data, *([None] * (yr.ndim - 2)))))
+        (self._e_raw, self._s_raw, self._h_raw, self._opt_e, self._opt_s,
+         self._opt_h, lossv) = fn(
+            self._e_raw, self._s_raw, self._h_raw, self._opt_e, self._opt_s,
+            self._opt_h, key, xr, yr, lr, _np.float32(self._t))
+        return lossv
+
+    def sync(self):
+        """Write device params back into the gluon Parameters (unstacking
+        the layerwise cell stacks)."""
+        for p, w in zip(self._embed_plist, self._e_raw):
+            p._data._set_data(w)
+        for p, w in zip(self._head_plist, self._h_raw):
+            p._data._set_data(w)
+        for i, w in enumerate(self._s_raw):
+            host = _np.asarray(w)
+            for j, cp in enumerate(self._cell_plists):
+                cp[i]._data._set_data(jnp.asarray(host[j]))
+
+    @property
+    def num_update(self):
+        return self._t
