@@ -107,7 +107,9 @@ def bench_resnet(batch, image, steps, warmup):
     from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
 
     mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
-    net = resnet50_v1()
+    # BENCH_S2D=1 swaps in the math-equivalent space-to-depth stem
+    # (model_zoo resnet.SpaceToDepthStem) for A/B on the chip
+    net = resnet50_v1(s2d_stem=os.environ.get("BENCH_S2D") == "1")
     # Initialize + deferred shape inference on CPU (ms-scale compiles);
     # the accelerator sees exactly one compile — the fused train step.
     with mx.cpu():

@@ -9,7 +9,8 @@ from ....base import MXNetError
 from ...block import HybridBlock
 from ... import nn
 
-__all__ = ["ResNetV1", "ResNetV2", "resnet18_v1", "resnet34_v1", "resnet50_v1",
+__all__ = ["ResNetV1", "ResNetV2", "SpaceToDepthStem",
+           "resnet18_v1", "resnet34_v1", "resnet50_v1",
            "resnet101_v1", "resnet152_v1", "resnet18_v2", "resnet34_v2",
            "resnet50_v2", "resnet101_v2", "resnet152_v2", "get_resnet"]
 
@@ -129,15 +130,54 @@ class BottleneckV2(HybridBlock):
         return x + residual
 
 
+class SpaceToDepthStem(HybridBlock):
+    """Math-equivalent replacement for the 7x7/stride-2 stem conv — the
+    classic TPU ResNet transform (MLPerf reference implementations):
+    space_to_depth(2) folds the stride into channels, turning the
+    7x7/s2 conv over 3 channels (an MXU-hostile shape: 147-deep
+    contraction, stride-2 halo) into a 4x4/s1 conv over 12 channels.
+    The parameter KEEPS the original (64, 3, 7, 7) layout — plain-stem
+    weights copy straight in — and the forward rearranges it:
+    zero-pad 7x7 -> 8x8 (top/left, compensating the odd pad=3), then view
+    each 2x2 tap block as one tap over the s2d (dy, dx, c) channel order.
+    Outputs equal the original conv up to float reduction order."""
+
+    def __init__(self, channels=64, in_channels=3, **kwargs):
+        super().__init__(**kwargs)
+        self._channels = channels
+        self.weight = self.params.get("weight",
+                                      shape=(channels, in_channels, 7, 7))
+
+    def hybrid_forward(self, F, x, weight):
+        O, C = self._channels, weight.shape[1]
+        x = F.space_to_depth(x, block_size=2)
+        # original pad=3 becomes asymmetric (2, 1) in block space
+        x = F.pad(x, mode="constant",
+                  pad_width=(0, 0, 0, 0, 2, 1, 2, 1))
+        w8 = F.pad(weight.reshape((1, O * C, 7, 7)), mode="constant",
+                   pad_width=(0, 0, 0, 0, 1, 0, 1, 0))
+        w8 = w8.reshape((O, C, 4, 2, 4, 2))
+        w = w8.transpose((0, 3, 5, 1, 2, 4)).reshape((O, 4 * C, 4, 4))
+        return F.Convolution(x, w, None, kernel=(4, 4), stride=(1, 1),
+                             pad=(0, 0), num_filter=O, no_bias=True)
+
+
 class ResNetV1(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False, **kwargs):
+    """s2d_stem=True swaps the 7x7/s2 stem conv for the math-equivalent
+    SpaceToDepthStem (same parameter shape, same outputs, MXU-friendly)."""
+
+    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
+                 s2d_stem=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         self.features = nn.HybridSequential()
         if thumbnail:
             self.features.add(_conv3x3(channels[0], 1, 0))
         else:
-            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
+            if s2d_stem:
+                self.features.add(SpaceToDepthStem(channels[0]))
+            else:
+                self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
             self.features.add(nn.BatchNorm())
             self.features.add(nn.Activation("relu"))
             self.features.add(nn.MaxPool2D(3, 2, 1))

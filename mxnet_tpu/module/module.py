@@ -1,8 +1,13 @@
 """Module (reference python/mxnet/module/module.py:40).
 
-One Symbol + one Executor (jit-compiled graph; the reference's
-DataParallelExecutorGroup multi-device slicing collapses into XLA sharding —
-use parallel.DataParallelTrainer for the multi-chip path).
+One Symbol bound to one executor per context: a single-entry ctx list is
+the common path (one jit-compiled graph), while a ctx LIST slices each
+batch across per-context executors with summed gradients and parameter
+broadcast — the reference DataParallelExecutorGroup semantics
+(python/mxnet/module/executor_group.py:144). The TPU-native path for real
+multi-chip training remains parallel.DataParallelTrainer (one jit over a
+mesh); this legacy path exists so ported multi-device Module scripts run
+correctly instead of silently training on context[0].
 """
 from __future__ import annotations
 
@@ -40,9 +45,18 @@ class Module(BaseModule):
         self._symbol = symbol
         self._data_names = list(data_names or [])
         self._label_names = list(label_names or [])
-        self._context = context if isinstance(context, Context) else \
-            (context[0] if isinstance(context, (list, tuple)) and context
-             else current_context())
+        # ctx list -> batch-slicing data parallelism over one executor per
+        # context (reference DataParallelExecutorGroup,
+        # python/mxnet/module/executor_group.py:144): inputs are sliced
+        # along axis 0, gradients are summed across executors before the
+        # update, updated params are broadcast back.
+        if isinstance(context, Context):
+            self._contexts = [context]
+        elif isinstance(context, (list, tuple)) and context:
+            self._contexts = list(context)
+        else:
+            self._contexts = [current_context()]
+        self._context = self._contexts[0]
         self._fixed_param_names = set(fixed_param_names or [])
         arg_names = symbol.list_arguments()
         self._param_names = [n for n in arg_names
@@ -75,12 +89,36 @@ class Module(BaseModule):
                                    or (inputs_need_grad and n in self._data_names))
                    else "null"
                    for n in self._symbol.list_arguments()}
-        self._exec = self._symbol.simple_bind(ctx=self._context, grad_req=req,
-                                              **shapes)
+        n_ctx = len(self._contexts)
+        if n_ctx > 1:
+            # per-executor shapes: batch axis 0 sliced evenly (the reference
+            # additionally supports uneven work_load_list splits; we refuse)
+            io_names = set(self._data_names) | set(self._label_names)
+            sliced = {}
+            for name, shape in shapes.items():
+                if name in io_names:
+                    if shape[0] % n_ctx != 0:
+                        raise MXNetError(
+                            f"batch dim {shape[0]} of '{name}' must divide "
+                            f"evenly across {n_ctx} contexts")
+                    sliced[name] = (shape[0] // n_ctx,) + tuple(shape[1:])
+                else:
+                    sliced[name] = shape
+            self._execs = [self._symbol.simple_bind(ctx=c, grad_req=req,
+                                                    **sliced)
+                           for c in self._contexts]
+        else:
+            self._execs = [self._symbol.simple_bind(ctx=self._context,
+                                                    grad_req=req, **shapes)]
+        self._exec = self._execs[0]
         # cache the name->grad mapping once: list_arguments/grad_arrays are
         # full-graph traversals, too slow for the per-batch update() loop
-        grads = dict(zip(self._symbol.list_arguments(),
-                         self._exec.grad_arrays))
+        arg_names_all = self._symbol.list_arguments()
+        self._exec_grads = [dict(zip(arg_names_all, e.grad_arrays))
+                            for e in self._execs]
+        self._exec_args = [dict(zip(arg_names_all, e.arg_arrays))
+                           for e in self._execs]
+        grads = self._exec_grads[0]
         self._param_grads = [(i, name, grads.get(name))
                              for i, name in enumerate(self._param_names)]
         self._data_grads = [grads.get(n) for n in self._data_names]
@@ -128,6 +166,15 @@ class Module(BaseModule):
                 arr._set_data(aux_params[name]._data.astype(arr.dtype))
         self._arg_params = {n: arg_dict[n] for n in self._param_names}
         self._aux_params = dict(aux_dict)
+        # replica executors start from the primary's values (reference
+        # executor_group broadcast); aux states then evolve per replica and
+        # get_params reads the primary's, like the reference's devices[0]
+        for e, rep_args in zip(self._execs[1:], self._exec_args[1:]):
+            for name in self._param_names:
+                self._arg_params[name].copyto(rep_args[name])
+            rep_aux = dict(zip(self._aux_names, e.aux_arrays))
+            for name in self._aux_names:
+                self._aux_params[name].copyto(rep_aux[name])
         self.params_initialized = True
 
     def get_params(self):
@@ -158,6 +205,19 @@ class Module(BaseModule):
         self.optimizer_initialized = True
 
     # -- compute -------------------------------------------------------------
+    def _slice_for(self, arr, k):
+        """Slice batch axis 0 for executor k and place on its context."""
+        n = len(self._contexts)
+        if arr.shape[0] % n != 0:
+            raise MXNetError(
+                f"batch dim {arr.shape[0]} must divide evenly across "
+                f"{n} contexts (a short final batch needs padding — "
+                "reference DataParallelExecutorGroup slices unevenly via "
+                "work_load_list, which we deliberately do not)")
+        m = arr.shape[0] // n
+        part = arr[k * m:(k + 1) * m]
+        return part.as_in_context(self._contexts[k])
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         if is_train is None:
@@ -168,7 +228,12 @@ class Module(BaseModule):
         if data_batch.label is not None and self._label_names:
             for name, arr in zip(self._label_names, data_batch.label):
                 feed[name] = arr
-        self._exec.forward(is_train=is_train, **feed)
+        if len(self._execs) == 1:
+            self._exec.forward(is_train=is_train, **feed)
+            return
+        for k, e in enumerate(self._execs):
+            e.forward(is_train=is_train,
+                      **{n_: self._slice_for(a, k) for n_, a in feed.items()})
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
@@ -177,21 +242,52 @@ class Module(BaseModule):
         # hide a training loop running on a for_training=False module
         assert self.for_training, \
             "backward() on a module bound with for_training=False"
-        self._exec.backward(out_grads=out_grads)
+        if len(self._execs) == 1:
+            self._exec.backward(out_grads=out_grads)
+            return
+        for k, e in enumerate(self._execs):
+            og = None
+            if out_grads is not None:
+                og = [self._slice_for(g, k) for g in out_grads]
+            e.backward(out_grads=og)
 
     def update(self):
         assert self.optimizer_initialized
+        multi = len(self._execs) > 1
         for i, name, g in self._param_grads:
             if g is None or name in self._fixed_param_names:
                 continue
+            if multi:
+                # sum the replica gradients onto the primary context
+                # (reference kvstore-local reduce semantics)
+                for eg in self._exec_grads[1:]:
+                    g = g + eg[name].as_in_context(self._context)
             self._updater(i, g, self._arg_params[name])
+        if multi:
+            # broadcast updated params back to the replica executors
+            for arg_dict in self._exec_args[1:]:
+                for name in self._param_names:
+                    self._arg_params[name].copyto(arg_dict[name])
 
     def get_outputs(self, merge_multi_context=True):
-        return self._exec.outputs
+        if len(self._execs) == 1 or not merge_multi_context:
+            return self._exec.outputs
+        merged = []
+        for outs in zip(*(e.outputs for e in self._execs)):
+            parts = [o.as_in_context(self._context) for o in outs]
+            merged.append(nd.concat(*parts, dim=0))
+        return merged
 
     def get_input_grads(self, merge_multi_context=True):
         assert self._inputs_need_grad
-        return list(self._data_grads)
+        if len(self._execs) == 1 or not merge_multi_context:
+            return list(self._data_grads)
+        merged = []
+        for name in self._data_names:
+            parts = [eg[name].as_in_context(self._context)
+                     for eg in self._exec_grads]
+            merged.append(nd.concat(*parts, dim=0))
+        return merged
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         eval_metric.update(labels, self.get_outputs())
@@ -242,6 +338,9 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         outs = self._exec.outputs
+        if outs and len(self._execs) > 1:
+            return [(name, (o.shape[0] * len(self._execs),) + tuple(o.shape[1:]))
+                    for name, o in zip(self.output_names, outs)]
         if outs:
             return list(zip(self.output_names, [o.shape for o in outs]))
         # before the first forward the executor has no materialized

@@ -50,12 +50,14 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_stack,
     """Differentiable circular pipeline schedule. Call INSIDE shard_map over
     `axis_name`.
 
-    stage_fn(stage_params, x_mb) -> y_mb must be shape-preserving;
-    stage_params is THIS device's stage pytree; x_stack is the (M, ...)
-    microbatch stack (only stage 0's copy is consumed — other stages receive
-    activations over ppermute). Returns the (M, ...) output stack, valid on
-    the LAST stage (finite zeros elsewhere — inactive ticks compute on
-    zeros and are masked, so no NaNs leak and no gradient flows from them).
+    stage_fn(stage_params, x_mb, tick) -> y_mb must be shape-preserving;
+    stage_params is THIS device's stage pytree; `tick` is the schedule step
+    (traced int32 — fold it into RNG keys so every microbatch draws fresh
+    dropout masks); x_stack is the (M, ...) microbatch stack (only stage 0's
+    copy is consumed — other stages receive activations over ppermute).
+    Returns the (M, ...) output stack, valid on the LAST stage (finite zeros
+    elsewhere — inactive ticks compute on zeros and are masked, so no NaNs
+    leak and no gradient flows from them).
 
     Reverse-mode differentiation through this function yields the reverse
     pipeline schedule with weight-gradient accumulation (see module
@@ -69,7 +71,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_stack,
 
     def body(inflight, t):
         x_in = jnp.where(idx == 0, x_stack[jnp.clip(t, 0, M - 1)], inflight)
-        y = f(stage_params, x_in)
+        y = f(stage_params, x_in, t)
         active = jnp.logical_and(t - idx >= 0, t - idx < M)
         y = jnp.where(active, y, jnp.zeros_like(y))
         perm = [(i, (i + 1) % n) for i in range(n)]
@@ -83,7 +85,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_stack,
 def gpipe_schedule(stage_fn: Callable, n_microbatch: int, axis_name: str):
     """Back-compat shim over pipeline_apply for parameterless stage fns."""
     def run(x_stack):
-        return pipeline_apply(lambda _, x: stage_fn(x), (), x_stack,
+        return pipeline_apply(lambda _, x, t: stage_fn(x), (), x_stack,
                               axis_name=axis_name, remat=False)
     return run
 
@@ -249,10 +251,14 @@ class PipelineTrainer:
             if dpax is not None:
                 kk = jax.random.fold_in(kk, lax.axis_index(dpax))
 
-            def stage_fn(params_local, h):
+            def stage_fn(params_local, h, tick):
+                # fold (tick, layer) so each microbatch draws fresh dropout
+                # masks — tick advances per microbatch in the schedule
+                kt = jax.random.fold_in(kk, tick)
+
                 def cell_body(hc, xs):
                     lp, li = xs
-                    klayer = jax.random.key_data(jax.random.fold_in(kk, li))
+                    klayer = jax.random.key_data(jax.random.fold_in(kt, li))
                     return _no_aux(cell_apply(klayer, lp, hc), "cell"), None
                 out, _ = lax.scan(cell_body, h, (params_local, jnp.arange(L)))
                 return out
@@ -265,7 +271,7 @@ class PipelineTrainer:
                             "embed block")
                 h = h.reshape((M, -1) + h.shape[1:])
                 out = pipeline_apply(
-                    lambda p, hx: stage_fn([_low(q) for q in p], hx),
+                    lambda p, hx, t_: stage_fn([_low(q) for q in p], hx, t_),
                     sp, h, axis_name=ppax, remat=remat)
                 of = out.reshape((-1,) + out.shape[2:])
                 logits = _no_aux(head_apply(k_h, [_low(p) for p in hp], of),

@@ -148,10 +148,11 @@ class Executor:
         else:
             req = {n: grad_req.get(n, "null") for n in arg_names}
         grad_dict = to_dict(args_grad, arg_names, "args_grad")
-        for n in arg_names:
-            if req.get(n, "null") != "null" and n not in grad_dict:
-                grad_dict[n] = nd.zeros(arg_dict[n].shape,
-                                        dtype=arg_dict[n].dtype)
+        with ctx:  # allocate on the executor's context, not the default
+            for n in arg_names:
+                if req.get(n, "null") != "null" and n not in grad_dict:
+                    grad_dict[n] = nd.zeros(arg_dict[n].shape,
+                                            dtype=arg_dict[n].dtype)
         return cls(symbol, ctx, arg_dict, grad_dict, req, aux_dict)
 
     @classmethod
@@ -164,15 +165,18 @@ class Executor:
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         arg_dict = {}
-        for name, s, t in zip(arg_names, arg_s, arg_t):
-            if s is None:
-                raise MXNetError(f"simple_bind: could not infer shape of {name}")
-            arg_dict[name] = nd.zeros(s, dtype=t)
         aux_dict = {}
-        for name, s, t in zip(aux_names, aux_s, aux_t):
-            init = nd.ones if name.endswith("_var") or name.endswith("var") \
-                else nd.zeros
-            aux_dict[name] = init(s, dtype=t)
+        with ctx:  # arrays live on the executor's context (multi-ctx Module
+            # binds replica executors on distinct devices)
+            for name, s, t in zip(arg_names, arg_s, arg_t):
+                if s is None:
+                    raise MXNetError(
+                        f"simple_bind: could not infer shape of {name}")
+                arg_dict[name] = nd.zeros(s, dtype=t)
+            for name, s, t in zip(aux_names, aux_s, aux_t):
+                init = nd.ones if name.endswith("_var") or name.endswith("var") \
+                    else nd.zeros
+                aux_dict[name] = init(s, dtype=t)
         return cls._bind(symbol, ctx, arg_dict, None, grad_req, aux_dict)
 
     # -- properties ----------------------------------------------------------

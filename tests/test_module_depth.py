@@ -305,3 +305,69 @@ def test_python_module_protocol():
     m = mmetric.MAE()
     mod.update_metric(m, [nd.array(np.full((2, 3), 2.0, np.float32))])
     assert m.get()[1] == 0.0
+
+
+def _fit_manual(mod, batches, lr=0.1, steps=6):
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", lr),))
+    losses = []
+    for b in batches[:steps]:
+        mod.forward(b, is_train=True)
+        out = np.asarray(mod.get_outputs()[0].asnumpy())
+        lbl = np.asarray(b.label[0].asnumpy()).astype(int)
+        losses.append(float(-np.mean(
+            np.log(out[np.arange(len(lbl)), lbl] + 1e-9))))
+        mod.backward()
+        mod.update()
+    return losses
+
+
+def test_module_ctx_list_matches_single_ctx():
+    """Module(context=[cpu(0), cpu(1)]) slices the batch across executors
+    (reference DataParallelExecutorGroup, executor_group.py:144) and must
+    track single-context training step for step."""
+    rng = np.random.RandomState(7)
+    batches = [_batch(rng) for _ in range(6)]
+    results = {}
+    for ctxs in ([mx.cpu(0)], [mx.cpu(0), mx.cpu(1)]):
+        mx.random.seed(42)  # deterministic init: the loss-decrease assert
+        # must not depend on conftest's per-process nodeid hash seed
+        mod = Module(_mlp_symbol(), context=ctxs)
+        mod.bind(data_shapes=[("data", (8, 4))],
+                 label_shapes=[("softmax_label", (8,))])
+        mod.init_params(initializer=mx.initializer.Xavier(rnd_type="uniform",
+                                                          magnitude=1.0))
+        # identical start: overwrite with a fixed set of params
+        arg, aux = mod.get_params()
+        if "ref_args" not in results:
+            results["ref_args"] = arg
+        else:
+            mod.set_params(results["ref_args"], aux)
+        results[len(ctxs)] = _fit_manual(mod, batches)
+    np.testing.assert_allclose(results[1], results[2], rtol=1e-4, atol=1e-5)
+    assert results[1][-1] < results[1][0]
+
+
+def test_module_ctx_list_outputs_and_input_grads_merge():
+    rng = np.random.RandomState(8)
+    mod = Module(_mlp_symbol(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.bind(data_shapes=[("data", (8, 4))],
+             label_shapes=[("softmax_label", (8,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    b = _batch(rng)
+    mod.forward(b, is_train=True)
+    outs = mod.get_outputs()
+    assert outs[0].shape == (8, 3)
+    assert mod.output_shapes[0][1] == (8, 3)
+    mod.backward()
+    assert mod.get_input_grads()[0].shape == (8, 4)
+    # per-executor (unmerged) view keeps the slices
+    assert mod.get_outputs(merge_multi_context=False)[0].shape == (4, 3)
+
+
+def test_module_ctx_list_refuses_uneven_batch():
+    mod = Module(_mlp_symbol(), context=[mx.cpu(0), mx.cpu(1), mx.cpu(2)])
+    with pytest.raises(mx.base.MXNetError, match="divide"):
+        mod.bind(data_shapes=[("data", (8, 4))],
+                 label_shapes=[("softmax_label", (8,))])

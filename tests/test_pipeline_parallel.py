@@ -75,7 +75,7 @@ def test_pipeline_apply_matches_sequential():
     # cross-device agreement, which by design does not hold — fetch the
     # last stage's shard instead
     out = jax.jit(shard_map(
-        lambda wi, xs: pipeline_apply(lambda p, h: stage(p[0], h), wi, xs,
+        lambda wi, xs: pipeline_apply(lambda p, h, t: stage(p[0], h), wi, xs,
                                       axis_name="pp")[None],
         mesh=mesh, in_specs=(P("pp"), P(None)), out_specs=P("pp"),
         check_vma=False))(w, x)

@@ -1,0 +1,59 @@
+"""SpaceToDepthStem must be math-equivalent to the 7x7/s2 stem conv it
+replaces (the TPU MLPerf-style stem transform, model_zoo/vision/resnet.py):
+same (64, 3, 7, 7) parameter, same outputs up to reduction order."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+from mxnet_tpu.gluon.model_zoo.vision.resnet import SpaceToDepthStem
+
+
+def test_s2d_stem_matches_conv7x7():
+    rs = onp.random.RandomState(0)
+    x = nd.array(rs.normal(0, 1, (2, 3, 64, 64)).astype(onp.float32))
+
+    conv = gluon.nn.Conv2D(64, 7, 2, 3, use_bias=False, in_channels=3)
+    conv.initialize()
+    ref = conv(x)
+
+    stem = SpaceToDepthStem(64)
+    stem.initialize()
+    stem.weight.set_data(conv.weight.data())
+    out = stem(x)
+
+    assert out.shape == ref.shape == (2, 64, 32, 32)
+    onp.testing.assert_allclose(onp.asarray(out._data), onp.asarray(ref._data),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_s2d_stem_gradients_match():
+    rs = onp.random.RandomState(1)
+    x = nd.array(rs.normal(0, 1, (2, 3, 32, 32)).astype(onp.float32))
+
+    conv = gluon.nn.Conv2D(8, 7, 2, 3, use_bias=False, in_channels=3)
+    conv.initialize()
+    stem = SpaceToDepthStem(8, in_channels=3)
+    stem.initialize()
+    stem.weight.set_data(conv.weight.data())
+
+    grads = []
+    for blk in (conv, stem):
+        xg = x.copy()
+        xg.attach_grad()
+        with mx.autograd.record():
+            y = blk(xg)
+            loss = (y * y).sum()
+        loss.backward()
+        grads.append((onp.asarray(xg.grad._data),
+                      onp.asarray(blk.weight.grad()._data)))
+    onp.testing.assert_allclose(grads[0][0], grads[1][0], rtol=1e-3, atol=1e-4)
+    onp.testing.assert_allclose(grads[0][1], grads[1][1], rtol=1e-3, atol=1e-4)
+
+
+def test_resnet50_s2d_stem_forward():
+    net = resnet50_v1(s2d_stem=True)
+    net.initialize()
+    out = net(nd.zeros((2, 3, 64, 64)))
+    assert out.shape == (2, 1000)
+    assert onp.isfinite(onp.asarray(out._data)).all()
