@@ -68,11 +68,73 @@ def probe(m, k, n):
     return flops / dt / 1e12
 
 
+# role -> (shape index, per-layer count x layers) for BERT-base bs16xT512;
+# train = fwd + dgrad + wgrad (~3x each contraction's FLOPs, both
+# orientations of which the carry-chain probe already exercises)
+ROLES = [
+    ("qkv fused (768->2304)", 0, 12),
+    ("attn out proj (768->768)", 1, 12),
+    ("ffn1 (768->3072)", 2, 12),
+    ("ffn2 (3072->768)", 3, 12),
+    ("vocab head (768->8192)", 4, 1),
+]
+
+
 def main():
+    results = []
     for m, k, n in SHAPES:
         tf = probe(m, k, n)
+        results.append(tf)
         print(json.dumps({"shape": f"({m},{k})x({k},{n})",
                           "tflops": round(tf, 1)}))
+
+    # FLOP-weighted ceiling: model TF/s if every contraction ran at its
+    # isolated speed and attention/elementwise/optimizer were free — the
+    # auditable upper bound the whole-model number is judged against
+    # (VERDICT r4 Weak #3: commit the per-GEMM table)
+    total_fl, total_t = 0.0, 0.0
+    rows = []
+    for role, i, count in ROLES:
+        m, k, n = SHAPES[i]
+        fl = 3 * 2.0 * m * k * n * count          # train ~ 3x fwd
+        t = fl / (results[i] * 1e12)
+        total_fl += fl
+        total_t += t
+        rows.append((role, f"({m},{k})x({k},{n})", count, fl / 1e9,
+                     results[i]))
+    ceiling = total_fl / total_t / 1e12
+
+    measured = os.environ.get("GP_MEASURED_TFLOPS")
+    if measured is not None:
+        measured = float(measured)
+    if measured is None:
+        bench = os.path.join(os.path.dirname(__file__), "..",
+                             "BENCH_r04.json")
+        try:
+            with open(bench) as f:
+                measured = json.load(f)["parsed"]["extra"]["bert_base_mlm"][
+                    "tflops"]
+        except Exception:
+            measured = None
+    out = os.path.join(os.path.dirname(__file__), "results",
+                       "bert_gemm_table.md")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        f.write("# BERT-base per-GEMM roofline (bs16 x T512, train ~3x fwd)\n\n")
+        f.write("| contraction | shape | count | GFLOP/step | isolated "
+                "TF/s |\n|---|---|---:|---:|---:|\n")
+        for role, shp, count, gf, tf in rows:
+            f.write(f"| {role} | {shp} | {count} | {gf:.1f} | {tf:.1f} |\n")
+        f.write(f"| big-matmul reference | (8192,8192)x(8192,8192) | - | - "
+                f"| {results[5]:.1f} |\n\n")
+        f.write(f"- FLOP-weighted GEMM ceiling: **{ceiling:.1f} TF/s** "
+                "(attention, elementwise, optimizer assumed free)\n")
+        if measured is not None:
+            f.write(f"- measured whole-model training: **{float(measured):.1f}"
+                    f" TF/s** = {float(measured) / ceiling * 100:.0f}% of "
+                    "the GEMM ceiling\n")
+    print(json.dumps({"gemm_weighted_ceiling_tflops": round(ceiling, 1),
+                      "measured_tflops": measured, "table": out}))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,132 @@
+"""MLM sequence packing on the real-data path (VERDICT r4 Weak #3).
+
+Variable-length documents padded to T=512 waste MXU cycles on pad tokens;
+greedy packing concatenates documents into full rows (RoBERTa
+FULL-SENTENCES style — no cross-document attention masking, matching that
+published recipe) so every row is ~100% real tokens. The chip step time
+per ROW is shape-identical either way, so the win is the pad fraction —
+this probe measures it end to end: synthetic corpus -> host
+pipeline (pad vs pack, including packing cost) -> fused train step ->
+REAL (non-pad) tokens/s.
+
+Usage: python benchmark/mlm_packing_probe.py        (real chip)
+       JAX_PLATFORMS=cpu PK_TINY=1 python ...       (logic smoke)
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+TINY = os.environ.get("PK_TINY") == "1"
+SEQ = 128 if TINY else 512
+BATCH = 4 if TINY else 16
+STEPS = 2 if TINY else 20
+VOCAB = 1024 if TINY else 8192
+
+
+def make_corpus(n_docs=2000, seed=0):
+    """Lognormal doc lengths (median ~T/3) — the realistic regime where
+    padding wastes most of the row."""
+    rng = np.random.RandomState(seed)
+    lengths = np.clip(rng.lognormal(np.log(SEQ / 3), 0.6, n_docs).astype(int),
+                      8, SEQ)
+    return [rng.randint(1, VOCAB, size=int(l)) for l in lengths], rng
+
+
+def padded_batches(corpus, rng):
+    """One doc per row, zero-padded to SEQ."""
+    i = 0
+    while True:
+        rows = np.zeros((BATCH, SEQ), np.int32)
+        real = 0
+        for b in range(BATCH):
+            doc = corpus[i % len(corpus)]
+            i += 1
+            rows[b, :len(doc)] = doc
+            real += len(doc)
+        yield rows, real
+
+
+def packed_batches(corpus, rng):
+    """Greedy first-fit packing of docs into full rows."""
+    i = 0
+    carry = []
+    while True:
+        rows = np.zeros((BATCH, SEQ), np.int32)
+        real = 0
+        for b in range(BATCH):
+            fill = 0
+            while fill < SEQ:
+                if not carry:
+                    carry = list(corpus[i % len(corpus)])
+                    i += 1
+                take = min(len(carry), SEQ - fill)
+                rows[b, fill:fill + take] = carry[:take]
+                carry = carry[take:]
+                fill += take
+                real += take
+        yield rows, real
+
+
+def run(mode, batches, trainer, nd):
+    gen = batches
+    # warmup/compile
+    x, _ = next(gen)
+    y = (x + 1) % VOCAB
+    trainer.run_steps(nd.array(x, dtype="int32"),
+                      nd.array(y, dtype="int32"), 2)
+    t0 = time.perf_counter()
+    real_total = 0
+    for _ in range(STEPS):
+        x, real = next(gen)
+        y = (x + 1) % VOCAB
+        losses = trainer.run_steps(nd.array(x, dtype="int32"),
+                                   nd.array(y, dtype="int32"), 1)
+        real_total += real
+    float(losses[-1])
+    dt = time.perf_counter() - t0
+    return {
+        "mode": mode,
+        "rows_s": round(BATCH * STEPS / dt, 2),
+        "real_tokens_s": round(real_total / dt, 1),
+        "pad_fraction": round(1 - real_total / (BATCH * STEPS * SEQ), 4),
+    }
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.models import bert_base, bert_tiny
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+    from bench import _loss_tokens, _enable_compile_cache
+
+    _enable_compile_cache()
+    corpus, rng = make_corpus()
+
+    results = []
+    for mode, mk in (("padded", padded_batches), ("packed", packed_batches)):
+        mx.random.seed(0)
+        net = (bert_tiny if TINY else bert_base)(vocab_size=VOCAB)
+        with mx.cpu():
+            net.initialize(ctx=mx.cpu())
+            net(nd.zeros((1, SEQ), ctx=mx.cpu(), dtype="int32"))
+        trainer = DataParallelTrainer(
+            net, _loss_tokens, optimizer="adamw",
+            optimizer_params={"learning_rate": 1e-4},
+            mesh=make_mesh({"dp": 1}, devices=jax.devices()[:1]),
+            dtype="bfloat16")
+        results.append(run(mode, mk(corpus, rng), trainer, nd))
+        print(json.dumps(results[-1]))
+    up = results[1]["real_tokens_s"] / results[0]["real_tokens_s"]
+    print(json.dumps({"packing_real_token_uplift": round(up, 3)}))
+
+
+if __name__ == "__main__":
+    main()

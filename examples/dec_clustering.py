@@ -1,0 +1,145 @@
+"""Deep embedded clustering (reference example/deep-embedded-clustering/dec.py:
+autoencoder pretraining, then KL-refinement of soft cluster assignments
+against the sharpened target distribution).
+
+Hermetic data: Gaussian blobs in 16-D observed through a fixed random
+64-D projection — the autoencoder must undo the projection before the
+cluster structure is visible.
+
+Run: python examples/dec_clustering.py [--epochs N]
+Returns clustering accuracy (best label permutation via greedy matching)
+from main().
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd, gluon  # noqa: E402
+
+K = 4
+OBS = 64
+LATENT = 8
+
+
+def make_blobs(n=512, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(K, 16) * 3.0
+    y = rng.randint(0, K, n)
+    z = centers[y] + rng.randn(n, 16)
+    proj = rng.randn(16, OBS) / 4.0
+    return (z @ proj).astype(np.float32), y
+
+
+def soft_assign(z, centroids):
+    """Student-t similarity (DEC eq. 1)."""
+    d2 = nd.sum((z.expand_dims(1) - centroids.expand_dims(0)) ** 2, axis=2)
+    q = 1.0 / (1.0 + d2)
+    return q / q.sum(axis=1, keepdims=True)
+
+
+def target_dist(q):
+    """Sharpened targets (DEC eq. 3)."""
+    w = q ** 2 / q.sum(axis=0, keepdims=True)
+    return (w / w.sum(axis=1, keepdims=True)).detach()
+
+
+def cluster_acc(pred, gold):
+    """Greedy cluster->label matching accuracy."""
+    best = 0
+    used = set()
+    for c in range(K):
+        counts = np.bincount(gold[pred == c], minlength=K).astype(float)
+        for u in used:
+            counts[u] = -1
+        lbl = int(np.argmax(counts))
+        used.add(lbl)
+        best += int(counts[lbl]) if counts[lbl] > 0 else 0
+    return best / len(gold)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--refine-epochs", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=128)
+    args = ap.parse_args(argv)
+
+    mx.random.seed(0)
+    x_np, y_np = make_blobs()
+    x_all = nd.array(x_np)
+
+    enc = gluon.nn.HybridSequential()
+    enc.add(gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(LATENT))
+    dec = gluon.nn.HybridSequential()
+    dec.add(gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(OBS))
+    for n in (enc, dec):
+        n.initialize()
+    enc(nd.zeros((2, OBS)))
+    dec(nd.zeros((2, LATENT)))
+    t_ae = gluon.Trainer(list(enc.collect_params().values()) +
+                         list(dec.collect_params().values()),
+                         "adam", {"learning_rate": 2e-3})
+    l2 = gluon.loss.L2Loss()
+    rng = np.random.RandomState(1)
+
+    # -- stage 1: autoencoder pretraining
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(x_np))
+        tot, nb = 0.0, 0
+        for s in range(0, len(perm), args.batch_size):
+            xb = nd.array(x_np[perm[s:s + args.batch_size]])
+            with autograd.record():
+                loss = l2(dec(enc(xb)), xb).mean()
+            loss.backward()
+            t_ae.step(1)
+            tot += float(loss)
+            nb += 1
+        if epoch % 10 == 0 or epoch == args.epochs - 1:
+            print(f"pretrain {epoch}: recon {tot / nb:.4f}")
+
+    # -- stage 2: init centroids by k-means on the embedding
+    z = enc(x_all).asnumpy()
+    cent = z[rng.choice(len(z), K, replace=False)].copy()
+    for _ in range(20):
+        d = ((z[:, None] - cent[None]) ** 2).sum(-1)
+        assign = d.argmin(1)
+        for c in range(K):
+            if (assign == c).any():
+                cent[c] = z[assign == c].mean(0)
+
+    centroids = nd.array(cent.astype(np.float32))
+    centroids.attach_grad()
+    t_enc = gluon.Trainer(enc.collect_params(), "sgd",
+                          {"learning_rate": 0.05})
+
+    # -- stage 3: KL refinement of q against sharpened p
+    for epoch in range(args.refine_epochs):
+        with autograd.record():
+            q = soft_assign(enc(x_all), centroids)
+            p = target_dist(q)
+            kl = nd.sum(p * ((p + 1e-9).log() - (q + 1e-9).log()), axis=1).mean()
+        kl.backward()
+        t_enc.step(1)
+        centroids -= 0.05 * centroids.grad
+        if epoch % 5 == 0 or epoch == args.refine_epochs - 1:
+            print(f"refine {epoch}: KL {float(kl):.5f}")
+
+    pred = np.asarray(soft_assign(enc(x_all), centroids)
+                      .argmax(axis=1).asnumpy(), np.int64)
+    acc = cluster_acc(pred, y_np)
+    print(f"clustering accuracy: {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

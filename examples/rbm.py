@@ -1,0 +1,83 @@
+"""Restricted Boltzmann machine with CD-1 on synthetic digits
+(reference example/restricted-boltzmann-machine/binary_rbm_gibbs.py).
+
+TPU-native notes: contrastive divergence has no loss to differentiate —
+the positive/negative phase statistics are computed with plain nd ops
+(matmuls on the MXU) and applied as manual parameter updates; Gibbs
+sampling uses nd.random_uniform thresholding. No autograd tape needed.
+
+Run: python examples/rbm.py [--epochs N]
+Returns (first_recon_err, last_recon_err) from main().
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+from mxnet_tpu.io import MNISTIter  # noqa: E402
+
+VISIBLE = 28 * 28
+HIDDEN = 64
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + (-x).exp())
+
+
+def sample(p, rng):
+    return (nd.array(rng.rand(*p.shape).astype(np.float32)) < p) \
+        .astype("float32")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=12)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args(argv)
+
+    rng = np.random.RandomState(0)
+    W = nd.array((rng.randn(VISIBLE, HIDDEN) * 0.01).astype(np.float32))
+    b_v = nd.zeros((VISIBLE,))
+    b_h = nd.zeros((HIDDEN,))
+
+    it = MNISTIter(batch_size=args.batch_size, flat=True,
+                   synthetic_size=512, seed=5)
+    errs = []
+    for epoch in range(args.epochs):
+        tot, nb = 0.0, 0
+        for batch in it:
+            v0 = ((batch.data[0].reshape((args.batch_size, -1)) / 255.0)
+                  > 0.5).astype("float32")
+            # positive phase
+            ph0 = sigmoid(nd.dot(v0, W) + b_h)
+            h0 = sample(ph0, rng)
+            # CD-1 negative phase
+            pv1 = sigmoid(nd.dot(h0, W.T) + b_v)
+            v1 = sample(pv1, rng)
+            ph1 = sigmoid(nd.dot(v1, W) + b_h)
+            # manual updates (no autograd: CD is not a gradient of any loss)
+            lr = args.lr / args.batch_size
+            W += lr * (nd.dot(v0.T, ph0) - nd.dot(v1.T, ph1))
+            b_v += lr * nd.sum(v0 - v1, axis=0)
+            b_h += lr * nd.sum(ph0 - ph1, axis=0)
+            tot += float(nd.mean(nd.abs(v0 - pv1)))
+            nb += 1
+        it.reset()
+        errs.append(tot / nb)
+        if epoch % 4 == 0 or epoch == args.epochs - 1:
+            print(f"epoch {epoch}: recon err {errs[-1]:.4f}")
+    return errs[0], errs[-1]
+
+
+if __name__ == "__main__":
+    first, last = main()
+    print(f"recon {first:.4f} -> {last:.4f}")

@@ -1,0 +1,111 @@
+"""Stochastic-depth ResNet (reference example/stochastic-depth/sd_mnist.py,
+sd_module.py): residual blocks are randomly dropped during training with
+linearly decaying survival probabilities; at test time every block runs,
+scaled by its survival probability.
+
+TPU-native notes: the reference flips a host-side coin per block per batch
+(mx.random via its custom StochasticDepthModule); data-dependent Python
+branching would retrace under jit, so each block keeps the coin INSIDE the
+graph — a Bernoulli mask broadcast over the residual branch, exactly like
+Dropout lowers. Eval mode multiplies by p_survive (inverted at train like
+standard stochastic depth).
+
+Run: python examples/stochastic_depth.py [--epochs N]
+Returns test accuracy from main().
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd, gluon  # noqa: E402
+from mxnet_tpu.io import MNISTIter  # noqa: E402
+
+
+class SDBlock(gluon.HybridBlock):
+    """Residual block whose body survives with probability p_survive."""
+
+    def __init__(self, channels, p_survive, **kw):
+        super().__init__(**kw)
+        self.p = p_survive
+        self.body = gluon.nn.HybridSequential()
+        self.body.add(gluon.nn.Conv2D(channels, 3, padding=1),
+                      gluon.nn.BatchNorm(),
+                      gluon.nn.Activation("relu"),
+                      gluon.nn.Conv2D(channels, 3, padding=1),
+                      gluon.nn.BatchNorm())
+
+    def hybrid_forward(self, F, x):
+        h = self.body(x)
+        if autograd.is_training():
+            B = x.shape[0]
+            # one coin per SAMPLE (batch-level dropping averages to the
+            # same expectation; per-sample keeps variance down), inverted
+            # scaling so eval needs no correction
+            gate = F.random.uniform(shape=(B, 1, 1, 1)) < self.p
+            h = h * gate.astype(h.dtype) / self.p
+        return F.Activation(x + h, act_type="relu")
+
+
+def make_net(n_blocks=4, p_last=0.5):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu"))
+    for i in range(n_blocks):
+        # linear decay rule from the stochastic-depth paper
+        p = 1.0 - (i + 1) / n_blocks * (1.0 - p_last)
+        net.add(SDBlock(16, p))
+    net.add(gluon.nn.GlobalAvgPool2D(), gluon.nn.Flatten(),
+            gluon.nn.Dense(10))
+    return net
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    mx.random.seed(0)
+    net = make_net()
+    net.initialize()
+    net(nd.zeros((2, 1, 28, 28)))
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 2e-3})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    it = MNISTIter(batch_size=args.batch_size, synthetic_size=512, seed=11)
+
+    for epoch in range(args.epochs):
+        tot, nb = 0.0, 0
+        for batch in it:
+            x = batch.data[0] / 255.0
+            y = batch.label[0].astype("int32")
+            with autograd.record():
+                loss = ce(net(x), y).mean()
+            loss.backward()
+            tr.step(1)
+            tot += float(loss)
+            nb += 1
+        it.reset()
+        if epoch % 2 == 0 or epoch == args.epochs - 1:
+            print(f"epoch {epoch}: loss {tot / nb:.4f}")
+
+    correct = total = 0
+    for batch in it:
+        x = batch.data[0] / 255.0
+        y = batch.label[0].astype("int32")
+        pred = net(x).argmax(axis=1).astype("int32")
+        correct += int((pred == y).sum())
+        total += y.shape[0]
+    acc = correct / total
+    print(f"test accuracy (all blocks active): {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -115,7 +115,73 @@ def rec_batches(path, batch_size, image=64):
         it.reset()
 
 
-def main():
+def _iou(a, b):
+    x1, y1 = max(a[0], b[0]), max(a[1], b[1])
+    x2, y2 = min(a[2], b[2]), min(a[3], b[3])
+    inter = max(0.0, x2 - x1) * max(0.0, y2 - y1)
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def evaluate_map(net, seed, num_classes, n_batches=8, batch=8, iou_thr=0.5):
+    """VOC-style mAP@IoU0.5, all-point interpolation, over fresh synthetic
+    scenes (reference example/ssd/evaluate/eval_metric.py MApMetric)."""
+    rng = np.random.RandomState(seed)
+    all_dets = {c: [] for c in range(num_classes)}
+    gts = {}
+    img_id = 0
+    for _ in range(n_batches):
+        x, labels = synthetic_batch(rng, batch, num_classes)
+        anchors, cls_preds, box_preds = net(x)
+        probs = nd.softmax(cls_preds, axis=-1).transpose((0, 2, 1))
+        det = nd.contrib.MultiBoxDetection(probs, box_preds, anchors,
+                                           nms_threshold=0.45, threshold=0.01)
+        d = det.asnumpy()   # (B, N, 6): class, score, x1, y1, x2, y2
+        lab = labels.asnumpy()
+        for b in range(d.shape[0]):
+            for row in d[b]:
+                if row[0] >= 0:
+                    all_dets[int(row[0])].append(
+                        (float(row[1]), img_id, row[2:6].copy()))
+            for obj in lab[b]:
+                if obj[0] >= 0:
+                    gts.setdefault((img_id, int(obj[0])), []).append(
+                        obj[1:5].copy())
+            img_id += 1
+    aps = []
+    for c in range(num_classes):
+        npos = sum(len(v) for (_, cc), v in gts.items() if cc == c)
+        if npos == 0:
+            continue
+        dets = sorted(all_dets[c], key=lambda r: -r[0])
+        matched = set()
+        tp = np.zeros(len(dets))
+        fp = np.zeros(len(dets))
+        for k, (_, iid, box) in enumerate(dets):
+            cands = gts.get((iid, c), [])
+            best_iou, best_j = 0.0, -1
+            for j, g in enumerate(cands):
+                iou = _iou(box, g)
+                if iou > best_iou:
+                    best_iou, best_j = iou, j
+            if best_iou >= iou_thr and (iid, best_j) not in matched:
+                matched.add((iid, best_j))
+                tp[k] = 1
+            else:
+                fp[k] = 1
+        rec = np.cumsum(tp) / npos
+        prec = np.cumsum(tp) / np.maximum(np.cumsum(tp) + np.cumsum(fp),
+                                          1e-9)
+        mrec = np.concatenate([[0.0], rec, [1.0]])
+        mpre = np.concatenate([[0.0], prec, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = np.where(mrec[1:] != mrec[:-1])[0]
+        aps.append(float(((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]).sum()))
+    return float(np.mean(aps)) if aps else 0.0
+
+
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--batch-size", type=int, default=8)
@@ -128,7 +194,12 @@ def main():
     ap.add_argument("--use-rec", action="store_true",
                     help="train from a det RecordIO via ImageDetRecordIter "
                          "instead of in-memory synthetic batches")
-    args = ap.parse_args()
+    ap.add_argument("--eval-map", action="store_true",
+                    help="after training, report VOC mAP@0.5 for fp32 AND "
+                         "the int8-quantized net (reference "
+                         "example/ssd/README.md:46 publishes this pair); "
+                         "main() then returns (map_fp32, map_int8)")
+    args = ap.parse_args(argv)
 
     rng = np.random.RandomState(0)
     net = TinySSD(num_classes=args.num_classes)
@@ -186,6 +257,23 @@ def main():
     print(f"detections kept per image: {kept.tolist()}")
     assert (kept > 0).all(), "NMS should keep at least one detection"
     print("ssd example ok")
+
+    if args.eval_map:
+        map_fp32 = evaluate_map(net, seed=1234, num_classes=args.num_classes)
+        print(f"fp32 mAP@0.5: {map_fp32:.4f}")
+        # int8: calibrate on fresh synthetic images, quantize IN PLACE,
+        # evaluate the same held-out scenes
+        from mxnet_tpu.contrib.quantization import quantize_net
+        calib_rng = np.random.RandomState(77)
+        calib = [synthetic_batch(calib_rng, args.batch_size,
+                                 args.num_classes)[0] for _ in range(4)]
+        qlayers = quantize_net(net, calib_data=calib, calib_mode="entropy")
+        print(f"quantized {len(qlayers)} layers to int8")
+        map_int8 = evaluate_map(net, seed=1234, num_classes=args.num_classes)
+        print(f"int8 mAP@0.5: {map_int8:.4f} (delta "
+              f"{(map_fp32 - map_int8) * 100:+.2f} pt)")
+        return map_fp32, map_int8
+    return None
 
 
 if __name__ == "__main__":

@@ -1,0 +1,164 @@
+"""VAE-GAN on synthetic digits (reference example/vae-gan/vaegan_mxnet.py:
+encoder + generator/decoder + discriminator; the VAE reconstruction loss
+is computed in the DISCRIMINATOR's feature space and the decoder doubles
+as the GAN generator).
+
+TPU-native notes: three Trainers over three sub-nets, each step a fused
+loss; the discriminator feature-matching reconstruction loss reuses the
+same forward features via a feature-extractor split of D.
+
+Run: python examples/vae_gan.py [--steps N]
+Returns (first_recon, last_recon, mean_d_fake) from main().
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd, gluon  # noqa: E402
+from mxnet_tpu.io import MNISTIter  # noqa: E402
+
+LATENT = 24
+
+
+def make_encoder():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, 4, strides=2, padding=1, activation="relu"),
+            gluon.nn.Conv2D(32, 4, strides=2, padding=1, activation="relu"),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(2 * LATENT))
+    return net
+
+
+def make_decoder():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64 * 7 * 7, activation="relu"),
+            gluon.nn.HybridLambda(lambda F, x: x.reshape((-1, 64, 7, 7))),
+            gluon.nn.Conv2DTranspose(32, 4, strides=2, padding=1,
+                                     activation="relu"),
+            gluon.nn.Conv2DTranspose(1, 4, strides=2, padding=1),
+            gluon.nn.Activation("sigmoid"))
+    return net
+
+
+class Discriminator(gluon.HybridBlock):
+    """Exposes the penultimate features for VAE-GAN's feature-space
+    reconstruction loss."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.feat = gluon.nn.HybridSequential()
+        self.feat.add(gluon.nn.Conv2D(16, 4, strides=2, padding=1),
+                      gluon.nn.LeakyReLU(0.2),
+                      gluon.nn.Conv2D(32, 4, strides=2, padding=1),
+                      gluon.nn.LeakyReLU(0.2),
+                      gluon.nn.Flatten())
+        self.head = gluon.nn.Dense(1)
+
+    def hybrid_forward(self, F, x):
+        f = self.feat(x)
+        return self.head(f), f
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=80)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    args = ap.parse_args(argv)
+
+    mx.random.seed(0)
+    enc, dec, disc = make_encoder(), make_decoder(), Discriminator()
+    for n in (enc, dec, disc):
+        n.initialize()
+    enc(nd.zeros((2, 1, 28, 28)))
+    dec(nd.zeros((2, LATENT)))
+    disc(nd.zeros((2, 1, 28, 28)))
+
+    t_e = gluon.Trainer(enc.collect_params(), "adam",
+                        {"learning_rate": args.lr})
+    t_d = gluon.Trainer(dec.collect_params(), "adam",
+                        {"learning_rate": args.lr})
+    t_disc = gluon.Trainer(disc.collect_params(), "adam",
+                           {"learning_rate": args.lr, "beta1": 0.5})
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    it = MNISTIter(batch_size=args.batch_size, synthetic_size=384, seed=17)
+    rng = np.random.RandomState(2)
+    ones = nd.ones((args.batch_size,))
+    zeros = nd.zeros((args.batch_size,))
+
+    recons = []
+    step = 0
+    while step < args.steps:
+        for batch in it:
+            if step >= args.steps:
+                break
+            x = batch.data[0] / 255.0
+            eps = nd.array(rng.randn(args.batch_size, LATENT)
+                           .astype(np.float32))
+            z_p = nd.array(rng.randn(args.batch_size, LATENT)
+                           .astype(np.float32))
+
+            # -- discriminator: real vs reconstruction vs prior sample
+            with autograd.record():
+                mulv = enc(x)
+                mu, logvar = mulv[:, :LATENT], mulv[:, LATENT:]
+                z = mu + eps * (0.5 * logvar).exp()
+                xr = dec(z)
+                xp = dec(z_p)
+                d_real, _ = disc(x)
+                d_rec, _ = disc(xr.detach())
+                d_fake, _ = disc(xp.detach())
+                d_loss = (bce(d_real[:, 0], ones) + bce(d_rec[:, 0], zeros) +
+                          bce(d_fake[:, 0], zeros)).mean()
+            d_loss.backward()
+            t_disc.step(1)
+
+            # -- encoder+decoder: KL + feature-space recon + fool D
+            with autograd.record():
+                mulv = enc(x)
+                mu, logvar = mulv[:, :LATENT], mulv[:, LATENT:]
+                z = mu + eps * (0.5 * logvar).exp()
+                xr = dec(z)
+                xp = dec(z_p)
+                _, f_real = disc(x)
+                d_rec, f_rec = disc(xr)
+                d_fake, _ = disc(xp)
+                recon = nd.mean((f_rec - f_real.detach()) ** 2)
+                kl = -0.5 * nd.mean(1 + logvar - mu * mu - logvar.exp())
+                fool = (bce(d_rec[:, 0], ones) + bce(d_fake[:, 0], ones)).mean()
+                eg_loss = recon + 0.1 * kl + 0.1 * fool
+            eg_loss.backward()
+            t_e.step(1)
+            t_d.step(1)
+
+            recons.append(float(recon))
+            step += 1
+            if step % 20 == 0:
+                print(f"step {step}: recon {np.mean(recons[-20:]):.4f} "
+                      f"d_loss {float(d_loss):.3f}")
+        it.reset()
+
+    d_scores = []
+    for batch in it:
+        z_p = nd.array(rng.randn(args.batch_size, LATENT).astype(np.float32))
+        s, _ = disc(dec(z_p))
+        d_scores.append(float(s.sigmoid().mean()))
+        break
+    first = float(np.mean(recons[:10]))
+    last = float(np.mean(recons[-10:]))
+    print(f"feature recon {first:.4f} -> {last:.4f}; mean D(sample) "
+          f"{d_scores[0]:.3f}")
+    return first, last, d_scores[0]
+
+
+if __name__ == "__main__":
+    main()

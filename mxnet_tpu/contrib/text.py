@@ -145,3 +145,143 @@ class TokenEmbedding:
             else _np.asarray(new_vectors)
         raw = raw.at[_np.asarray(idxs)].set(nv)
         self._idx_to_vec._set_data(raw)
+
+
+# ---------------------------------------------------------------------------
+# Registered embedding catalog
+# (reference contrib/text/embedding.py register/create/GloVe/FastText/
+#  CustomEmbedding/CompositeEmbedding. Zero-egress stance: the catalogs
+#  list the reference's pretrained file names, but files must already sit
+#  under embedding_root — there is no downloader; the error says where to
+#  put them.)
+# ---------------------------------------------------------------------------
+
+_EMBEDDING_REGISTRY: Dict[str, type] = {}
+
+
+def register(embedding_cls):
+    """Class decorator: register a TokenEmbedding subclass under its
+    lowercased class name (reference embedding.py:43)."""
+    name = embedding_cls.__name__.lower()
+    _EMBEDDING_REGISTRY[name] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """create('glove', pretrained_file_name=..., ...) (reference
+    embedding.py:66)."""
+    name = embedding_name.lower()
+    if name not in _EMBEDDING_REGISTRY:
+        raise MXNetError(
+            f"unknown embedding {embedding_name!r}; registered: "
+            f"{sorted(_EMBEDDING_REGISTRY)}")
+    return _EMBEDDING_REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Catalog of pretrained file names per registered embedding
+    (reference embedding.py get_pretrained_file_names)."""
+    if embedding_name is not None:
+        cls = _EMBEDDING_REGISTRY.get(embedding_name.lower())
+        if cls is None:
+            raise MXNetError(f"unknown embedding {embedding_name!r}")
+        return list(getattr(cls, "pretrained_file_name_sha1", {}))
+    # only catalog-backed embeddings appear in the overview (Custom/
+    # Composite take explicit paths, not pretrained names)
+    return {name: list(cat) for name, cls in _EMBEDDING_REGISTRY.items()
+            if (cat := getattr(cls, "pretrained_file_name_sha1", {}))}
+
+
+class _PretrainedEmbedding(TokenEmbedding):
+    """Shared loader for catalog-registered embeddings: resolves
+    pretrained_file_name under embedding_root/<name>/ and loads it."""
+
+    pretrained_file_name_sha1: Dict[str, str] = {}
+
+    def __init__(self, pretrained_file_name=None, embedding_root=None,
+                 vocabulary=None, init_unknown_vec=None, elem_delim=" "):
+        import os
+        name = type(self).__name__.lower()
+        if pretrained_file_name is None:
+            pretrained_file_name = next(iter(self.pretrained_file_name_sha1))
+        if pretrained_file_name not in self.pretrained_file_name_sha1:
+            raise MXNetError(
+                f"{pretrained_file_name!r} is not a known {name} file; "
+                f"known: {sorted(self.pretrained_file_name_sha1)}")
+        root = os.path.expanduser(
+            embedding_root or os.path.join("~", ".mxnet", "embedding"))
+        path = os.path.join(root, name, pretrained_file_name)
+        if not os.path.exists(path):
+            raise MXNetError(
+                f"pretrained file {path} not found. This build has no "
+                f"downloader (zero egress); place the {name} file there "
+                "yourself, or use CustomEmbedding for arbitrary paths")
+        loaded = TokenEmbedding.from_file(
+            path, elem_delim=elem_delim, vocabulary=vocabulary,
+            init_unknown_vec=init_unknown_vec)
+        super().__init__(loaded.vocabulary, loaded.vec_len)
+        self._idx_to_vec = loaded.idx_to_vec
+
+
+@register
+class GloVe(_PretrainedEmbedding):
+    """GloVe catalog (reference embedding.py:484; file list mirrors the
+    reference's pretrained_file_name_sha1 keys)."""
+
+    pretrained_file_name_sha1 = {
+        "glove.42B.300d.txt": "", "glove.6B.50d.txt": "",
+        "glove.6B.100d.txt": "", "glove.6B.200d.txt": "",
+        "glove.6B.300d.txt": "", "glove.840B.300d.txt": "",
+        "glove.twitter.27B.25d.txt": "", "glove.twitter.27B.50d.txt": "",
+        "glove.twitter.27B.100d.txt": "", "glove.twitter.27B.200d.txt": "",
+    }
+
+
+@register
+class FastText(_PretrainedEmbedding):
+    """fastText catalog (reference embedding.py:556)."""
+
+    pretrained_file_name_sha1 = {
+        "wiki.en.vec": "", "wiki.simple.vec": "", "wiki.zh.vec": "",
+        "wiki.de.vec": "", "wiki.fr.vec": "", "wiki.es.vec": "",
+        "crawl-300d-2M.vec": "",
+    }
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """User-provided `token v1 v2 ...` file at an arbitrary path
+    (reference embedding.py:638)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 vocabulary=None, init_unknown_vec=None):
+        loaded = TokenEmbedding.from_file(
+            pretrained_file_path, elem_delim=elem_delim,
+            vocabulary=vocabulary, init_unknown_vec=init_unknown_vec)
+        super().__init__(loaded.vocabulary, loaded.vec_len)
+        self._idx_to_vec = loaded.idx_to_vec
+
+
+@register
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenation of several TokenEmbeddings over one vocabulary
+    (reference embedding.py:680): vec_len = sum of the parts; lookups
+    concatenate each part's vector for the token."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        if not token_embeddings:
+            raise MXNetError("CompositeEmbedding needs >= 1 embeddings")
+        vec_len = sum(e.vec_len for e in token_embeddings)
+        super().__init__(vocabulary, vec_len)
+        parts = []
+        for emb in token_embeddings:
+            # remap each part's table onto the composite vocabulary; tokens
+            # the part has never seen fall back to its unknown (index 0) row
+            src = _np.asarray(emb.idx_to_vec._data)
+            idxs = _np.asarray(
+                [emb.vocabulary.token_to_idx.get(t, 0)
+                 for t in vocabulary.idx_to_token])
+            parts.append(src[idxs])
+        self._idx_to_vec = array(_np.concatenate(parts, axis=1))

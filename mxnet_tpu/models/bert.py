@@ -31,13 +31,22 @@ class SelfAttention(HybridBlock):
     (benchmark/qkv_fusion_probe.py)."""
 
     def __init__(self, units, num_heads, dropout=0.0, use_blockwise=True,
-                 fused_qkv=True, **kwargs):
+                 fused_qkv=True, head_major_qkv=False, **kwargs):
         super().__init__(**kwargs)
         assert units % num_heads == 0
         self._units = units
         self._heads = num_heads
         self._use_blockwise = use_blockwise
         self._fused_qkv = fused_qkv
+        # head_major_qkv reorders the fused projection's output neurons to
+        # (head, qkv, d) so a CONTIGUOUS split of the weight's out dim —
+        # exactly what P('tp', None) gives — lands whole heads (their q, k
+        # AND v) on one shard: tensor parallelism over attention heads with
+        # no resharding inside the block. The (3, head, d) default layout
+        # would make XLA reshard at the reshape (3 doesn't divide tp).
+        # Same parameter shapes; a checkpoint from one layout is a neuron
+        # permutation of the other, so pick the layout at pretrain time.
+        self._head_major = head_major_qkv
         if fused_qkv:
             self.qkv = nn.Dense(3 * units, flatten=False, in_units=units)
         else:
@@ -54,8 +63,11 @@ class SelfAttention(HybridBlock):
         d = C // H
         if self._fused_qkv:
             qkv = self.qkv(x)  # (B, T, 3C)
-            qkv = qkv.reshape((B, T, 3, H, d)).transpose((2, 0, 3, 1, 4))  # (3,B,H,T,d)
-            q, k, v = qkv[0], qkv[1], qkv[2]
+            if self._head_major:
+                qkv = qkv.reshape((B, T, H, 3, d)).transpose((3, 0, 2, 1, 4))
+            else:
+                qkv = qkv.reshape((B, T, 3, H, d)).transpose((2, 0, 3, 1, 4))
+            q, k, v = qkv[0], qkv[1], qkv[2]  # (B, H, T, d)
         else:
             q = self.q_proj(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))
             k = self.k_proj(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))
@@ -109,11 +121,12 @@ class TransformerEncoderCell(HybridBlock):
     """Pre-LN encoder block."""
 
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
-                 fused_qkv=True, **kwargs):
+                 fused_qkv=True, head_major_qkv=False, **kwargs):
         super().__init__(**kwargs)
         self.ln1 = nn.LayerNorm(in_channels=units)
         self.attn = SelfAttention(units, num_heads, dropout,
-                                  fused_qkv=fused_qkv)
+                                  fused_qkv=fused_qkv,
+                                  head_major_qkv=head_major_qkv)
         self.ln2 = nn.LayerNorm(in_channels=units)
         self.ffn = PositionwiseFFN(units, hidden_size, dropout)
 
@@ -125,13 +138,13 @@ class TransformerEncoderCell(HybridBlock):
 
 class BertEncoder(HybridBlock):
     def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.0,
-                 fused_qkv=True, **kwargs):
+                 fused_qkv=True, head_major_qkv=False, **kwargs):
         super().__init__(**kwargs)
         self.layers = nn.HybridSequential()
         for _ in range(num_layers):
-            self.layers.add(TransformerEncoderCell(units, hidden_size,
-                                                   num_heads, dropout,
-                                                   fused_qkv=fused_qkv))
+            self.layers.add(TransformerEncoderCell(
+                units, hidden_size, num_heads, dropout,
+                fused_qkv=fused_qkv, head_major_qkv=head_major_qkv))
         self.ln = nn.LayerNorm(in_channels=units)
 
     def hybrid_forward(self, F, x):
@@ -143,7 +156,7 @@ class BertModel(HybridBlock):
 
     def __init__(self, vocab_size=30522, num_layers=12, units=768,
                  hidden_size=3072, num_heads=12, max_length=512,
-                 dropout=0.0, fused_qkv=True, **kwargs):
+                 dropout=0.0, fused_qkv=True, head_major_qkv=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self.word_embed = nn.Embedding(vocab_size, units)
@@ -152,7 +165,8 @@ class BertModel(HybridBlock):
         self.embed_ln = nn.LayerNorm(in_channels=units)
         self.embed_drop = nn.Dropout(dropout) if dropout else None
         self.encoder = BertEncoder(num_layers, units, hidden_size, num_heads,
-                                   dropout, fused_qkv=fused_qkv)
+                                   dropout, fused_qkv=fused_qkv,
+                                   head_major_qkv=head_major_qkv)
         self.mlm_dense = nn.Dense(units, flatten=False, activation="gelu",
                                   in_units=units)
         self.mlm_ln = nn.LayerNorm(in_channels=units)

@@ -270,8 +270,13 @@ class Module(BaseModule):
                     self._arg_params[name].copyto(arg_dict[name])
 
     def get_outputs(self, merge_multi_context=True):
-        if len(self._execs) == 1 or not merge_multi_context:
+        if len(self._execs) == 1:
             return self._exec.outputs
+        if not merge_multi_context:
+            # reference executor_group semantics: per-output list of
+            # per-device arrays, so every batch slice stays reachable
+            return [list(outs) for outs in zip(*(e.outputs
+                                                 for e in self._execs))]
         merged = []
         for outs in zip(*(e.outputs for e in self._execs)):
             parts = [o.as_in_context(self._context) for o in outs]
@@ -280,8 +285,11 @@ class Module(BaseModule):
 
     def get_input_grads(self, merge_multi_context=True):
         assert self._inputs_need_grad
-        if len(self._execs) == 1 or not merge_multi_context:
+        if len(self._execs) == 1:
             return list(self._data_grads)
+        if not merge_multi_context:
+            return [[eg[name] for eg in self._exec_grads]
+                    for name in self._data_names]
         merged = []
         for name in self._data_names:
             parts = [eg[name].as_in_context(self._context)

@@ -1,0 +1,101 @@
+"""Registered embedding catalog (reference contrib/text/embedding.py:
+register/create/GloVe/FastText/CustomEmbedding/CompositeEmbedding),
+backed by shipped 50-token fixture files — no egress."""
+import collections
+import os
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import text as ctext
+
+ROOT = os.path.join(os.path.dirname(__file__), "data", "embedding")
+
+
+def _file_vec(path, token, skip_header=False):
+    with open(path) as f:
+        if skip_header:
+            next(f)
+        for line in f:
+            parts = line.split()
+            if parts[0] == token:
+                return onp.asarray([float(x) for x in parts[1:]], onp.float32)
+    raise KeyError(token)
+
+
+def test_glove_catalog_loads_fixture():
+    emb = ctext.create("glove", pretrained_file_name="glove.6B.50d.txt",
+                       embedding_root=ROOT)
+    assert emb.vec_len == 50
+    v = emb.get_vecs_by_tokens("the")
+    ref = _file_vec(os.path.join(ROOT, "glove", "glove.6B.50d.txt"), "the")
+    onp.testing.assert_allclose(onp.asarray(v._data), ref, rtol=1e-6)
+
+
+def test_fasttext_catalog_skips_header():
+    emb = ctext.create("fasttext", pretrained_file_name="wiki.simple.vec",
+                       embedding_root=ROOT)
+    assert emb.vec_len == 30
+    ref = _file_vec(os.path.join(ROOT, "fasttext", "wiki.simple.vec"),
+                    "and", skip_header=True)
+    onp.testing.assert_allclose(
+        onp.asarray(emb.get_vecs_by_tokens("and")._data), ref, rtol=1e-6)
+
+
+def test_catalog_names_and_errors():
+    names = ctext.get_pretrained_file_names()
+    assert "glove.6B.300d.txt" in names["glove"]
+    assert "wiki.en.vec" in names["fasttext"]
+    assert ctext.get_pretrained_file_names("glove") == names["glove"]
+    with pytest.raises(MXNetError, match="not a known"):
+        ctext.create("glove", pretrained_file_name="nope.txt",
+                     embedding_root=ROOT)
+    with pytest.raises(MXNetError, match="zero egress"):
+        ctext.create("glove", pretrained_file_name="glove.6B.300d.txt",
+                     embedding_root=ROOT)
+    with pytest.raises(MXNetError, match="unknown embedding"):
+        ctext.create("word2vec")
+
+
+def test_custom_embedding_roundtrip(tmp_path):
+    p = tmp_path / "my.vec"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = ctext.create("customembedding", pretrained_file_path=str(p))
+    assert emb.vec_len == 3
+    onp.testing.assert_allclose(
+        onp.asarray(emb.get_vecs_by_tokens("world")._data), [4.0, 5.0, 6.0])
+    # unknown token -> index 0 (zeros table row by default)
+    onp.testing.assert_allclose(
+        onp.asarray(emb.get_vecs_by_tokens("zzz")._data), [0.0, 0.0, 0.0])
+
+
+def test_composite_embedding_concatenates(tmp_path):
+    p = tmp_path / "tiny.vec"
+    p.write_text("the 9.0 8.0\nof 7.0 6.0\n")
+    glove = ctext.create("glove", pretrained_file_name="glove.6B.50d.txt",
+                         embedding_root=ROOT)
+    tiny = ctext.CustomEmbedding(str(p))
+    vocab = ctext.Vocabulary(collections.Counter({"the": 2, "of": 1,
+                                                  "unseen": 1}))
+    comp = ctext.CompositeEmbedding(vocab, [glove, tiny])
+    assert comp.vec_len == 52
+    v = onp.asarray(comp.get_vecs_by_tokens("the")._data)
+    ref_g = _file_vec(os.path.join(ROOT, "glove", "glove.6B.50d.txt"), "the")
+    onp.testing.assert_allclose(v[:50], ref_g, rtol=1e-6)
+    onp.testing.assert_allclose(v[50:], [9.0, 8.0])
+    # token absent from a part falls back to that part's unknown row
+    v2 = onp.asarray(comp.get_vecs_by_tokens("unseen")._data)
+    onp.testing.assert_allclose(v2, onp.zeros(52))
+
+
+def test_register_decorator_extends_catalog(tmp_path):
+    @ctext.register
+    class MyEmbed(ctext.CustomEmbedding):
+        pass
+
+    p = tmp_path / "m.vec"
+    p.write_text("a 1.0 1.0\n")
+    emb = ctext.create("myembed", pretrained_file_path=str(p))
+    assert isinstance(emb, MyEmbed)
+    assert emb.vec_len == 2

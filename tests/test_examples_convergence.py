@@ -94,3 +94,87 @@ def test_reinforce_cartpole_improves_policy():
     lengthen episodes well past the untrained ~20 steps."""
     final = _load("reinforce_cartpole").main(["--episodes", "300"])
     assert final >= 55.0, f"REINFORCE did not improve: {final}"
+
+
+# --- round-5 example families (VERDICT r4 Missing #1) ----------------------
+
+@pytest.mark.slow
+def test_vae_elbo_improves():
+    """Reference example/autoencoder/variational_autoencoder: the negative
+    ELBO must drop substantially from its initial value."""
+    first, last = _load("vae").main(["--epochs", "12"])
+    assert last < 0.55 * first, f"VAE ELBO barely moved: {first} -> {last}"
+
+
+@pytest.mark.slow
+def test_vae_gan_feature_recon_improves():
+    """Reference example/vae-gan: discriminator-feature reconstruction
+    falls while D stays off collapse for prior samples."""
+    first, last, d_fake = _load("vae_gan").main(["--steps", "80"])
+    assert last < 0.7 * first, f"VAE-GAN recon stuck: {first} -> {last}"
+    assert d_fake > 0.02, f"D collapsed: D(sample) {d_fake}"
+
+
+@pytest.mark.slow
+def test_capsnet_routing_learns():
+    """Reference example/capsnet: margin loss over routed capsule lengths
+    classifies the synthetic digits."""
+    acc = _load("capsnet").main(["--epochs", "12"])
+    assert acc > 0.9, f"capsnet failed: acc {acc}"
+
+
+@pytest.mark.slow
+def test_ner_bilstm_contextual_tagging():
+    """Reference example/named_entity_recognition: trigger-context tag
+    grammar needs sequence context, not token lookup."""
+    f1 = _load("ner_bilstm").main(["--epochs", "10"])
+    assert f1 > 0.85, f"NER F1 too low: {f1}"
+
+
+@pytest.mark.slow
+def test_fgsm_attack_fools_trained_net():
+    """Reference example/adversary: the trained net must be accurate clean
+    AND collapse under the FGSM perturbation (gradient-of-input path)."""
+    clean, adv = _load("adversary_fgsm").main(["--epochs", "20"])
+    assert clean > 0.9, f"clean training failed: {clean}"
+    assert adv < clean - 0.3, f"FGSM did not bite: clean {clean} adv {adv}"
+
+
+@pytest.mark.slow
+def test_stochastic_depth_trains_with_dropped_blocks():
+    """Reference example/stochastic-depth: in-graph Bernoulli block drops
+    must not prevent convergence."""
+    acc = _load("stochastic_depth").main(["--epochs", "20"])
+    assert acc > 0.9, f"stochastic depth failed: acc {acc}"
+
+
+@pytest.mark.slow
+def test_time_series_beats_naive_forecast():
+    """Reference example/multivariate_time_series: LSTNet-style model must
+    beat the last-value baseline on coupled channels."""
+    rmse, naive = _load("time_series_lstm").main(["--epochs", "10"])
+    assert rmse < 0.75 * naive, f"forecast no better than naive: {rmse} vs {naive}"
+
+
+@pytest.mark.slow
+def test_rbm_cd1_reduces_reconstruction_error():
+    """Reference example/restricted-boltzmann-machine: CD-1 updates (no
+    autograd) must reduce the Gibbs reconstruction error."""
+    first, last = _load("rbm").main(["--epochs", "10"])
+    assert last < 0.8 * first, f"RBM stuck: {first} -> {last}"
+
+
+@pytest.mark.slow
+def test_bi_lstm_sort_learns_sorting():
+    """Reference example/bi-lstm-sort: per-token accuracy of the emitted
+    sorted sequence."""
+    acc = _load("bi_lstm_sort").main(["--epochs", "8"])
+    assert acc > 0.8, f"sort accuracy too low: {acc}"
+
+
+@pytest.mark.slow
+def test_dec_clustering_recovers_blobs():
+    """Reference example/deep-embedded-clustering: AE pretrain + KL
+    refinement must recover the latent blob structure."""
+    acc = _load("dec_clustering").main([])
+    assert acc > 0.85, f"DEC clustering failed: acc {acc}"

@@ -1,0 +1,94 @@
+"""Large-tensor RUNS — actually allocating >2^31-element tensors.
+
+Reference analog: tests/nightly/test_large_array.py:1 (the int64-indexing
+nightly). tests/test_large_tensor_policy.py pins the x32 POLICY at CI
+scale; this suite is the opt-in counterpart that really crosses the
+2^31-element line for the ops the reference nightly hits hardest — take,
+dot, broadcast, argsort, slice — proving the flat-index arithmetic under
+the lowering is 64-bit even though the user-facing index dtype is x32
+(per-dimension sizes stay below 2^31, like the reference's (LARGE_X,
+SMALL_Y) shapes).
+
+Opt-in: MXNET_LARGE_TENSOR_RUNS=1 python -m pytest tests/test_large_tensor_runs.py
+(each case allocates 2-10 GB host RAM on the CPU backend; sizes chosen to
+be bandwidth-bound, not compute-bound, so a single-core box finishes).
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(os.environ.get("MXNET_LARGE_TENSOR_RUNS") != "1",
+                       reason="opt-in: set MXNET_LARGE_TENSOR_RUNS=1 "
+                              "(allocates >2^31-element tensors)"),
+]
+
+ROWS = 1 << 26          # 67,108,864
+COLS = 33               # ROWS*COLS = 2,214,592,512 > 2^31
+
+
+def _big_rows():
+    """(ROWS, COLS) int8 where row r is filled with r % 251 — row identity
+    checkable at any index without materializing a reference array."""
+    r = nd.arange(0, ROWS, dtype="int64").reshape((ROWS, 1))
+    return nd.broadcast_to((r % 251).astype("int8"), shape=(ROWS, COLS))
+
+
+def test_broadcast_and_slice_cross_2g_elements():
+    big = _big_rows()
+    assert big.shape[0] * big.shape[1] > (1 << 31)
+    tail = big[ROWS - 2:ROWS]           # basic slice near the 64-bit edge
+    vals = tail.asnumpy()
+    assert vals.shape == (2, COLS)
+    assert int(vals[0, 0]) == (ROWS - 2) % 251
+    assert int(vals[1, COLS - 1]) == (ROWS - 1) % 251
+    mid = nd.slice_axis(big, axis=0, begin=ROWS // 2, end=ROWS // 2 + 1)
+    assert int(mid.asnumpy()[0, 0]) == (ROWS // 2) % 251
+
+
+def test_take_rows_beyond_int32_flat_offsets():
+    big = _big_rows()
+    # flat offsets of these rows exceed 2^31 — an int32 flat-index path
+    # would wrap and fetch the wrong rows
+    idx = nd.array([0, ROWS // 2, ROWS - 1], dtype="int32")
+    got = nd.take(big, idx, axis=0).asnumpy()
+    assert got.shape == (3, COLS)
+    assert [int(v) for v in got[:, 0]] == [0, (ROWS // 2) % 251,
+                                          (ROWS - 1) % 251]
+
+
+def test_dot_output_crosses_2g_elements():
+    # rank-1 outer product: 2^26 x 33 output (>2^31 elements) with O(N)
+    # compute — proves 64-bit output indexing without matmul cost
+    a = nd.arange(0, ROWS, dtype="float32").reshape((ROWS, 1)) % 16
+    b = nd.ones((1, COLS), dtype="float32")
+    out = nd.dot(a, b)
+    assert out.shape == (ROWS, COLS)
+    spot = out[ROWS - 1:ROWS].asnumpy()
+    assert float(spot[0, COLS - 1]) == (ROWS - 1) % 16
+
+
+def test_argsort_over_2g_elements():
+    rows = 1 << 25
+    cols = 65          # rows*cols = 2,181,038,080 > 2^31
+    # each row is a reversed ramp shifted by the row id; argsort along the
+    # row must return the reversal permutation for every row
+    c = nd.arange(0, cols, dtype="float32").reshape((1, cols))
+    x = nd.broadcast_to(-c, shape=(rows, cols))
+    order = nd.argsort(x, axis=-1, dtype="int32")
+    got = order[rows - 1:rows].asnumpy()
+    onp.testing.assert_array_equal(got[0], onp.arange(cols)[::-1])
+
+
+def test_elementwise_reduce_over_2g_elements():
+    big = _big_rows()
+    # sum of row-constant int8 values, accumulated wide: closed form
+    s = nd.sum(big.astype("int64"))
+    n_cycles, rem = divmod(ROWS, 251)
+    expect = COLS * (n_cycles * (250 * 251 // 2) + rem * (rem - 1) // 2)
+    assert int(s.asnumpy()) == expect

@@ -363,7 +363,12 @@ def test_module_ctx_list_outputs_and_input_grads_merge():
     mod.backward()
     assert mod.get_input_grads()[0].shape == (8, 4)
     # per-executor (unmerged) view keeps the slices
-    assert mod.get_outputs(merge_multi_context=False)[0].shape == (4, 3)
+    # per-executor (unmerged) view: per-output list of per-device slices
+    unmerged = mod.get_outputs(merge_multi_context=False)
+    assert len(unmerged[0]) == 2
+    assert all(o.shape == (4, 3) for o in unmerged[0])
+    assert [g.shape for g in
+            mod.get_input_grads(merge_multi_context=False)[0]] == [(4, 4)] * 2
 
 
 def test_module_ctx_list_refuses_uneven_batch():
