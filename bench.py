@@ -37,9 +37,18 @@ QUICK = os.environ.get("BENCH_QUICK") == "1"
 
 
 def resnet50_train_flops_per_image(image=224):
-    """Forward ~4.089 GFLOP per 224^2 image (2 FLOP/MAC); train = 3x
-    (backward is ~2x forward). Scales with spatial resolution."""
-    return 3 * 4.089e9 * (image / 224.0) ** 2
+    """Forward 7.64 GFLOP per 224^2 image at 2 FLOP/MAC; train = 3x
+    (backward ~2x forward). Scales with spatial resolution.
+
+    Rounds 1-4 used 4.089e9 here, labeled '2 FLOP/MAC' — that figure is
+    actually the MAC count (the fvcore/torchvision \"4.1 GFLOPs\"
+    convention counts multiply-accumulates), so reported TF/s and MFU
+    were ~2x LOW. The direct per-conv inventory of the real model
+    (benchmark/results/resnet_layer_ledger.md: every conv's
+    N*C*K*k_h*k_w*H_out*W_out summed) gives 3.82 GMAC = 7.64 GFLOP
+    forward, which this constant now reflects. BERT's formula below was
+    already 2-FLOP/MAC and is unchanged."""
+    return 3 * 7.64e9 * (image / 224.0) ** 2
 
 
 def bert_train_flops_per_token(layers, hidden, ffn_mult, seq, vocab):

@@ -124,6 +124,45 @@ def _eager_bwd_latency(fn, ndin, kwargs, varargs, warmup=2, runs=3):
     return min(ts) * 1e3
 
 
+def _compiled_stats(fn, ndin, kwargs, varargs, runs=3):
+    """AOT-compile the op as one pure jitted function and report XLA's
+    memory plan + its jitted latency (reference opperf records pool memory
+    alongside latency via its profiler, benchmark/opperf/utils/
+    benchmark_utils.py:23-57 — here the compiled memory_analysis IS the
+    planner's answer, no allocator sampling needed).
+
+    Returns (temp_bytes, peak_bytes, jit_ms): temp = XLA scratch beyond
+    args/outputs (the quantity a lowering regression inflates); peak =
+    args + outputs + temp; jit_ms = min-of-runs latency of the compiled
+    executable (on TPU this approximates device time — dispatch overhead
+    is out of the measurement)."""
+    import jax
+    from mxnet_tpu.ndarray import NDArray
+
+    raws = [x._data if isinstance(x, NDArray) else x for x in ndin]
+
+    def pure(*raw_in):
+        ins = [type(x)(r) if isinstance(x, NDArray) else r
+               for x, r in zip(ndin, raw_in)]
+        out = fn(ins, **kwargs) if varargs else fn(*ins, **kwargs)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        return out._data if isinstance(out, NDArray) else out
+
+    compiled = jax.jit(pure).lower(*raws).compile()
+    ma = compiled.memory_analysis()
+    temp = int(getattr(ma, "temp_size_in_bytes", 0))
+    peak = temp + int(getattr(ma, "argument_size_in_bytes", 0)) + \
+        int(getattr(ma, "output_size_in_bytes", 0))
+    compiled(*raws).block_until_ready()
+    ts = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        compiled(*raws).block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    return temp, peak, min(ts) * 1e3
+
+
 def _pin_cpu():
     """The image force-registers the TPU plugin, so JAX_PLATFORMS=cpu is
     not enough — pin the default device the way tests/conftest.py does.
@@ -166,9 +205,20 @@ def full_sweep(runs=3, ops_filter=None):
                                          case.varargs, runs=runs)
             except Exception:
                 bwd = None
+            # memory plan + compiled latency (ops whose frontends are not
+            # purely traceable — e.g. host-side RNG consumers — stay blank)
+            try:
+                temp_b, peak_b, jit_ms = _compiled_stats(
+                    fn, _case_inputs(case), case.kwargs, case.varargs,
+                    runs=runs)
+            except Exception:
+                temp_b = peak_b = jit_ms = None
             rows.append({"op": name, "ns": case.ns,
                          "fwd_ms": round(fwd, 4),
                          "fwd_bwd_ms": round(bwd, 4) if bwd else None,
+                         "jit_ms": round(jit_ms, 4) if jit_ms is not None
+                         else None,
+                         "temp_bytes": temp_b, "peak_bytes": peak_b,
                          "shapes": [list(np.shape(a)) for a in ndin]})
         except Exception as e:  # noqa: BLE001
             failures.append({"op": name, "error": f"{type(e).__name__}: {e}"[:120]})
@@ -202,14 +252,23 @@ def emit_results(rows, failures, path_json=None, path_md=None):
         "commits, not for absolute kernel time (see the curated hot-set "
         "mode for kernel-side numbers).",
         "",
-        "| operator | ns | fwd (ms) | fwd+bwd (ms) | shapes |",
-        "|---|---|---:|---:|---|",
+        "The jit/temp/peak columns come from the AOT-compiled op: jit = "
+        "compiled-executable latency (device time on TPU), temp = XLA "
+        "scratch bytes beyond args+outputs (the number a lowering "
+        "regression inflates), peak = args+outputs+temp.",
+        "",
+        "| operator | ns | fwd (ms) | fwd+bwd (ms) | jit (ms) | temp (B) "
+        "| peak (B) | shapes |",
+        "|---|---|---:|---:|---:|---:|---:|---|",
     ]
     for r in sorted(rows, key=lambda r: -r["fwd_ms"]):
         bwd = f"{r['fwd_bwd_ms']:.3f}" if r["fwd_bwd_ms"] else ""
+        jit = f"{r['jit_ms']:.3f}" if r.get("jit_ms") is not None else ""
+        tmp = str(r["temp_bytes"]) if r.get("temp_bytes") is not None else ""
+        pk = str(r["peak_bytes"]) if r.get("peak_bytes") is not None else ""
         shp = "×".join(str(tuple(s)) for s in r["shapes"][:3])
         lines.append(f"| {r['op']} | {r['ns']} | {r['fwd_ms']:.3f} | "
-                     f"{bwd} | {shp} |")
+                     f"{bwd} | {jit} | {tmp} | {pk} | {shp} |")
     if failures:
         lines += ["", "## Failures", ""]
         for f_ in failures:

@@ -89,3 +89,15 @@ def test_full_sweep_runs_in_fresh_process():
         env={**os.environ, "JAX_PLATFORMS": ""})
     assert out.returncode == 0, out.stderr[-2000:]
     assert "3 ops measured, 0 failed" in out.stdout
+
+
+def test_results_cover_memory_plan():
+    """r5: the committed table must include the compiled memory columns
+    (reference opperf records pool memory alongside latency —
+    benchmark/opperf/utils/benchmark_utils.py:23-57)."""
+    with open(RESULTS) as f:
+        rows = json.load(f)["results"]
+    n_mem = sum(1 for r in rows if r.get("peak_bytes"))
+    n_jit = sum(1 for r in rows if r.get("jit_ms") is not None)
+    assert n_mem >= 200, f"only {n_mem} ops carry a compiled memory plan"
+    assert n_jit >= 200, f"only {n_jit} ops carry a compiled-jit latency"
