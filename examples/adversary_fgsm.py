@@ -72,7 +72,7 @@ def main(argv=None):
     for epoch in range(args.epochs):
         tot, nb = 0.0, 0
         for batch in it:
-            x = batch.data[0] / 255.0
+            x = batch.data[0]  # MNISTIter already yields [0, 1]
             y = batch.label[0].astype("int32")
             with autograd.record():
                 loss = ce(net(x), y).mean()
@@ -86,7 +86,7 @@ def main(argv=None):
 
     clean, adv = [], []
     for batch in it:
-        x = batch.data[0] / 255.0
+        x = batch.data[0]  # MNISTIter already yields [0, 1]
         y = batch.label[0].astype("int32")
         clean.append((x, y))
         adv.append((fgsm(net, ce, x, y, args.eps), y))

@@ -55,7 +55,7 @@ def main(argv=None):
     for epoch in range(args.epochs):
         tot, nb = 0.0, 0
         for batch in it:
-            v0 = ((batch.data[0].reshape((args.batch_size, -1)) / 255.0)
+            v0 = ((batch.data[0].reshape((args.batch_size, -1)))
                   > 0.5).astype("float32")
             # positive phase
             ph0 = sigmoid(nd.dot(v0, W) + b_h)

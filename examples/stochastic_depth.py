@@ -83,7 +83,7 @@ def main(argv=None):
     for epoch in range(args.epochs):
         tot, nb = 0.0, 0
         for batch in it:
-            x = batch.data[0] / 255.0
+            x = batch.data[0]  # MNISTIter already yields [0, 1]
             y = batch.label[0].astype("int32")
             with autograd.record():
                 loss = ce(net(x), y).mean()
@@ -97,7 +97,7 @@ def main(argv=None):
 
     correct = total = 0
     for batch in it:
-        x = batch.data[0] / 255.0
+        x = batch.data[0]  # MNISTIter already yields [0, 1]
         y = batch.label[0].astype("int32")
         pred = net(x).argmax(axis=1).astype("int32")
         correct += int((pred == y).sum())

@@ -8,7 +8,16 @@ KL) is one fused loss, so the whole training step lowers into a single
 XLA program under the gluon Trainer.
 
 Run: python examples/vae.py [--epochs N]
-Returns (first_elbo, last_elbo) per-sample nats from main().
+Returns (first_elbo, last_elbo, last_kl) per-sample nats from main().
+
+Note on attainable ELBO: the hermetic MNISTIter digits are a
+class-dependent low-frequency pattern PLUS 50%-amplitude per-pixel
+uniform noise (io/io.py MNISTIter) — the noise is incompressible, so the
+reconstruction floor sits near 509 nats (measured recon-only) out of the
+~543-nat random-logits start. The learnable content is the ~25-35 nat
+gap, not the folklore "ELBO halves" of clean MNIST; gates must be
+absolute-nats, and last_kl > 0 certifies the latent is actually used
+(no posterior collapse).
 """
 from __future__ import annotations
 
@@ -51,18 +60,19 @@ class VAE(gluon.HybridBlock):
 
 
 def elbo_loss(logits, x, mu, logvar):
-    """Negative ELBO per sample: BCE(recon) + KL(q(z|x) || N(0,1))."""
+    """Negative ELBO per sample: BCE(recon) + KL(q(z|x) || N(0,1)).
+    Returns (scalar loss, scalar kl) so callers can watch for collapse."""
     bce = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=False)
     recon = bce(logits, x) * (28 * 28)  # sum over pixels, mean over batch
     kl = -0.5 * nd.sum(1 + logvar - mu * mu - logvar.exp(), axis=1)
-    return (recon + kl).mean()
+    return (recon + kl).mean(), kl.mean()
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epochs", type=int, default=15)
+    ap.add_argument("--epochs", type=int, default=30)
     ap.add_argument("--batch-size", type=int, default=64)
-    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--lr", type=float, default=3e-3)
     args = ap.parse_args(argv)
 
     mx.random.seed(0)
@@ -76,26 +86,30 @@ def main(argv=None):
     rng = np.random.RandomState(1)
 
     epoch_elbo = []
+    kl_last = 0.0
     for epoch in range(args.epochs):
-        tot, nb = 0.0, 0
+        tot, kltot, nb = 0.0, 0.0, 0
         for batch in it:
-            x = batch.data[0].reshape((args.batch_size, -1)) / 255.0
+            x = batch.data[0].reshape((args.batch_size, -1))  # already [0, 1]
             eps = nd.array(rng.randn(args.batch_size, LATENT)
                            .astype(np.float32))
             with autograd.record():
                 logits, mu, logvar = net(x, eps)
-                loss = elbo_loss(logits, x, mu, logvar)
+                loss, kl = elbo_loss(logits, x, mu, logvar)
             loss.backward()
             tr.step(1)
             tot += float(loss)
+            kltot += float(kl)
             nb += 1
         it.reset()
         epoch_elbo.append(tot / nb)
+        kl_last = kltot / nb
         if epoch % 5 == 0 or epoch == args.epochs - 1:
-            print(f"epoch {epoch}: -ELBO {epoch_elbo[-1]:.2f} nats")
-    return epoch_elbo[0], epoch_elbo[-1]
+            print(f"epoch {epoch}: -ELBO {epoch_elbo[-1]:.2f} nats "
+                  f"(KL {kl_last:.2f})")
+    return epoch_elbo[0], epoch_elbo[-1], kl_last
 
 
 if __name__ == "__main__":
-    first, last = main()
-    print(f"-ELBO {first:.2f} -> {last:.2f}")
+    first, last, kl = main()
+    print(f"-ELBO {first:.2f} -> {last:.2f} (KL {kl:.2f})")

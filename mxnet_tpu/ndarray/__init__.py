@@ -30,6 +30,31 @@ def ones_like(a):
     return invoke("ones_like", [a], {})
 
 
+def maximum(lhs, rhs):
+    """Elementwise max with scalar/array dispatch (reference
+    python/mxnet/ndarray/ndarray.py maximum(): `_maximum` for two arrays,
+    `_maximum_scalar` when one side is a python scalar)."""
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return invoke("_maximum", [lhs, rhs], {})
+    if isinstance(lhs, NDArray):
+        return invoke("_maximum_scalar", [lhs], {"scalar": float(rhs)})
+    if isinstance(rhs, NDArray):
+        return invoke("_maximum_scalar", [rhs], {"scalar": float(lhs)})
+    return max(lhs, rhs)
+
+
+def minimum(lhs, rhs):
+    """Elementwise min with scalar/array dispatch (reference
+    python/mxnet/ndarray/ndarray.py minimum())."""
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return invoke("_minimum", [lhs, rhs], {})
+    if isinstance(lhs, NDArray):
+        return invoke("_minimum_scalar", [lhs], {"scalar": float(rhs)})
+    if isinstance(rhs, NDArray):
+        return invoke("_minimum_scalar", [rhs], {"scalar": float(lhs)})
+    return min(lhs, rhs)
+
+
 def cast_storage(arr, stype):
     """Dense <-> sparse storage conversion (reference
     src/operator/tensor/cast_storage.cc). Sparse is dense-backed here, so
