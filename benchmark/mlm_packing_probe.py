@@ -75,27 +75,38 @@ def packed_batches(corpus, rng):
 
 
 def run(mode, batches, trainer, nd):
+    """Time STEPS steps as ONE stacked run_steps call (a single compiled
+    scan over per-step batches): per-call tunnel overhead amortizes to
+    zero, so rows/s parity between the two arms actually holds — earlier
+    drafts timed per-step calls and the ~1.7 s/call tunnel cost swamped
+    the 69 ms step, faking a throughput delta between arms."""
     gen = batches
-    # warmup/compile
-    x, _ = next(gen)
-    y = (x + 1) % VOCAB
-    trainer.run_steps(nd.array(x, dtype="int32"),
-                      nd.array(y, dtype="int32"), 2)
-    t0 = time.perf_counter()
-    real_total = 0
+    xs, reals = [], 0
     for _ in range(STEPS):
         x, real = next(gen)
-        y = (x + 1) % VOCAB
-        losses = trainer.run_steps(nd.array(x, dtype="int32"),
-                                   nd.array(y, dtype="int32"), 1)
-        real_total += real
-    float(losses[-1])
-    dt = time.perf_counter() - t0
+        xs.append(x)
+        reals += real
+    x_stack = np.stack(xs)                   # (STEPS, B, T)
+    y_stack = (x_stack + 1) % VOCAB
+    xb = nd.array(x_stack, dtype="int32")
+    yb = nd.array(y_stack, dtype="int32")
+    # warm until back-to-back timings stabilize (tunnel slow-mode)
+    prev = None
+    for _ in range(6):
+        t0 = time.perf_counter()
+        losses = trainer.run_steps(xb, yb, STEPS, stacked=True)
+        float(losses[-1])
+        dt = time.perf_counter() - t0
+        if prev is not None and abs(dt - prev) < 0.08 * max(dt, prev):
+            break
+        prev = dt
+    best = min(dt, prev if prev is not None else dt)
     return {
         "mode": mode,
-        "rows_s": round(BATCH * STEPS / dt, 2),
-        "real_tokens_s": round(real_total / dt, 1),
-        "pad_fraction": round(1 - real_total / (BATCH * STEPS * SEQ), 4),
+        "rows_s": round(BATCH * STEPS / best, 2),
+        "real_tokens_s": round(reals / best, 1),
+        "real_fraction": round(reals / (BATCH * STEPS * SEQ), 4),
+        "pad_fraction": round(1 - reals / (BATCH * STEPS * SEQ), 4),
     }
 
 
@@ -124,8 +135,17 @@ def main():
             dtype="bfloat16")
         results.append(run(mode, mk(corpus, rng), trainer, nd))
         print(json.dumps(results[-1]))
-    up = results[1]["real_tokens_s"] / results[0]["real_tokens_s"]
-    print(json.dumps({"packing_real_token_uplift": round(up, 3)}))
+    # the chip cost per ROW is shape-identical in both arms, so the
+    # STRUCTURAL uplift is the real-token-fraction ratio; the measured
+    # tokens/s ratio must agree within tunnel variance or the timing is
+    # suspect (rows_s parity is the cross-check)
+    structural = results[1]["real_fraction"] / results[0]["real_fraction"]
+    measured = results[1]["real_tokens_s"] / results[0]["real_tokens_s"]
+    print(json.dumps({
+        "packing_structural_uplift": round(structural, 3),
+        "packing_measured_uplift": round(measured, 3),
+        "rows_s_parity": round(results[1]["rows_s"] / results[0]["rows_s"], 3),
+    }))
 
 
 if __name__ == "__main__":

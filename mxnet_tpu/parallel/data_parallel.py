@@ -34,11 +34,24 @@ from .mesh import current_mesh, P
 
 def functional_optimizer(opt: "opt_mod.Optimizer"):
     """Return (init_state(w_tree)->s_tree, update(g,w,s,t)->(w,s)) for an
-    Optimizer instance, reusing its jitted kernels."""
+    Optimizer instance, reusing its update formulas."""
     from ..optimizer.optimizer import (SGD, NAG, Adam, AdamW, LAMB, LARS,
                                        RMSProp, AdaGrad, _k_sgd, _k_sgd_mom,
                                        _k_nag, _k_adam, _k_adamw, _k_lamb,
                                        _k_lars, _k_rmsprop, _k_adagrad)
+
+    # UNWRAP the @jax.jit kernels: inside the fused train step each jitted
+    # kernel traces as a closed pjit call, so ~160 per-param updates become
+    # ~160 separate XLA computations per step that cannot fuse with each
+    # other or the backward. Measured on ResNet-50 bs32 (chip): the true
+    # SGD-momentum cost is 0.38 ms/step inlined vs ~5 ms through the
+    # nested-jit calls (benchmark/opt_overhead_probe.py). The eager
+    # Updater path still uses the jitted aliases directly.
+    (_k_sgd, _k_sgd_mom, _k_nag, _k_adam, _k_adamw, _k_lamb, _k_lars,
+     _k_rmsprop, _k_adagrad) = (
+        getattr(k, "__wrapped__", k)
+        for k in (_k_sgd, _k_sgd_mom, _k_nag, _k_adam, _k_adamw, _k_lamb,
+                  _k_lars, _k_rmsprop, _k_adagrad))
 
     def _f(x):
         return jnp.float32(x)
@@ -162,6 +175,11 @@ def functional_lazy_update(opt: "opt_mod.Optimizer"):
     from ..optimizer.optimizer import (SGD, NAG, Adam, AdamW, LAMB,
                                        _k_sgd_lazy, _k_sgd_mom_lazy,
                                        _k_adam_lazy)
+
+    # unwrap nested jits for the same fusion reason as functional_optimizer
+    _k_sgd_lazy, _k_sgd_mom_lazy, _k_adam_lazy = (
+        getattr(k, "__wrapped__", k)
+        for k in (_k_sgd_lazy, _k_sgd_mom_lazy, _k_adam_lazy))
 
     if not getattr(opt, "lazy_update", False):
         return None
@@ -408,8 +426,24 @@ class DataParallelTrainer:
     def _put_batch(self, arr, sharding):
         """Batch input: in multi-process SPMD each process passes its LOCAL
         shard of the global batch (reference dist-DP feeds per-worker
-        partitions); single-process passes the global batch."""
+        partitions); single-process passes the global batch.
+
+        Skip the device_put when the array is already placed compatibly:
+        through the tunneled TPU backend even a NO-OP device_put of a
+        bs32 ResNet batch costs ~90 ms (it round-trips the buffer), which
+        at run_steps(n=20) was ~4.5 ms/step of pure re-upload — the
+        entire 'trainer machinery' gap of benchmark/opt_overhead_probe2.py.
+        A 1-device NamedSharding is satisfied by any single-device array
+        on that device; otherwise require an exactly-equivalent sharding."""
         if not self._is_multiprocess():
+            if isinstance(arr, jax.Array):
+                cur = arr.sharding
+                dev = set(cur.device_set)
+                want = set(sharding.device_set)
+                if dev == want and (
+                        len(want) == 1
+                        or cur.is_equivalent_to(sharding, arr.ndim)):
+                    return arr
             return jax.device_put(arr, sharding)
         return jax.make_array_from_process_local_data(
             sharding, _np.asarray(arr))
@@ -643,9 +677,17 @@ class DataParallelTrainer:
                         r2 = resid
                     return (p2, s2, r2, t + 1.0), (lossv, finite)
 
-                (p, s, r, _), (losses, finites) = lax.scan(
+                (p, s, r, t_out), (losses, finites) = lax.scan(
                     sbody, (params, opt_state, resid, t0), jnp.arange(n))
-                return p, s, r, losses, jnp.all(finites)
+                # advance the carried RNG stream and step counter ON DEVICE:
+                # returning them lets run_steps keep every per-call scalar
+                # device-resident (each host->device upload costs 50-100 ms
+                # through the tunnel REGARDLESS of size — four small uploads
+                # per call were ~5 ms/step of the ResNet bench; see
+                # benchmark/opt_overhead_probe2.py)
+                key_next = jax.random.key_data(
+                    jax.random.fold_in(kk, jnp.int32(n)))
+                return p, s, r, losses, jnp.all(finites), key_next, t_out
             fn = multi
             self._step_jit[key] = fn
         return fn
@@ -671,24 +713,56 @@ class DataParallelTrainer:
                 f"{xr.shape[0]}/{yr.shape[0]}")
         sig = (xr.shape, str(xr.dtype), yr.shape, str(yr.dtype), stacked)
         fn = self._get_multi(sig, n, stacked)
-        # per-step lr from the scheduler (host-evaluated, scanned on device)
+        # Every host->device upload costs 50-100 ms through the tunneled
+        # backend regardless of payload size, so all per-call scalars are
+        # kept device-resident: lr/scale are cached by host value, and the
+        # RNG key + step counter ride the donated carry (multi returns
+        # their advanced values).
         lrs = []
         for i in range(n):
             self.optimizer.num_update = self._t + 1 + i
             lrs.append(float(self.optimizer.learning_rate))
-        lr = _np.asarray(lrs, _np.float32)
-        key = _np.asarray(_rng.next_key_raw())
+        scale_val = float(self._scaler.loss_scale if self._scaler else 1.0)
+        if self._is_multiprocess():
+            # multi-process SPMD: plain host values (device_put cannot
+            # target non-addressable devices; per-call upload cost is a
+            # local-PJRT path there, not the tunneled one)
+            lr_in = _np.asarray(lrs, _np.float32)
+            scale_in = _np.float32(scale_val)
+            key_in = _np.asarray(_rng.next_key_raw())
+            t_in = _np.float32(self._t + 1)
+        else:
+            lr_sig = (tuple(lrs),)
+            if getattr(self, "_lr_cache_sig", None) != lr_sig:
+                self._lr_dev = jax.device_put(_np.asarray(lrs, _np.float32))
+                self._lr_cache_sig = lr_sig
+            if getattr(self, "_scale_cache_val", None) != scale_val:
+                self._scale_dev = jax.device_put(_np.float32(scale_val))
+                self._scale_cache_val = scale_val
+            ep = _rng._host_state["epoch"]
+            if getattr(self, "_key_dev", None) is None \
+                    or self._key_epoch != ep:
+                self._key_dev = jax.device_put(
+                    _np.asarray(_rng.next_key_raw()))
+                self._key_epoch = ep
+            if getattr(self, "_t_dev_val", None) != self._t:
+                self._t_dev = jax.device_put(_np.float32(self._t + 1))
+                self._t_dev_val = self._t
+            lr_in, scale_in = self._lr_dev, self._scale_dev
+            key_in, t_in = self._key_dev, self._t_dev
         spec = self.data_spec
         if stacked:
             spec = P(None, *self.data_spec)
         xr = self._put_batch(xr, NamedSharding(self.mesh, P(*spec[:xr.ndim])))
         yr = self._put_batch(yr, NamedSharding(self.mesh, P(*spec[:yr.ndim])))
-        scale = _np.float32(self._scaler.loss_scale if self._scaler else 1.0)
         (self._params_raw, self._opt_state, self._comp_resid, losses,
-         finite) = fn(
-            self._params_raw, self._opt_state, self._comp_resid, key, xr, yr,
-            lr, _np.float32(self._t + 1), scale)
+         finite, key_out, t_out) = fn(
+            self._params_raw, self._opt_state, self._comp_resid,
+            key_in, xr, yr, lr_in, t_in, scale_in)
         self._t += n
+        if not self._is_multiprocess():
+            self._key_dev, self._t_dev = key_out, t_out
+            self._t_dev_val = self._t
         self.optimizer.num_update = self._t
         if self._scaler is not None:
             self._scaler.update_scale(not bool(finite))

@@ -116,3 +116,8 @@ def set_state_raw(raw):
     with _lock:
         _key = jax.random.wrap_key_data(jnp.asarray(raw, jnp.uint32),
                                         impl="threefry2x32")
+        # any state restore invalidates streams derived from the old key
+        # (DataParallelTrainer caches a device-resident key keyed on this
+        # epoch — without the bump, run_steps after a checkpoint restore
+        # would keep folding the stale pre-restore chain)
+        _host_state["epoch"] += 1
