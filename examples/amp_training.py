@@ -1,0 +1,104 @@
+"""Automatic mixed precision: train fp16 with dynamic loss scaling
+(reference example/automatic-mixed-precision/ — amp_model_conversion.py).
+
+`amp.init("float16")` flips the gluon compute path to half precision with
+fp32 master weights; `amp.scale_loss` multiplies the loss by the dynamic
+scale and `trainer.step` unscales + skips on overflow (LossScaler halves
+the scale on inf/nan and doubles it after a clean window). On TPU the
+production dtype is bfloat16 (no scaling needed — same exponent range as
+fp32); fp16 is exercised here because it is the mode where the loss-scale
+machinery actually has to work. After training, the example converts the
+net for inference with `amp.convert_hybrid_block` (the reference
+example's conversion flow) and checks the converted net agrees.
+
+Run: python examples/amp_training.py [--epochs N]
+Returns (final_acc, final_loss_scale, max_abs_diff_converted) from main().
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd, autograd, gluon  # noqa: E402
+from mxnet_tpu.contrib import amp  # noqa: E402
+
+
+def make_data(n=1024, seed=0, classes=10):
+    rs = np.random.RandomState(seed)
+    x = rs.uniform(0, 0.3, (n, 1, 28, 28)).astype(np.float32)
+    y = rs.randint(0, classes, n).astype(np.float32)
+    for i in range(n):
+        r = int(y[i]) * 28 // classes
+        x[i, 0, r:r + 3, 4:24] += 1.0
+    return x, y
+
+
+def build_net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Conv2D(32, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    return net
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    amp.init(target_dtype="float16")
+    try:
+        mx.random.seed(0)
+        net = build_net()
+        net.initialize(ctx=mx.cpu())
+        x, y = make_data()
+        net(nd.array(x[:2]))
+
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        amp.init_trainer(trainer)
+        ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        for epoch in range(args.epochs):
+            for i in range(0, len(x), args.batch_size):
+                xb = nd.array(x[i:i + args.batch_size])
+                yb = nd.array(y[i:i + args.batch_size])
+                with autograd.record():
+                    out = net(xb)
+                    loss = ce(out, yb)
+                    with amp.scale_loss(loss, trainer) as scaled:
+                        scaled.backward()
+                trainer.step(xb.shape[0])
+
+        preds = net(nd.array(x)).asnumpy().argmax(axis=1)
+        acc = float((preds == y).mean())
+        scale = float(getattr(trainer, "_amp_loss_scaler").loss_scale) \
+            if hasattr(trainer, "_amp_loss_scaler") else 1.0
+
+        # inference conversion flow (the reference example's endpoint)
+        ref_out = net(nd.array(x[:64])).asnumpy()
+        amp.convert_hybrid_block(net, "float16")
+        conv_out = net(nd.array(x[:64])).asnumpy().astype(np.float32)
+        diff = float(np.abs(ref_out - conv_out).max())
+        print(f"acc {acc:.3f}  loss_scale {scale:.0f}  "
+              f"converted max|diff| {diff:.3f}")
+        return acc, scale, diff
+    finally:
+        amp.amp._state["on"] = False
+        amp.amp._state["dtype"] = None
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Profiling a training loop (reference example/profiler/profiler_ndarray.py
++ profiler_executor.py).
+
+The profiler has two complementary surfaces on this stack:
+  - the framework-level aggregate profiler (`mx.profiler.set_config` +
+    `set_state('run')`): per-op call counts and wall times for the eager
+    dispatch layer, dumped as a table (`dumps`) and as a chrome://tracing
+    JSON (`dump`) — the reference's `profile_operator` view;
+  - the XLA trace bridge (`profiler.start_xla_trace`) for device-side
+    kernel timelines in TensorBoard — the TPU replacement for the
+    reference's CUDA-kernel rows, not exercised here (needs TensorBoard).
+
+Run: python examples/profiler_demo.py
+Returns (num_profiled_op_names, trace_event_count) from main().
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd, autograd, gluon, profiler  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    args = ap.parse_args(argv)
+
+    tracefile = os.path.join(tempfile.mkdtemp(prefix="profile_"),
+                             "profile.json")
+    profiler.set_config(profile_all=True, filename=tracefile)
+
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(64, activation="relu"), gluon.nn.Dense(10))
+    net.initialize(ctx=mx.cpu())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.uniform(-1, 1, (32, 16)).astype(np.float32))
+    y = nd.array(rs.randint(0, 10, 32).astype(np.float32))
+
+    profiler.set_state("run")
+    for _ in range(args.steps):
+        with autograd.record():
+            loss = ce(net(x), y)
+        loss.backward()
+        trainer.step(32)
+    nd.waitall()
+    profiler.set_state("stop")
+
+    table = profiler.dumps()
+    n_ops = sum(1 for line in table.splitlines()
+                if line.strip() and not line.startswith(("Profile", "=", "-"))
+                and line.split()[0] not in ("Name", "Time"))
+    profiler.dump()
+    with open(tracefile) as f:
+        events = json.load(f)
+    n_events = len(events["traceEvents"]) if isinstance(events, dict) \
+        else len(events)
+
+    print(table[:800])
+    print(f"{n_ops} op rows; {n_events} trace events -> {tracefile}")
+    return n_ops, n_events
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Post-training INT8 quantization with calibration
+(reference example/quantization/imagenet_gen_qsym.py — the fp32->int8
+calibrate-and-convert flow, accuracy table in example/ssd/README.md:46).
+
+Trains a small convnet in fp32, calibrates activation ranges with the
+entropy (KL) mode over held-out batches, converts Conv/Dense layers to
+int8xint8->int32 MXU kernels with `contrib.quantization.quantize_net`,
+and reports fp32-vs-int8 accuracy side by side — the reference example's
+deliverable. Per-output-channel weight scales and the clip-mass-guarded
+KL search keep the delta inside 1 point (see BENCHMARKS.md INT8 table).
+
+Run: python examples/quantize_int8.py [--epochs N]
+Returns (fp32_acc, int8_acc) from main().
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd, autograd, gluon  # noqa: E402
+from mxnet_tpu.contrib import quantization  # noqa: E402
+
+
+def make_data(n=1024, seed=0, classes=10):
+    rs = np.random.RandomState(seed)
+    x = rs.uniform(0, 0.3, (n, 1, 28, 28)).astype(np.float32)
+    y = rs.randint(0, classes, n).astype(np.float32)
+    for i in range(n):
+        r = int(y[i]) * 28 // classes
+        x[i, 0, r:r + 3, 4:24] += 1.0
+    return x, y
+
+
+def build_net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Conv2D(32, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    return net
+
+
+def accuracy(net, x, y, bs=128):
+    hits = 0
+    for i in range(0, len(x), bs):
+        p = net(nd.array(x[i:i + bs])).asnumpy().argmax(axis=1)
+        hits += int((p == y[i:i + bs]).sum())
+    return hits / len(x)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--calib-batches", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    mx.random.seed(0)
+    xtr, ytr = make_data(1024, seed=0)
+    xva, yva = make_data(512, seed=1)
+
+    net = build_net()
+    net.initialize(ctx=mx.cpu())
+    net(nd.array(xtr[:2]))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    for epoch in range(args.epochs):
+        for i in range(0, len(xtr), args.batch_size):
+            xb = nd.array(xtr[i:i + args.batch_size])
+            yb = nd.array(ytr[i:i + args.batch_size])
+            with autograd.record():
+                loss = ce(net(xb), yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+
+    fp32_acc = accuracy(net, xva, yva)
+
+    calib = [nd.array(xtr[i * args.batch_size:(i + 1) * args.batch_size])
+             for i in range(args.calib_batches)]
+    quantized = quantization.quantize_net(net, calib_data=calib,
+                                          calib_mode="entropy")
+    int8_acc = accuracy(net, xva, yva)
+    print(f"quantized {len(quantized)} layers: "
+          f"fp32 {fp32_acc:.4f}  int8 {int8_acc:.4f}  "
+          f"delta {100 * (fp32_acc - int8_acc):.2f} pt")
+    return fp32_acc, int8_acc
+
+
+if __name__ == "__main__":
+    main()

@@ -7,10 +7,11 @@ subset in `onnx_proto/` (same wire format — files interchange with stock
 onnx/onnxruntime). Both `export_model` and `import_model` therefore always
 work, unlike the reference which hard-requires the pip package.
 
-Coverage: 113 MXNet op names on the export side and 99 ONNX op types on
-the import side (see `export_op_names()` / `import_op_names()`), enough
-for the vision model zoo (resnet/vgg/alexnet/mobilenet/squeezenet/densenet)
-to roundtrip with numerical equality — tests/test_onnx_zoo.py.
+Coverage: 136 MXNet op names on the export side and 116 ONNX op types on
+the import side (see `export_op_names()` / `import_op_names()`) — a
+superset of the reference's 100 registered export / 93 import names —
+enough for the vision model zoo (resnet/vgg/alexnet/mobilenet/squeezenet/
+densenet) to roundtrip with numerical equality — tests/test_onnx_zoo.py.
 Target opset: 11-13 semantics (Slice/Clip/Pad bounds as inputs, Reshape
 shape as input; Squeeze/Unsqueeze/ReduceSum accept either attr or input
 axes on import).
@@ -147,6 +148,101 @@ def _export_node(ex: _Exporter, op_name: str, p: Dict, ins: List[str],
         return ex.emit(_BINARY_EXPORT[op_name], ins, [out])
     if op_name == "add_n":
         return ex.emit("Sum", ins, [out])
+    if op_name in ("BlockGrad", "MakeLoss", "make_loss", "stop_gradient"):
+        # gradient-flow markers: inference-graph identity
+        return ex.emit("Identity", [ins[0]], [out])
+    if op_name == "square":
+        return ex.emit("Mul", [ins[0], ins[0]], [out])
+    if op_name == "size_array":
+        ex.value_dtypes[out] = _TP.INT64
+        return ex.emit("Size", ins, [out])
+    if op_name in ("_maximum", "_minimum"):
+        return ex.emit("Max" if op_name == "_maximum" else "Min", ins, [out])
+    if op_name == "_power":
+        return ex.emit("Pow", ins, [out])
+    if op_name == "SoftmaxOutput":
+        # label input + loss gradient are train-time machinery; the
+        # inference contract is softmax over axis 1 (multi_output) or -1
+        return ex.emit("Softmax", [ins[0]], [out],
+                       axis=1 if p.get("multi_output") else -1)
+    if op_name == "LogisticRegressionOutput":
+        return ex.emit("Sigmoid", [ins[0]], [out])
+    if op_name == "LRN":
+        # identical parameterizations: x / (bias + alpha/size * sqsum)^beta
+        return ex.emit("LRN", ins, [out], alpha=float(p.get("alpha", 1e-4)),
+                       beta=float(p.get("beta", 0.75)),
+                       bias=float(p.get("knorm", 2.0)), size=int(p["nsize"]))
+    if op_name == "Crop":
+        if len(ins) > 1 or p.get("center_crop"):
+            raise MXNetError("ONNX export: Crop supports the static "
+                             "offset+h_w form only")
+        oy, ox = (int(v) for v in p.get("offset", (0, 0)))
+        th, tw = (int(v) for v in p.get("h_w", (0, 0)))
+        return ex.emit(
+            "Slice",
+            [ins[0],
+             ex.const("starts", _np.asarray([oy, ox], _np.int64)),
+             ex.const("ends", _np.asarray([oy + th, ox + tw], _np.int64)),
+             ex.const("axes", _np.asarray([2, 3], _np.int64))], [out])
+    if op_name == "ROIPooling":
+        ph, pw = (int(v) for v in p["pooled_size"])
+        return ex.emit("MaxRoiPool", ins, [out], pooled_shape=[ph, pw],
+                       spatial_scale=float(p.get("spatial_scale", 1.0)))
+    if op_name in ("_linalg_gemm2", "linalg_gemm2"):
+        alpha = float(p.get("alpha", 1.0))
+        if p.get("transpose_a") or p.get("transpose_b"):
+            # rank-2 contract: Gemm carries both transposes and alpha
+            return ex.emit("Gemm", ins, [out], alpha=alpha,
+                           transA=int(bool(p.get("transpose_a"))),
+                           transB=int(bool(p.get("transpose_b"))))
+        if alpha == 1.0:
+            return ex.emit("MatMul", ins, [out])
+        m = ex.emit("MatMul", ins, [ex.fresh("mm")])
+        c = ex.const("alpha", _np.float32(alpha))
+        return ex.emit("Mul", [m, c], [out])
+    if op_name in ("_random_uniform", "_random_normal"):
+        # the key input is the executor's RNG var — ONNX generators carry
+        # their own implementation-defined RNG, so it is dropped
+        shape = p.get("shape", (1,))
+        shape = [int(shape)] if isinstance(shape, int) else \
+            [int(s) for s in shape]
+        if op_name == "_random_uniform":
+            return ex.emit("RandomUniform", [], [out], shape=shape,
+                           low=float(p.get("low", 0.0)),
+                           high=float(p.get("high", 1.0)))
+        return ex.emit("RandomNormal", [], [out], shape=shape,
+                       mean=float(p.get("loc", 0.0)),
+                       scale=float(p.get("scale", 1.0)))
+    if op_name in ("_random_uniform_like", "_random_normal_like"):
+        if op_name == "_random_uniform_like":
+            return ex.emit("RandomUniformLike", [ins[0]], [out],
+                           low=float(p.get("low", 0.0)),
+                           high=float(p.get("high", 1.0)))
+        return ex.emit("RandomNormalLike", [ins[0]], [out],
+                       mean=float(p.get("loc", 0.0)),
+                       scale=float(p.get("scale", 1.0)))
+    if op_name == "_sample_multinomial":
+        # mxnet samples from probability rows; ONNX Multinomial takes
+        # unnormalized log-probs — Log bridges exactly. Multinomial requires
+        # rank-2 input and emits (batch, sample_size); a tuple draw shape
+        # gets its rank back with a trailing Reshape (0 = copy batch dim)
+        shape = p.get("shape")
+        if shape is None:
+            n, multi = 1, None
+        elif isinstance(shape, (int, float)):
+            n, multi = int(shape), None
+        else:
+            dims = [int(s) for s in shape]
+            n, multi = int(_np.prod(dims)), (dims if len(dims) > 1 else None)
+        lg = ex.emit("Log", [ins[0]], [ex.fresh("logp")])
+        ex.value_dtypes[out] = _TP.INT32
+        if multi is None:
+            return ex.emit("Multinomial", [lg], [out], sample_size=n,
+                           dtype=_TP.INT32)
+        m = ex.emit("Multinomial", [lg], [ex.fresh("mn")], sample_size=n,
+                    dtype=_TP.INT32)
+        c = ex.const("shape", _np.asarray([0] + multi, _np.int64))
+        return ex.emit("Reshape", [m, c], [out])
 
     if op_name in _SCALAR_EXPORT:
         onnx_op, side = _SCALAR_EXPORT[op_name]
@@ -468,6 +564,13 @@ def export_op_names() -> List[str]:
         "Pooling", "BatchNorm", "LayerNorm", "InstanceNorm",
         "L2Normalization", "Embedding", "take", "Dropout", "UpSampling",
         "batch_dot", "topk",
+        # round-5 parity additions (reference mx2onnx/_op_translations.py)
+        "BlockGrad", "MakeLoss", "make_loss", "stop_gradient", "square",
+        "size_array", "_maximum", "_minimum", "_power", "SoftmaxOutput",
+        "LogisticRegressionOutput", "LRN", "Crop", "ROIPooling",
+        "_linalg_gemm2", "linalg_gemm2", "_random_uniform", "_random_normal",
+        "_random_uniform_like", "_random_normal_like", "_sample_multinomial",
+        "Pad", "null",   # null = graph variable nodes, handled in export_model
     }
     return sorted(names)
 
@@ -493,6 +596,10 @@ def export_model(sym, params, input_shape: List[Tuple[int, ...]],
     for node in sym._topo():
         if node.kind == "var":
             value_names[id(node)] = node.name
+            if node.is_rng():
+                # executor RNG key feed — ONNX random generators own their
+                # RNG, so the key is neither a graph input nor initializer
+                continue
             if node.name in params:
                 arr = params[node.name]
                 np_arr = arr.asnumpy() if hasattr(arr, "asnumpy") else \
@@ -628,6 +735,11 @@ def import_op_names() -> List[str]:
         "Split", "Tile", "Pad", "Cast", "Where", "Expand", "Shape",
         "ArgMax", "ArgMin", "ReduceL2", "TopK", "Resize", "Upsample",
         "DepthToSpace", "SpaceToDepth",
+        # round-5 parity additions (reference onnx2mx/_import_helper.py)
+        "FC", "SpatialBN", "LRN", "MaxRoiPool", "GlobalLpPool", "LpPool",
+        "Hardmax", "Multinomial", "RandomNormal", "RandomNormalLike",
+        "RandomUniform", "RandomUniformLike", "ReduceL1", "ReduceLogSum",
+        "ReduceLogSumExp", "ReduceSumSquare", "Size",
     }
     return sorted(names)
 
@@ -753,10 +865,28 @@ def import_model(model_file: str):
         elif op == "Gemm":
             w = params.get(node.input[1])
             if w is None:
-                num_hidden = 0
-            else:
-                num_hidden = int(w.shape[0] if at.get("transB")
-                                 else w.shape[1])
+                # dynamic B (no initializer): FullyConnected's A.B^T contract
+                # cannot absorb transB here — lower to matmul directly
+                a_in = ins[0]
+                if at.get("transA"):
+                    a_in = sym_mod.transpose(a_in)
+                b_in = ins[1]
+                if at.get("transB"):
+                    b_in = sym_mod.transpose(b_in)
+                out = sym_mod._npi_matmul(a_in, b_in)
+                alpha = float(at.get("alpha", 1.0))
+                if alpha != 1.0:
+                    out = out * alpha
+                if len(node.input) > 2:
+                    out = sym_mod.broadcast_add(
+                        out, env[node.input[2]] * float(at.get("beta", 1.0)))
+                for iname in node.input:
+                    if iname in params and iname not in const_only:
+                        tensor_used.add(iname)
+                env[node.output[0]] = out
+                continue
+            num_hidden = int(w.shape[0] if at.get("transB")
+                             else w.shape[1])
             alpha = float(at.get("alpha", 1.0))
             beta = float(at.get("beta", 1.0))
             a_in = ins[0]
@@ -850,7 +980,7 @@ def import_model(model_file: str):
         elif op == "GlobalMaxPool":
             out = sym_mod.Pooling(ins[0], kernel=(1, 1), pool_type="max",
                                   global_pool=True)
-        elif op == "BatchNormalization":
+        elif op in ("BatchNormalization", "SpatialBN"):
             out = sym_mod.BatchNorm(
                 ins[0], env[node.input[1]], env[node.input[2]],
                 env[node.input[3]], env[node.input[4]],
@@ -1062,6 +1192,100 @@ def import_model(model_file: str):
                                    for n in node.input[:3])
             add_const_output(node, _np.arange(start, limit, delta))
             continue
+        elif op == "FC":
+            # pre-standard experimental op some legacy exporters emit
+            w = params.get(node.input[1])
+            has_c = len(node.input) > 2
+            out = sym_mod.FullyConnected(
+                ins[0], env[node.input[1]],
+                env[node.input[2]] if has_c else None,
+                num_hidden=int(w.shape[0]) if w is not None else 0,
+                no_bias=not has_c)
+        elif op == "LRN":
+            out = sym_mod.LRN(ins[0], nsize=int(at.get("size", 5)),
+                              alpha=float(at.get("alpha", 1e-4)),
+                              beta=float(at.get("beta", 0.75)),
+                              knorm=float(at.get("bias", 1.0)))
+        elif op == "MaxRoiPool":
+            out = sym_mod.ROIPooling(
+                ins[0], ins[1],
+                pooled_size=tuple(int(v) for v in at["pooled_shape"]),
+                spatial_scale=float(at.get("spatial_scale", 1.0)))
+        elif op == "GlobalLpPool":
+            pv = int(at.get("p", 2))
+            s = sym_mod.sum(sym_mod._power_scalar(sym_mod.abs(ins[0]),
+                                                  scalar=float(pv)),
+                            axis=(2, 3), keepdims=True)
+            out = sym_mod._power_scalar(s, scalar=1.0 / pv)
+        elif op == "LpPool":
+            pv = int(at.get("p", 2))
+            k = tuple(int(v) for v in at.get("kernel_shape", (2, 2)))
+            strides = tuple(at.get("strides", (1,) * len(k)))
+            xp = sym_mod._power_scalar(sym_mod.abs(ins[0]),
+                                       scalar=float(pv))
+            data_in, sym_pad = _apply_pads(sym_mod, xp, at, len(k))
+            avg = sym_mod.Pooling(data_in, kernel=k, pool_type="avg",
+                                  stride=strides, pad=sym_pad,
+                                  count_include_pad=True)
+            win = 1
+            for v in k:
+                win *= int(v)
+            out = sym_mod._power_scalar(
+                sym_mod._mul_scalar(avg, scalar=float(win)),
+                scalar=1.0 / pv)
+        elif op == "Hardmax":
+            ax = int(at.get("axis", -1))
+            mx_ = sym_mod.max(ins[0], axis=ax, keepdims=True)
+            eq = sym_mod.broadcast_equal(ins[0], mx_)
+            # first-occurrence tie-break: cumsum of the hit mask is exactly
+            # 1 at the first max and >1 at every later tie
+            first = sym_mod._equal_scalar(sym_mod.cumsum(eq, axis=ax),
+                                          scalar=1.0)
+            out = sym_mod.elemwise_mul(eq, first)
+        elif op == "Multinomial":
+            # ONNX input is unnormalized log-probs; our sampler takes
+            # probability rows — softmax bridges exactly
+            n = int(at.get("sample_size", 1))
+            probs = sym_mod.softmax(ins[0], axis=-1)
+            out = sym_mod._sample_multinomial(
+                probs, shape=n,
+                dtype="int64" if int(at.get("dtype", _TP.INT32)) == _TP.INT64
+                else "int32")
+        elif op in ("RandomNormal", "RandomUniform"):
+            shape = tuple(int(v) for v in at.get("shape", (1,)))
+            dt = _TP2NP.get(int(at.get("dtype", _TP.FLOAT)), "float32")
+            if op == "RandomNormal":
+                out = sym_mod._random_normal(
+                    loc=float(at.get("mean", 0.0)),
+                    scale=float(at.get("scale", 1.0)), shape=shape, dtype=dt)
+            else:
+                out = sym_mod._random_uniform(
+                    low=float(at.get("low", 0.0)),
+                    high=float(at.get("high", 1.0)), shape=shape, dtype=dt)
+        elif op == "RandomNormalLike":
+            out = sym_mod._random_normal_like(
+                ins[0], loc=float(at.get("mean", 0.0)),
+                scale=float(at.get("scale", 1.0)))
+        elif op == "RandomUniformLike":
+            out = sym_mod._random_uniform_like(
+                ins[0], low=float(at.get("low", 0.0)),
+                high=float(at.get("high", 1.0)))
+        elif op in ("ReduceL1", "ReduceLogSum", "ReduceLogSumExp",
+                    "ReduceSumSquare"):
+            axes = axes_of(node, at)
+            kw = {"keepdims": bool(at.get("keepdims", 1))}
+            if axes is not None:
+                kw["axis"] = tuple(axes)
+            if op == "ReduceL1":
+                out = sym_mod.sum(sym_mod.abs(ins[0]), **kw)
+            elif op == "ReduceLogSum":
+                out = sym_mod.log(sym_mod.sum(ins[0], **kw))
+            elif op == "ReduceLogSumExp":
+                out = sym_mod.log(sym_mod.sum(sym_mod.exp(ins[0]), **kw))
+            else:
+                out = sym_mod.sum(sym_mod.square(ins[0]), **kw)
+        elif op == "Size":
+            out = sym_mod.size_array(ins[0])
         else:
             raise MXNetError(f"ONNX import: unsupported op {op}")
         for iname in node.input:

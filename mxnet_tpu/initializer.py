@@ -34,6 +34,21 @@ class Initializer:
         self._kwargs = kwargs
 
     def __call__(self, desc, arr):
+        # init values are computed host-side and would otherwise land on
+        # jax's default device — pin the result back to the destination
+        # array's device (a Module bound to mx.cpu() on a TPU-visible
+        # process must keep its params on the CPU)
+        dev = None
+        data = getattr(arr, "_data", None)
+        if data is not None:
+            devs = data.devices()
+            if len(devs) == 1:
+                dev = next(iter(devs))
+        self._dispatch(desc, arr)
+        if dev is not None and arr._data.devices() != {dev}:
+            arr._set_data(jax.device_put(arr._data, dev))
+
+    def _dispatch(self, desc, arr):
         if not isinstance(desc, str):
             desc = InitDesc("weight")
         init_name = getattr(desc, "attrs", {}).get("__init__", None)

@@ -112,8 +112,14 @@ class BucketingModule(BaseModule):
         if isinstance(optimizer, opt_mod.Optimizer):
             self._shared_optimizer = optimizer
         else:
-            self._shared_optimizer = opt_mod.create(
-                optimizer, **dict(optimizer_params or ()))
+            # same 1/batch_size default the child Modules would apply
+            # (module.py _default_rescale_grad) — the shared optimizer is
+            # handed to them pre-built, so the default must land here
+            from .module import _default_rescale_grad
+            params = dict(optimizer_params or ())
+            params.setdefault("rescale_grad", _default_rescale_grad(
+                getattr(self._curr_module, "_data_shapes", None), kvstore))
+            self._shared_optimizer = opt_mod.create(optimizer, **params)
         self._shared_updater = opt_mod.get_updater(self._shared_optimizer)
         self._opt_args = dict(kvstore=kvstore)
         for mod in self._buckets.values():

@@ -22,6 +22,21 @@ from ..initializer import InitDesc
 from .base_module import BaseModule
 
 
+def _default_rescale_grad(data_shapes, kvstore):
+    """reference module.py:503-518: Module-owned optimizers default
+    rescale_grad to 1/batch_size (x num_workers under dist_sync) —
+    output-op gradients (SoftmaxOutput & co) are batch-SUMMED, so without
+    this every standard lr diverges."""
+    batch_size = data_shapes[0][1][0] if data_shapes else 1
+    kv_type = kvstore if isinstance(kvstore, str) \
+        else getattr(kvstore, "type", "")
+    if kv_type and "dist" in kv_type and "_sync" in kv_type:
+        from ..kvstore import create as _kv_create
+        kv = kvstore if not isinstance(kvstore, str) else _kv_create(kvstore)
+        batch_size *= kv.num_workers
+    return 1.0 / max(batch_size, 1)
+
+
 def _shapes_dict(*shape_lists):
     """Normalize (name, shape) tuples / DataDesc objects into one dict —
     the single place bind() and output_shapes parse descriptors."""
@@ -192,11 +207,19 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             return
+        rescale_grad = _default_rescale_grad(self._data_shapes, kvstore)
         if isinstance(optimizer, opt_mod.Optimizer):
+            if abs(optimizer.rescale_grad - rescale_grad) > 1e-12:
+                import warnings
+                warnings.warn(
+                    "Optimizer created manually outside Module but "
+                    f"rescale_grad is not 1/batch_size ({optimizer.rescale_grad}"
+                    f" vs {rescale_grad}). Is this intended?", stacklevel=2)
             self._optimizer = optimizer
         else:
-            self._optimizer = opt_mod.create(optimizer,
-                                             **dict(optimizer_params or ()))
+            params = dict(optimizer_params or ())
+            params.setdefault("rescale_grad", rescale_grad)
+            self._optimizer = opt_mod.create(optimizer, **params)
         self._updater = opt_mod.get_updater(self._optimizer)
         states_file = getattr(self, "_preloaded_states", None)
         if states_file is not None:
@@ -229,7 +252,13 @@ class Module(BaseModule):
             for name, arr in zip(self._label_names, data_batch.label):
                 feed[name] = arr
         if len(self._execs) == 1:
-            self._exec.forward(is_train=is_train, **feed)
+            # place batch data on the module's context (reference
+            # executor_group _load_data as_in_context) — a no-op when the
+            # iterator already produced arrays there
+            ctx = self._contexts[0]
+            self._exec.forward(is_train=is_train,
+                               **{n_: a.as_in_context(ctx)
+                                  for n_, a in feed.items()})
             return
         for k, e in enumerate(self._execs):
             e.forward(is_train=is_train,

@@ -15,6 +15,7 @@ python/mxnet/ndarray/ndarray.py. TPU-native redesign (SURVEY.md §7):
 from __future__ import annotations
 
 import functools
+import time as _time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -507,6 +508,8 @@ def invoke(op: Union[str, Op], inputs: Sequence[NDArray], params: Dict[str, Any]
     from ..ops import registry as _reg
     _plat = _reg._platform_of(raw)
     _tok = _reg.exec_platform.set(_plat) if _plat is not None else None
+    _ph = _reg._profile_hook
+    _t0 = _time.perf_counter() if _ph is not None else 0.0
     try:
         if need_grad:
             # vjp over the unjitted fn: linearizing through an inner pjit
@@ -518,6 +521,8 @@ def invoke(op: Union[str, Op], inputs: Sequence[NDArray], params: Dict[str, Any]
     finally:
         if _tok is not None:
             _reg.exec_platform.reset(_tok)
+    if _ph is not None:
+        _ph(op.name, _t0, _time.perf_counter())
     if isinstance(outs_raw, tuple):
         was_tuple = True
     else:

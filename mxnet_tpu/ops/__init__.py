@@ -19,6 +19,7 @@ from . import detection  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import misc       # noqa: F401
 from . import random_pdf  # noqa: F401
+from . import random_sample  # noqa: F401
 from . import contrib_misc  # noqa: F401
 from . import legacy     # noqa: F401
 from . import quantized  # noqa: F401

@@ -1,0 +1,86 @@
+"""Symbolic random-sampling ops (reference src/operator/random/sample_op.cc
+`_random_uniform`/`_random_normal` and src/operator/random/multisample_op.cc
+`_sample_multinomial`).
+
+Each op takes a ``key`` input: the symbol layer auto-creates an RNG variable
+for it (symbol.py `__rng__` attr) and the executor splits its per-forward
+threefry key across all RNG nodes — the TPU-native replacement for the
+reference's per-device PRNG resource states. The *_like variants mirror
+`RandomNormalLike`/`RandomUniformLike` ONNX semantics (sample with the shape
+and dtype of a tensor input).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .registry import register
+
+
+def _as_key(key):
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        return key
+    return jax.random.wrap_key_data(key.astype(jnp.uint32), impl="threefry2x32")
+
+
+@register("_random_uniform", aliases=("random_uniform",), differentiable=False)
+def random_uniform(key, *, low=0.0, high=1.0, shape=(1,), dtype="float32"):
+    shape = (shape,) if isinstance(shape, int) else tuple(int(s) for s in shape)
+    return jax.random.uniform(_as_key(key), shape, jnp.dtype(dtype),
+                              minval=float(low), maxval=float(high))
+
+
+@register("_random_normal", aliases=("random_normal",), differentiable=False)
+def random_normal(key, *, loc=0.0, scale=1.0, shape=(1,), dtype="float32"):
+    shape = (shape,) if isinstance(shape, int) else tuple(int(s) for s in shape)
+    return float(loc) + float(scale) * jax.random.normal(
+        _as_key(key), shape, jnp.dtype(dtype))
+
+
+@register("_random_uniform_like", aliases=("random_uniform_like",),
+          differentiable=False)
+def random_uniform_like(data, key, *, low=0.0, high=1.0):
+    return jax.random.uniform(_as_key(key), data.shape, data.dtype,
+                              minval=float(low), maxval=float(high))
+
+
+@register("_random_normal_like", aliases=("random_normal_like",),
+          differentiable=False)
+def random_normal_like(data, key, *, loc=0.0, scale=1.0):
+    return (jnp.asarray(loc, data.dtype)
+            + jnp.asarray(scale, data.dtype)
+            * jax.random.normal(_as_key(key), data.shape, data.dtype))
+
+
+@register("_sample_multinomial", aliases=("sample_multinomial",),
+          differentiable=False)
+def sample_multinomial(data, key, *, shape=None, get_prob=False,
+                       dtype="int32"):
+    """Category indices sampled per probability row. ``shape`` is the number
+    of draws per row (reference multisample contract: output
+    data.shape[:-1] + shape)."""
+    if get_prob:
+        raise NotImplementedError(
+            "_sample_multinomial get_prob=True is not supported symbolically")
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    # draw-shape arithmetic is static — keep it in numpy (a jnp.prod here
+    # would trace under the executor's jit and break int())
+    if shape is None:
+        n, draw_dims = 1, None
+    elif isinstance(shape, (int, float)):
+        n, draw_dims = int(shape), (int(shape),)
+    else:
+        draw_dims = tuple(int(s) for s in shape)
+        n = int(_np.prod(draw_dims)) if draw_dims else 1
+    if logits.ndim == 1:
+        out = jax.random.categorical(_as_key(key), logits, shape=(n,))
+        out = out[0] if shape is None else out.reshape(draw_dims)
+    else:
+        out = jax.random.categorical(
+            _as_key(key), logits, axis=-1,
+            shape=(n,) + logits.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+        out = out[..., 0] if shape is None else \
+            out.reshape(logits.shape[:-1] + draw_dims)
+    return out.astype(jnp.dtype(dtype))

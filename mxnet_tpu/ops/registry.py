@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextvars
 import functools
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -161,6 +162,18 @@ def set_autograd_hooks(is_recording, record):
     global _is_recording_hook, _record_hook
     _is_recording_hook = is_recording
     _record_hook = record
+
+
+# profiler.set_state('run') swaps this for a timing wrapper consumed by
+# ndarray.invoke (the eager dispatch path); a None check per eager call is
+# the entire cost when profiling is off (reference profiler.h IsProfiling()
+# check in imperative invoke)
+_profile_hook: Optional[Callable] = None
+
+
+def set_profile_hook(hook: Optional[Callable]):
+    global _profile_hook
+    _profile_hook = hook
 
 
 def invoke_raw(op: Op, raw_inputs, params):

@@ -37,7 +37,12 @@ def set_config(**kwargs):
     _config.update(kwargs)
 
 
+def _op_hook(name: str, start: float, end: float):
+    _record(name, "operator", start, end)
+
+
 def set_state(state="stop", profile_process="worker"):
+    from .ops import registry as _registry
     if state == "run":
         if not _state["running"]:
             d = os.path.splitext(_config["filename"])[0] + "_xplane"
@@ -47,9 +52,15 @@ def set_state(state="stop", profile_process="worker"):
                 _state["trace_dir"] = d
             except Exception:
                 _state["trace_dir"] = None
+            # per-op eager dispatch timing (reference profile_imperative);
+            # the registry pays one None-check per call while off
+            if _config.get("profile_imperative", True) \
+                    or _config.get("profile_all", False):
+                _registry.set_profile_hook(_op_hook)
             _state["running"] = True
     elif state == "stop":
         if _state["running"]:
+            _registry.set_profile_hook(None)
             if _state["trace_dir"]:
                 try:
                     jax.profiler.stop_trace()

@@ -297,3 +297,81 @@ def test_sgld_posterior_is_accurate_and_uncertain_ood():
     acc, ood_gain = _run("sgld_bayes", [])
     assert acc >= 0.9, f"SGLD ensemble acc {acc}"
     assert ood_gain >= 0.1, f"no OOD uncertainty gain: {ood_gain}"
+
+
+@pytest.mark.slow
+def test_module_api_checkpoint_roundtrip():
+    """Reference example/module: Module.fit + do_checkpoint, reload the
+    checkpoint into a fresh Module, and score it — the full symbolic
+    workflow including serialization."""
+    train_acc, val_acc = _run("module_api", ["--epochs", "6"])
+    assert train_acc >= 0.9, f"Module.fit failed to learn: {train_acc}"
+    assert val_acc >= 0.85, f"reloaded checkpoint val acc {val_acc}"
+
+
+@pytest.mark.slow
+def test_numpy_custom_op_trains():
+    """Reference example/numpy-ops: the host-side CustomOp softmax loss
+    must backprop through the tape and train the net."""
+    acc = _run("numpy_ops_custom", ["--epochs", "12"])
+    assert acc >= 0.9, f"CustomOp training failed: acc {acc}"
+
+
+@pytest.mark.slow
+def test_svrg_matches_or_beats_sgd():
+    """Reference example/svrg_module: variance-reduced updates must reach
+    at least plain SGD's final loss on the noisy least-squares problem and
+    land near the noise floor (sigma^2 = 0.09)."""
+    svrg_loss, sgd_loss = _run("svrg_train", ["--epochs", "10"])
+    assert svrg_loss <= sgd_loss * 1.10, \
+        f"SVRG worse than SGD: {svrg_loss} vs {sgd_loss}"
+    assert svrg_loss < 0.2, f"SVRG did not converge: {svrg_loss}"
+
+
+@pytest.mark.slow
+def test_amp_fp16_training_with_loss_scaling():
+    """Reference example/automatic-mixed-precision: fp16 training under
+    dynamic loss scaling must learn, keep a finite scale, and the
+    inference-converted net must agree with the trained one."""
+    acc, scale, diff = _run("amp_training", ["--epochs", "6"])
+    assert acc >= 0.9, f"AMP training failed: acc {acc}"
+    assert scale > 0 and np.isfinite(scale), f"loss scale broken: {scale}"
+    assert diff < 0.25, f"converted net diverged: max|diff| {diff}"
+
+
+@pytest.mark.slow
+def test_profiler_captures_op_table_and_trace():
+    """Reference example/profiler: the aggregate table and the
+    chrome://tracing dump must both record the training loop's ops."""
+    n_ops, n_events = _run("profiler_demo", ["--steps", "10"])
+    assert n_ops >= 5, f"profiler table too small: {n_ops} rows"
+    assert n_events >= 50, f"chrome trace too small: {n_events} events"
+
+
+@pytest.mark.slow
+def test_quantize_int8_example_flow():
+    """Reference example/quantization: the user-facing calibrate+convert
+    flow keeps int8 within 2 points of fp32 on the held-out set."""
+    fp32_acc, int8_acc = _run("quantize_int8", ["--epochs", "6"])
+    assert fp32_acc >= 0.9, f"fp32 training failed: {fp32_acc}"
+    assert fp32_acc - int8_acc <= 0.02, \
+        f"int8 drop too large: {fp32_acc} -> {int8_acc}"
+
+
+@pytest.mark.slow
+def test_model_parallel_lstm_pipeline():
+    """Reference example/model-parallel/lstm redesigned as pipeline
+    stages: the pp=2 fused pipeline step must drive the LM loss toward
+    the deterministic task's floor."""
+    first, last = _run("model_parallel_lstm", ["--steps", "150"])
+    assert first > 1.5, f"suspicious start loss {first}"
+    assert last < 0.8, f"pipeline LM did not learn: {first} -> {last}"
+
+
+@pytest.mark.slow
+def test_extensions_oplib_example():
+    """Reference example/extensions/lib_custom_op: compile + load + run
+    the C++ op library, eagerly and inside jit."""
+    eager_ok, jit_ok = _run("extensions_oplib", [])
+    assert eager_ok, "eager custom-op result wrong"
+    assert jit_ok, "jit custom-op result wrong"

@@ -94,3 +94,28 @@ def test_onnx_self_contained(tmp_path):
 def test_profiler_annotate_runs():
     with mx.profiler.annotate("test-region"):
         _ = nd.zeros((2, 2)) + 1
+
+
+def test_profiler_records_eager_op_dispatch(tmp_path):
+    """reference profile_imperative: ops executed while the profiler runs
+    must appear in the aggregate table and the chrome trace."""
+    import json
+    mx.profiler.set_config(profile_all=True,
+                           filename=str(tmp_path / "prof.json"))
+    mx.profiler.dumps(reset=True)
+    mx.profiler.set_state("run")
+    try:
+        a = nd.zeros((4, 4)) + 1.0
+        b = (a * 2.0).sum()
+        b.asnumpy()
+    finally:
+        mx.profiler.set_state("stop")
+    table = mx.profiler.dumps()
+    assert "operator" in table, table
+    mx.profiler.dump()
+    with open(tmp_path / "prof.json") as f:
+        events = json.load(f)["traceEvents"]
+    assert any(e.get("cat") == "operator" for e in events)
+    # hook must be uninstalled after stop
+    from mxnet_tpu.ops import registry as reg
+    assert reg._profile_hook is None

@@ -32,8 +32,10 @@ def test_module_fit_converges():
     x, y = _toy_data()
     train = NDArrayIter(x, y, batch_size=32, shuffle=True)
     mod = Module(_mlp_symbol(), context=mx.cpu())
+    # per-sample lr (Module defaults rescale_grad=1/batch_size, reference
+    # module.py:506): 1.6 = the pre-rescale batch-summed 0.05
     mod.fit(train, num_epoch=10, optimizer="sgd",
-            optimizer_params=(("learning_rate", 0.05),))
+            optimizer_params=(("learning_rate", 1.6),))
     score = mod.score(NDArrayIter(x, y, batch_size=32), "acc")
     assert dict(score)["accuracy"] > 0.8
 
@@ -97,7 +99,7 @@ def test_feedforward_fit_predict():
     x, y = _toy_data(128, seed=1)
     model = mx.FeedForward(_mlp_symbol(), ctx=mx.cpu(), num_epoch=10,
                            optimizer="sgd", numpy_batch_size=32,
-                           optimizer_params=(("learning_rate", 0.05),))
+                           optimizer_params=(("learning_rate", 1.6),))
     model.fit(x, y)
     pred = model.predict(x)
     acc = ((pred.argmax(axis=1) == y).mean())

@@ -264,7 +264,7 @@ def test_fit_with_eval_data_and_callbacks():
     mod = Module(_mlp_symbol(hidden=16), context=mx.cpu())
     epochs_seen = []
     mod.fit(train, eval_data=val, num_epoch=6,
-            optimizer="adam", optimizer_params=(("learning_rate", 5e-2),),
+            optimizer="adam", optimizer_params=(("learning_rate", 1e-1),),
             epoch_end_callback=lambda e, *a: epochs_seen.append(e),
             batch_end_callback=None)
     assert epochs_seen == list(range(6))
@@ -307,7 +307,10 @@ def test_python_module_protocol():
     assert m.get()[1] == 0.0
 
 
-def _fit_manual(mod, batches, lr=0.1, steps=6):
+def _fit_manual(mod, batches, lr=0.8, steps=6):
+    # lr is a per-sample rate: Module defaults rescale_grad=1/batch_size
+    # (reference module.py:506), so batch-summed output-op grads become
+    # means before the update
     mod.init_optimizer(optimizer="sgd",
                        optimizer_params=(("learning_rate", lr),))
     losses = []
@@ -376,3 +379,20 @@ def test_module_ctx_list_refuses_uneven_batch():
     with pytest.raises(mx.base.MXNetError, match="divide"):
         mod.bind(data_shapes=[("data", (8, 4))],
                  label_shapes=[("softmax_label", (8,))])
+
+
+def test_module_defaults_rescale_grad_to_inverse_batch():
+    """reference module.py:503-518: Module-created optimizers divide the
+    batch-summed output-op gradients by the bound batch size; an explicit
+    rescale_grad in optimizer_params wins."""
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 4))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    assert abs(mod._optimizer.rescale_grad - 1.0 / 8) < 1e-12
+    mod.init_optimizer(optimizer="sgd", force_init=True,
+                       optimizer_params=(("learning_rate", 0.1),
+                                         ("rescale_grad", 1.0)))
+    assert mod._optimizer.rescale_grad == 1.0

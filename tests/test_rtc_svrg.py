@@ -49,8 +49,10 @@ def test_svrg_module_trains():
     mod = SVRGModule(_mlp_sym(), context=mx.cpu(), update_freq=1)
     mod.bind(it.provide_data, it.provide_label)
     mod.init_params()
+    # per-sample lr: Module defaults rescale_grad=1/batch_size (reference
+    # module.py:506), so 1.6 here = the old batch-summed 0.05
     mod.init_optimizer(optimizer="sgd",
-                       optimizer_params=(("learning_rate", 0.05),))
+                       optimizer_params=(("learning_rate", 1.6),))
     for epoch in range(4):
         mod.update_full_grads(it)
         it.reset()
