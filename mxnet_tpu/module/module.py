@@ -27,13 +27,20 @@ def _default_rescale_grad(data_shapes, kvstore):
     rescale_grad to 1/batch_size (x num_workers under dist_sync) —
     output-op gradients (SoftmaxOutput & co) are batch-SUMMED, so without
     this every standard lr diverges."""
+    import os
     batch_size = data_shapes[0][1][0] if data_shapes else 1
     kv_type = kvstore if isinstance(kvstore, str) \
         else getattr(kvstore, "type", "")
     if kv_type and "dist" in kv_type and "_sync" in kv_type:
-        from ..kvstore import create as _kv_create
-        kv = kvstore if not isinstance(kvstore, str) else _kv_create(kvstore)
-        batch_size *= kv.num_workers
+        if not isinstance(kvstore, str):
+            batch_size *= kvstore.num_workers
+        else:
+            # env read, not a throwaway KVStoreDist — instantiating one
+            # here would parse the cluster env and build allreduce state
+            # just to ask its size
+            batch_size *= max(1, int(os.environ.get(
+                "MXNET_TPU_NUM_WORKERS",
+                os.environ.get("DMLC_NUM_WORKER", "1"))))
     return 1.0 / max(batch_size, 1)
 
 

@@ -82,6 +82,14 @@ def load(fname):
 
 _SPECIAL_KEY_OPS = {"Dropout"}
 
+# random sampling ops: the trailing `key` input is auto-created as an RNG
+# variable in symbol graphs; eager calls draw one from the global stream
+# here (reference-compatible imperative surface: nd.random_uniform(...),
+# nd.sample_multinomial(probs), ...)
+_RNG_SAMPLE_OPS = {"_random_uniform", "_random_normal",
+                   "_random_uniform_like", "_random_normal_like",
+                   "_sample_multinomial"}
+
 # Derived ops for tensor-valued KEYWORD arguments (e.g.
 # nd.CTCLoss(..., label_lengths=arr)): the reference treats these as
 # tensor inputs, so they must ride the traced-input path — leaving them
@@ -131,6 +139,9 @@ def _make_wrapper(op_name: str):
             elif len(inputs) == 1:
                 import jax.numpy as jnp
                 inputs.append(NDArray(jnp.zeros((2,), jnp.uint32)))
+        elif op.name in _RNG_SAMPLE_OPS:
+            from .. import random as _rnd
+            inputs.append(NDArray(_rnd.next_key_raw()))
         nd_kw = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
         if nd_kw:
             names = tuple(sorted(nd_kw))

@@ -36,6 +36,7 @@ _UNARY = {
     "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
     "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
     "gammaln": jax.scipy.special.gammaln,
+    "digamma": jax.scipy.special.digamma,
     "logical_not": lambda x: (x == 0).astype(x.dtype),
     "relu": lambda x: jnp.maximum(x, 0),
     "sigmoid": jax.nn.sigmoid,
@@ -59,6 +60,30 @@ register("size_array", differentiable=False)(
     lambda x: jnp.asarray([x.size], dtype=jnp.int32))
 
 
+@register("_contrib_arange_like", aliases=("arange_like",),
+          differentiable=False)
+def arange_like(data, *, start=0.0, step=1.0, repeat=1, axis=None):
+    """reference src/operator/tensor/init_op.cc _contrib_arange_like:
+    a range shaped like the input (axis=None) or like its given axis —
+    the shape is static under trace, so positional encodings built from
+    it stay jit-compatible."""
+    n = data.size if axis is None else data.shape[int(axis)]
+    idx = jnp.arange(n, dtype=data.dtype)
+    if repeat != 1:
+        # output length stays n; each value holds for `repeat` slots
+        idx = jnp.floor(idx / repeat)
+    vals = start + step * idx
+    return vals.reshape(data.shape) if axis is None else vals
+
+
+@register("_contrib_div_sqrt_dim", aliases=("div_sqrt_dim",))
+def div_sqrt_dim(x):
+    """reference src/operator/contrib/transformer.cc:828
+    _contrib_div_sqrt_dim: x / sqrt(last-dim size) — the scaled-attention
+    helper."""
+    return x / jnp.sqrt(jnp.asarray(x.shape[-1], x.dtype))
+
+
 @register("Cast", aliases=("cast",), differentiable=True)
 def cast(x, *, dtype):
     return x.astype(jnp.dtype(dtype))
@@ -72,7 +97,11 @@ def amp_cast(x, *, dtype):
 
 @register("clip")
 def clip(x, *, a_min, a_max):
-    return jnp.clip(x, a_min, a_max)
+    # where-form, not jnp.clip: the reference's gradient contract passes
+    # boundary values through (mask a_min <= x <= a_max → grad 1 AT the
+    # bounds), while jnp.clip's VJP halves the gradient exactly there
+    return jnp.where(x < a_min, jnp.asarray(a_min, x.dtype),
+                     jnp.where(x > a_max, jnp.asarray(a_max, x.dtype), x))
 
 
 @register("LeakyReLU")
@@ -125,9 +154,15 @@ _BINARY = {
     "elemwise_mul": jnp.multiply, "broadcast_mul": jnp.multiply,
     "elemwise_div": jnp.divide, "broadcast_div": jnp.divide,
     "broadcast_mod": jnp.mod, "broadcast_power": jnp.power,
-    "broadcast_maximum": jnp.maximum, "broadcast_minimum": jnp.minimum,
+    # where-form max/min, not jnp.maximum: the reference's gradient contract
+    # (mshadow ge/le masks) routes the WHOLE tie gradient to the first
+    # argument, while jnp.maximum's VJP splits ties 0.5/0.5
+    "broadcast_maximum": lambda x, y: jnp.where(x >= y, x, y),
+    "broadcast_minimum": lambda x, y: jnp.where(x <= y, x, y),
     "broadcast_hypot": jnp.hypot,
-    "_power": jnp.power, "_mod": jnp.mod, "_maximum": jnp.maximum, "_minimum": jnp.minimum,
+    "_power": jnp.power, "_mod": jnp.mod,
+    "_maximum": lambda x, y: jnp.where(x >= y, x, y),
+    "_minimum": lambda x, y: jnp.where(x <= y, x, y),
     "arctan2": jnp.arctan2,
     "ldexp": lambda x, y: x * jnp.exp2(y),
 }

@@ -265,7 +265,14 @@ def take(a, indices, *, axis=0, mode="clip"):
 
 @register("pick")
 def pick(x, index, *, axis=-1, keepdims=False, mode="clip"):
-    idx = jnp.clip(index.astype(jnp.int32), 0, x.shape[axis] - 1)
+    """reference src/operator/tensor/broadcast_reduce_op_index.cc pick:
+    mode='wrap' wraps out-of-range indices by the axis length, 'clip'
+    clamps them."""
+    n = x.shape[axis]
+    if mode == "wrap":
+        idx = jnp.mod(index.astype(jnp.int32), n)
+    else:
+        idx = jnp.clip(index.astype(jnp.int32), 0, n - 1)
     out = jnp.take_along_axis(x, jnp.expand_dims(idx, axis % x.ndim), axis=axis)
     if not keepdims:
         out = jnp.squeeze(out, axis=axis % x.ndim)

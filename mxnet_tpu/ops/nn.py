@@ -206,10 +206,25 @@ def pooling(data, *, kernel=(), pool_type="max", global_pool=False, stride=None,
 @register("UpSampling")
 def upsampling(data, *weights, scale=2, sample_type="nearest", num_filter=0,
                multi_input_mode="concat", num_args=1, workspace=None):
-    if sample_type != "nearest":
-        raise MXNetError("UpSampling: only nearest supported; use contrib.BilinearResize2D")
-    out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
-    return out
+    """reference src/operator/nn/upsampling.cc. `bilinear` is a LEARNABLE
+    depthwise deconv (upsampling-inl.h:172 GetDeconvolutionParam: kernel
+    2*scale - scale%2, stride scale, pad ceil((scale-1)/2), num_group ==
+    num_filter, no bias) — the weight input is trained, so it must be
+    honored, not replaced by a fixed resize."""
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    if sample_type == "bilinear":
+        if not weights:
+            raise MXNetError(
+                "UpSampling bilinear needs a weight input (it is a "
+                "deconvolution; initialize with init.Bilinear())")
+        k = 2 * scale - scale % 2
+        p = int(_np.ceil((scale - 1) / 2.0))
+        nf = num_filter or data.shape[1]
+        return deconvolution(data, weights[0], None, kernel=(k, k),
+                             num_filter=nf, stride=(scale, scale),
+                             pad=(p, p), num_group=nf, no_bias=True)
+    raise MXNetError(f"UpSampling: unknown sample_type {sample_type!r}")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,391 @@
+"""Corner cases mirrored from the reference's test_operator.py long tail
+(reference tests/python/unittest/test_operator.py, 9,850 lines) — the
+per-op edge behaviors the dtype/fuzz sweeps do not pin: gradient routing
+through duplicate/shared inputs, grouped/dilated conv impulse responses,
+boundary gradients, zero-size edge cases, tie-breaking, and the round-5
+op additions (arange_like, div_sqrt_dim, bilinear UpSampling, digamma).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def _grad_of(fn, *arrs):
+    xs = [nd.array(a) for a in arrs]
+    for x in xs:
+        x.attach_grad()
+    with autograd.record():
+        y = fn(*xs)
+    y.backward()
+    return [x.grad.asnumpy() for x in xs]
+
+
+# --- gradient routing ------------------------------------------------------
+
+def test_binary_op_duplicate_input():
+    """reference test_binary_op_duplicate_input: y = x*x must give 2x, not
+    x — both tape edges route into the same array."""
+    a = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    x = nd.array(a)
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * a, rtol=1e-5)
+
+
+def test_elementwise_sum_grad_fans_out():
+    """reference test_elementwise_sum: add_n backward sends the out-grad to
+    every input, including a repeated one (counted twice)."""
+    rs = np.random.RandomState(1)
+    a, b = rs.randn(2, 3).astype(np.float32), rs.randn(2, 3).astype(np.float32)
+    ga, gb = _grad_of(lambda x, y: nd.add_n(x, y, x).sum(), a, b)
+    np.testing.assert_allclose(ga, 2 * np.ones_like(a), rtol=1e-6)
+    np.testing.assert_allclose(gb, np.ones_like(b), rtol=1e-6)
+
+
+def test_clip_gradient_boundary():
+    """reference test_clip: grad passes inside [a_min, a_max] INCLUSIVE of
+    the boundary values and is zero strictly outside."""
+    x = np.array([-2.0, -1.0, 0.0, 1.0, 2.0], np.float32)
+    (g,) = _grad_of(lambda t: nd.clip(t, a_min=-1.0, a_max=1.0).sum(), x)
+    np.testing.assert_array_equal(g, [0.0, 1.0, 1.0, 1.0, 0.0])
+
+
+def test_take_grad_accumulates_duplicate_indices():
+    """reference test_take ('grad of repeated index accumulates'): both
+    gathers of row 1 must sum into its gradient."""
+    w = np.random.RandomState(2).randn(4, 3).astype(np.float32)
+    idx = np.array([1, 1, 3], np.float32)
+    x = nd.array(w)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.take(x, nd.array(idx))
+    y.backward()
+    g = x.grad.asnumpy()
+    np.testing.assert_allclose(g[1], 2 * np.ones(3), rtol=1e-6)
+    np.testing.assert_allclose(g[3], np.ones(3), rtol=1e-6)
+    np.testing.assert_allclose(g[0], np.zeros(3))
+
+
+def test_where_grad_routes_by_condition():
+    """reference test_where: each branch's grad is masked by the
+    condition; the condition itself gets no gradient."""
+    rs = np.random.RandomState(3)
+    cond = np.array([[1, 0], [0, 1]], np.float32)
+    a, b = rs.randn(2, 2).astype(np.float32), rs.randn(2, 2).astype(np.float32)
+    x, y = nd.array(a), nd.array(b)
+    x.attach_grad()
+    y.attach_grad()
+    with autograd.record():
+        out = nd.where(nd.array(cond), x, y)
+    out.backward()
+    np.testing.assert_array_equal(x.grad.asnumpy(), cond)
+    np.testing.assert_array_equal(y.grad.asnumpy(), 1 - cond)
+
+
+def test_maximum_grad_tie_splits_to_lhs():
+    """reference test_maximum_minimum: at a == b, mxnet routes the whole
+    gradient to the FIRST argument (x >= y mask), not half each."""
+    a = np.array([1.0, 2.0, 3.0], np.float32)
+    ga, gb = _grad_of(lambda x, y: nd._maximum(x, y).sum(), a, a.copy())
+    np.testing.assert_array_equal(ga, np.ones(3))
+    np.testing.assert_array_equal(gb, np.zeros(3))
+
+
+# --- convolution impulse/grouping -----------------------------------------
+
+def test_convolution_dilated_impulse_response():
+    """reference test_convolution_dilated_impulse_response: a centered
+    impulse through a dilate-d 3x3 kernel of ones must light exactly the
+    taps at offsets {-d, 0, d}."""
+    for d in (1, 2, 3):
+        img = np.zeros((1, 1, 9, 9), np.float32)
+        img[0, 0, 4, 4] = 1.0
+        w = nd.array(np.ones((1, 1, 3, 3), np.float32))
+        out = nd.Convolution(nd.array(img), w, kernel=(3, 3), num_filter=1,
+                             pad=(d, d), dilate=(d, d), no_bias=True)
+        got = out.asnumpy()[0, 0]
+        exp = np.zeros((9, 9), np.float32)
+        for dy in (-d, 0, d):
+            for dx in (-d, 0, d):
+                exp[4 + dy, 4 + dx] = 1.0
+        np.testing.assert_array_equal(got, exp)
+
+
+def test_convolution_grouping_matches_per_group():
+    """reference test_convolution_grouping: num_group=2 equals two
+    independent convs over channel halves, fwd AND weight grads."""
+    rs = np.random.RandomState(4)
+    x = rs.randn(2, 4, 6, 6).astype(np.float32)
+    w = rs.randn(6, 2, 3, 3).astype(np.float32)
+    b = rs.randn(6).astype(np.float32)
+
+    xg = nd.array(x)
+    wg = nd.array(w)
+    bg = nd.array(b)
+    for t in (xg, wg, bg):
+        t.attach_grad()
+    with autograd.record():
+        yg = nd.Convolution(xg, wg, bg, kernel=(3, 3), num_filter=6,
+                            num_group=2)
+    yg.backward()
+
+    parts, wgrads = [], []
+    for g in range(2):
+        xs = nd.array(x[:, 2 * g:2 * g + 2])
+        ws = nd.array(w[3 * g:3 * g + 3])
+        bs = nd.array(b[3 * g:3 * g + 3])
+        xs.attach_grad()
+        ws.attach_grad()
+        with autograd.record():
+            ys = nd.Convolution(xs, ws, bs, kernel=(3, 3), num_filter=3)
+        ys.backward()
+        parts.append(ys.asnumpy())
+        wgrads.append(ws.grad.asnumpy())
+    np.testing.assert_allclose(yg.asnumpy(), np.concatenate(parts, axis=1),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(wg.grad.asnumpy(), np.concatenate(wgrads),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_depthwise_convolution():
+    """reference test_depthwise_convolution: num_group == channels, one
+    filter per channel — equals per-channel 2d correlation."""
+    rs = np.random.RandomState(5)
+    x = rs.randn(1, 3, 5, 5).astype(np.float32)
+    w = rs.randn(3, 1, 3, 3).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=3, num_group=3, no_bias=True).asnumpy()
+    for c in range(3):
+        exp = np.zeros((3, 3), np.float32)
+        for i in range(3):
+            for j in range(3):
+                exp[i, j] = (x[0, c, i:i + 3, j:j + 3] * w[c, 0]).sum()
+        np.testing.assert_allclose(out[0, c], exp, rtol=1e-4, atol=1e-5)
+
+
+def test_deconvolution_forward_with_bias():
+    """reference test_deconvolution_forward_with_bias: bias adds per
+    output channel after the transpose conv."""
+    rs = np.random.RandomState(6)
+    x = rs.randn(1, 2, 4, 4).astype(np.float32)
+    w = rs.randn(2, 3, 2, 2).astype(np.float32)
+    b = np.array([1.0, -2.0, 0.5], np.float32)
+    no_b = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(2, 2),
+                            num_filter=3, no_bias=True).asnumpy()
+    with_b = nd.Deconvolution(nd.array(x), nd.array(w), nd.array(b),
+                              kernel=(2, 2), num_filter=3).asnumpy()
+    np.testing.assert_allclose(with_b, no_b + b[None, :, None, None],
+                               rtol=1e-5, atol=1e-6)
+
+
+# --- zero-size and empty edges --------------------------------------------
+
+def test_concat_with_zero_size_tensor():
+    """reference test_concat_with_zero_size_tensor."""
+    a = nd.zeros((2, 0, 3))
+    b = nd.ones((2, 4, 3))
+    out = nd.Concat(a, b, dim=1)
+    assert out.shape == (2, 4, 3)
+    np.testing.assert_array_equal(out.asnumpy(), b.asnumpy())
+
+
+def test_empty_reps_and_empty_tensor_tile():
+    """reference test_empty_reps/test_empty_tensor: tile of a zero-size
+    tensor keeps zero size; reps=() is identity."""
+    z = nd.array(np.zeros((0, 3), np.float32))
+    assert nd.tile(z, reps=(2, 2)).shape == (0, 6)
+    x = nd.array(np.ones((2, 2), np.float32))
+    np.testing.assert_array_equal(nd.tile(x, reps=()).asnumpy(), x.asnumpy())
+
+
+def test_empty_indices_take():
+    """reference test_empty_indices: gather with an empty index tensor."""
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    out = nd.take(x, nd.array(np.array([], np.float32)))
+    assert out.shape == (0, 3)
+
+
+# --- ordering / tie-breaking ----------------------------------------------
+
+def test_order_topk_and_argsort_edges():
+    """reference test_order: k == n equals a full sort; is_ascend flips;
+    argsort of ties is a valid permutation."""
+    x = np.array([3.0, 1.0, 2.0, 2.0], np.float32)
+    vals, idx = nd.topk(nd.array(x), k=4, ret_typ="both", is_ascend=False)
+    np.testing.assert_array_equal(vals.asnumpy(), [3.0, 2.0, 2.0, 1.0])
+    asc = nd.topk(nd.array(x), k=2, ret_typ="value", is_ascend=True)
+    np.testing.assert_array_equal(asc.asnumpy(), [1.0, 2.0])
+    order = nd.argsort(nd.array(x)).asnumpy().astype(int)
+    np.testing.assert_array_equal(np.sort(x[order]), np.sort(x))
+    np.testing.assert_array_equal(x[order], np.sort(x))
+
+
+def test_pick_negative_axis_and_wrap_mode():
+    """reference test_pick: axis=-1 and mode='wrap' index semantics."""
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    got = nd.pick(nd.array(x), nd.array(np.array([0, -1, 5], np.float32)),
+                  axis=-1, mode="wrap").asnumpy()
+    np.testing.assert_array_equal(got, [x[0, 0], x[1, -1], x[2, 1]])
+
+
+# --- special functions and round-5 op additions ---------------------------
+
+def test_cbrt_rcbrt_grads():
+    """reference test_cbrt_op/test_rcbrt_op incl. negative inputs."""
+    x = np.array([-8.0, -1.0, 1.0, 8.0], np.float32)
+    np.testing.assert_allclose(nd.cbrt(nd.array(x)).asnumpy(),
+                               np.cbrt(x), rtol=1e-5)
+    (g,) = _grad_of(lambda t: nd.cbrt(t).sum(), np.array([8.0], np.float32))
+    np.testing.assert_allclose(g, 1.0 / (3.0 * 4.0), rtol=1e-4)
+    np.testing.assert_allclose(
+        nd.rcbrt(nd.array(np.array([8.0], np.float32))).asnumpy(), [0.5],
+        rtol=1e-5)
+
+
+def test_digamma_matches_scipy_recurrence():
+    """digamma(x+1) = digamma(x) + 1/x pins the implementation without a
+    scipy dependency."""
+    x = np.array([0.5, 1.0, 2.5, 7.0], np.float32)
+    d = nd.digamma(nd.array(x)).asnumpy()
+    d1 = nd.digamma(nd.array(x + 1.0)).asnumpy()
+    np.testing.assert_allclose(d1, d + 1.0 / x, rtol=1e-4, atol=1e-5)
+
+
+def test_arange_like():
+    """reference test_arange_like(+without_axis): full-shape and per-axis
+    ranges shaped off the input."""
+    x = nd.zeros((2, 3, 4))
+    full = nd.arange_like(x).asnumpy()
+    assert full.shape == (2, 3, 4)
+    np.testing.assert_array_equal(full.ravel(), np.arange(24, dtype=np.float32))
+    ax = nd.arange_like(x, axis=1, start=5.0, step=2.0).asnumpy()
+    np.testing.assert_array_equal(ax, [5.0, 7.0, 9.0])
+
+
+def test_div_sqrt_dim():
+    """reference contrib.div_sqrt_dim (transformer.cc:828)."""
+    x = np.random.RandomState(7).randn(2, 9).astype(np.float32)
+    got = nd.div_sqrt_dim(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, x / 3.0, rtol=1e-6)
+
+
+def test_blockgrad_stops_and_identity_passes():
+    """reference test_blockgrad: BlockGrad forwards values, kills grads."""
+    a = np.random.RandomState(8).randn(3).astype(np.float32)
+    x = nd.array(a)
+    x.attach_grad()
+    with autograd.record():
+        y = (nd.BlockGrad(x) * x).sum()
+    y.backward()
+    # d/dx [sg(x) * x] = sg(x) — the blocked factor contributes nothing
+    np.testing.assert_allclose(x.grad.asnumpy(), a, rtol=1e-5)
+
+
+def test_sequence_ops_with_lengths():
+    """reference test_sequence_last/test_sequence_reverse with
+    use_sequence_length=True (TNC layout, per-batch lengths)."""
+    x = np.arange(2 * 3 * 1, dtype=np.float32).reshape(2, 3, 1)
+    lens = np.array([1.0, 2.0, 1.0], np.float32)
+    last = nd.SequenceLast(nd.array(x), nd.array(lens),
+                           use_sequence_length=True).asnumpy()
+    np.testing.assert_array_equal(last.ravel(), [x[0, 0, 0], x[1, 1, 0],
+                                                 x[0, 2, 0]])
+    rev = nd.SequenceReverse(nd.array(x), nd.array(lens),
+                             use_sequence_length=True).asnumpy()
+    # batch 1 has length 2: rows swap; batches 0/2 (length 1) unchanged
+    np.testing.assert_array_equal(rev[0, 1], x[1, 1])
+    np.testing.assert_array_equal(rev[1, 1], x[0, 1])
+    np.testing.assert_array_equal(rev[:, 0], x[:, 0])
+    np.testing.assert_array_equal(rev[:, 2], x[:, 2])
+
+
+def test_one_hot_dtype_and_values():
+    """reference test_one_hot: on/off values and dtype override."""
+    got = nd.one_hot(nd.array(np.array([0, 2], np.float32)), depth=3,
+                     on_value=5.0, off_value=-1.0, dtype="float32").asnumpy()
+    np.testing.assert_array_equal(got, [[5, -1, -1], [-1, -1, 5]])
+
+
+def test_diag_offsets():
+    """reference test_diag: k offsets both directions, 2d->1d and 1d->2d."""
+    m = np.arange(9, dtype=np.float32).reshape(3, 3)
+    np.testing.assert_array_equal(nd.diag(nd.array(m), k=1).asnumpy(),
+                                  np.diag(m, k=1))
+    np.testing.assert_array_equal(nd.diag(nd.array(m), k=-1).asnumpy(),
+                                  np.diag(m, k=-1))
+    v = np.array([1.0, 2.0], np.float32)
+    np.testing.assert_array_equal(nd.diag(nd.array(v), k=1).asnumpy(),
+                                  np.diag(v, k=1))
+
+
+def test_all_finite_flags():
+    """reference test_all_finite: scalar 1/0 flag incl. the multi-array
+    form used by the AMP overflow check."""
+    ok = nd.all_finite(nd.array(np.ones(4, np.float32)))
+    bad = nd.all_finite(nd.array(np.array([1.0, np.inf], np.float32)))
+    assert int(ok.asnumpy()) == 1 and int(bad.asnumpy()) == 0
+    multi = nd.multi_all_finite(nd.array(np.ones(2, np.float32)),
+                                nd.array(np.array([np.nan], np.float32)),
+                                num_arrays=2)
+    assert int(multi.asnumpy()) == 0
+
+def test_arange_like_repeat():
+    """reference arange_like repeat contract: output length is unchanged,
+    each value holds for `repeat` slots (value = start + step*(i//repeat))."""
+    x = nd.zeros((2, 3))
+    full = nd.arange_like(x, repeat=2).asnumpy()
+    assert full.shape == (2, 3)
+    np.testing.assert_array_equal(full.ravel(), [0, 0, 1, 1, 2, 2])
+    ax = nd.arange_like(nd.zeros((2, 4)), axis=1, repeat=2).asnumpy()
+    np.testing.assert_array_equal(ax, [0, 0, 1, 1])
+
+
+def test_bilinear_upsampling_honors_weight():
+    """reference upsampling-inl.h:172: bilinear UpSampling IS a depthwise
+    deconv over the weight input — a zero weight must zero the output, and
+    the bilinear-init weight must reproduce interpolation."""
+    x = nd.ones((1, 1, 3, 3))
+    wz = nd.zeros((1, 1, 4, 4))
+    out = nd.UpSampling(x, wz, scale=2, sample_type="bilinear",
+                        num_filter=1, num_args=2)
+    assert out.shape == (1, 1, 6, 6)
+    np.testing.assert_allclose(out.asnumpy(), 0.0)
+    # classic bilinear kernel for scale 2 (deconv k=4): outer([.25,.75,.75,.25])
+    v = np.array([0.25, 0.75, 0.75, 0.25], np.float32)
+    wb = nd.array(np.outer(v, v)[None, None])
+    interior = nd.UpSampling(x, wb, scale=2, sample_type="bilinear",
+                             num_filter=1, num_args=2).asnumpy()[0, 0]
+    np.testing.assert_allclose(interior[2:4, 2:4], 1.0, rtol=1e-5)
+    with pytest.raises(mx.base.MXNetError, match="weight"):
+        nd.UpSampling(x, scale=2, sample_type="bilinear", num_filter=1)
+
+
+def test_eager_random_sampling_ops():
+    """The reference's imperative random surface: nd.random_uniform /
+    nd.random_normal / *_like / nd.sample_multinomial draw from the global
+    stream without an explicit key."""
+    u = nd.random_uniform(low=1.0, high=2.0, shape=(500,))
+    assert u.shape == (500,)
+    a = u.asnumpy()
+    assert a.min() >= 1.0 and a.max() <= 2.0
+    n = nd.random_normal(loc=3.0, scale=0.1, shape=(500,)).asnumpy()
+    assert abs(n.mean() - 3.0) < 0.05
+    like = nd.random_normal_like(nd.zeros((4, 5)))
+    assert like.shape == (4, 5)
+    probs = nd.array(np.array([[0.0, 1.0], [1.0, 0.0]], np.float32))
+    s = nd.sample_multinomial(probs, shape=6).asnumpy()
+    assert s.shape == (2, 6)
+    assert (s[0] == 1).all() and (s[1] == 0).all()
+    # consecutive draws differ (the key advances)
+    u2 = nd.random_uniform(low=1.0, high=2.0, shape=(500,)).asnumpy()
+    assert not np.array_equal(a, u2)
+    # and mx.random.seed reproduces the stream
+    mx.random.seed(77)
+    r1 = nd.random_normal(shape=(8,)).asnumpy()
+    mx.random.seed(77)
+    r2 = nd.random_normal(shape=(8,)).asnumpy()
+    np.testing.assert_array_equal(r1, r2)
