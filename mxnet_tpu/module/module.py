@@ -35,12 +35,15 @@ def _default_rescale_grad(data_shapes, kvstore):
         if not isinstance(kvstore, str):
             batch_size *= kvstore.num_workers
         else:
-            # env read, not a throwaway KVStoreDist — instantiating one
-            # here would parse the cluster env and build allreduce state
-            # just to ask its size
+            # env read + process_count, not a throwaway KVStoreDist —
+            # instantiating one here would parse the cluster env and build
+            # allreduce state just to ask its size. Mirrors
+            # KVStoreDist.num_workers = max(env size, jax.process_count())
+            import jax as _jax
             batch_size *= max(1, int(os.environ.get(
                 "MXNET_TPU_NUM_WORKERS",
-                os.environ.get("DMLC_NUM_WORKER", "1"))))
+                os.environ.get("DMLC_NUM_WORKER", "1"))),
+                _jax.process_count())
     return 1.0 / max(batch_size, 1)
 
 

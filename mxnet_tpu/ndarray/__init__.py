@@ -140,8 +140,10 @@ def _make_wrapper(op_name: str):
                 import jax.numpy as jnp
                 inputs.append(NDArray(jnp.zeros((2,), jnp.uint32)))
         elif op.name in _RNG_SAMPLE_OPS:
+            # ride the tensor-kwarg path: a positional append would bind
+            # the key to `data` when the caller passed data= by keyword
             from .. import random as _rnd
-            inputs.append(NDArray(_rnd.next_key_raw()))
+            kwargs["key"] = NDArray(_rnd.next_key_raw())
         nd_kw = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
         if nd_kw:
             names = tuple(sorted(nd_kw))

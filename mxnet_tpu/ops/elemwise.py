@@ -68,11 +68,11 @@ def arange_like(data, *, start=0.0, step=1.0, repeat=1, axis=None):
     the shape is static under trace, so positional encodings built from
     it stay jit-compatible."""
     n = data.size if axis is None else data.shape[int(axis)]
-    idx = jnp.arange(n, dtype=data.dtype)
-    if repeat != 1:
-        # output length stays n; each value holds for `repeat` slots
-        idx = jnp.floor(idx / repeat)
-    vals = start + step * idx
+    # output length stays n; each value holds for `repeat` slots. Integer
+    # floor-division + a final cast keep integer inputs integer (float
+    # true-divide/promotion would silently change the dtype vs repeat=1)
+    idx = jnp.arange(n) // int(repeat)
+    vals = (start + step * idx).astype(data.dtype)
     return vals.reshape(data.shape) if axis is None else vals
 
 

@@ -212,7 +212,16 @@ def upsampling(data, *weights, scale=2, sample_type="nearest", num_filter=0,
     num_filter, no bias) — the weight input is trained, so it must be
     honored, not replaced by a fixed resize."""
     if sample_type == "nearest":
-        return jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+        # reference multi_input_mode='concat': every input is upsampled to
+        # the FIRST input's scaled size and channel-concatenated
+        # (upsampling-inl.h nearest path; smaller inputs get a larger
+        # integer factor)
+        oh, ow = data.shape[2] * scale, data.shape[3] * scale
+        outs = []
+        for x in (data,) + weights:
+            fh, fw = oh // x.shape[2], ow // x.shape[3]
+            outs.append(jnp.repeat(jnp.repeat(x, fh, axis=2), fw, axis=3))
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
     if sample_type == "bilinear":
         if not weights:
             raise MXNetError(

@@ -339,17 +339,19 @@ class DataParallelTrainer:
         # later use of the net or a second trainer on it)
         self._params_raw = [self._put_replicated(jnp.array(w, copy=True), s)
                             for w, s in zip(self._params_raw, self._param_shardings)]
-        if self._is_multiprocess():
-            # multi-controller jit needs GLOBAL arrays everywhere: lift the
-            # (identical-per-process, seeded) optimizer state onto the mesh.
-            # Requires every process to have initialized the net with the
-            # same seed — the same contract as the reference's dist workers
-            # starting from a rank-0 broadcast.
-            self._opt_state = [
-                jax.tree_util.tree_map(
-                    lambda l: self._put_replicated(l, s), st) if t else st
-                for st, s, t in zip(self._opt_state, self._param_shardings,
-                                    self._trainable)]
+        # opt_state was initialized from the params BEFORE placement (nets
+        # deferred-init on CPU), so it must be lifted onto the mesh exactly
+        # like the params — single-process included: the step jit requires
+        # params and opt_state co-located, and net init under mx.cpu() on a
+        # TPU-visible process otherwise leaves the state on the host. In
+        # multi-controller SPMD this doubles as the global-array lift
+        # (identical-per-process seeded state, the reference's rank-0
+        # broadcast contract).
+        self._opt_state = [
+            jax.tree_util.tree_map(
+                lambda l: self._put_replicated(l, s), st) if t else st
+            for st, s, t in zip(self._opt_state, self._param_shardings,
+                                self._trainable)]
 
         # 2-bit gradient compression with per-device error feedback
         # (reference src/kvstore/gradient_compression.cc:60). Each device

@@ -389,3 +389,30 @@ def test_eager_random_sampling_ops():
     mx.random.seed(77)
     r2 = nd.random_normal(shape=(8,)).asnumpy()
     np.testing.assert_array_equal(r1, r2)
+
+
+def test_upsampling_nearest_multi_input_concat():
+    """reference UpSampling multi_input_mode='concat': every input is
+    upsampled to the first input's scaled size and channel-concatenated."""
+    a = nd.ones((1, 2, 4, 4))
+    b = nd.array(2 * np.ones((1, 3, 2, 2), np.float32))
+    out = nd.UpSampling(a, b, scale=2, sample_type="nearest", num_args=2)
+    assert out.shape == (1, 5, 8, 8)
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[:, :2], 1.0)
+    np.testing.assert_allclose(got[:, 2:], 2.0)
+
+
+def test_arange_like_repeat_keeps_integer_dtype():
+    x = nd.zeros((6,), dtype="int32")
+    out = nd.arange_like(x, repeat=2)
+    assert str(out.dtype) == "int32"
+    np.testing.assert_array_equal(out.asnumpy(), [0, 0, 1, 1, 2, 2])
+
+
+def test_random_like_accepts_keyword_data():
+    like = nd.random_normal_like(data=nd.zeros((3, 4)))
+    assert like.shape == (3, 4)
+    s = nd.sample_multinomial(
+        data=nd.array(np.array([[0.0, 1.0]], np.float32)), shape=4)
+    assert (s.asnumpy() == 1).all()
