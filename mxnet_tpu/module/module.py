@@ -35,15 +35,22 @@ def _default_rescale_grad(data_shapes, kvstore):
         if not isinstance(kvstore, str):
             batch_size *= kvstore.num_workers
         else:
-            # env read + process_count, not a throwaway KVStoreDist —
-            # instantiating one here would parse the cluster env and build
-            # allreduce state just to ask its size. Mirrors
-            # KVStoreDist.num_workers = max(env size, jax.process_count())
-            import jax as _jax
+            # env read + (guarded) process_count, not a throwaway
+            # KVStoreDist — instantiating one here would parse the cluster
+            # env and build allreduce state just to ask its size. Mirrors
+            # KVStoreDist.num_workers = max(env size, jax.process_count()),
+            # but only reads process_count when the distributed client is
+            # already up: calling it cold would initialize the XLA backend
+            # and forbid a later jax.distributed.initialize (the hazard
+            # kvstore.py:334-337 documents)
+            from .._dist_util import dist_client_active
+            n_proc = 1
+            if dist_client_active():
+                import jax as _jax
+                n_proc = _jax.process_count()
             batch_size *= max(1, int(os.environ.get(
                 "MXNET_TPU_NUM_WORKERS",
-                os.environ.get("DMLC_NUM_WORKER", "1"))),
-                _jax.process_count())
+                os.environ.get("DMLC_NUM_WORKER", "1"))), n_proc)
     return 1.0 / max(batch_size, 1)
 
 

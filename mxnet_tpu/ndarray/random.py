@@ -47,7 +47,12 @@ def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None):
     return r
 
 
-randn = normal
+def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None, out=None):
+    """reference ndarray/random.py:170 randn(*shape, loc=, scale=): the
+    shape is POSITIONAL — `randn(2, 3)` draws a (2, 3) standard normal
+    (an alias to `normal` here would silently read loc=2, scale=3)."""
+    return normal(loc=loc, scale=scale, shape=shape or None, dtype=dtype,
+                  ctx=ctx, out=out)
 
 
 def randint(low, high=None, shape=None, dtype="int32", ctx=None):

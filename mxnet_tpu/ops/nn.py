@@ -212,16 +212,23 @@ def upsampling(data, *weights, scale=2, sample_type="nearest", num_filter=0,
     num_filter, no bias) — the weight input is trained, so it must be
     honored, not replaced by a fixed resize."""
     if sample_type == "nearest":
-        # reference multi_input_mode='concat': every input is upsampled to
-        # the FIRST input's scaled size and channel-concatenated
-        # (upsampling-inl.h nearest path; smaller inputs get a larger
-        # integer factor)
+        # reference multi_input_mode: every input is upsampled to the
+        # FIRST input's scaled size (smaller inputs get a larger integer
+        # factor), then channel-concatenated ('concat', default) or
+        # elementwise-summed ('sum') — upsampling-inl.h nearest path
         oh, ow = data.shape[2] * scale, data.shape[3] * scale
         outs = []
         for x in (data,) + weights:
             fh, fw = oh // x.shape[2], ow // x.shape[3]
             outs.append(jnp.repeat(jnp.repeat(x, fh, axis=2), fw, axis=3))
-        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+        if len(outs) == 1:
+            return outs[0]
+        if multi_input_mode == "sum":
+            out = outs[0]
+            for x in outs[1:]:
+                out = out + x
+            return out
+        return jnp.concatenate(outs, axis=1)
     if sample_type == "bilinear":
         if not weights:
             raise MXNetError(

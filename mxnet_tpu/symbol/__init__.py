@@ -43,6 +43,9 @@ for _name, _op in sorted(_all_ops().items()):
 def __getattr__(name):
     # ops registered after this module imported (e.g. contrib extensions)
     # resolve lazily from the live registry, keeping nd/sym in sync
+    if name == "random":
+        import importlib
+        return importlib.import_module(__name__ + ".random")
     if name == "contrib":
         # importlib, not `from . import`: the latter's hasattr() probe
         # re-enters this __getattr__ before the submodule import starts.

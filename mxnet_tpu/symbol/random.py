@@ -1,0 +1,48 @@
+"""``mx.sym.random`` — symbolic sampling namespace
+(reference python/mxnet/symbol/random.py: uniform/normal/multinomial
+wrappers over the `_random_*`/`_sample_*` registered ops).
+
+Each function builds a graph node whose `key` input is auto-created as an
+RNG variable (symbol.py `__rng__` attr); the executor splits a fresh
+threefry key across all RNG nodes every forward, so re-running the same
+executor draws new samples — the symbolic analog of the reference's
+per-forward resource RNG."""
+from __future__ import annotations
+
+from . import _apply_op
+from ..ops.registry import get_op as _get_op
+
+
+def _shape(shape):
+    if shape is None:
+        return (1,)
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", **kwargs):
+    """reference symbol/random.py uniform."""
+    return _apply_op(_get_op("_random_uniform"), low=low, high=high,
+                     shape=_shape(shape), dtype=dtype, **kwargs)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", **kwargs):
+    """reference symbol/random.py normal."""
+    return _apply_op(_get_op("_random_normal"), loc=loc, scale=scale,
+                     shape=_shape(shape), dtype=dtype, **kwargs)
+
+
+def uniform_like(data, low=0.0, high=1.0, **kwargs):
+    return _apply_op(_get_op("_random_uniform_like"), data, low=low,
+                     high=high, **kwargs)
+
+
+def normal_like(data, loc=0.0, scale=1.0, **kwargs):
+    return _apply_op(_get_op("_random_normal_like"), data, loc=loc,
+                     scale=scale, **kwargs)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kwargs):
+    """reference symbol/random.py multinomial (samples category indices
+    from probability rows)."""
+    return _apply_op(_get_op("_sample_multinomial"), data, shape=shape,
+                     get_prob=get_prob, dtype=dtype, **kwargs)

@@ -1376,3 +1376,17 @@ def _np_group_adagrad(w, g, h, lr=0.1, eps=1e-5):
 add("group_adagrad_update", _group_adagrad_inputs,
     lambda w, g, h: _np_group_adagrad(w, g, h),
     kwargs={"lr": 0.1, "epsilon": 1e-5}, rtol=1e-4, atol=1e-4)
+
+
+# round-5 op additions (deterministic refs; the random sampling ops are
+# distribution-tested in tests/test_operator_reference_tail.py instead)
+add("digamma", pos((2, 4)),
+    lambda x: _t().digamma(_t().from_numpy(x).double()).numpy().astype(F32),
+    grad=True)
+add("arange_like", std((2, 3)),
+    lambda x: np.arange(6, dtype=F32).reshape(2, 3))
+add("arange_like", std((3, 4)),
+    lambda x: np.array([3.0, 5.0, 7.0, 9.0], F32),
+    kwargs={"axis": 1, "start": 3.0, "step": 2.0}, ident="axis")
+add("div_sqrt_dim", std((2, 9)),
+    lambda x: (x / 3.0).astype(F32), grad=True)

@@ -416,3 +416,40 @@ def test_random_like_accepts_keyword_data():
     s = nd.sample_multinomial(
         data=nd.array(np.array([[0.0, 1.0]], np.float32)), shape=4)
     assert (s.asnumpy() == 1).all()
+
+
+def test_sym_random_namespace():
+    """reference python/mxnet/symbol/random.py: mx.sym.random.* builds
+    graph nodes whose RNG key is auto-fed by the executor per forward."""
+    import mxnet_tpu.symbol as sym
+    s = sym.random.normal(loc=2.0, scale=0.1, shape=(500,))
+    ex = s.bind(mx.cpu(), {})
+    a = ex.forward()[0].asnumpy()
+    assert abs(a.mean() - 2.0) < 0.05
+    b = ex.forward()[0].asnumpy()
+    assert not np.array_equal(a, b)  # fresh draw per forward
+    x = sym.Variable("x")
+    m = sym.random.multinomial(sym.softmax(x), shape=3)
+    got = m.bind(mx.cpu(), {"x": nd.array(
+        np.array([[9.0, 0.0, 0.0]], np.float32))}).forward()[0].asnumpy()
+    assert got.shape == (1, 3) and (got == 0).all()
+
+
+def test_upsampling_nearest_multi_input_sum():
+    """reference multi_input_mode='sum': inputs are upsampled to the first
+    input's scaled size and elementwise-summed (same channel count)."""
+    a = nd.ones((1, 2, 4, 4))
+    b = nd.array(2 * np.ones((1, 2, 2, 2), np.float32))
+    out = nd.UpSampling(a, b, scale=2, sample_type="nearest", num_args=2,
+                        multi_input_mode="sum")
+    assert out.shape == (1, 2, 8, 8)
+    np.testing.assert_allclose(out.asnumpy(), 3.0)
+
+
+def test_randn_positional_shape():
+    """reference ndarray/random.py:170: randn(*shape) — the shape is
+    positional, NOT (loc, scale)."""
+    out = mx.nd.random.randn(2, 3)
+    assert out.shape == (2, 3)
+    big = mx.nd.random.randn(2000, loc=5.0, scale=0.1).asnumpy()
+    assert abs(big.mean() - 5.0) < 0.05
