@@ -98,3 +98,14 @@ class ProgressBar:
         percents = math.ceil(100.0 * count / float(self.total))
         prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
         logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+
+
+class LogValidationMetricsCallback:
+    """Log eval metrics at epoch end (reference callback.py:214)."""
+
+    def __call__(self, param):
+        if not getattr(param, "eval_metric", None):
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f",
+                         param.epoch, name, value)

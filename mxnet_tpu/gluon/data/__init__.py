@@ -1,8 +1,10 @@
 from .dataset import Dataset, ArrayDataset, SimpleDataset, RecordFileDataset
-from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler
+from .sampler import (Sampler, SequentialSampler, RandomSampler,
+                      BatchSampler, FilterSampler)
 from .dataloader import DataLoader
 from . import vision
 
 __all__ = ["Dataset", "ArrayDataset", "SimpleDataset", "RecordFileDataset",
            "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
+           "FilterSampler",
            "DataLoader", "vision"]

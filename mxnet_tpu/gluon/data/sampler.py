@@ -68,3 +68,17 @@ class BatchSampler(Sampler):
         if self._last_batch == "discard":
             return n // self._batch_size
         return (n + len(self._prev)) // self._batch_size
+
+
+class FilterSampler(Sampler):
+    """Samples the indices whose dataset element satisfies ``fn``
+    (reference gluon/data/sampler.py FilterSampler)."""
+
+    def __init__(self, fn, dataset):
+        self._indices = [i for i in range(len(dataset)) if fn(dataset[i])]
+
+    def __iter__(self):
+        return iter(self._indices)
+
+    def __len__(self):
+        return len(self._indices)

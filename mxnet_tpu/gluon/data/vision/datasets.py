@@ -146,3 +146,30 @@ class ImageFolderDataset(Dataset):
         if self._transform is not None:
             return self._transform(img, label)
         return img, label
+
+
+class ImageRecordDataset(Dataset):
+    """Images + labels from an indexed RecordIO file (reference
+    gluon/data/vision/datasets.py:233 — each record is a packed header
+    with the label followed by the encoded image)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ..dataset import RecordFileDataset
+        self._rec = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __len__(self):
+        return len(self._rec)
+
+    def __getitem__(self, idx):
+        from ....recordio import unpack
+        from .... import image as _image
+        header, buf = unpack(self._rec[idx])
+        # image.imdecode, not unpack_img: RGB output like every other
+        # decode path here (+ the PIL fallback on cv2-less hosts)
+        data = _image.imdecode(buf, flag=self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label

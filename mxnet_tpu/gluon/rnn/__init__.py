@@ -1,8 +1,10 @@
 from .rnn_layer import RNN, LSTM, GRU
 from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
-                       SequentialRNNCell, DropoutCell, ZoneoutCell,
+                       SequentialRNNCell, HybridSequentialRNNCell,
+                       DropoutCell, ZoneoutCell,
                        ResidualCell, BidirectionalCell, HybridRecurrentCell)
 
 __all__ = ["RNN", "LSTM", "GRU", "RecurrentCell", "RNNCell", "LSTMCell",
-           "GRUCell", "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+           "DropoutCell", "ZoneoutCell",
            "ResidualCell", "BidirectionalCell", "HybridRecurrentCell"]

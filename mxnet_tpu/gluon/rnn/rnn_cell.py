@@ -303,3 +303,11 @@ class BidirectionalCell(HybridRecurrentCell):
         if merge_outputs:
             outputs = nd.stack(*outputs, axis=axis)
         return outputs, l_states + r_states
+
+
+class HybridSequentialRNNCell(SequentialRNNCell):
+    """Hybridizable sequential cell container (reference rnn_cell.py
+    HybridSequentialRNNCell). The cell chain here is jit-traced through
+    the same registry path either way, so the hybrid variant shares
+    SequentialRNNCell's implementation — the class exists for API parity
+    and isinstance checks."""

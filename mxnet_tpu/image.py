@@ -479,3 +479,453 @@ class ImageIter:
                          pad=pad)
 
     __next__ = next
+
+
+class RandomOrderAug(Augmenter):
+    """Apply a list of augmenters in random order (reference
+    image.py:RandomOrderAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        order = list(range(len(self.ts)))
+        _pyrandom.shuffle(order)
+        for i in order:
+            src = self.ts[i](src)
+        return src
+
+
+class ColorJitterAug(RandomOrderAug):
+    """Brightness/contrast/saturation jitter in random order (reference
+    image.py:ColorJitterAug)."""
+
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class RandomGrayAug(Augmenter):
+    """With probability p collapse to grayscale replicated over channels
+    (reference image.py:RandomGrayAug)."""
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], "float32")
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        src = _npx(src)
+        if _pyrandom.random() < self.p:
+            src = _np.repeat((src * self._coef).sum(axis=2, keepdims=True),
+                             3, axis=2)
+        return src
+
+
+# ---------------------------------------------------------------------------
+# Detection augmenters (reference python/mxnet/image/detection.py). Labels
+# are (N, 5+) float arrays [cls, xmin, ymin, xmax, ymax, ...] with corners
+# NORMALIZED to [0, 1]; every augmenter maps (HWC image, label) -> same.
+# ---------------------------------------------------------------------------
+
+class DetAugmenter:
+    """Detection augmenter base (reference detection.py:41)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap a label-invariant classification augmenter (reference
+    detection.py:67)."""
+
+    def __init__(self, augmenter):
+        if not isinstance(augmenter, Augmenter):
+            raise MXNetError("DetBorrowAug needs an image Augmenter")
+        super().__init__()
+        self.augmenter = augmenter
+
+    def dumps(self):
+        return [type(self).__name__, self.augmenter.dumps()]
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Apply ONE randomly chosen augmenter from the list, or none with
+    probability skip_prob (reference detection.py:92)."""
+
+    def __init__(self, aug_list, skip_prob=0):
+        super().__init__(skip_prob=skip_prob)
+        if not isinstance(aug_list, (list, tuple)):
+            aug_list = [aug_list]
+        for a in aug_list:
+            if not isinstance(a, DetAugmenter):
+                raise MXNetError("DetRandomSelectAug takes DetAugmenters")
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob if aug_list else 1.0
+
+    def dumps(self):
+        return [type(self).__name__, [a.dumps() for a in self.aug_list]]
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.skip_prob:
+            return src, label
+        return _pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and x-coordinates together (reference detection.py:128)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.p:
+            src = _npx(src)[:, ::-1]
+            label = label.copy()
+            x1 = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - label[:, 1]
+            label[:, 1] = x1
+        return src, label
+
+
+def _box_areas(boxes):
+    return _np.maximum(0, boxes[:, 3] - boxes[:, 1]) * \
+        _np.maximum(0, boxes[:, 2] - boxes[:, 0])
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Constrained random crop (reference detection.py:154): the crop must
+    cover >= min_object_covered of some box, sit inside the area/aspect
+    ranges, and boxes keeping < min_eject_coverage of their area are
+    dropped; after max_attempts the input passes through unchanged."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+        self.enabled = (0 < area_range[0] <= area_range[1]
+                        and 0 < aspect_ratio_range[0] <= aspect_ratio_range[1])
+
+    def __call__(self, src, label):
+        src = _npx(src)
+        h, w = src.shape[0], src.shape[1]
+        prop = self._propose(label, h, w)
+        if prop is not None:
+            x, y, cw, ch, label = prop
+            src = src[y:y + ch, x:x + cw]
+        return src, label
+
+    def _covered_enough(self, boxes, x1, y1, x2, y2):
+        areas = _box_areas(boxes)
+        good = areas > 0
+        if not good.any():
+            return False
+        bx = boxes[good]
+        ix1 = _np.maximum(bx[:, 0], x1)
+        iy1 = _np.maximum(bx[:, 1], y1)
+        ix2 = _np.minimum(bx[:, 2], x2)
+        iy2 = _np.minimum(bx[:, 3], y2)
+        inter = _np.maximum(0, ix2 - ix1) * _np.maximum(0, iy2 - iy1)
+        cov = inter / areas[good]
+        cov = cov[cov > 0]
+        return cov.size > 0 and cov.min() > self.min_object_covered
+
+    def _remap_labels(self, label, x, y, cw, ch, h, w):
+        # crop box in normalized coords
+        nx, ny, nw, nh = x / w, y / h, cw / w, ch / h
+        out = label.copy()
+        out[:, (1, 3)] = (out[:, (1, 3)] - nx) / nw
+        out[:, (2, 4)] = (out[:, (2, 4)] - ny) / nh
+        out[:, 1:5] = _np.clip(out[:, 1:5], 0, 1)
+        keep_area = _box_areas(out[:, 1:5]) * nw * nh
+        orig_area = _box_areas(label[:, 1:5])
+        with _np.errstate(divide="ignore", invalid="ignore"):
+            coverage = _np.where(orig_area > 0, keep_area / orig_area, 0.0)
+        valid = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2]) & \
+            (coverage > self.min_eject_coverage)
+        return out[valid] if valid.any() else None
+
+    def _propose(self, label, h, w):
+        if not self.enabled or h <= 0 or w <= 0:
+            return None
+        for _ in range(self.max_attempts):
+            ratio = _pyrandom.uniform(*self.aspect_ratio_range)
+            area = _pyrandom.uniform(*self.area_range) * h * w
+            ch = int(round((area / ratio) ** 0.5))
+            cw = int(round(ch * ratio))
+            if ch < 1 or cw < 1 or ch > h or cw > w:
+                continue
+            y = _pyrandom.randint(0, h - ch)
+            x = _pyrandom.randint(0, w - cw)
+            if not self._covered_enough(label[:, 1:5], x / w, y / h,
+                                        (x + cw) / w, (y + ch) / h):
+                continue
+            new_label = self._remap_labels(label, x, y, cw, ch, h, w)
+            if new_label is not None:
+                return x, y, cw, ch, new_label
+        return None
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion padding (reference detection.py:325): embed the
+    image in a larger canvas filled with pad_val and shrink the boxes
+    accordingly."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33), area_range=(1.0, 3.0),
+                 max_attempts=50, pad_val=(128, 128, 128)):
+        if not isinstance(pad_val, (tuple, list)):
+            pad_val = (pad_val,) * 3
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.pad_val = pad_val
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.enabled = (area_range[1] > 1.0
+                        and area_range[0] <= area_range[1]
+                        and 0 < aspect_ratio_range[0] <= aspect_ratio_range[1])
+
+    def __call__(self, src, label):
+        src = _npx(src)
+        h, w = src.shape[0], src.shape[1]
+        prop = self._propose(h, w)
+        if prop is not None:
+            x, y, pw, ph = prop
+            canvas = _np.empty((ph, pw, src.shape[2]), "float32")
+            canvas[:] = _np.asarray(self.pad_val, "float32")
+            canvas[y:y + h, x:x + w] = src
+            src = canvas
+            label = label.copy()
+            label[:, (1, 3)] = (label[:, (1, 3)] * w + x) / pw
+            label[:, (2, 4)] = (label[:, (2, 4)] * h + y) / ph
+        return src, label
+
+    def _propose(self, h, w):
+        if not self.enabled or h <= 0 or w <= 0:
+            return None
+        for _ in range(self.max_attempts):
+            ratio = _pyrandom.uniform(*self.aspect_ratio_range)
+            area = _pyrandom.uniform(*self.area_range) * h * w
+            ph = int(round((area / ratio) ** 0.5))
+            pw = int(round(ph * ratio))
+            if ph - h < 2 or pw - w < 2:
+                continue
+            y = _pyrandom.randint(0, ph - h)
+            x = _pyrandom.randint(0, pw - w)
+            return x, y, pw, ph
+        return None
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0):
+    """One DetRandomSelectAug over per-constraint croppers (reference
+    detection.py:419 — list-valued constraints make one cropper each)."""
+    if isinstance(min_object_covered, (list, tuple)):
+        n = len(min_object_covered)
+    else:
+        n = 1
+        min_object_covered = [min_object_covered]
+    aspect = aspect_ratio_range if isinstance(aspect_ratio_range[0],
+                                              (list, tuple)) \
+        else [aspect_ratio_range] * n
+    areas = area_range if isinstance(area_range[0], (list, tuple)) \
+        else [area_range] * n
+    eject = min_eject_coverage if isinstance(min_eject_coverage,
+                                             (list, tuple)) \
+        else [min_eject_coverage] * n
+    if not (len(aspect) == len(areas) == len(eject) == n):
+        raise MXNetError(
+            "CreateMultiRandCropAugmenter: list-valued constraints must "
+            f"all have the same length (got {n}, {len(aspect)}, "
+            f"{len(areas)}, {len(eject)})")
+    crops = [DetRandomCropAug(min_object_covered=m, aspect_ratio_range=a,
+                              area_range=r, min_eject_coverage=e,
+                              max_attempts=max_attempts)
+             for m, a, r, e in zip(min_object_covered, aspect, areas, eject)]
+    return DetRandomSelectAug(crops, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 3.0),
+                       min_eject_coverage=0.3, max_attempts=50,
+                       pad_val=(127, 127, 127)):
+    """Standard detection pipeline (reference detection.py:484): optional
+    resize -> constrained crop -> mirror -> expansion pad -> force resize
+    -> cast -> color/pca/gray -> normalize."""
+    augs = []
+    if resize > 0:
+        augs.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        augs.append(CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range,
+            (area_range[0], min(1.0, area_range[1])), min_eject_coverage,
+            max_attempts, skip_prob=1 - rand_crop))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:
+        augs.append(DetRandomSelectAug(
+            [DetRandomPadAug(aspect_ratio_range, (1.0, area_range[1]),
+                             max_attempts, pad_val)], 1 - rand_pad))
+    augs.append(DetBorrowAug(ForceResizeAug((data_shape[2], data_shape[1]),
+                                            inter_method)))
+    augs.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        augs.append(DetBorrowAug(ColorJitterAug(brightness, contrast,
+                                                saturation)))
+    if hue:
+        augs.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        augs.append(DetBorrowAug(LightingAug(
+            pca_noise, _np.array([55.46, 4.794, 1.148]),
+            _np.array([[-0.5675, 0.7192, 0.4009],
+                       [-0.5808, -0.0045, -0.8140],
+                       [-0.5836, -0.6948, 0.4203]]))))
+    if rand_gray > 0:
+        augs.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        augs.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return augs
+
+
+class ImageDetIter(ImageIter):
+    """Detection image iterator (reference detection.py:626): labels are
+    the im2rec detection format [header_width, obj_width, extras...,
+    (cls, xmin, ymin, xmax, ymax)*N] with normalized corners; batches pad
+    the object axis with -1 rows to the estimated max object count."""
+
+    def __init__(self, batch_size, data_shape, path_imglist=None,
+                 path_root="", imglist=None, aug_list=None, shuffle=False,
+                 seed=0, label_name="label", **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **kwargs)
+        super().__init__(batch_size, data_shape, path_imglist=path_imglist,
+                         path_root=path_root, imglist=imglist,
+                         aug_list=[], shuffle=shuffle, seed=seed,
+                         label_width=-1)
+        self.det_auglist = aug_list
+        self.label_name = label_name
+        self.label_shape = self._estimate_label_shape()
+
+    def _parse_label(self, raw):
+        raw = _np.asarray(raw, "float32").ravel()
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if obj_width < 5:
+            raise MXNetError(f"object width {obj_width} must be >= 5")
+        body = raw[header_width:]
+        if body.size % obj_width != 0:
+            raise MXNetError("label length does not divide into objects")
+        out = body.reshape(-1, obj_width)
+        valid = _np.where(out[:, 0] > -0.5)[0]
+        if valid.size < 1:
+            raise MXNetError("no valid object in label")
+        return out[valid]
+
+    def _estimate_label_shape(self):
+        max_count, width = 0, 5
+        for lab, _ in self.items:
+            parsed = self._parse_label(lab)
+            max_count = max(max_count, parsed.shape[0])
+            width = max(width, parsed.shape[1])
+        return (max_count, width)
+
+    @property
+    def provide_label(self):
+        return [(self.label_name, (self.batch_size,) + self.label_shape)]
+
+    def reshape(self, data_shape=None, label_shape=None):
+        if data_shape is not None:
+            self.data_shape = tuple(data_shape)
+        if label_shape is not None:
+            self.check_label_shape(label_shape)
+            self.label_shape = tuple(label_shape)
+
+    def check_label_shape(self, label_shape):
+        if len(label_shape) != 2 or label_shape[0] < self.label_shape[0] \
+                or label_shape[1] < self.label_shape[1]:
+            raise MXNetError(
+                f"label_shape {label_shape} smaller than estimated "
+                f"{self.label_shape}")
+
+    def sync_label_shape(self, it, verbose=False):
+        """Grow both iterators' label shapes to their union (reference
+        detection.py:968 — train/val iterators must batch identically)."""
+        if not isinstance(it, ImageDetIter):
+            raise MXNetError("sync_label_shape needs an ImageDetIter")
+        shape = (max(self.label_shape[0], it.label_shape[0]),
+                 max(self.label_shape[1], it.label_shape[1]))
+        self.label_shape = shape
+        it.label_shape = shape
+        return it
+
+    def next(self):
+        from .io.io import DataBatch
+        from .ndarray import array as nd_array
+        if self._cur >= len(self.items):
+            raise StopIteration
+        xs, ys = [], []
+        n_obj, width = self.label_shape
+        while len(xs) < self.batch_size and self._cur < len(self.items):
+            lab, fname = self.items[self._order[self._cur]]
+            self._cur += 1
+            img = imread(fname).asnumpy().astype("float32")
+            label = self._parse_label(lab)
+            for aug in self.det_auglist:
+                img, label = aug(img, label)
+            xs.append(_np.moveaxis(_np.asarray(img, "float32"), -1, 0))
+            padded = _np.full((n_obj, width), -1.0, "float32")
+            k = min(n_obj, label.shape[0])
+            padded[:k, :label.shape[1]] = label[:k]
+            ys.append(padded)
+        pad = self.batch_size - len(xs)
+        if pad:
+            xs += [xs[-1]] * pad
+            ys += [ys[-1]] * pad
+        return DataBatch(data=[nd_array(_np.stack(xs))],
+                        label=[nd_array(_np.stack(ys))], pad=pad)

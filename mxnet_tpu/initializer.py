@@ -246,3 +246,152 @@ def create(name, **kwargs) -> Initializer:
         except Exception:
             pass
     raise MXNetError(f"unknown initializer {name!r}")
+
+
+class Load:
+    """Initialize variables from a params file or dict (reference
+    initializer.py:319). ``arg:``/``aux:`` prefixes are dropped; names not
+    found fall back to ``default_init``."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .model import load_params
+            arg, aux = load_params(param)
+            param = {**arg, **aux}
+        self.param = {}
+        for name, arr in param.items():
+            key = name[4:] if name.startswith(("arg:", "aux:")) else name
+            self.param[key] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        key = str(name)
+        if key in self.param:
+            src = self.param[key]
+            raw = src._data if hasattr(src, "_data") else jnp.asarray(src)
+            if tuple(raw.shape) != tuple(arr.shape):
+                raise MXNetError(
+                    f"Load: parameter {key} shape mismatch "
+                    f"{tuple(raw.shape)} vs {tuple(arr.shape)}")
+            arr._set_data(raw.astype(arr.dtype))
+            if self.verbose:
+                import logging
+                logging.info("Initialized %s by loading", key)
+        else:
+            if self.default_init is None:
+                raise MXNetError(
+                    f"Load: no initialization for {key} and no "
+                    "default_init given")
+            self.default_init(name, arr)
+
+
+class Mixed:
+    """Pattern-dispatched initializer list (reference initializer.py:366):
+    the FIRST regex that matches the parameter name picks the
+    initializer."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise MXNetError("Mixed: len(patterns) != len(initializers)")
+        self.map = [(re.compile(p), i) for p, i in zip(patterns,
+                                                       initializers)]
+
+    def __call__(self, name, arr):
+        key = str(name)
+        for prog, init in self.map:
+            if prog.match(key):
+                init(name if isinstance(name, InitDesc) else InitDesc(key),
+                     arr)
+                return
+        raise MXNetError(
+            f"Mixed: parameter {key} did not match any pattern; add '.*' "
+            "as the final pattern for a default")
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize the fused RNN op's FLAT parameter vector (reference
+    initializer.py:720): unpack per-layer/per-direction wx/wh/bx/bh slices
+    (the layout of ops/nn.py _unpack_rnn_params), run the inner
+    initializer on each, and set the LSTM forget-gate bias."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        super().__init__(init=None, num_hidden=num_hidden,
+                         num_layers=num_layers, mode=mode,
+                         bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = create(init) if isinstance(init, str) else init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .ops.nn import _gates
+        ng = _gates(self._mode)
+        h = self._num_hidden
+        L = self._num_layers
+        d = 2 if self._bidirectional else 1
+        total = int(arr.shape[0])
+        # solve the flat length for input_size (layer 0 reads it; deeper
+        # layers read h*d): total = d*ng*h*(isz + h)
+        #   + (L-1)*d*ng*h*(h*d + h) + L*d*2*ng*h
+        rest = (L - 1) * d * ng * h * (h * d + h) + L * d * 2 * ng * h \
+            + d * ng * h * h
+        isz = (total - rest) // (d * ng * h)
+        if isz <= 0 or d * ng * h * (isz + h) + rest - d * ng * h * h \
+                != total:
+            raise MXNetError("FusedRNN: parameter length does not match "
+                             "num_hidden/num_layers/mode")
+        out = _np.empty(total, dtype=_np.float32)
+        off = 0
+
+        def fill(shape, kind):
+            # bias-suffixed desc so the inner initializer's name dispatch
+            # routes bias slices to _init_bias (zeros), matching the
+            # reference's per-name unpack_weights initialization
+            nonlocal off
+            n = int(_np.prod(shape))
+            tmp = _NDArrayShim(shape)
+            self._init(InitDesc(f"{desc}_{kind}"), tmp)
+            out[off:off + n] = _np.asarray(tmp._data).reshape(-1)
+            off += n
+
+        for layer in range(L):
+            for _dir in range(d):
+                cur = isz if layer == 0 else h * d
+                fill((ng * h, cur), "weight")
+                fill((ng * h, h), "weight")
+        for layer in range(L):
+            for _dir in range(d):
+                for _b in range(2):   # bx, bh
+                    start = off
+                    fill((ng * h,), "bias")
+                    if self._mode == "lstm" and _b == 0:
+                        # gate order [i f g o]: forget slice of bx
+                        out[start + h:start + 2 * h] = self._forget_bias
+        arr._set_data(jnp.asarray(out, dtype=arr.dtype))
+
+
+class _NDArrayShim:
+    """Minimal array target for inner initializers (supports the
+    _set_data / __setitem__ surface they use)."""
+
+    def __init__(self, shape):
+        self._data = jnp.zeros(shape, jnp.float32)
+        self.shape = tuple(shape)
+        self.dtype = jnp.float32
+
+    def _set_data(self, raw):
+        self._data = jnp.asarray(raw, jnp.float32).reshape(self.shape)
+
+    def __setitem__(self, key, value):
+        if key == slice(None):
+            self._data = jnp.full(self.shape, float(value), jnp.float32) \
+                if _np.isscalar(value) else \
+                jnp.asarray(value, jnp.float32).reshape(self.shape)
+        else:
+            raise MXNetError("shim supports full-slice assignment only")

@@ -289,6 +289,47 @@ class MCC(EvalMetric):
             self.global_sum_metric, self.global_num_inst = self.sum_metric, 1
 
 
+@register("pcc")
+class PCC(EvalMetric):
+    """Multiclass MCC from a growing KxK confusion matrix (reference
+    metric.py:1528 PCC — the discrete Pearson correlation / R_K
+    statistic; binary case equals MCC)."""
+
+    def __init__(self, name="pcc", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+        self._cm = _np.zeros((2, 2))
+
+    def reset(self):
+        super().reset()
+        self._cm = _np.zeros((2, 2))
+
+    def _grow(self, k):
+        if k > self._cm.shape[0]:
+            cm = _np.zeros((k, k))
+            cm[:self._cm.shape[0], :self._cm.shape[1]] = self._cm
+            self._cm = cm
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            l = _as_numpy(label).astype("int64").reshape(-1)
+            p = _as_numpy(pred)
+            ph = _np.argmax(p, axis=-1).reshape(-1) if p.ndim > 1 \
+                else (p > 0.5).astype("int64").reshape(-1)
+            self._grow(max(int(l.max()), int(ph.max())) + 1)
+            _np.add.at(self._cm, (l, ph), 1)
+            c = self._cm
+            n = c.sum()
+            t = c.sum(axis=1)   # true counts per class
+            q = c.sum(axis=0)   # predicted counts per class
+            cov_xy = n * _np.trace(c) - (t * q).sum()
+            cov_xx = n * n - (t * t).sum()
+            cov_yy = n * n - (q * q).sum()
+            denom = math.sqrt(cov_xx * cov_yy)
+            self.sum_metric = cov_xy / denom if denom > 0 else 0.0
+            self.num_inst = 1
+            self.global_sum_metric, self.global_num_inst = self.sum_metric, 1
+
+
 @register("loss")
 class Loss(EvalMetric):
     def __init__(self, name="loss", output_names=None, label_names=None):

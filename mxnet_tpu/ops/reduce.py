@@ -100,3 +100,16 @@ def moments(x, *, axes=None, keepdims=False):
     mean = jnp.mean(x, axis=ax, keepdims=keepdims)
     var = jnp.var(x, axis=ax, keepdims=keepdims)
     return mean, var
+
+
+@register("_square_sum", aliases=("square_sum",))
+def square_sum(x, *, axis=None, keepdims=False, exclude=False):
+    """Sum of squares (reference src/operator/tensor/square_sum-inl.h
+    _square_sum — the row_sparse gradient-norm reduction the reference's
+    sparse optimizers use; dense-backed here, same math)."""
+    ax = _norm_axis(axis, x.ndim, exclude)
+    acc = _acc_dtype(x)
+    if acc is not None:
+        return jnp.sum(jnp.square(x.astype(acc)), axis=ax,
+                       keepdims=keepdims).astype(x.dtype)
+    return jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims)

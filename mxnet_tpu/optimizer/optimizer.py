@@ -455,6 +455,12 @@ class SGD(Optimizer):
 
 
 @register
+class ccSGD(SGD):
+    """Deprecated alias kept for reference script compatibility
+    (reference optimizer.py ccSGD: 'renamed to SGD in 0.9')."""
+
+
+@register
 class NAG(Optimizer):
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)

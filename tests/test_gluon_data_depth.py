@@ -234,3 +234,48 @@ def test_transforms_in_dataloader_pipeline():
     assert bx.shape == (4, 3, 8, 8)
     want = (x[:4].transpose(0, 3, 1, 2) / 255.0 - 0.5) / 0.25
     np.testing.assert_allclose(bx.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+
+def test_filter_sampler():
+    """reference gluon/data/sampler.py FilterSampler: indices whose
+    element passes the predicate, in order."""
+    ds = gluon.data.ArrayDataset(nd.array(np.arange(10, dtype=np.float32)))
+    fs = gluon.data.FilterSampler(lambda x: float(x) % 2 == 0, ds)
+    assert list(fs) == [0, 2, 4, 6, 8] and len(fs) == 5
+
+
+def test_image_record_dataset_roundtrip(tmp_path):
+    """reference gluon/data/vision/datasets.py:233 ImageRecordDataset:
+    packed header label + encoded image come back per index, transform
+    applies to (data, label)."""
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "data.rec")
+    w = recordio.MXIndexedRecordIO(rec[:-4] + ".idx", rec, "w")
+    rs = np.random.RandomState(0)
+    for i in range(3):
+        img = rs.uniform(0, 255, (8, 8, 3)).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), 0, 0), img, img_fmt=".png"))
+    w.close()
+    ds = gluon.data.vision.ImageRecordDataset(rec)
+    assert len(ds) == 3
+    data, label = ds[2]
+    assert data.shape == (8, 8, 3) and float(label) == 2.0
+    t = gluon.data.vision.ImageRecordDataset(
+        rec, transform=lambda d, l: (d.astype("float32") / 255, l))
+    d2, _ = t[0]
+    assert str(d2.dtype) == "float32" and float(d2.asnumpy().max()) <= 1.0
+
+
+def test_hybrid_sequential_rnn_cell():
+    """reference rnn_cell.py HybridSequentialRNNCell: stacked cells
+    unroll as a chain."""
+    mx.random.seed(0)
+    cell = gluon.rnn.HybridSequentialRNNCell()
+    cell.add(gluon.rnn.LSTMCell(8))
+    cell.add(gluon.rnn.LSTMCell(8))
+    cell.initialize()
+    x = nd.array(np.random.RandomState(1).randn(2, 5, 4).astype(np.float32))
+    out, states = cell.unroll(5, x, merge_outputs=True)
+    assert out.shape == (2, 5, 8)
+    assert len(states) == len(cell.state_info())

@@ -199,3 +199,136 @@ def test_imagerecorditer_error_then_retry_raises_again(tmp_path):
     # second call must not hang; it restarts the producer and re-raises
     with pytest.raises(Exception):
         it.next()
+
+
+# ---------------------------------------------------------------------------
+# Detection augmenters + ImageDetIter (reference image/detection.py)
+# ---------------------------------------------------------------------------
+
+def _det_img(tmp_path, name="a.npy", shape=(40, 60, 3), seed=0):
+    arr = np.random.RandomState(seed).uniform(0, 255, shape).astype(np.uint8)
+    np.save(str(tmp_path / name), arr)
+    return arr
+
+
+def _det_label(objs):
+    return [4, 5, 0, 0] + [v for o in objs for v in o]
+
+
+def test_det_horizontal_flip_maps_x():
+    """reference detection.py:128: x1' = 1-x2, x2' = 1-x1; y unchanged."""
+    aug = mimg.DetHorizontalFlipAug(p=1.0)
+    lb = np.array([[1.0, 0.2, 0.3, 0.6, 0.8]], np.float32)
+    src = np.zeros((4, 6, 3), np.float32)
+    src[:, 0] = 1.0   # mark the left edge
+    out, lb2 = aug(src, lb)
+    np.testing.assert_allclose(lb2[0], [1.0, 0.4, 0.3, 0.8, 0.8], rtol=1e-6)
+    assert (out[:, -1] == 1.0).all()   # image flipped with the label
+
+
+def test_det_random_pad_shrinks_boxes_and_fills():
+    """reference detection.py:325: canvas grows, boxes shrink, pad value
+    fills the border."""
+    aug = mimg.DetRandomPadAug(area_range=(2.0, 2.5), pad_val=(9, 9, 9))
+    src = np.ones((20, 30, 3), np.float32)
+    lb = np.array([[0.0, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    out, lb2 = aug(src, lb.copy())
+    assert out.shape[0] * out.shape[1] >= 2.0 * 20 * 30 * 0.9
+    assert (lb2[0, 3] - lb2[0, 1]) < 1.0 and (lb2[0, 4] - lb2[0, 2]) < 1.0
+    # the original area is intact somewhere; the border is pad_val
+    assert (out == 1.0).sum() == 20 * 30 * 3
+    assert (out == 9.0).any()
+
+
+def test_det_random_crop_respects_coverage_and_remaps():
+    """reference detection.py:154: the surviving box keeps >= the eject
+    coverage and coordinates stay in [0,1]."""
+    rngsrc = np.zeros((50, 50, 3), np.float32)
+    lb = np.array([[1.0, 0.3, 0.3, 0.7, 0.7]], np.float32)
+    aug = mimg.DetRandomCropAug(min_object_covered=0.5,
+                                area_range=(0.4, 0.9),
+                                min_eject_coverage=0.3)
+    hit = False
+    for _ in range(10):
+        out, lb2 = aug(rngsrc, lb.copy())
+        assert lb2.shape[1] == 5
+        assert (lb2[:, 1:] >= 0).all() and (lb2[:, 1:] <= 1).all()
+        if out.shape != rngsrc.shape:
+            hit = True
+    assert hit, "crop never fired in 10 attempts"
+
+
+def test_det_borrow_and_select_augs():
+    aug = mimg.DetBorrowAug(mimg.CastAug())
+    src, lb = aug(np.ones((4, 4, 3), np.uint8),
+                  np.zeros((1, 5), np.float32))
+    assert src.dtype == np.float32
+    sel = mimg.DetRandomSelectAug([mimg.DetHorizontalFlipAug(1.0)],
+                                  skip_prob=1.0)
+    src2, _ = sel(src.copy(), lb)
+    np.testing.assert_array_equal(src2, src)    # always skipped
+    with pytest.raises(mx.base.MXNetError):
+        mimg.DetBorrowAug("not an augmenter")
+
+
+def test_random_gray_and_color_jitter_and_order():
+    """RandomGrayAug collapses channels; ColorJitterAug composes the three
+    jitters in random order (reference image.py ColorJitterAug)."""
+    src = np.random.RandomState(0).uniform(0, 255, (6, 6, 3)) \
+        .astype(np.float32)
+    g = mimg.RandomGrayAug(p=1.0)(src)
+    assert np.allclose(g[..., 0], g[..., 1]) and \
+        np.allclose(g[..., 1], g[..., 2])
+    cj = mimg.ColorJitterAug(0.1, 0.1, 0.1)
+    assert len(cj.ts) == 3
+    out = cj(src)
+    assert out.shape == src.shape
+    order = mimg.RandomOrderAug([mimg.CastAug()])
+    assert order(src).dtype == np.float32
+
+
+def test_image_det_iter_batches_and_sync(tmp_path):
+    """reference detection.py:626 ImageDetIter: parsed labels pad with -1
+    rows to the estimated max object count; sync_label_shape grows both
+    iterators to the union."""
+    _det_img(tmp_path)
+    one = _det_label([[1.0, 0.2, 0.3, 0.6, 0.8]])
+    two = _det_label([[1.0, 0.2, 0.3, 0.6, 0.8],
+                      [2.0, 0.1, 0.1, 0.4, 0.5]])
+    it = mimg.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                           imglist=[(two, "a.npy"), (one, "a.npy")],
+                           path_root=str(tmp_path), rand_mirror=True)
+    assert it.provide_label[0][1] == (2, 2, 5)
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 32, 32)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (2, 2, 5)
+    assert (lab[:, :, 0] >= -1).all()          # -1 padding rows allowed
+    # one-object image has exactly one real row
+    counts = (lab[:, :, 0] > -0.5).sum(axis=1)
+    assert sorted(counts.tolist()) == [1, 2]
+
+    it2 = mimg.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                            imglist=[(one, "a.npy"), (one, "a.npy")],
+                            path_root=str(tmp_path))
+    assert it2.label_shape == (1, 5)
+    it.sync_label_shape(it2)
+    assert it2.label_shape == (2, 5) == it.label_shape
+    with pytest.raises(mx.base.MXNetError, match="smaller"):
+        it.reshape(label_shape=(1, 5))
+
+
+def test_create_det_augmenter_pipeline(tmp_path):
+    """CreateDetAugmenter end to end: force-resize target shape, cast,
+    normalize, constrained crop/pad all compose."""
+    arr = _det_img(tmp_path, seed=3)
+    augs = mimg.CreateDetAugmenter((3, 24, 24), rand_crop=0.5, rand_pad=0.5,
+                                   rand_mirror=True, mean=True, std=True,
+                                   brightness=0.1, contrast=0.1,
+                                   saturation=0.1, rand_gray=0.1)
+    lb = np.array([[1.0, 0.2, 0.3, 0.6, 0.8]], np.float32)
+    img2, lb2 = arr.astype(np.float32), lb
+    for a in augs:
+        img2, lb2 = a(img2, lb2)
+    assert img2.shape == (24, 24, 3)
+    assert lb2.shape[1] == 5

@@ -243,3 +243,73 @@ def test_symbol_json_roundtrip_preserves_attrs(tmp_path):
     r1 = y.bind(mx.cpu(), {"data": xin, "w": w, "b": b}).forward()[0]
     r2 = y2.bind(mx.cpu(), {"data": xin, "w": w, "b": b}).forward()[0]
     np.testing.assert_allclose(r1.asnumpy(), r2.asnumpy(), rtol=1e-6)
+
+
+def test_initializer_load_dict_and_default():
+    """reference initializer.py:319 Load: arg:/aux: prefixes dropped,
+    shape mismatches raise, default_init covers missing names."""
+    params = {"arg:w": nd.array(np.full((2, 2), 7.0, np.float32))}
+    ld = mx.initializer.Load(params, default_init=mx.initializer.Zero())
+    w = nd.array(np.ones((2, 2), np.float32))
+    ld("w", w)
+    np.testing.assert_array_equal(w.asnumpy(), 7.0)
+    other = nd.array(np.ones(3, np.float32))
+    ld("missing", other)
+    np.testing.assert_array_equal(other.asnumpy(), 0.0)
+    with pytest.raises(mx.base.MXNetError, match="shape"):
+        ld("w", nd.zeros((3, 3)))
+
+
+def test_initializer_mixed_first_match_wins():
+    """reference initializer.py:366 Mixed: first regex match picks."""
+    init = mx.initializer.Mixed(
+        [".*bias", ".*"],
+        [mx.initializer.Zero(), mx.initializer.Constant(2.0)])
+    b = nd.array(np.ones(4, np.float32))
+    w = nd.array(np.zeros((2, 2), np.float32))
+    init(mx.initializer.InitDesc("fc_bias"), b)
+    init(mx.initializer.InitDesc("fc_weight"), w)
+    np.testing.assert_array_equal(b.asnumpy(), 0.0)
+    np.testing.assert_array_equal(w.asnumpy(), 2.0)
+    nomatch = mx.initializer.Mixed(["onlybias"], [mx.initializer.Zero()])
+    with pytest.raises(mx.base.MXNetError, match="pattern"):
+        nomatch(mx.initializer.InitDesc("weight"), w)
+
+
+def test_initializer_fused_rnn_layout_and_forget_bias():
+    """reference initializer.py:720 FusedRNN: per-slice init over the flat
+    RNN op parameter vector + LSTM forget-gate bias."""
+    h, L, isz, ng, d = 8, 2, 4, 4, 1
+    total = d * ng * h * (isz + h) + (L - 1) * d * ng * h * (h * d + h) \
+        + L * d * 2 * ng * h
+    arr = nd.zeros((total,))
+    fi = mx.initializer.FusedRNN(mx.initializer.Uniform(0.1), num_hidden=h,
+                                 num_layers=L, mode="lstm", forget_bias=1.5)
+    fi(mx.initializer.InitDesc("rnn_parameters"), arr)
+    a = arr.asnumpy()
+    w_end = total - L * d * 2 * ng * h
+    assert np.abs(a[:w_end]).mean() > 0           # weights initialized
+    biases = a[w_end:].reshape(L * d * 2, ng * h)
+    for bx in biases[::2]:                        # bx rows
+        np.testing.assert_allclose(bx[h:2 * h], 1.5)   # forget gate
+        np.testing.assert_allclose(bx[:h], 0.0)        # i gate: bias init
+    for bh in biases[1::2]:                       # bh rows all zero
+        np.testing.assert_allclose(bh, 0.0)
+
+
+def test_ccsgd_alias_and_validation_callback(caplog):
+    """reference optimizer.py ccSGD (deprecated SGD alias) +
+    callback.py:214 LogValidationMetricsCallback."""
+    import logging
+    opt = mx.optimizer.create("ccsgd", learning_rate=0.1, momentum=0.9)
+    assert isinstance(opt, mx.optimizer.SGD)
+
+    class P:
+        epoch = 3
+        eval_metric = mx.metric.Accuracy()
+    P.eval_metric.update([nd.array(np.array([1.0], np.float32))],
+                         [nd.array(np.array([[0.1, 0.9]], np.float32))])
+    cb = mx.callback.LogValidationMetricsCallback()
+    with caplog.at_level(logging.INFO):
+        cb(P())
+    assert any("Validation-accuracy" in r.message for r in caplog.records)

@@ -259,3 +259,26 @@ def test_accuracy_rejects_mismatched_batch():
     m = mmetric.Accuracy()
     with pytest.raises(Exception):
         m.update([nd.zeros((4,)), nd.zeros((4,))], [nd.zeros((4, 2))])
+
+
+def test_pcc_binary_equals_mcc_and_multiclass():
+    """reference metric.py:1528 PCC: binary case equals MCC; multiclass is
+    the R_K statistic (perfect prediction = 1, uniform-wrong < 1)."""
+    rs = np.random.RandomState(0)
+    l = rs.randint(0, 2, 200).astype(np.float32)
+    noisy = np.where(rs.uniform(size=200) < 0.8, l, 1 - l)
+    preds = np.eye(2, dtype=np.float32)[noisy.astype(int)]
+    pcc = mx.metric.PCC()
+    mcc = mx.metric.MCC()
+    pcc.update([mx.nd.array(l)], [mx.nd.array(preds)])
+    mcc.update([mx.nd.array(l)], [mx.nd.array(preds)])
+    assert abs(pcc.get()[1] - mcc.get()[1]) < 1e-9
+
+    # multiclass: perfect prediction gives exactly 1
+    l3 = rs.randint(0, 3, 90).astype(np.float32)
+    p3 = np.eye(3, dtype=np.float32)[l3.astype(int)]
+    pcc3 = mx.metric.PCC()
+    pcc3.update([mx.nd.array(l3)], [mx.nd.array(p3)])
+    assert abs(pcc3.get()[1] - 1.0) < 1e-9
+    # created via the registry name too
+    assert mx.metric.create("pcc").name == "pcc"
