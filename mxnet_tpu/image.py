@@ -388,6 +388,8 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                             [-0.5808, -0.0045, -0.8140],
                             [-0.5836, -0.6948, 0.4203]])
         auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = _np.array([123.68, 116.28, 103.53])
     if std is True:
@@ -514,8 +516,9 @@ class ColorJitterAug(RandomOrderAug):
 
 class RandomGrayAug(Augmenter):
     """With probability p collapse to grayscale replicated over channels
-    (reference image.py:RandomGrayAug)."""
-    _coef = _np.array([[[0.299, 0.587, 0.114]]], "float32")
+    (reference image.py:RandomGrayAug — its 0.21/0.72/0.07 luma weights,
+    not the Rec.601 ones the jitter augs use)."""
+    _coef = _np.array([[[0.21, 0.72, 0.07]]], "float32")
 
     def __init__(self, p):
         super().__init__(p=p)

@@ -370,8 +370,10 @@ class FusedRNN(Initializer):
                 for _b in range(2):   # bx, bh
                     start = off
                     fill((ng * h,), "bias")
-                    if self._mode == "lstm" and _b == 0:
-                        # gate order [i f g o]: forget slice of bx
+                    if self._mode == "lstm":
+                        # gate order [i f g o]: the reference writes
+                        # forget_bias into EVERY *_f_bias (i2h AND h2h),
+                        # so the cell's bx+bh sums to 2*forget_bias
                         out[start + h:start + 2 * h] = self._forget_bias
         arr._set_data(jnp.asarray(out, dtype=arr.dtype))
 

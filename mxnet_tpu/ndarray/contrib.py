@@ -9,11 +9,32 @@ from ..ops.registry import all_ops as _all_ops, get_op as _get_op
 from ..base import MXNetError
 
 
-def isfinite(data):
-    from . import NDArray
-    import jax.numpy as jnp
-    raw = data._data if isinstance(data, NDArray) else data
-    return NDArray(jnp.isfinite(raw).astype(jnp.float32))
+# isnan/isinf/isfinite resolve through __getattr__ to the registered
+# _contrib_is* ops — one definition serving nd, sym, and jit paths
+
+
+def rand_zipfian(true_classes, num_sampled, range_max, ctx=None):
+    """Log-uniform (Zipfian) candidate sampler (reference
+    ndarray/contrib.py:40): draws num_sampled candidates with replacement
+    from P(class) = (log(class+2) - log(class+1)) / log(range_max+1) and
+    returns (samples, expected_count_true, expected_count_sampled) — the
+    NCE/sampled-softmax helper for frequency-sorted vocabularies."""
+    import math as _math
+    from .random import uniform
+
+    log_range = _math.log(range_max + 1)
+    rand = uniform(0, log_range, shape=(num_sampled,), ctx=ctx)
+    # int32 under the x32 policy (reference returns int64)
+    sampled = (rand.exp() - 1.0).astype("int32") % range_max
+
+    def _expected(cls_float):
+        return ((cls_float + 2.0) / (cls_float + 1.0)).log() \
+            / log_range * num_sampled
+
+    true_f = true_classes.astype("float32")
+    expected_true = _expected(true_f)
+    expected_sampled = _expected(sampled.astype("float32"))
+    return sampled, expected_true, expected_sampled
 
 
 def __getattr__(name):

@@ -38,6 +38,11 @@ _UNARY = {
     "gammaln": jax.scipy.special.gammaln,
     "digamma": jax.scipy.special.digamma,
     "logical_not": lambda x: (x == 0).astype(x.dtype),
+    # float 0/1 masks like the comparison family (reference contrib isnan/
+    # isinf/isfinite)
+    "_contrib_isnan": lambda x: jnp.isnan(x).astype(jnp.float32),
+    "_contrib_isinf": lambda x: jnp.isinf(x).astype(jnp.float32),
+    "_contrib_isfinite": lambda x: jnp.isfinite(x).astype(jnp.float32),
     "relu": lambda x: jnp.maximum(x, 0),
     "sigmoid": jax.nn.sigmoid,
     "softsign": jax.nn.soft_sign,

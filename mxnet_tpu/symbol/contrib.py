@@ -20,3 +20,29 @@ def __getattr__(name):
         return fn
     raise AttributeError(
         f"module 'mxnet_tpu.symbol.contrib' has no attribute '{name}'")
+
+
+def rand_zipfian(true_classes, num_sampled, range_max):
+    """Symbolic log-uniform candidate sampler (reference
+    symbol/contrib.py rand_zipfian) — same math as the nd version, built
+    from sym ops so the sampling runs inside the compiled graph (the RNG
+    key rides the executor's per-forward split)."""
+    import math as _math
+    from . import random as _random
+    from . import (exp as _exp, floor as _floor, Cast as _cast,
+                   _mod_scalar, log as _log, _plus_scalar,
+                   _mul_scalar, elemwise_div)
+
+    log_range = _math.log(range_max + 1)
+    rand = _random.uniform(0, log_range, shape=(num_sampled,))
+    sampled = _cast(_mod_scalar(_floor(_exp(rand) - 1.0),
+                                scalar=range_max), dtype="int32")
+
+    def _expected(cls_float):
+        ratio = elemwise_div(_plus_scalar(cls_float, scalar=2.0),
+                             _plus_scalar(cls_float, scalar=1.0))
+        return _mul_scalar(_log(ratio), scalar=num_sampled / log_range)
+
+    expected_true = _expected(_cast(true_classes, dtype="float32"))
+    expected_sampled = _expected(_cast(sampled, dtype="float32"))
+    return sampled, expected_true, expected_sampled

@@ -240,3 +240,134 @@ def assert_raises(exc, fn, *args, **kwargs):
 
 def discard_stderr(fn):
     return fn
+
+
+def almost_equal_ignore_nan(a, b, rtol=None, atol=None):
+    """Elementwise closeness ignoring positions where EITHER side is NaN
+    (reference test_utils.py almost_equal_ignore_nan)."""
+    a, b = _as_np(a).copy(), _as_np(b).copy()
+    nan = _np.isnan(a) | _np.isnan(b)
+    a[nan], b[nan] = 0, 0
+    return almost_equal(a, b, rtol, atol)
+
+
+def assert_almost_equal_ignore_nan(a, b, rtol=None, atol=None, names=("a", "b")):
+    if not almost_equal_ignore_nan(a, b, rtol, atol):
+        raise AssertionError(
+            f"{names[0]} != {names[1]} (ignoring NaN) within "
+            f"rtol={rtol} atol={atol}")
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    """reference test_utils.py assert_exception(f, exc, ...) — note the
+    REVERSED argument order vs assert_raises(exc, f, ...)."""
+    return assert_raises(exception_type, f, *args, **kwargs)
+
+
+def _bind_with_location(sym, location, aux_states, ctx, grad_req="null"):
+    from . import nd as _nd
+    from .context import cpu as _cpu
+    ctx = ctx or default_context()
+    names = sym.list_arguments()
+    if isinstance(location, dict):
+        args = {k: _nd.array(v, ctx=ctx) for k, v in location.items()}
+    else:
+        args = {n: _nd.array(v, ctx=ctx) for n, v in zip(names, location)}
+    aux = None
+    if aux_states is not None:
+        aux_names = sym.list_auxiliary_states()
+        if isinstance(aux_states, dict):
+            aux = {k: _nd.array(v, ctx=ctx) for k, v in aux_states.items()}
+        else:
+            aux = {n: _nd.array(v, ctx=ctx)
+                   for n, v in zip(aux_names, aux_states)}
+    return sym.bind(ctx, args, args_grad=None if grad_req == "null" else {
+        n: _nd.zeros(a.shape, ctx=ctx, dtype=a.dtype)
+        for n, a in args.items()}, grad_req=grad_req, aux_states=aux), args
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=None,
+                           aux_states=None, ctx=None, equal_nan=False):
+    """Bind + forward and compare each output against `expected`
+    (reference test_utils.py:1124)."""
+    exe, _ = _bind_with_location(sym, location, aux_states, ctx)
+    outs = exe.forward(is_train=False)
+    assert len(outs) == len(expected), \
+        f"{len(outs)} outputs vs {len(expected)} expected"
+    for i, (o, e) in enumerate(zip(outs, expected)):
+        if equal_nan:
+            assert_almost_equal_ignore_nan(o, e, rtol, atol,
+                                           names=(f"output[{i}]", "expected"))
+        else:
+            assert_almost_equal(o, e, rtol, atol)
+    return outs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None, equal_nan=False):
+    """Bind + forward + backward and compare input gradients (reference
+    test_utils.py:1194). `expected` maps argument name -> gradient (or is
+    a positional list)."""
+    from . import nd as _nd
+    exe, args = _bind_with_location(sym, location, aux_states, ctx,
+                                    grad_req=grad_req)
+    exe.forward(is_train=True)
+    ogs = [_nd.array(g) for g in out_grads] if out_grads is not None else None
+    exe.backward(out_grads=ogs)
+    grads = dict(zip(sym.list_arguments(), exe.grad_arrays))
+    if not isinstance(expected, dict):
+        expected = dict(zip(sym.list_arguments(), expected))
+    for name, e in expected.items():
+        if e is None:
+            continue
+        g = grads[name]
+        if equal_nan:
+            assert_almost_equal_ignore_nan(g, e, rtol, atol,
+                                           names=(f"grad[{name}]", "expected"))
+        else:
+            assert_almost_equal(g, e, rtol, atol)
+    return grads
+
+
+def check_speed(sym, location=None, ctx=None, N=20, grad_req="write",
+                typ="whole", **kwargs):
+    """Average seconds per forward(+backward) run (reference
+    test_utils.py:1340). typ='whole' times fwd+bwd, 'forward' only fwd."""
+    import time as _time
+    from . import nd as _nd
+    ctx = ctx or default_context()
+    if location is None:
+        arg_shapes, _, _ = sym.infer_shape(**kwargs)
+        rng = _np.random.RandomState(0)
+        location = {n: rng.normal(0, 1, s).astype("float32")
+                    for n, s in zip(sym.list_arguments(), arg_shapes)}
+    exe, _ = _bind_with_location(
+        sym, location, None, ctx,
+        grad_req=grad_req if typ == "whole" else "null")
+
+    def once():
+        outs = exe.forward(is_train=(typ == "whole"))
+        if typ == "whole":
+            exe.backward()
+            _ = [g.asnumpy() for g in exe.grad_arrays if g is not None]
+        else:
+            _ = [o.asnumpy() for o in outs]
+
+    once()  # warmup/compile
+    t0 = _time.perf_counter()
+    for _ in range(N):
+        once()
+    return (_time.perf_counter() - t0) / N
+
+
+def rand_sparse_ndarray(shape, stype, density=0.5, dtype="float32",
+                        rng=None):
+    """Random sparse array + its dense numpy value (reference
+    test_utils.py rand_sparse_ndarray, simplified to the data-generation
+    contract the tests use)."""
+    from . import nd as _nd
+    rng = rng or _np.random.RandomState(0)
+    x = rng.uniform(-1, 1, shape).astype(dtype)
+    x[rng.uniform(0, 1, shape) > density] = 0
+    return _nd.cast_storage(_nd.array(x), stype), x

@@ -172,3 +172,44 @@ def test_library_load_registers_ops(tmp_path):
     s = sym._custom_double_it(sym.Variable("x"))
     e = s.bind(mx.cpu(), {"x": nd.ones((2,))})
     assert e.forward()[0].asnumpy().tolist() == [2, 2]
+
+
+def test_rand_zipfian_nd_and_sym():
+    """reference ndarray/contrib.py:40 + symbol/contrib.py rand_zipfian:
+    log-uniform candidate sampling with expected-count outputs, eager and
+    symbolic."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    import mxnet_tpu.symbol as sym
+    p = (np.log(np.arange(10) + 2) - np.log(np.arange(10) + 1)) / np.log(11)
+
+    s, et, es = nd.contrib.rand_zipfian(
+        nd.array(np.array([3.0], np.float32)), 5000, 10)
+    a = s.asnumpy()
+    assert a.min() >= 0 and a.max() < 10
+    counts = np.bincount(a.astype(int), minlength=10) / 5000
+    assert np.abs(counts - p).max() < 0.03
+    assert np.isclose(float(et.asnumpy()[0]), p[3] * 5000, rtol=0.01)
+    assert es.shape == (5000,)
+
+    t = sym.Variable("t")
+    g = sym.Group(list(sym.contrib.rand_zipfian(t, 2000, 10)))
+    outs = g.bind(mx.cpu(), {"t": nd.array(np.array([3.0], np.float32))}) \
+        .forward()
+    a2 = outs[0].asnumpy()
+    assert a2.dtype == np.int32 and a2.min() >= 0 and a2.max() < 10
+    assert np.isclose(float(outs[1].asnumpy()[0]), p[3] * 2000, rtol=0.01)
+
+
+def test_contrib_isnan_isinf_isfinite():
+    """reference contrib isnan/isinf/isfinite: float 0/1 masks."""
+    import numpy as np
+    from mxnet_tpu import nd
+    x = nd.array(np.array([1.0, np.nan, np.inf, -np.inf], np.float32))
+    np.testing.assert_array_equal(nd.contrib.isnan(x).asnumpy(),
+                                  [0, 1, 0, 0])
+    np.testing.assert_array_equal(nd.contrib.isinf(x).asnumpy(),
+                                  [0, 0, 1, 1])
+    np.testing.assert_array_equal(nd.contrib.isfinite(x).asnumpy(),
+                                  [1, 0, 0, 0])

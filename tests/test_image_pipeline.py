@@ -279,6 +279,10 @@ def test_random_gray_and_color_jitter_and_order():
     g = mimg.RandomGrayAug(p=1.0)(src)
     assert np.allclose(g[..., 0], g[..., 1]) and \
         np.allclose(g[..., 1], g[..., 2])
+    # the reference's 0.21/0.72/0.07 luma weights, not Rec.601
+    one = mimg.RandomGrayAug(p=1.0)(
+        np.array([[[100.0, 50.0, 200.0]]], np.float32))
+    np.testing.assert_allclose(one[0, 0, 0], 71.0, rtol=1e-5)
     cj = mimg.ColorJitterAug(0.1, 0.1, 0.1)
     assert len(cj.ts) == 3
     out = cj(src)

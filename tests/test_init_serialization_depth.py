@@ -290,11 +290,9 @@ def test_initializer_fused_rnn_layout_and_forget_bias():
     w_end = total - L * d * 2 * ng * h
     assert np.abs(a[:w_end]).mean() > 0           # weights initialized
     biases = a[w_end:].reshape(L * d * 2, ng * h)
-    for bx in biases[::2]:                        # bx rows
-        np.testing.assert_allclose(bx[h:2 * h], 1.5)   # forget gate
-        np.testing.assert_allclose(bx[:h], 0.0)        # i gate: bias init
-    for bh in biases[1::2]:                       # bh rows all zero
-        np.testing.assert_allclose(bh, 0.0)
+    for b in biases:                              # EVERY bias row (bx & bh)
+        np.testing.assert_allclose(b[h:2 * h], 1.5)    # forget gate
+        np.testing.assert_allclose(b[:h], 0.0)         # i gate: bias init
 
 
 def test_ccsgd_alias_and_validation_callback(caplog):

@@ -148,3 +148,28 @@ def test_sparse_embedding_grad_stype():
     assert (g[[0, 2, 3, 4, 6, 7]] == 0).all()
     np.testing.assert_allclose(g[1], 2 * np.ones(3), rtol=1e-6)
     np.testing.assert_allclose(g[5], np.ones(3), rtol=1e-6)
+
+
+def test_test_utils_symbolic_checkers():
+    """reference test_utils.py:1124/1194/1340: check_symbolic_forward /
+    check_symbolic_backward / check_speed drive the bind path; the sparse
+    generator returns (sparse_nd, dense_np) pairs."""
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.test_utils import (check_symbolic_forward,
+                                      check_symbolic_backward, check_speed,
+                                      rand_sparse_ndarray,
+                                      assert_almost_equal_ignore_nan)
+    x = sym.Variable("x")
+    s = sym.square(x)
+    loc = [np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)]
+    check_symbolic_forward(s, loc, [loc[0] ** 2])
+    check_symbolic_backward(s, loc, [np.ones((2, 2), np.float32)],
+                            {"x": 2 * loc[0]})
+    assert check_speed(s, location={"x": loc[0]}, N=2) > 0
+    arr, dense = rand_sparse_ndarray((4, 5), "row_sparse", 0.4)
+    assert arr.stype == "row_sparse"
+    np.testing.assert_array_equal(arr.asnumpy(), dense)
+    assert_almost_equal_ignore_nan(np.array([1.0, np.nan]),
+                                   np.array([1.0, np.nan]))
+    with pytest.raises(AssertionError):
+        check_symbolic_forward(s, loc, [loc[0]])
