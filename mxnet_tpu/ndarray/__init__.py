@@ -88,7 +88,11 @@ _SPECIAL_KEY_OPS = {"Dropout"}
 # nd.sample_multinomial(probs), ...)
 _RNG_SAMPLE_OPS = {"_random_uniform", "_random_normal",
                    "_random_uniform_like", "_random_normal_like",
-                   "_sample_multinomial"}
+                   "_sample_multinomial", "_sample_uniform",
+                   "_sample_normal", "_sample_gamma",
+                   "_sample_exponential", "_sample_poisson",
+                   "_sample_negative_binomial",
+                   "_sample_generalized_negative_binomial"}
 
 # Derived ops for tensor-valued KEYWORD arguments (e.g.
 # nd.CTCLoss(..., label_lengths=arr)): the reference treats these as

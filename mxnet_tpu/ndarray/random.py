@@ -26,7 +26,29 @@ def _shape(shape):
     return tuple(shape)
 
 
+
+def _sample_op(op_name, params, shape, dtype, out=None):
+    """Array-parameterized draw through the registered multisample op
+    (reference python/mxnet/ndarray/random.py _random_helper: NDArray
+    params dispatch to _sample_<dist>; sample.shape = params.shape +
+    shape). Honors out= like the scalar paths."""
+    from . import invoke
+    from .ndarray import array as _array
+    from ..ops.registry import get_op
+    nds = [pv if isinstance(pv, NDArray) else _array(pv) for pv in params]
+    key = NDArray(_rng.next_key_raw())
+    kwargs = {"shape": shape, "dtype": dtype or str(default_dtype())}
+    r = invoke(get_op(op_name), nds + [key], kwargs)
+    if out is not None:
+        out._set_data(r._data)
+        return out
+    return r
+
+
 def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None):
+    if isinstance(low, NDArray) or isinstance(high, NDArray):
+        return _sample_op("_sample_uniform", [low, high], shape, dtype,
+                          out=out)
     dtype = dtype or default_dtype()
     raw = jax.random.uniform(_rng.next_key(), _shape(shape), dtype=jnp.float32,
                              minval=low, maxval=high).astype(dtype)
@@ -38,6 +60,9 @@ def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None):
 
 
 def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None):
+    if isinstance(loc, NDArray) or isinstance(scale, NDArray):
+        return _sample_op("_sample_normal", [loc, scale], shape, dtype,
+                          out=out)
     dtype = dtype or default_dtype()
     raw = loc + scale * jax.random.normal(_rng.next_key(), _shape(shape), dtype=jnp.float32)
     r = _make(raw.astype(dtype), ctx)
@@ -64,27 +89,40 @@ def randint(low, high=None, shape=None, dtype="int32", ctx=None):
 
 
 def poisson(lam=1.0, shape=None, dtype=None, ctx=None):
+    if isinstance(lam, NDArray):
+        return _sample_op("_sample_poisson", [lam], shape, dtype)
     raw = jax.random.poisson(_rng.next_key(), lam, _shape(shape))
     return _make(raw.astype(dtype or default_dtype()), ctx)
 
 
 def exponential(scale=1.0, shape=None, dtype=None, ctx=None):
+    if isinstance(scale, NDArray):
+        # the multisample op takes the RATE lam = 1/scale (reference
+        # random.py exponential -> _sample_exponential(1/scale))
+        return _sample_op("_sample_exponential", [1.0 / scale], shape, dtype)
     raw = scale * jax.random.exponential(_rng.next_key(), _shape(shape))
     return _make(raw.astype(dtype or default_dtype()), ctx)
 
 
 def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None):
+    if isinstance(alpha, NDArray) or isinstance(beta, NDArray):
+        return _sample_op("_sample_gamma", [alpha, beta], shape, dtype)
     raw = beta * jax.random.gamma(_rng.next_key(), alpha, _shape(shape))
     return _make(raw.astype(dtype or default_dtype()), ctx)
 
 
 def negative_binomial(k=1, p=1, shape=None, dtype=None, ctx=None):
+    if isinstance(k, NDArray) or isinstance(p, NDArray):
+        return _sample_op("_sample_negative_binomial", [k, p], shape, dtype)
     g = jax.random.gamma(_rng.next_key(), k, _shape(shape)) * (1 - p) / p
     raw = jax.random.poisson(_rng.next_key(), g, _shape(shape))
     return _make(raw.astype(dtype or default_dtype()), ctx)
 
 
 def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None, ctx=None):
+    if isinstance(mu, NDArray) or isinstance(alpha, NDArray):
+        return _sample_op("_sample_generalized_negative_binomial",
+                          [mu, alpha], shape, dtype)
     r = 1.0 / alpha
     p = r / (r + mu)
     return negative_binomial(r, p, shape, dtype, ctx)

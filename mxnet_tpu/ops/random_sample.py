@@ -84,3 +84,128 @@ def sample_multinomial(data, key, *, shape=None, get_prob=False,
         out = out[..., 0] if shape is None else \
             out.reshape(logits.shape[:-1] + draw_dims)
     return out.astype(jnp.dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# Multisample family (reference src/operator/random/multisample_op.cc
+# _sample_{uniform,normal,gamma,exponential,poisson,negative_binomial,
+# generalized_negative_binomial}): the parameter arrays describe a batch of
+# distributions; ``shape`` draws per distribution are appended as trailing
+# axes — sample.shape = params.shape + shape (shape=None draws one with no
+# extra axis).
+# ---------------------------------------------------------------------------
+
+def _draw_dims(shape):
+    if shape is None:
+        return ()
+    return (int(shape),) if isinstance(shape, (int, float)) else \
+        tuple(int(s) for s in shape)
+
+
+def _expand_params(p, draw):
+    return p.reshape(p.shape + (1,) * len(draw)) if draw else p
+
+
+def _multisample(key, params, draw, base):
+    """Broadcast params to a common shape, draw params.shape + draw."""
+    params = jnp.broadcast_arrays(*params)
+    full = params[0].shape + draw
+    expanded = [_expand_params(p, draw) for p in params]
+    return base(key, full, expanded)
+
+
+@register("_sample_uniform", aliases=("sample_uniform",),
+          differentiable=False)
+def sample_uniform_op(low, high, key, *, shape=None, dtype="float32"):
+    draw = _draw_dims(shape)
+
+    def base(k, full, ps):
+        lo, hi = ps
+        u = jax.random.uniform(k, full, jnp.dtype(dtype))
+        # param arithmetic upcasts; the op's dtype contract wins
+        return (lo + (hi - lo) * u).astype(jnp.dtype(dtype))
+    return _multisample(_as_key(key), (low, high), draw, base)
+
+
+@register("_sample_normal", aliases=("sample_normal",),
+          differentiable=False)
+def sample_normal_op(mu, sigma, key, *, shape=None, dtype="float32"):
+    draw = _draw_dims(shape)
+
+    def base(k, full, ps):
+        m, s = ps
+        return (m + s * jax.random.normal(k, full, jnp.dtype(dtype))) \
+            .astype(jnp.dtype(dtype))
+    return _multisample(_as_key(key), (mu, sigma), draw, base)
+
+
+@register("_sample_gamma", aliases=("sample_gamma",), differentiable=False)
+def sample_gamma_op(alpha, beta, key, *, shape=None, dtype="float32"):
+    """alpha = shape, beta = SCALE (the reference's parameterization)."""
+    draw = _draw_dims(shape)
+
+    def base(k, full, ps):
+        a, b = ps
+        return (b * jax.random.gamma(k, jnp.broadcast_to(a, full),
+                                     dtype=jnp.dtype(dtype))) \
+            .astype(jnp.dtype(dtype))
+    return _multisample(_as_key(key), (alpha, beta), draw, base)
+
+
+@register("_sample_exponential", aliases=("sample_exponential",),
+          differentiable=False)
+def sample_exponential_op(lam, key, *, shape=None, dtype="float32"):
+    """lam is the RATE: mean 1/lam (reference exponential contract)."""
+    draw = _draw_dims(shape)
+
+    def base(k, full, ps):
+        return (jax.random.exponential(k, full, jnp.dtype(dtype)) / ps[0]) \
+            .astype(jnp.dtype(dtype))
+    return _multisample(_as_key(key), (lam,), draw, base)
+
+
+@register("_sample_poisson", aliases=("sample_poisson",),
+          differentiable=False)
+def sample_poisson_op(lam, key, *, shape=None, dtype="float32"):
+    draw = _draw_dims(shape)
+
+    def base(k, full, ps):
+        out = jax.random.poisson(k, jnp.broadcast_to(ps[0], full))
+        return out.astype(jnp.dtype(dtype))
+    return _multisample(_as_key(key), (lam,), draw, base)
+
+
+@register("_sample_negative_binomial", aliases=("sample_negative_binomial",),
+          differentiable=False)
+def sample_negative_binomial_op(k_param, p, key, *, shape=None,
+                                dtype="float32"):
+    """Gamma-Poisson mixture: NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    (reference sampler's construction)."""
+    draw = _draw_dims(shape)
+    k1, k2 = jax.random.split(_as_key(key))
+
+    def base(kk, full, ps):
+        kp, pp = ps
+        rate = jax.random.gamma(k1, jnp.broadcast_to(kp, full)) \
+            * (1.0 - pp) / pp
+        return jax.random.poisson(k2, rate).astype(jnp.dtype(dtype))
+    return _multisample(None, (k_param, p), draw, base)
+
+
+@register("_sample_generalized_negative_binomial",
+          aliases=("sample_generalized_negative_binomial",),
+          differentiable=False)
+def sample_gnb_op(mu, alpha, key, *, shape=None, dtype="float32"):
+    """mu/alpha parameterization: k = 1/alpha, p = 1/(1 + mu*alpha)."""
+    draw = _draw_dims(shape)
+    k1, k2 = jax.random.split(_as_key(key))
+
+    def base(kk, full, ps):
+        m, a = ps
+        # clamp alpha consistently in BOTH factors: as a -> 0 the rate
+        # gamma(1/a_c) * m * a_c concentrates at m, i.e. Poisson(mu)
+        a_c = jnp.maximum(a, 1e-8)
+        rate = jax.random.gamma(k1, jnp.broadcast_to(1.0 / a_c, full)) \
+            * (m * a_c)
+        return jax.random.poisson(k2, rate).astype(jnp.dtype(dtype))
+    return _multisample(None, (mu, alpha), draw, base)

@@ -110,3 +110,81 @@ def test_dropout_train_mode_statistics():
     nz = a[a != 0]
     np.testing.assert_allclose(nz, 1.0 / 0.6, rtol=1e-5)
     assert abs(a.mean() - 1.0) < 0.02  # expectation preserved
+
+
+def test_multisample_array_parameterized():
+    """reference multisample_op.cc _sample_<dist>: parameter ARRAYS
+    describe a batch of distributions; sample.shape = params.shape + shape
+    (shape=None draws one with no extra axis). Front-end dispatch:
+    nd.random.<dist>(NDArray params) routes to the op."""
+    mx.random.seed(0)
+    lo = nd.array(np.array([0.0, 10.0], np.float32))
+    hi = nd.array(np.array([1.0, 20.0], np.float32))
+    u = nd.random.uniform(lo, hi, shape=(4000,)).asnumpy()
+    assert u.shape == (2, 4000)
+    assert abs(u[0].mean() - 0.5) < 0.03 and abs(u[1].mean() - 15.0) < 0.3
+    assert u[0].min() >= 0 and u[0].max() <= 1
+    assert u[1].min() >= 10 and u[1].max() <= 20
+
+    n = nd.random.normal(nd.array(np.array([0.0, 50.0], np.float32)),
+                         nd.array(np.array([1.0, 2.0], np.float32)),
+                         shape=(4000,)).asnumpy()
+    assert abs(n[0].mean()) < 0.1 and abs(n[1].mean() - 50) < 0.2
+    assert abs(n[1].std() - 2.0) < 0.15
+
+    g = nd.random.gamma(nd.array(np.array([2.0, 9.0], np.float32)),
+                        nd.array(np.array([3.0, 0.5], np.float32)),
+                        shape=(8000,)).asnumpy()
+    assert abs(g[0].mean() - 6.0) < 0.3        # alpha*beta
+    assert abs(g[1].mean() - 4.5) < 0.2
+
+    e = nd.random.exponential(nd.array(np.array([2.0], np.float32)),
+                              shape=(8000,)).asnumpy()
+    assert abs(e.mean() - 2.0) < 0.15          # scale = mean
+
+    p = nd.random.poisson(nd.array(np.array([4.0], np.float32)),
+                          shape=(8000,)).asnumpy()
+    assert abs(p.mean() - 4.0) < 0.15 and abs(p.var() - 4.0) < 0.5
+
+    nb = nd.random.negative_binomial(
+        nd.array(np.array([3.0], np.float32)),
+        nd.array(np.array([0.4], np.float32)), shape=(8000,)).asnumpy()
+    assert abs(nb.mean() - 4.5) < 0.3          # k(1-p)/p
+
+    gnb = nd.random.generalized_negative_binomial(
+        nd.array(np.array([5.0], np.float32)),
+        nd.array(np.array([0.3], np.float32)), shape=(8000,)).asnumpy()
+    assert abs(gnb.mean() - 5.0) < 0.3         # mu
+    assert abs(gnb.var() - 12.5) < 1.5         # mu + alpha*mu^2
+
+    # shape=None: one draw shaped like the params
+    one = nd.random.normal(nd.array(np.zeros((2, 3), np.float32)),
+                           nd.array(np.ones((2, 3), np.float32)))
+    assert one.shape == (2, 3)
+
+    # raw op surface (eager key auto-fed) and the symbolic path
+    s = nd.sample_uniform(lo, hi, shape=3)
+    assert s.shape == (2, 3)
+    import mxnet_tpu.symbol as sym
+    x = sym.Variable("x")
+    ss = sym.sample_normal(x, sym.ones_like(x), shape=4)
+    o = ss.bind(mx.cpu(), {"x": nd.array(np.zeros(5, np.float32))}) \
+        .forward()[0]
+    assert o.shape == (5, 4)
+
+
+def test_multisample_dtype_out_and_alpha_zero():
+    """Review pins: the multisample ops honor the dtype contract, the
+    front-end honors out=, and GNB at alpha=0 degenerates to Poisson(mu)
+    instead of zeros."""
+    lo = nd.array(np.array([0.0, 10.0], np.float32))
+    hi = nd.array(np.array([1.0, 20.0], np.float32))
+    h = nd.sample_uniform(lo, hi, shape=3, dtype="float16")
+    assert str(h.dtype) == "float16"
+    buf = nd.zeros((2, 4))
+    r = nd.random.uniform(lo, hi, shape=(4,), out=buf)
+    assert r is buf and float(np.abs(buf.asnumpy()).sum()) > 0
+    g = nd.random.generalized_negative_binomial(
+        nd.array(np.array([5.0], np.float32)),
+        nd.array(np.array([0.0], np.float32)), shape=(4000,)).asnumpy()
+    assert abs(g.mean() - 5.0) < 0.3
