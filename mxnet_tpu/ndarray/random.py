@@ -28,21 +28,16 @@ def _shape(shape):
 
 
 def _sample_op(op_name, params, shape, dtype, out=None):
-    """Array-parameterized draw through the registered multisample op
-    (reference python/mxnet/ndarray/random.py _random_helper: NDArray
-    params dispatch to _sample_<dist>; sample.shape = params.shape +
-    shape). Honors out= like the scalar paths."""
-    from . import invoke
+    """Array-parameterized draw (reference python/mxnet/ndarray/random.py
+    _random_helper: NDArray params dispatch to _sample_<dist>). Routes
+    through the generated nd wrapper so the RNG-key feeding lives in ONE
+    place (_RNG_SAMPLE_OPS in ndarray/__init__.py)."""
+    import importlib
     from .ndarray import array as _array
-    from ..ops.registry import get_op
+    nd_mod = importlib.import_module("mxnet_tpu.ndarray")
     nds = [pv if isinstance(pv, NDArray) else _array(pv) for pv in params]
-    key = NDArray(_rng.next_key_raw())
-    kwargs = {"shape": shape, "dtype": dtype or str(default_dtype())}
-    r = invoke(get_op(op_name), nds + [key], kwargs)
-    if out is not None:
-        out._set_data(r._data)
-        return out
-    return r
+    return getattr(nd_mod, op_name)(
+        *nds, shape=shape, dtype=dtype or str(default_dtype()), out=out)
 
 
 def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None):

@@ -463,3 +463,9 @@ __all__ += ["array", "zeros", "ones", "empty", "full", "eye", "identity",
             "arange", "linspace", "logspace", "zeros_like", "ones_like",
             "full_like", "empty_like", "copy", "asarray", "shape", "ndim",
             "size", "random", "linalg", "newaxis", "pi", "inf", "nan"]
+
+
+def fix(x, out=None):
+    """Round toward zero (np.fix). Delegates to trunc — jnp.fix is
+    deprecated (removed in jax 0.10) and truncation is the same op."""
+    return trunc(x)

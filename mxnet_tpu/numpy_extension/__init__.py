@@ -272,3 +272,63 @@ def load(fname):
 def save(fname, data):
     from ..serialization import save_ndarrays
     save_ndarrays(fname, data)
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None, ctx=None,
+              out=None):
+    """Bernoulli samples parameterized by prob OR logit, not both
+    (reference numpy_extension/random.py:78)."""
+    from .. import random as _rng
+    if (prob is None) == (logit is None):
+        raise MXNetError("bernoulli needs exactly one of prob/logit")
+    p = prob if prob is not None else jax.nn.sigmoid(
+        jnp.asarray(getattr(logit, "_data", logit)))
+    p = jnp.asarray(getattr(p, "_data", p))
+    shape = p.shape if size is None else (
+        (size,) if isinstance(size, int) else tuple(size))
+    draw = jax.random.bernoulli(_rng.next_key(), p, shape) \
+        .astype(jnp.dtype(dtype) if dtype else jnp.float32)
+    if ctx is not None:
+        draw = jax.device_put(draw, ctx.jax_device)
+    res = _renp(NDArray(draw))
+    if out is not None:
+        out._set_data(draw)
+        return out
+    return res
+
+
+def _batched_draw(base, params, batch_shape, dtype, ctx):
+    """Shared body of the *_n samplers: draw batch_shape + broadcast
+    params.shape and apply the affine transform; honors ctx placement."""
+    from .. import random as _rng
+    arrs = [jnp.asarray(getattr(pv, "_data", pv), jnp.float32)
+            for pv in params]
+    pshape = jnp.broadcast_shapes(*(a.shape for a in arrs))
+    batch = () if batch_shape is None else (
+        (batch_shape,) if isinstance(batch_shape, int) else
+        tuple(batch_shape))
+    raw = base(_rng.next_key(), batch + pshape,
+               jnp.dtype(dtype) if dtype else jnp.float32, arrs)
+    if ctx is not None:
+        raw = jax.device_put(raw, ctx.jax_device)
+    return _renp(NDArray(raw))
+
+
+def uniform_n(low=0.0, high=1.0, batch_shape=None, dtype=None, ctx=None):
+    """Like np.random.uniform but `batch_shape` is PREPENDED to the
+    broadcast parameter shape (reference numpy_extension/random.py:131
+    uniform_n: out.shape = batch_shape + params.shape)."""
+    def base(key, shape, dt, ps):
+        lo, hi = ps
+        u = jax.random.uniform(key, shape, dt)
+        return (lo + (hi - lo) * u).astype(dt)
+    return _batched_draw(base, (low, high), batch_shape, dtype, ctx)
+
+
+def normal_n(loc=0.0, scale=1.0, batch_shape=None, dtype=None, ctx=None):
+    """Like np.random.normal but `batch_shape` is PREPENDED (reference
+    numpy_extension/random.py normal_n)."""
+    def base(key, shape, dt, ps):
+        m, sd = ps
+        return (m + sd * jax.random.normal(key, shape, dt)).astype(dt)
+    return _batched_draw(base, (loc, scale), batch_shape, dtype, ctx)

@@ -188,3 +188,26 @@ def test_multisample_dtype_out_and_alpha_zero():
         nd.array(np.array([5.0], np.float32)),
         nd.array(np.array([0.0], np.float32)), shape=(4000,)).asnumpy()
     assert abs(g.mean() - 5.0) < 0.3
+
+
+def test_npx_random_helpers_and_np_fix():
+    """reference numpy_extension/random.py bernoulli/uniform_n/normal_n
+    (batch_shape PREPENDS) + np.fix delegation."""
+    b = mx.npx.bernoulli(prob=0.3, size=(4000,))
+    assert abs(float(np.asarray(b._data).mean()) - 0.3) < 0.03
+    b2 = np.asarray(mx.npx.bernoulli(logit=mx.np.array([10.0, -10.0]))._data)
+    np.testing.assert_array_equal(b2, [1.0, 0.0])
+    with pytest.raises(mx.base.MXNetError):
+        mx.npx.bernoulli(prob=0.5, logit=0.0)
+
+    u = np.asarray(mx.npx.uniform_n(mx.np.array([0.0, 10.0]),
+                                    mx.np.array([1.0, 20.0]),
+                                    batch_shape=(3000,))._data)
+    assert u.shape == (3000, 2)
+    assert abs(u[:, 0].mean() - 0.5) < 0.03 and abs(u[:, 1].mean() - 15) < 0.3
+    n = np.asarray(mx.npx.normal_n(5.0, 0.1, batch_shape=(2000,))._data)
+    assert n.shape == (2000,) and abs(n.mean() - 5.0) < 0.02
+
+    np.testing.assert_array_equal(
+        np.asarray(mx.np.fix(mx.np.array([-1.7, 1.7, 0.2]))._data),
+        [-1.0, 1.0, 0.0])
