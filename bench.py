@@ -216,8 +216,136 @@ def bench_wide_conv(batch, steps, warmup, ch=768, hw=28):
     }
 
 
+def _make_train_net(body):
+    """Wrap body+softmax-CE loss into one HybridBlock so the whole training
+    forward (incl. loss) is a single compiled artifact."""
+    from mxnet_tpu import gluon
+
+    class _TrainNet(gluon.HybridBlock):
+        def __init__(self, b):
+            super().__init__()
+            self.body = b
+            self.ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def hybrid_forward(self, F, x, y):
+            return self.ce(self.body(x), y).mean()
+
+    return _TrainNet(body)
+
+
+def _eager_train_loop(net, x, y, steps, trainer=None, lr=0.05):
+    """One eager-gluon training loop: record -> forward -> backward ->
+    trainer.step. This is the hot path the vjp-artifact refactor targets
+    (DataParallelTrainer fuses the whole step separately)."""
+    from mxnet_tpu import autograd, gluon
+
+    if trainer is None:
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": lr, "momentum": 0.9})
+    loss = None
+    for _ in range(steps):
+        with autograd.record():
+            loss = net(x, y)
+        loss.backward()
+        trainer.step(x.shape[0])
+    return loss, trainer
+
+
+def bench_train_step(steps, warmup):
+    """Eager train-step throughput + recompile accounting for a small MLP
+    and a conv(ResNet-ish) block, fused residual-caching backward vs the
+    MXNET_TPU_REMAT_BWD=1 recompute-forward baseline."""
+    import os as _os
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, gluon
+    from mxnet_tpu import engine
+
+    rs = np.random.RandomState(0)
+
+    def mlp():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(1024, activation="relu"),
+                gluon.nn.Dense(1024, activation="relu"),
+                gluon.nn.Dense(1024, activation="relu"),
+                gluon.nn.Dense(64))
+        return net
+
+    def resnet_block():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(64, 3, padding=1),
+                gluon.nn.BatchNorm(),
+                gluon.nn.Activation("relu"),
+                gluon.nn.Conv2D(64, 3, padding=1),
+                gluon.nn.BatchNorm(),
+                gluon.nn.GlobalAvgPool2D(),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(10))
+        return net
+
+    def run(make_net, x, y, remat):
+        prev = _os.environ.pop("MXNET_TPU_REMAT_BWD", None)
+        if remat:
+            _os.environ["MXNET_TPU_REMAT_BWD"] = "1"
+        try:
+            net = _make_train_net(make_net())
+            net.initialize()
+            net(x, y)  # shape inference
+            net.hybridize()
+            # fresh artifact accounting per run (a later run would otherwise
+            # adopt the earlier run's shared executables and report 0)
+            engine.clear_compilation_cache()
+            engine.reset_stats()
+            _, trainer = _eager_train_loop(net, x, y, warmup)
+            assert engine.cache_stats()["compiles"] >= 1
+            warm_stats = engine.cache_stats()
+            t0 = time.perf_counter()
+            out, _ = _eager_train_loop(net, x, y, steps, trainer=trainer)
+            out.asnumpy()
+            dt = time.perf_counter() - t0
+            stats = engine.cache_stats()
+            return {
+                "steps_s": round(steps / dt, 2),
+                "compiles": stats["compiles"],
+                "retraces_in_measured_loop":
+                    stats["traces"] - warm_stats["traces"],
+            }
+        finally:
+            _os.environ.pop("MXNET_TPU_REMAT_BWD", None)
+            if prev is not None:
+                _os.environ["MXNET_TPU_REMAT_BWD"] = prev
+
+    x_mlp = nd.array(rs.uniform(-1, 1, (256, 512)).astype(np.float32))
+    y_mlp = nd.array(rs.randint(0, 64, (256,)), dtype="int32")
+    x_cnn = nd.array(rs.uniform(-1, 1, (16, 3, 32, 32)).astype(np.float32))
+    y_cnn = nd.array(rs.randint(0, 10, (16,)), dtype="int32")
+
+    fused = run(mlp, x_mlp, y_mlp, remat=False)
+    recompute = run(mlp, x_mlp, y_mlp, remat=True)
+    rb_fused = run(resnet_block, x_cnn, y_cnn, remat=False)
+    rb_recompute = run(resnet_block, x_cnn, y_cnn, remat=True)
+    return {
+        "metric": "train_step_mlp_steps_s",
+        "value": fused["steps_s"],
+        "unit": "steps/s",
+        # baseline = the recompute-forward backward this refactor replaced
+        "vs_baseline": round(fused["steps_s"]
+                             / max(recompute["steps_s"], 1e-9), 3),
+        "extra": {
+            "mlp_fused": fused,
+            "mlp_recompute_baseline": recompute,
+            "resnet_block_fused": rb_fused,
+            "resnet_block_recompute_baseline": rb_recompute,
+        },
+    }
+
+
 def main():
     _enable_compile_cache()
+    if os.environ.get("BENCH_SCENARIO") == "train_step":
+        print(json.dumps(bench_train_step(
+            int(os.environ.get("BENCH_TRAIN_STEPS", 50)),
+            int(os.environ.get("BENCH_TRAIN_WARMUP", 10)))))
+        return
     headline = bench_resnet(BATCH, IMAGE, STEPS, WARMUP)
     result = {
         "metric": "resnet50_train_throughput_bs32",

@@ -84,6 +84,7 @@ __version__ = "1.0.0.dev0"
 # (`mx.gluon`, `from mxnet_tpu import optimizer`) resolves identically to
 # the old eager imports.
 _LAZY_SUBMODULES = {
+    "engine": ".engine",
     "initializer": ".initializer",
     "optimizer": ".optimizer",
     "lr_scheduler": ".lr_scheduler",

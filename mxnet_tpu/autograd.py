@@ -48,10 +48,10 @@ class Node:
 
 class TapeEntry:
     __slots__ = ("vjp_fn", "in_nodes", "out_nodes", "out_is_tuple", "out_avals",
-                 "refn", "in_raws", "recordable_bwd")
+                 "refn", "in_raws", "recordable_bwd", "residuals")
 
     def __init__(self, vjp_fn, in_nodes, out_nodes, out_is_tuple, out_avals,
-                 refn=None, in_raws=None, recordable_bwd=None):
+                 refn=None, in_raws=None, recordable_bwd=None, residuals=None):
         self.vjp_fn = vjp_fn
         self.in_nodes = in_nodes    # list[Node|None] aligned with op inputs
         self.out_nodes = out_nodes  # list[Node] aligned with op outputs
@@ -67,6 +67,11 @@ class TapeEntry:
         # backward through the NDArray layer (no pause) so a create_graph
         # walk can record it and differentiate the returned grads again
         self.recordable_bwd = recordable_bwd
+        # compiled-artifact path (hybridized blocks / executors): the VJP
+        # residuals saved by the forward. vjp_fn's closure holds them too;
+        # keeping them addressable lets backward() free each entry's
+        # residual memory as soon as its pullback has run
+        self.residuals = residuals
 
 
 # ---------------------------------------------------------------------------
@@ -148,12 +153,15 @@ def _participates(arr) -> bool:
 
 
 def record_op(vjp_fn, inputs, outputs, out_is_tuple: bool, refn=None,
-              recordable_bwd=None):
+              recordable_bwd=None, residuals=None):
     """Called by the NDArray dispatch layer after a recorded forward.
     `refn`, when given, is the pure raw-array forward used to re-derive the
     backward under create_graph (higher-order autograd). `recordable_bwd`
     is the custom-Function alternative: the user's explicit backward run
-    through the recording NDArray layer (see Function.__call__)."""
+    through the recording NDArray layer (see Function.__call__).
+    `residuals` are the saved VJP intermediates of a compiled forward
+    artifact (hybridized block / executor) — the backward walk invokes the
+    compiled pullback on them instead of re-running the forward."""
     in_nodes = [getattr(x, "_ag_node", None) for x in inputs]
     out_nodes = []
     for o in outputs:
@@ -168,7 +176,8 @@ def record_op(vjp_fn, inputs, outputs, out_is_tuple: bool, refn=None,
         else None
     _STATE.tape.append(TapeEntry(vjp_fn, in_nodes, out_nodes, out_is_tuple,
                                  avals, refn=refn, in_raws=in_raws,
-                                 recordable_bwd=recordable_bwd))
+                                 recordable_bwd=recordable_bwd,
+                                 residuals=residuals))
 
 
 def _zeros_like_raw(arr):
@@ -242,6 +251,12 @@ def _run_backward(heads, head_grads, retain_graph) -> Dict[Node, Any]:
             continue
         cot = tuple(outs_g) if entry.out_is_tuple else outs_g[0]
         in_gs = entry.vjp_fn(cot)
+        if not retain_graph:
+            # free compiled-forward residuals as the walk passes each entry
+            # instead of holding every layer's saved activations until the
+            # whole tape drops
+            entry.vjp_fn = None
+            entry.residuals = None
         for node, g in zip(entry.in_nodes, in_gs):
             if node is not None:
                 add_grad(node, g)

@@ -260,7 +260,9 @@ def quantize_net(net, calib_data=None, calib_mode="entropy",
     for blk in _iter_blocks(net, []):
         if hasattr(blk, "_active"):
             blk._active = False
-        if hasattr(blk, "_cached_graphs"):
+        if hasattr(blk, "clear_cache"):
+            blk.clear_cache()  # also evicts the shared engine-cache entries
+        elif hasattr(blk, "_cached_graphs"):
             blk._cached_graphs.clear()
 
     targets = []

@@ -1,0 +1,257 @@
+"""Compilation engine: process-wide executable cache + buffer-donation policy.
+
+The reference framework funnels every training step through ONE compiled
+CachedOp/GraphExecutor artifact with planned memory reuse
+(src/imperative/cached_op.h, src/executor/graph_executor.cc). This module is
+the jax_graft analog of that shared engine state:
+
+  - a process-wide compilation cache keyed on (graph-structure fingerprint,
+    input signature, train flag) so N instances of the same model share one
+    set of XLA executables instead of compiling privately per instance
+    (gluon HybridBlock and symbol Executor both publish into it);
+  - wiring for jax's persistent on-disk compilation cache via the
+    ``MXNET_TPU_COMPILATION_CACHE_DIR`` environment variable, so repeat
+    processes skip recompiles entirely;
+  - the buffer-donation policy used by the optimizer update kernels
+    (weight/optimizer-state aliasing a la arXiv:2004.13336's weight-update
+    sharding — donated inputs alias their outputs in-place on TPU);
+  - hit/miss/trace/compile-time/donation counters surfaced through
+    ``profiler.compilation_stats()`` so cache regressions are visible.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["lookup", "insert", "clear_compilation_cache", "cache_stats",
+           "reset_stats", "donation_enabled", "record_donation",
+           "compile_timer", "record_trace", "record_execution",
+           "structural_fingerprint", "graph_fingerprint"]
+
+
+_LOCK = threading.RLock()
+_CACHE: Dict[Tuple, Any] = {}
+
+_STATS = {
+    "hits": 0,            # shared-cache lookups that returned an artifact
+    "misses": 0,          # lookups that required a fresh build
+    "traces": 0,          # python-level retraces of cached forwards
+    "compiles": 0,        # artifact builds (one per miss that completed)
+    "compile_seconds": 0.0,
+    "fwd_executions": 0,  # compiled forward invocations (gluon cached path)
+    "bwd_executions": 0,  # compiled pullback invocations (no fwd recompute)
+    "donated_updates": 0, # optimizer update calls that donated buffers
+}
+
+
+# ---------------------------------------------------------------------------
+# Persistent on-disk XLA cache (MXNET_TPU_COMPILATION_CACHE_DIR)
+# ---------------------------------------------------------------------------
+
+_persistent_dir = None
+
+
+def _init_persistent_cache():
+    """Point jax's persistent compilation cache at the user-chosen directory.
+    Safe to call before any backend initializes (pure config updates)."""
+    global _persistent_dir
+    d = os.environ.get("MXNET_TPU_COMPILATION_CACHE_DIR")
+    if not d or _persistent_dir == d:
+        return
+    try:
+        import jax
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _persistent_dir = d
+    except Exception:
+        pass
+
+
+_init_persistent_cache()
+
+
+def persistent_cache_dir() -> Optional[str]:
+    return _persistent_dir
+
+
+# ---------------------------------------------------------------------------
+# Shared executable cache
+# ---------------------------------------------------------------------------
+
+def lookup(key: Tuple):
+    """Fetch a shared artifact; counts a hit or a miss."""
+    with _LOCK:
+        ent = _CACHE.get(key)
+        if ent is None:
+            _STATS["misses"] += 1
+        else:
+            _STATS["hits"] += 1
+        return ent
+
+
+def insert(key: Tuple, artifact):
+    with _LOCK:
+        _CACHE[key] = artifact
+    return artifact
+
+
+def clear_compilation_cache(fingerprint=None):
+    """Drop shared executables — all of them, or only the entries whose key
+    carries `fingerprint` (HybridBlock.clear_cache uses the latter so one
+    block's invalidation doesn't flush unrelated models)."""
+    with _LOCK:
+        if fingerprint is None:
+            _CACHE.clear()
+        else:
+            for k in [k for k in _CACHE if fingerprint in k]:
+                del _CACHE[k]
+
+
+def cache_size() -> int:
+    with _LOCK:
+        return len(_CACHE)
+
+
+def cache_stats() -> Dict[str, Any]:
+    with _LOCK:
+        st = dict(_STATS)
+        st["artifacts"] = len(_CACHE)
+        st["persistent_cache_dir"] = _persistent_dir
+        return st
+
+
+def reset_stats():
+    with _LOCK:
+        for k in _STATS:
+            _STATS[k] = 0.0 if k == "compile_seconds" else 0
+
+
+def _bump(key, n=1):
+    with _LOCK:
+        _STATS[key] += n
+
+
+def record_trace():
+    _bump("traces")
+
+
+def record_execution(kind: str):
+    _bump("fwd_executions" if kind == "fwd" else "bwd_executions")
+
+
+@contextmanager
+def compile_timer(name: str = "build"):
+    """Times an artifact build; feeds both the stats dict and the profiler's
+    aggregate table (category 'compilation')."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        t1 = time.perf_counter()
+        with _LOCK:
+            _STATS["compiles"] += 1
+            _STATS["compile_seconds"] += t1 - t0
+        try:
+            from .. import profiler as _profiler
+            _profiler._record(name, "compilation", t0, t1)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Buffer donation policy
+# ---------------------------------------------------------------------------
+
+_donation_cache = {"value": None}
+
+
+def donation_enabled() -> bool:
+    """True when donate_argnums should be used for optimizer updates.
+    MXNET_TPU_DONATION=0/1 overrides; otherwise enabled on accelerator
+    backends (CPU ignores donation and would warn on every call)."""
+    ov = os.environ.get("MXNET_TPU_DONATION")
+    if ov is not None:
+        return ov.lower() not in ("0", "false", "off")
+    if _donation_cache["value"] is None:
+        try:
+            import jax
+            _donation_cache["value"] = jax.default_backend() not in ("cpu",)
+        except Exception:
+            _donation_cache["value"] = False
+    return _donation_cache["value"]
+
+
+def record_donation(n: int = 1):
+    _bump("donated_updates", n)
+
+
+# ---------------------------------------------------------------------------
+# Graph-structure fingerprints
+# ---------------------------------------------------------------------------
+
+# bookkeeping attrs that vary per instance without changing the computation
+_SKIP_ATTRS = {
+    "_prefix", "_params", "_children", "_reg_params", "_scope",
+    "_forward_hooks", "_forward_pre_hooks", "_empty_init_guard",
+    "_active", "_flags", "_fingerprint_memo",
+}
+
+_SCALARS = (int, float, bool, str, bytes, type(None))
+
+
+def _stable_value(v):
+    """A deterministic token for a config attribute. Scalars and containers
+    of scalars hash by value; anything opaque (callables, arrays, objects)
+    hashes by identity so two blocks never falsely share executables."""
+    if isinstance(v, _SCALARS):
+        return repr(v)
+    if isinstance(v, (tuple, list)):
+        return "(" + ",".join(_stable_value(x) for x in v) + ")"
+    if isinstance(v, dict):
+        return "{" + ",".join(
+            f"{k!r}:{_stable_value(v[k])}" for k in sorted(v, key=repr)) + "}"
+    return f"id:{id(v)}"
+
+
+def _block_config_items(block):
+    items = []
+    for k in sorted(vars(block)):
+        if k in _SKIP_ATTRS or k.startswith("_cached"):
+            continue
+        v = vars(block)[k]
+        if hasattr(v, "_deferred_init") or hasattr(v, "_reg_params"):
+            continue  # params/children are fingerprinted structurally below
+        items.append((k, _stable_value(v)))
+    return items
+
+
+def structural_fingerprint(block) -> str:
+    """Deterministic digest of a Block tree: class, scalar config, parameter
+    shapes/dtypes, children (recursively). Two instances of the same model
+    definition produce the same fingerprint and therefore share compiled
+    executables; prefixes/names are deliberately excluded."""
+    h = hashlib.sha1()
+
+    def walk(b):
+        h.update(f"<{type(b).__module__}.{type(b).__qualname__}".encode())
+        for k, v in _block_config_items(b):
+            h.update(f"|{k}={v}".encode())
+        for k, p in getattr(b, "_reg_params", {}).items():
+            h.update(f"|p:{k}:{tuple(p.shape or ())}:{p.dtype}".encode())
+        for k, c in getattr(b, "_children", {}).items():
+            h.update(f"|c:{k}".encode())
+            walk(c)
+        h.update(b">")
+
+    walk(block)
+    return h.hexdigest()
+
+
+def graph_fingerprint(text: str) -> str:
+    """Digest of an explicit graph serialization (Symbol.tojson)."""
+    return hashlib.sha1(text.encode()).hexdigest()

@@ -2,10 +2,14 @@
 
 `hybridize()` is the reference's CachedOp boundary (src/imperative/cached_op.h)
 re-designed for XLA (SURVEY.md §3.3): the block's forward is traced ONCE per
-(input-signature, train-mode) into a jitted function over (rng_key, inputs,
-params); backward is a second jitted function that recomputes forward and
-applies the VJP (rematerialized backward — the XLA-native analog of
-static_alloc, trading FLOPs for memory exactly like MXNET_BACKWARD_DO_MIRROR).
+(input-signature, train-mode) into a single `jax.vjp`-based artifact — the
+training forward returns outputs PLUS the VJP residuals, autograd's tape
+keeps the residual handle, and `backward()` invokes the compiled pullback
+directly, so one training step runs the forward computation exactly once
+(the reference's one-CachedOp-artifact contract, not the recompute-forward
+mirror mode earlier revisions used). Compiled artifacts live in the
+process-wide `mxnet_tpu.engine` cache keyed on (structure fingerprint,
+signature, train flag), so N instances of the same model compile once.
 
 Mutable aux state (BatchNorm running stats) is threaded functionally through
 `defer_aux_update`: under a trace the new value becomes an extra output and is
@@ -21,11 +25,14 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+import os
+
 from ..base import MXNetError
 from ..context import Context, current_context
 from ..ndarray import NDArray
 from .. import ndarray as nd
 from .. import autograd
+from .. import engine as _engine
 from .. import random as _rng
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
@@ -296,17 +303,33 @@ def _flatten_nd(args):
 
 
 class _CachedGraph:
-    """One compiled (signature → executable) entry: forward jit + backward jit
-    (recompute-mode VJP) + aux layout."""
+    """One shared compiled artifact per (fingerprint, signature, train) key.
 
-    __slots__ = ("fwd", "bwd", "out_treedef", "n_aux", "aux_params", "n_outs")
+    - ``fwd``:     jitted inference forward ``(key, *flat) -> (outs, aux)``
+    - ``fwd_res``: jitted training forward ``(key, *flat) -> (outs, aux,
+                   residuals)`` — the forward of ``jax.vjp``, residuals out
+    - ``bwd``:     jitted pullback ``(residuals, cots) -> input cotangents``
+                   (never re-runs the forward)
+
+    Aux params (BN running stats) are stored as structural PATHS so a
+    different instance of the same model can map them onto its own
+    Parameters when it reuses the artifact from the engine cache.
+    """
+
+    __slots__ = ("fwd", "fwd_res", "bwd", "bwd_recompute", "out_treedef",
+                 "res_treedef", "aux_paths", "aux_params_builder",
+                 "builder_id")
 
     def __init__(self):
         self.fwd = None
+        self.fwd_res = None
         self.bwd = None
+        self.bwd_recompute = None
         self.out_treedef = None
-        self.aux_params = None
-        self.n_outs = 0
+        self.res_treedef = None
+        self.aux_paths = None          # set on first trace
+        self.aux_params_builder = None
+        self.builder_id = None
 
 
 class HybridBlock(Block):
@@ -315,7 +338,8 @@ class HybridBlock(Block):
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix, params)
         self._active = False
-        self._cached_graphs: Dict[Any, _CachedGraph] = {}
+        self._cached_graphs: Dict[Any, list] = {}
+        self._fingerprint_memo: Optional[str] = None
         self._flags = {}
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
@@ -323,15 +347,24 @@ class HybridBlock(Block):
         self._active = active
         self._flags = dict(static_alloc=static_alloc, static_shape=static_shape)
         self._cached_graphs.clear()
+        self._fingerprint_memo = None
         super().hybridize(active, **kwargs)
 
     def clear_cache(self):
+        # drop this block's entries from the process-wide cache too, so a
+        # structurally-stale artifact can't be handed back on the next call
+        if self._fingerprint_memo is not None:
+            _engine.clear_compilation_cache(self._fingerprint_memo)
+        self._fingerprint_memo = None
         self._cached_graphs.clear()
         for c in self._children.values():
             if isinstance(c, HybridBlock):
                 c.clear_cache()
 
     def cast(self, dtype):
+        if self._fingerprint_memo is not None:
+            _engine.clear_compilation_cache(self._fingerprint_memo)
+        self._fingerprint_memo = None
         self._cached_graphs.clear()
         super().cast(dtype)
 
@@ -435,7 +468,27 @@ class HybridBlock(Block):
     # -- CachedOp path ---------------------------------------------------------
     def _signature(self, raw_inputs):
         return (tuple((tuple(r.shape), str(r.dtype)) for r in raw_inputs),
-                autograd.is_training(), autograd.is_recording())
+                autograd.is_training())
+
+    def _fingerprint(self) -> str:
+        if self._fingerprint_memo is None:
+            self._fingerprint_memo = _engine.structural_fingerprint(self)
+        return self._fingerprint_memo
+
+    def _resolve_aux_params(self, graph: _CachedGraph) -> Optional[List[Parameter]]:
+        """Map the artifact's aux-param paths onto THIS instance's Parameters.
+        Returns None when the artifact can't be adopted (an aux param of the
+        builder has no structural path and we are not the builder)."""
+        if not graph.aux_paths:
+            return []
+        if None not in graph.aux_paths:
+            by_path = self._collect_params_with_prefix()
+            try:
+                return [by_path[p] for p in graph.aux_paths]
+            except KeyError:
+                return None
+        return graph.aux_params_builder if graph.builder_id == id(self) \
+            else None
 
     def _call_cached(self, *args):
         params_dict = self.collect_params()
@@ -446,16 +499,53 @@ class HybridBlock(Block):
         raw_inputs, in_treedef, _ = _flatten_nd(list(args))
         raw_params = [p._data._data for p in plist]
         sig = self._signature(raw_inputs)
-        graph = self._cached_graphs.get(sig)
-        if graph is None:
-            graph = self._build_graph(args, in_treedef, plist, sig)
-            self._cached_graphs[sig] = graph
+        entry = self._cached_graphs.get(sig)
+        if entry is None:
+            cache_key = ("gluon", self._fingerprint(), sig)
+            graph = _engine.lookup(cache_key)
+            if graph is None:
+                with _engine.compile_timer(f"gluon:{type(self).__name__}"):
+                    graph = self._build_graph(args, in_treedef, plist, sig)
+                _engine.insert(cache_key, graph)
+            entry = [graph, None]  # aux mapping resolved after first trace
+            self._cached_graphs[sig] = entry
+        graph = entry[0]
         key = _rng.next_key_raw()
         recording = autograd.is_recording()
+        # MXNET_TPU_REMAT_BWD=1: rematerialized backward (the reference's
+        # MXNET_BACKWARD_DO_MIRROR) — forward saves NO residuals and the
+        # pullback re-runs the forward, trading ~2x forward FLOPs for
+        # activation memory. Default is the residual-caching vjp artifact.
+        remat = os.environ.get("MXNET_TPU_REMAT_BWD", "") not in ("", "0")
         all_raw = tuple(raw_inputs) + tuple(raw_params)
-        outs_flat, aux_vals = graph.fwd(key, *all_raw)
+        res = None
+        if recording and not remat:
+            outs_flat, aux_vals, res = graph.fwd_res(key, *all_raw)
+        else:
+            outs_flat, aux_vals = graph.fwd(key, *all_raw)
+        _engine.record_execution("fwd")
+        if entry[1] is None:
+            aux_params = self._resolve_aux_params(graph)
+            if aux_params is None:
+                # artifact not adoptable by this instance: build a private
+                # one (keyed by instance identity) and redo the call
+                cache_key = ("gluon", self._fingerprint(), sig, id(self))
+                graph = _engine.lookup(cache_key)
+                if graph is None:
+                    with _engine.compile_timer(
+                            f"gluon:{type(self).__name__}"):
+                        graph = self._build_graph(args, in_treedef, plist,
+                                                  sig)
+                    _engine.insert(cache_key, graph)
+                entry[0] = graph
+                if recording and not remat:
+                    outs_flat, aux_vals, res = graph.fwd_res(key, *all_raw)
+                else:
+                    outs_flat, aux_vals = graph.fwd(key, *all_raw)
+                aux_params = graph.aux_params_builder
+            entry[1] = aux_params
         # apply aux updates (BN running stats) outside the trace
-        for p, v in zip(graph.aux_params, aux_vals):
+        for p, v in zip(entry[1], aux_vals):
             p._data._set_data(v)
         ctx = args[0].ctx if isinstance(args[0], NDArray) else current_context()
         out_nds = [NDArray(o, ctx) for o in outs_flat]
@@ -463,25 +553,46 @@ class HybridBlock(Block):
             input_nds = [a for a in jax.tree_util.tree_leaves(
                 list(args), is_leaf=lambda x: isinstance(x, NDArray))]
             param_nds = [p._data for p in plist]
+            out_dtypes = [o.dtype for o in outs_flat]
 
-            def vjp_fn(cots, _graph=graph, _key=key, _all_raw=all_raw):
-                cots_t = cots if isinstance(cots, tuple) else (cots,)
-                return _graph.bwd(_key, _all_raw, tuple(cots_t))
+            if res is not None:
+                def vjp_fn(cots, _graph=graph, _res=res, _dts=out_dtypes):
+                    cots_t = cots if isinstance(cots, tuple) else (cots,)
+                    # the compiled pullback's cotangent avals are fixed;
+                    # cast mismatched head grads instead of tripping a
+                    # vjp error
+                    cots_t = tuple(
+                        c if getattr(c, "dtype", None) == dt else
+                        jnp.asarray(c, dt)
+                        for c, dt in zip(cots_t, _dts))
+                    _engine.record_execution("bwd")
+                    return _graph.bwd(_res, cots_t)
+            else:
+                def vjp_fn(cots, _graph=graph, _key=key, _all_raw=all_raw,
+                           _dts=out_dtypes):
+                    cots_t = cots if isinstance(cots, tuple) else (cots,)
+                    cots_t = tuple(
+                        c if getattr(c, "dtype", None) == dt else
+                        jnp.asarray(c, dt)
+                        for c, dt in zip(cots_t, _dts))
+                    _engine.record_execution("bwd")
+                    return _graph.bwd_recompute(_key, _all_raw, cots_t)
 
             autograd.record_op(vjp_fn, input_nds + param_nds, out_nds,
-                               out_is_tuple=len(out_nds) > 1)
+                               out_is_tuple=len(out_nds) > 1, residuals=res)
         out_tree = jax.tree_util.tree_unflatten(graph.out_treedef, out_nds)
         return out_tree
 
     def _build_graph(self, args, in_treedef, plist, sig) -> _CachedGraph:
         graph = _CachedGraph()
+        graph.builder_id = id(self)
         n_in = len(_flatten_nd(list(args))[0])
-        train_flag, rec_flag = sig[1], sig[2]
+        train_flag = sig[1]
         block = self
-        aux_order: List[Parameter] = []
         first_trace = {"done": False}
 
         def pure_fn(key_raw, *flat):
+            _engine.record_trace()
             raw_inputs = flat[:n_in]
             raw_params = flat[n_in:]
             in_nds = [NDArray(r) for r in raw_inputs]
@@ -508,23 +619,49 @@ class HybridBlock(Block):
             out_flat, out_treedef, _ = _flatten_nd(out)
             if not first_trace["done"]:
                 graph.out_treedef = out_treedef
-                aux_order.extend(p for p, _ in aux_collector)
+                aux_order = [p for p, _ in aux_collector]
+                path_of = {id(p): k for k, p in
+                           block._collect_params_with_prefix().items()}
+                graph.aux_paths = [path_of.get(id(p)) for p in aux_order]
+                graph.aux_params_builder = aux_order
                 first_trace["done"] = True
             return tuple(out_flat), tuple(v for _, v in aux_collector)
 
-        fwd_jit = jax.jit(pure_fn)
+        graph.fwd = jax.jit(pure_fn)
 
-        def bwd_impl(key_raw, all_raw, cots):
+        def fwd_res_impl(key_raw, *flat):
+            # ONE vjp artifact: forward emits outputs + aux + residuals; the
+            # pullback below consumes the residuals without recomputing the
+            # forward (jax's vjp closure is a Partial pytree, so its leaves
+            # cross the jit boundary as ordinary arrays)
+            def f(*ins):
+                return pure_fn(key_raw, *ins)
+
+            outs, vjp_fn, aux = jax.vjp(f, *flat, has_aux=True)
+            res_leaves, res_treedef = jax.tree_util.tree_flatten(vjp_fn)
+            graph.res_treedef = res_treedef
+            return outs, aux, tuple(res_leaves)
+
+        graph.fwd_res = jax.jit(fwd_res_impl)
+
+        def bwd_impl(res_leaves, cots):
+            vjp_fn = jax.tree_util.tree_unflatten(graph.res_treedef,
+                                                  list(res_leaves))
+            return vjp_fn(tuple(cots))
+
+        graph.bwd = jax.jit(bwd_impl)
+
+        def bwd_recompute_impl(key_raw, all_raw, cots):
+            # MXNET_TPU_REMAT_BWD mode: re-derive the forward inside the
+            # pullback (never compiled unless that mode is active)
             def fwd_only(*flat):
                 outs, _aux = pure_fn(key_raw, *flat)
                 return outs
-            _, vjp = jax.vjp(fwd_only, *all_raw)
-            return vjp(cots)
 
-        bwd_jit = jax.jit(bwd_impl)
-        graph.fwd = fwd_jit
-        graph.bwd = bwd_jit
-        graph.aux_params = aux_order
+            _, vjp = jax.vjp(fwd_only, *all_raw)
+            return vjp(tuple(cots))
+
+        graph.bwd_recompute = jax.jit(bwd_recompute_impl)
         return graph
 
     # -- deployment -----------------------------------------------------------

@@ -8,9 +8,11 @@ imperative semantics for flexibility and parity.
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 from ..base import MXNetError
+from .. import engine as _engine
 from .. import optimizer as opt_mod
 from .. import kvstore as kvs_mod
 from .parameter import Parameter, ParameterDict
@@ -88,14 +90,28 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     # -- step ------------------------------------------------------------------
+    @property
+    def donation_active(self) -> bool:
+        """True when the update kernels alias weight/optimizer-state buffers
+        in place (engine.donation_enabled(); TPU/GPU backends)."""
+        return _engine.donation_enabled()
+
     def step(self, batch_size, ignore_stale_grad=False):
         """rescale grads by 1/batch_size, allreduce, update (reference
-        trainer.py:320)."""
+        trainer.py:320). The per-param updates run through the donated
+        optimizer kernels, so on backends with input-output aliasing each
+        weight/state buffer is updated in place; step timing lands in the
+        profiler's aggregate table while a profile is running."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        from .. import profiler as _profiler
+        t0 = time.perf_counter() if _profiler._state["running"] else None
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        if t0 is not None:
+            _profiler._record("trainer.step", "trainer", t0,
+                              time.perf_counter())
 
     def allreduce_grads(self):
         if not self._kv_initialized:

@@ -19,6 +19,7 @@ import numpy as _np
 
 from ..base import MXNetError
 from ..ndarray import NDArray, zeros
+from .. import engine as _engine
 
 _OPT_REGISTRY: Dict[str, type] = {}
 
@@ -225,31 +226,69 @@ def get_updater(optimizer: Optimizer) -> Updater:
 from ..ops import optimizer_ops as _oo
 
 
-@jax.jit
+class _UpdateKernel:
+    """Jitted optimizer update that donates the weight/state buffers when the
+    backend supports input-output aliasing (engine.donation_enabled()), so
+    each step's weight update mutates storage in place on TPU instead of
+    allocating a second copy of every parameter and optimizer state
+    (the weight-update aliasing of arXiv:2004.13336). Exposes ``__wrapped__``
+    so the fused data-parallel step can inline the raw math (see
+    parallel/data_parallel.py functional_optimizer)."""
+
+    __slots__ = ("__wrapped__", "_donate", "_jit", "_donating")
+
+    def __init__(self, fn, donate=()):
+        self.__wrapped__ = fn
+        self._donate = tuple(donate)
+        self._jit = None
+        self._donating = False
+
+    def __call__(self, *args):
+        if self._jit is None:
+            # resolved lazily: the backend must not initialize at import
+            self._donating = bool(self._donate) and _engine.donation_enabled()
+            self._jit = jax.jit(
+                self.__wrapped__,
+                donate_argnums=self._donate if self._donating else ())
+        if self._donating:
+            _engine.record_donation(len(self._donate))
+        return self._jit(*args)
+
+
+def _update_kernel(*donate):
+    """Decorator: jit an update rule, donating the given argnums (the weight
+    and every mutable state buffer — never the gradient, which grad_req=add
+    flows may still read)."""
+    def wrap(fn):
+        return _UpdateKernel(fn, donate)
+    return wrap
+
+
+@_update_kernel(0)
 def _k_sgd(w, g, lr, wd, rescale, clip):
     return _oo.sgd_update(w, g, lr, wd=wd, rescale_grad=rescale,
                           clip_gradient=clip)
 
 
-@jax.jit
+@_update_kernel(0, 2)
 def _k_sgd_mom(w, g, mom, lr, wd, rescale, clip, momentum):
     return _oo.sgd_mom_update(w, g, mom, lr, momentum=momentum, wd=wd,
                               rescale_grad=rescale, clip_gradient=clip)
 
 
-@jax.jit
+@_update_kernel(0)
 def _k_sgd_lazy(w, g, lr, wd, rescale, clip):
     return _oo.sgd_lazy_update(w, g, lr, wd=wd, rescale_grad=rescale,
                                clip_gradient=clip)
 
 
-@jax.jit
+@_update_kernel(0, 2)
 def _k_sgd_mom_lazy(w, g, mom, lr, wd, rescale, clip, momentum):
     return _oo.sgd_mom_lazy_update(w, g, mom, lr, momentum=momentum, wd=wd,
                                    rescale_grad=rescale, clip_gradient=clip)
 
 
-@jax.jit
+@_update_kernel(0, 2, 3)
 def _k_adam_lazy(w, g, m, v, lr, wd, rescale, clip, beta1, beta2, eps,
                  coef1, coef2):
     lr_t = lr * jnp.sqrt(coef2) / coef1
@@ -264,13 +303,13 @@ def _is_lazy(opt, grad):
     return opt.lazy_update and getattr(grad, "stype", "default") == "row_sparse"
 
 
-@jax.jit
+@_update_kernel(0, 2)
 def _k_nag(w, g, mom, lr, wd, rescale, clip, momentum):
     return _oo.nag_mom_update(w, g, mom, lr, momentum=momentum, wd=wd,
                               rescale_grad=rescale, clip_gradient=clip)
 
 
-@jax.jit
+@_update_kernel(0, 2, 3)
 def _k_adam(w, g, m, v, lr, wd, rescale, clip, beta1, beta2, eps, coef1, coef2):
     # bias correction folded into lr, exactly how the reference class drives
     # the (correction-free) adam_update op
@@ -280,7 +319,7 @@ def _k_adam(w, g, m, v, lr, wd, rescale, clip, beta1, beta2, eps, coef1, coef2):
                            clip_gradient=clip)
 
 
-@jax.jit
+@_update_kernel(0, 2, 3)
 def _k_adamw(w, g, m, v, lr, eta, wd, rescale, clip, beta1, beta2, eps, coef1, coef2):
     g = g * rescale
     g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
@@ -291,20 +330,20 @@ def _k_adamw(w, g, m, v, lr, eta, wd, rescale, clip, beta1, beta2, eps, coef1, c
     return w - eta * (lr * mhat / (jnp.sqrt(vhat) + eps) + wd * w), m2, v2
 
 
-@jax.jit
+@_update_kernel(0, 2)
 def _k_rmsprop(w, g, n, lr, wd, rescale, clip, rho, eps):
     return _oo.rmsprop_update(w, g, n, lr, rho=rho, epsilon=eps, wd=wd,
                               rescale_grad=rescale, clip_gradient=clip)
 
 
-@jax.jit
+@_update_kernel(0, 2, 3, 4)
 def _k_rmsprop_alex(w, g, n, gavg, delta, lr, wd, rescale, clip, rho, momentum, eps):
     return _oo.rmspropalex_update(w, g, n, gavg, delta, lr, rho=rho,
                                   momentum=momentum, epsilon=eps, wd=wd,
                                   rescale_grad=rescale, clip_gradient=clip)
 
 
-@jax.jit
+@_update_kernel(0, 2)
 def _k_adagrad(w, g, h, lr, wd, rescale, clip, eps):
     g = g * rescale
     g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
@@ -313,7 +352,7 @@ def _k_adagrad(w, g, h, lr, wd, rescale, clip, eps):
     return w - lr * g / (jnp.sqrt(h2) + eps), h2
 
 
-@jax.jit
+@_update_kernel(0, 2, 3)
 def _k_adadelta(w, g, acc_g, acc_d, wd, rescale, clip, rho, eps):
     g = g * rescale
     g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
@@ -324,13 +363,13 @@ def _k_adadelta(w, g, acc_g, acc_d, wd, rescale, clip, rho, eps):
     return w - d, acc_g2, acc_d2
 
 
-@jax.jit
+@_update_kernel(0, 2, 3)
 def _k_ftrl(w, g, z, n, lr, wd, rescale, clip, lamda1, beta):
     return _oo.ftrl_update(w, g, z, n, lr, lamda1=lamda1, beta=beta, wd=wd,
                            rescale_grad=rescale, clip_gradient=clip)
 
 
-@jax.jit
+@_update_kernel(0, 2, 3)
 def _k_adamax(w, g, m, u, lr, wd, rescale, clip, beta1, beta2, coef1):
     g = g * rescale
     g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
@@ -340,7 +379,7 @@ def _k_adamax(w, g, m, u, lr, wd, rescale, clip, beta1, beta2, coef1):
     return w - (lr / coef1) * m2 / (u2 + 1e-8), m2, u2
 
 
-@jax.jit
+@_update_kernel(0, 2, 3)
 def _k_nadam(w, g, m, v, lr, wd, rescale, clip, beta1, beta2, eps, mschedule, mnext, coef2):
     g = g * rescale
     g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
@@ -355,21 +394,21 @@ def _k_nadam(w, g, m, v, lr, wd, rescale, clip, beta1, beta2, eps, mschedule, mn
     return w - lr * mbar / (jnp.sqrt(vhat) + eps), m2, v2
 
 
-@jax.jit
+@_update_kernel(0, 2)
 def _k_signum(w, g, mom, lr, wd, rescale, clip, momentum, wd_lh):
     return _oo.signum_update(w, g, mom, lr, momentum=momentum, wd=wd,
                              rescale_grad=rescale, clip_gradient=clip,
                              wd_lh=wd_lh)
 
 
-@jax.jit
+@_update_kernel(0, 2, 3, 4)
 def _k_ftml(w, g, d, v, z, lr, wd, rescale, clip, beta1, beta2, eps, t):
     return _oo.ftml_update(w, g, d, v, z, lr, t, beta1=beta1, beta2=beta2,
                            epsilon=eps, wd=wd, rescale_grad=rescale,
                            clip_grad=clip)
 
 
-@jax.jit
+@_update_kernel()
 def _k_dcasgd(w, g, prev_w, lr, wd, rescale, clip, lamda):
     g = g * rescale
     g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
@@ -378,7 +417,7 @@ def _k_dcasgd(w, g, prev_w, lr, wd, rescale, clip, lamda):
     return w - lr * (g + comp), w
 
 
-@jax.jit
+@_update_kernel(0)
 def _k_sgld(w, g, noise, lr, wd, rescale, clip):
     g = g * rescale
     g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
@@ -390,7 +429,7 @@ def _norm(x):
     return jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
 
 
-@jax.jit
+@_update_kernel(0, 2)
 def _k_lars(w, g, mom, lr, wd, rescale, clip, momentum, eta, eps):
     g = g * rescale
     g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
@@ -402,7 +441,7 @@ def _k_lars(w, g, mom, lr, wd, rescale, clip, momentum, eta, eps):
     return w - mom2, mom2
 
 
-@jax.jit
+@_update_kernel(0, 2, 3)
 def _k_lamb(w, g, m, v, lr, wd, rescale, clip, beta1, beta2, eps, coef1, coef2,
             lower, upper, bias_correction):
     g = g * rescale

@@ -169,6 +169,19 @@ def dumps(reset=False, format="table") -> str:
     return "\n".join(lines)
 
 
+def compilation_stats(reset=False) -> dict:
+    """Shared compilation-engine counters: cache hits/misses, retraces,
+    artifact builds + compile seconds, compiled forward/backward execution
+    counts, and optimizer buffer-donation counts (engine.cache_stats()).
+    Compile durations also land in the aggregate table under the
+    'compilation' category while the engine builds artifacts."""
+    from . import engine as _engine
+    st = _engine.cache_stats()
+    if reset:
+        _engine.reset_stats()
+    return st
+
+
 def dump(finished=True, profile_process="worker"):
     """Write chrome://tracing JSON (reference DumpProfile profiler.h:299)."""
     with _stats_lock:

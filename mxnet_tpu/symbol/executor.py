@@ -3,11 +3,16 @@
 Reference: src/executor/graph_executor.cc (Bind :2043, SimpleBind :1959,
 Forward :80, Backward :93) + python/mxnet/executor.py. TPU-native redesign
 (SURVEY.md §7): instead of a memory-planned per-op engine schedule, ``bind``
-lowers the whole DAG to ONE jitted XLA computation per (is_train) mode;
-``backward`` is a second jitted computation that rematerializes the forward
-and applies the VJP (the reference's mirror-recompute, gradient.cc:147, as the
-default — XLA's scheduler handles memory planning/fusion that the reference's
-MXPlanMemory/FusePointwise passes did by hand).
+lowers the whole DAG to ONE jitted XLA computation per (is_train) mode.
+A training ``forward`` runs the `jax.vjp`-based artifact that also emits the
+VJP residuals; ``backward`` then invokes the compiled pullback on those
+residuals — the forward computation runs exactly once per step (the
+reference's single-GraphExecutor-artifact contract). The old
+rematerialize-the-forward backward (the reference's mirror-recompute,
+gradient.cc:147) remains only as a fallback for ``backward`` calls with no
+preceding training forward. Compiled runners are shared process-wide through
+``mxnet_tpu.engine`` keyed on the symbol-graph fingerprint, so N executors
+bound to the same graph compile once.
 
 BatchNorm auxiliary-state semantics (reference mutates aux in-op): the
 executor computes the momentum blend of the batch statistics as extra traced
@@ -26,6 +31,7 @@ from ..base import MXNetError, default_dtype
 from ..context import Context, current_context
 from ..ndarray import NDArray
 from .. import ndarray as nd
+from .. import engine as _engine
 from .symbol import (Symbol, _Node, _num_outputs, _resolved_params,
                      _op_param_names)
 
@@ -43,6 +49,7 @@ def _graph_runner(symbol: Symbol, is_train: bool):
     heads = symbol._heads
 
     def run(arg_vals: Tuple, aux_vals: Tuple, rng_key):
+        _engine.record_trace()
         env: Dict[int, Tuple] = {}
         for node, val in zip(arg_nodes, arg_vals):
             env[id(node)] = (val,)
@@ -81,6 +88,45 @@ def _graph_runner(symbol: Symbol, is_train: bool):
     return run, arg_nodes, aux_nodes, rng_nodes
 
 
+class _VjpArtifact:
+    """Compiled train-mode forward+pullback pair for one (graph, wrt) key:
+    ``fwd_res`` emits (outputs, aux updates, residuals); ``bwd`` applies the
+    pullback to saved residuals without re-running the forward."""
+
+    __slots__ = ("fwd_res", "bwd", "arg_nodes", "aux_nodes")
+
+    def __init__(self, symbol: Symbol, wrt_names: Tuple[str, ...]):
+        run, arg_nodes, aux_nodes, _ = _graph_runner(symbol, True)
+        arg_names_all = [n.name for n in arg_nodes]
+        wrt_idx = [arg_names_all.index(n) for n in wrt_names]
+        holder = {"treedef": None}
+
+        def fwd_res(arg_vals, aux_vals, rng_key):
+            sel = tuple(arg_vals[i] for i in wrt_idx)
+
+            def f(sel_vals):
+                vals = list(arg_vals)
+                for i, v in zip(wrt_idx, sel_vals):
+                    vals[i] = v
+                return run(tuple(vals), aux_vals, rng_key)
+
+            outs, vjp_fn, upd = jax.vjp(f, sel, has_aux=True)
+            leaves, treedef = jax.tree_util.tree_flatten(vjp_fn)
+            holder["treedef"] = treedef
+            return outs, upd, tuple(leaves)
+
+        def bwd(res_leaves, cots):
+            vjp_fn = jax.tree_util.tree_unflatten(holder["treedef"],
+                                                  list(res_leaves))
+            (grads,) = vjp_fn(tuple(cots))
+            return grads
+
+        self.fwd_res = jax.jit(fwd_res)
+        self.bwd = jax.jit(bwd)
+        self.arg_nodes = arg_nodes
+        self.aux_nodes = aux_nodes
+
+
 class Executor:
     """Holds bound argument/gradient/aux arrays + the compiled graph."""
 
@@ -98,6 +144,9 @@ class Executor:
         self.outputs: List[NDArray] = []
         self._runner_cache: Dict[bool, Any] = {}
         self._bwd_cache: Dict[Any, Any] = {}
+        self._vjp_artifact: Optional[_VjpArtifact] = None
+        self._residuals: Optional[Tuple] = None
+        self._fingerprint_memo: Optional[str] = None
         self._rng_seed = 0
         self._last_key = None
         self._monitor_callback = None
@@ -198,14 +247,45 @@ class Executor:
         return dict(zip(self._symbol.list_outputs(), self.outputs))
 
     # -- execution -----------------------------------------------------------
+    def _fingerprint(self) -> str:
+        if self._fingerprint_memo is None:
+            try:
+                self._fingerprint_memo = _engine.graph_fingerprint(
+                    self._symbol.tojson())
+            except Exception:
+                # unserializable graph: private (per-instance) cache keys
+                self._fingerprint_memo = f"executor-{id(self)}"
+        return self._fingerprint_memo
+
     def _fwd(self, is_train: bool):
         cached = self._runner_cache.get(is_train)
         if cached is None:
-            run, arg_nodes, aux_nodes, rng_nodes = _graph_runner(
-                self._symbol, is_train)
-            cached = (jax.jit(run), arg_nodes, aux_nodes, rng_nodes)
+            key = ("executor", self._fingerprint(), bool(is_train))
+            cached = _engine.lookup(key)
+            if cached is None:
+                with _engine.compile_timer("executor:fwd"):
+                    run, arg_nodes, aux_nodes, rng_nodes = _graph_runner(
+                        self._symbol, is_train)
+                    cached = (jax.jit(run), arg_nodes, aux_nodes, rng_nodes)
+                _engine.insert(key, cached)
             self._runner_cache[is_train] = cached
         return cached
+
+    def _wrt_names(self) -> Tuple[str, ...]:
+        return tuple(n for n in self._symbol.list_arguments()
+                     if self._grad_req.get(n, "null") != "null")
+
+    def _fwd_vjp(self) -> _VjpArtifact:
+        art = self._vjp_artifact
+        if art is None:
+            key = ("executor_vjp", self._fingerprint(), self._wrt_names())
+            art = _engine.lookup(key)
+            if art is None:
+                with _engine.compile_timer("executor:vjp"):
+                    art = _VjpArtifact(self._symbol, self._wrt_names())
+                _engine.insert(key, art)
+            self._vjp_artifact = art
+        return art
 
     def _next_key(self):
         self._rng_seed += 1
@@ -224,13 +304,27 @@ class Executor:
                 raise MXNetError(f"forward: unknown argument {k}")
             self.arg_dict[k]._set_data(
                 (v.handle if isinstance(v, NDArray) else jnp.asarray(v)))
-        fn, arg_nodes, aux_nodes, _ = self._fwd(bool(is_train))
+        use_vjp = bool(is_train) and bool(self._wrt_names())
+        if use_vjp:
+            # training forward through the vjp artifact: outputs + aux
+            # updates + residuals in ONE compiled call; backward() replays
+            # the pullback on the saved residuals (no forward recompute)
+            art = self._fwd_vjp()
+            arg_nodes, aux_nodes = art.arg_nodes, art.aux_nodes
+        else:
+            fn, arg_nodes, aux_nodes, _ = self._fwd(bool(is_train))
         arg_vals = tuple(self.arg_dict[n.name].handle for n in arg_nodes)
         aux_vals = tuple(self.aux_dict[n.name].handle for n in aux_nodes)
         key = self._next_key()
         if is_train:
             self._last_key = key
-        outs, aux_upd = fn(arg_vals, aux_vals, key)
+        if use_vjp:
+            outs, aux_upd, res = art.fwd_res(arg_vals, aux_vals, key)
+            self._residuals = (art, res,
+                               tuple((tuple(o.shape), o.dtype) for o in outs))
+        else:
+            outs, aux_upd = fn(arg_vals, aux_vals, key)
+        _engine.record_execution("fwd")
         if is_train:
             for node, newv in zip(aux_nodes, aux_upd):
                 self.aux_dict[node.name]._set_data(newv)
@@ -240,12 +334,64 @@ class Executor:
                 self._monitor_callback(name, arr)
         return self.outputs
 
+    def _head_cotangents(self, out_grads, out_avals):
+        """Normalize user head grads into concrete cotangents matching the
+        forward outputs' shapes AND dtypes (a mismatched-dtype out_grads
+        must cast, never reuse a stale compiled entry — the cache key
+        includes dtypes and the values are cast before the pullback)."""
+        nout = len(out_avals)
+        if out_grads is None:
+            heads: List[Optional[Any]] = [None] * nout
+        else:
+            if isinstance(out_grads, (NDArray, jnp.ndarray, _np.ndarray)):
+                out_grads = [out_grads]
+            heads = [g.handle if isinstance(g, NDArray) else jnp.asarray(g)
+                     for g in out_grads]
+        return tuple(
+            jnp.ones(s, t) if h is None
+            else (h if h.dtype == t else h.astype(t))
+            for h, (s, t) in zip(heads, out_avals))
+
     def backward(self, out_grads=None, is_train: bool = True):
-        wrt_names = [n for n in self._symbol.list_arguments()
-                     if self._grad_req.get(n, "null") != "null"]
+        wrt_names = list(self._wrt_names())
         if not wrt_names:
             return
-        key = tuple(wrt_names)
+        if self._residuals is not None:
+            # hot path: compiled pullback over the residuals saved by the
+            # last training forward
+            art, res, out_avals = self._residuals
+            heads = self._head_cotangents(out_grads, out_avals)
+            grads = art.bwd(res, heads)
+            _engine.record_execution("bwd")
+        else:
+            grads = self._backward_recompute(wrt_names, out_grads)
+        for name, g in zip(wrt_names, grads):
+            tgt = self.grad_dict[name]
+            if self._grad_req[name] == "add":
+                tgt._set_data(tgt.handle + g)
+            else:
+                tgt._set_data(g)
+
+    def _backward_recompute(self, wrt_names, out_grads):
+        """Fallback for backward() with no preceding training forward:
+        rematerialize the forward from the CURRENT argument values and apply
+        the VJP in one jitted computation (the reference's mirror-recompute
+        mode)."""
+        _, arg_nodes, aux_nodes, _ = self._fwd(True)
+        nout = len(self._symbol._heads)
+        if len(self.outputs) == nout:
+            out_avals = [(tuple(o.shape), o.dtype) for o in self.outputs]
+        else:
+            _, out_s, _, _, out_t, _ = self._symbol._infer(
+                {n.name: tuple(self.arg_dict[n.name].shape)
+                 for n in arg_nodes},
+                {n.name: self.arg_dict[n.name].dtype for n in arg_nodes},
+                partial=True)
+            out_avals = list(zip([tuple(s) for s in out_s], out_t))
+        heads = self._head_cotangents(out_grads, out_avals)
+        # dtypes are part of the key: a second backward() whose out_grads
+        # carry different dtypes must not silently reuse the stale entry
+        key = (tuple(wrt_names), tuple(str(h.dtype) for h in heads))
         cached = self._bwd_cache.get(key)
         if cached is None:
             run, arg_nodes_b, _, _ = _graph_runner(self._symbol, True)
@@ -264,45 +410,16 @@ class Executor:
 
                 outs, vjp = jax.vjp(fn, sel)
                 cot = tuple(
-                    (jnp.ones_like(o) if g is None else g)
+                    g if g.dtype == o.dtype else g.astype(o.dtype)
                     for o, g in zip(outs, head_grads))
                 (grads,) = vjp(cot)
                 return grads
 
             cached = jax.jit(bwd)
             self._bwd_cache[key] = cached
-        _, arg_nodes, aux_nodes, _ = self._fwd(True)
         arg_vals = tuple(self.arg_dict[n.name].handle for n in arg_nodes)
         aux_vals = tuple(self.aux_dict[n.name].handle for n in aux_nodes)
-        nout = len(self._symbol._heads)
-        if out_grads is None:
-            heads: List[Optional[Any]] = [None] * nout
-        else:
-            if isinstance(out_grads, (NDArray, jnp.ndarray, _np.ndarray)):
-                out_grads = [out_grads]
-            heads = [g.handle if isinstance(g, NDArray) else jnp.asarray(g)
-                     for g in out_grads]
-        if any(h is None for h in heads):
-            # jit needs concrete cotangents: ones shaped like the heads. Use
-            # the last forward's outputs when available; else infer once.
-            if len(self.outputs) == nout:
-                shapes = [(o.shape, o.dtype) for o in self.outputs]
-            else:
-                _, out_s, _, _, out_t, _ = self._symbol._infer(
-                    {n.name: tuple(self.arg_dict[n.name].shape)
-                     for n in arg_nodes},
-                    {n.name: self.arg_dict[n.name].dtype for n in arg_nodes},
-                    partial=True)
-                shapes = list(zip(out_s, out_t))
-            heads = [jnp.ones(s, t) if h is None else h
-                     for h, (s, t) in zip(heads, shapes)]
-        grads = cached(arg_vals, aux_vals, self._current_key(), tuple(heads))
-        for name, g in zip(wrt_names, grads):
-            tgt = self.grad_dict[name]
-            if self._grad_req[name] == "add":
-                tgt._set_data(tgt.handle + g)
-            else:
-                tgt._set_data(g)
+        return cached(arg_vals, aux_vals, self._current_key(), heads)
 
     # -- misc API parity ----------------------------------------------------
     def set_monitor_callback(self, callback, monitor_all=False):
