@@ -95,6 +95,7 @@ _LAZY_SUBMODULES = {
     "kvstore": ".kvstore",
     "parallel": ".parallel",
     "profiler": ".profiler",
+    "telemetry": ".telemetry",
     "runtime": ".runtime",
     "test_utils": ".test_utils",
     "image": ".image",

@@ -109,3 +109,59 @@ class LogValidationMetricsCallback:
         for name, value in param.eval_metric.get_name_value():
             logging.info("Epoch[%d] Validation-%s=%f",
                          param.epoch, name, value)
+
+
+class TelemetryCallback:
+    """Periodic telemetry exporter for the fit-loop hooks.
+
+    Use the instance as a `batch_end_callback` (exports the running
+    eval-metric values into `mx_train_metric` gauges and, every `frequent`
+    batches, refreshes the scrape file / log) and its `.epoch_end` bound
+    method as an `epoch_end_callback` / lr_scheduler epoch hook (sets
+    `mx_epoch` and refreshes the export). The per-step metrics themselves
+    (step time, examples/sec, MFU) come from the instrumented fit loops —
+    this callback is the periodic EXPORT vehicle, so it never double-counts
+    steps.
+
+        mod.fit(it, num_epoch=2,
+                batch_end_callback=cb, epoch_end_callback=cb.epoch_end)
+    """
+
+    def __init__(self, frequent=50, scrape_path=None, log_report=False,
+                 enable=True):
+        from . import telemetry
+        self._telem = telemetry
+        if enable:
+            telemetry.enable()
+        self.frequent = int(frequent)
+        self.scrape_path = scrape_path
+        self.log_report = log_report
+        self._nbatch = 0
+
+    def _export(self):
+        if self.scrape_path:
+            tmp = f"{self.scrape_path}.tmp"
+            with open(tmp, "w") as f:
+                f.write(self._telem.scrape())
+            import os
+            os.replace(tmp, self.scrape_path)
+        if self.log_report:
+            logging.info("telemetry:\n%s", self._telem.report())
+
+    def __call__(self, param):
+        t = self._telem
+        if not t._ENABLED:
+            return
+        if getattr(param, "eval_metric", None) is not None:
+            for name, value in param.eval_metric.get_name_value():
+                if value == value:  # skip NaN (empty metric)
+                    t.gauge("mx_train_metric", "Running training metric",
+                            ("name",)).labels(name).set(value)
+        self._nbatch += 1
+        if self.frequent and self._nbatch % self.frequent == 0:
+            self._export()
+
+    def epoch_end(self, iter_no, sym=None, arg=None, aux=None):
+        if self._telem._ENABLED:
+            self._telem.set_epoch(iter_no + 1)
+            self._export()

@@ -30,7 +30,7 @@ from typing import Any, Dict, Optional, Tuple
 __all__ = ["lookup", "insert", "clear_compilation_cache", "cache_stats",
            "reset_stats", "donation_enabled", "record_donation",
            "compile_timer", "record_trace", "record_execution",
-           "structural_fingerprint", "graph_fingerprint"]
+           "estimate_cost", "structural_fingerprint", "graph_fingerprint"]
 
 
 _LOCK = threading.RLock()
@@ -45,6 +45,9 @@ _STATS = {
     "fwd_executions": 0,  # compiled forward invocations (gluon cached path)
     "bwd_executions": 0,  # compiled pullback invocations (no fwd recompute)
     "donated_updates": 0, # optimizer update calls that donated buffers
+    "flops_executed": 0.0,  # cost_analysis FLOPs of executed artifacts
+                            # (telemetry's MFU numerator; 0 when telemetry
+                            # is off — costs are only captured then)
 }
 
 
@@ -128,7 +131,7 @@ def cache_stats() -> Dict[str, Any]:
 def reset_stats():
     with _LOCK:
         for k in _STATS:
-            _STATS[k] = 0.0 if k == "compile_seconds" else 0
+            _STATS[k] = 0.0 if isinstance(_STATS[k], float) else 0
 
 
 def _bump(key, n=1):
@@ -140,8 +143,33 @@ def record_trace():
     _bump("traces")
 
 
-def record_execution(kind: str):
-    _bump("fwd_executions" if kind == "fwd" else "bwd_executions")
+def record_execution(kind: str, flops: float = 0.0):
+    with _LOCK:
+        _STATS["fwd_executions" if kind == "fwd" else "bwd_executions"] += 1
+        if flops:
+            _STATS["flops_executed"] += flops
+
+
+def estimate_cost(jitted, *args) -> Dict[str, float]:
+    """XLA cost-model estimate for a jitted callable at example args:
+    ``{"flops": ..., "bytes_accessed": ...}`` (empty when the backend has no
+    cost model). Captured ONCE per artifact at build time while telemetry is
+    enabled — the AOT lower+compile shares XLA's compilation caches, and the
+    result feeds the MFU/roofline gauges (mx_mfu, mx_model_flops_per_second).
+    """
+    try:
+        c = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(c, (list, tuple)):
+            c = c[0] if c else {}
+        out = {}
+        for src, dst in (("flops", "flops"),
+                         ("bytes accessed", "bytes_accessed")):
+            v = c.get(src)
+            if v is not None and float(v) >= 0:
+                out[dst] = float(v)
+        return out
+    except Exception:
+        return {}
 
 
 @contextmanager

@@ -34,6 +34,7 @@ from .. import ndarray as nd
 from .. import autograd
 from .. import engine as _engine
 from .. import random as _rng
+from .. import telemetry as _telem
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
 
@@ -318,7 +319,7 @@ class _CachedGraph:
 
     __slots__ = ("fwd", "fwd_res", "bwd", "bwd_recompute", "out_treedef",
                  "res_treedef", "aux_paths", "aux_params_builder",
-                 "builder_id")
+                 "builder_id", "cost")
 
     def __init__(self):
         self.fwd = None
@@ -330,6 +331,7 @@ class _CachedGraph:
         self.aux_paths = None          # set on first trace
         self.aux_params_builder = None
         self.builder_id = None
+        self.cost = None               # cost_analysis capture (telemetry on)
 
 
 class HybridBlock(Block):
@@ -518,12 +520,17 @@ class HybridBlock(Block):
         # activation memory. Default is the residual-caching vjp artifact.
         remat = os.environ.get("MXNET_TPU_REMAT_BWD", "") not in ("", "0")
         all_raw = tuple(raw_inputs) + tuple(raw_params)
+        if _telem._ENABLED and graph.cost is None:
+            # artifact-build-time FLOPs capture for the MFU/roofline gauges
+            # (one AOT lower+compile per artifact, shared with jax's caches)
+            graph.cost = _engine.estimate_cost(graph.fwd, key, *all_raw)
         res = None
         if recording and not remat:
             outs_flat, aux_vals, res = graph.fwd_res(key, *all_raw)
         else:
             outs_flat, aux_vals = graph.fwd(key, *all_raw)
-        _engine.record_execution("fwd")
+        fwd_flops = (graph.cost or {}).get("flops", 0.0)
+        _engine.record_execution("fwd", fwd_flops)
         if entry[1] is None:
             aux_params = self._resolve_aux_params(graph)
             if aux_params is None:
@@ -538,6 +545,10 @@ class HybridBlock(Block):
                                                   sig)
                     _engine.insert(cache_key, graph)
                 entry[0] = graph
+                if _telem._ENABLED and graph.cost is None:
+                    graph.cost = _engine.estimate_cost(graph.fwd, key,
+                                                       *all_raw)
+                    fwd_flops = (graph.cost or {}).get("flops", 0.0)
                 if recording and not remat:
                     outs_flat, aux_vals, res = graph.fwd_res(key, *all_raw)
                 else:
@@ -555,8 +566,14 @@ class HybridBlock(Block):
             param_nds = [p._data for p in plist]
             out_dtypes = [o.dtype for o in outs_flat]
 
+            # backward FLOPs ~ 2x forward (the standard roofline convention;
+            # docs/observability.md) — exact per-artifact pullback costs
+            # would need a second lower at first-backward time
+            bwd_flops = 2.0 * fwd_flops
+
             if res is not None:
-                def vjp_fn(cots, _graph=graph, _res=res, _dts=out_dtypes):
+                def vjp_fn(cots, _graph=graph, _res=res, _dts=out_dtypes,
+                           _fl=bwd_flops):
                     cots_t = cots if isinstance(cots, tuple) else (cots,)
                     # the compiled pullback's cotangent avals are fixed;
                     # cast mismatched head grads instead of tripping a
@@ -565,17 +582,17 @@ class HybridBlock(Block):
                         c if getattr(c, "dtype", None) == dt else
                         jnp.asarray(c, dt)
                         for c, dt in zip(cots_t, _dts))
-                    _engine.record_execution("bwd")
+                    _engine.record_execution("bwd", _fl)
                     return _graph.bwd(_res, cots_t)
             else:
                 def vjp_fn(cots, _graph=graph, _key=key, _all_raw=all_raw,
-                           _dts=out_dtypes):
+                           _dts=out_dtypes, _fl=bwd_flops):
                     cots_t = cots if isinstance(cots, tuple) else (cots,)
                     cots_t = tuple(
                         c if getattr(c, "dtype", None) == dt else
                         jnp.asarray(c, dt)
                         for c, dt in zip(cots_t, _dts))
-                    _engine.record_execution("bwd")
+                    _engine.record_execution("bwd", _fl)
                     return _graph.bwd_recompute(_key, _all_raw, cots_t)
 
             autograd.record_op(vjp_fn, input_nds + param_nds, out_nds,

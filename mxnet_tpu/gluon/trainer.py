@@ -15,6 +15,7 @@ from ..base import MXNetError
 from .. import engine as _engine
 from .. import optimizer as opt_mod
 from .. import kvstore as kvs_mod
+from .. import telemetry as _telem
 from .parameter import Parameter, ParameterDict
 
 
@@ -112,6 +113,13 @@ class Trainer:
         if t0 is not None:
             _profiler._record("trainer.step", "trainer", t0,
                               time.perf_counter())
+        if _telem._ENABLED:
+            # step() is the once-per-iteration sync point: the inter-step
+            # interval telemetry derives here covers the WHOLE eager loop
+            # (forward + backward + update), and the engine's executed-FLOPs
+            # delta over the same window yields the MFU estimate
+            _telem.record_step(batch_size, source="trainer",
+                               lr=float(self._optimizer.learning_rate))
 
     def allreduce_grads(self):
         if not self._kv_initialized:

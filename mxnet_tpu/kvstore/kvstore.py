@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .. import optimizer as opt_mod
+from .. import telemetry as _telem
 
 
 from .._dist_util import dist_client_active as _dist_client_active
@@ -95,6 +96,11 @@ class KVStore:
         stores, allgather-sum in KVStoreDist."""
         return merged
 
+    # telemetry (mx.telemetry): each public comm entry point is decorated
+    # with bytes-moved/timing accounting + an xplane TraceAnnotation; the
+    # scopes are re-entrant so pushpull -> push/pull counts once. Disabled
+    # cost: one wrapper call + module-flag check per call.
+    @_telem.instrument_comm("push")
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
@@ -112,6 +118,7 @@ class KVStore:
                 self._store[k]._set_data(
                     merged._data.astype(self._store[k].dtype))
 
+    @_telem.instrument_comm("pull")
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)
         for k, o in zip(keys, outs):
@@ -120,6 +127,7 @@ class KVStore:
             for t in olist:
                 t._set_data(src._data.astype(t.dtype))
 
+    @_telem.instrument_comm("pushpull")
     def pushpull(self, key, value, out=None, priority=0):
         """Fused allreduce-style op (reference MXKVStorePushPullEx)."""
         keys, values = self._normalize(key, value)
@@ -160,6 +168,7 @@ class KVStore:
                 f"the table {tuple(table_shape)} nor the gathered rows "
                 f"{tuple(rows.shape)}")
 
+    @_telem.instrument_comm("row_sparse_pull")
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only given rows (reference kvstore.h:236). Dense-backed: the
         rows are gathered on device via XLA take."""
@@ -173,6 +182,7 @@ class KVStore:
                 rows = jnp.take(src._data, idx, axis=0)
                 self._fill_rows_out(t, rows, idx, src.shape)
 
+    @_telem.instrument_comm("broadcast")
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)
         self.pull(key, out, priority)
@@ -399,6 +409,7 @@ class KVStoreDist(KVStore):
                     self._ps_client.wait_ready(self._home(k), k)
 
     # -- async (parameter-server) paths -------------------------------------
+    @_telem.instrument_comm("push")
     def push(self, key, value, priority=0):
         if self._ps_client is None:
             return super().push(key, value, priority)
@@ -420,6 +431,7 @@ class KVStoreDist(KVStore):
                 raise MXNetError(
                     f"dist_async push of key {k} failed: {resp}")
 
+    @_telem.instrument_comm("pull")
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if self._ps_client is None:
             return super().pull(key, out, priority, ignore_sparse)
@@ -430,6 +442,7 @@ class KVStoreDist(KVStore):
             for t in olist:
                 t._set_data(jnp.asarray(cur).astype(t.dtype))
 
+    @_telem.instrument_comm("pushpull")
     def pushpull(self, key, value, out=None, priority=0):
         if self._ps_client is None:
             return super().pushpull(key, value, out, priority)
@@ -437,6 +450,7 @@ class KVStoreDist(KVStore):
         if out is not None:
             self.pull(key, out, priority)
 
+    @_telem.instrument_comm("row_sparse_pull")
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         if self._ps_client is None:
             return super().row_sparse_pull(key, out, priority, row_ids)

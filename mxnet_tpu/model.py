@@ -100,19 +100,27 @@ class FeedForward:
         for k, v in self.kwargs.items():
             if k != "optimizer_params":
                 opt_params[k] = v
-        self._module.fit(data, eval_data=eval_data, eval_metric=eval_metric,
-                         epoch_end_callback=epoch_end_callback,
-                         batch_end_callback=batch_end_callback,
-                         kvstore=kvstore, optimizer=self.optimizer,
-                         optimizer_params=tuple(opt_params.items()),
-                         initializer=self.initializer,
-                         arg_params=self.arg_params,
-                         aux_params=self.aux_params,
-                         begin_epoch=self.begin_epoch,
-                         num_epoch=self.num_epoch,
-                         eval_end_callback=eval_end_callback,
-                         eval_batch_end_callback=eval_batch_end_callback,
-                         monitor=monitor)
+        import contextlib
+        from . import telemetry as _telem
+        # whole-fit wall time into mx_phase_seconds; the inner epoch loop
+        # (BaseModule.fit) reports the per-step metrics
+        phase = _telem.timed("fit", "feedforward") if _telem._ENABLED \
+            else contextlib.nullcontext()
+        with phase:
+            self._module.fit(data, eval_data=eval_data,
+                             eval_metric=eval_metric,
+                             epoch_end_callback=epoch_end_callback,
+                             batch_end_callback=batch_end_callback,
+                             kvstore=kvstore, optimizer=self.optimizer,
+                             optimizer_params=tuple(opt_params.items()),
+                             initializer=self.initializer,
+                             arg_params=self.arg_params,
+                             aux_params=self.aux_params,
+                             begin_epoch=self.begin_epoch,
+                             num_epoch=self.num_epoch,
+                             eval_end_callback=eval_end_callback,
+                             eval_batch_end_callback=eval_batch_end_callback,
+                             monitor=monitor)
         self.arg_params, self.aux_params = self._module.get_params()
         return self
 

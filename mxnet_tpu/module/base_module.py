@@ -14,6 +14,7 @@ from typing import Any, Callable, List, Optional
 from ..base import MXNetError
 from .. import metric as metric_mod
 from .. import io as io_mod
+from .. import telemetry as _telem
 
 
 class BatchEndParam:
@@ -175,11 +176,17 @@ class BaseModule:
             eval_metric.reset()
             nbatch = 0
             train_data.reset()
+            if _telem._ENABLED:
+                _telem.set_epoch(epoch)
             for data_batch in train_data:
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                if _telem._ENABLED:
+                    d = getattr(data_batch, "data", None)
+                    _telem.record_step(int(d[0].shape[0]) if d else 0,
+                                       source="module")
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()

@@ -7,9 +7,17 @@ from __future__ import annotations
 
 import logging
 import re
+import warnings
 from typing import Callable, List, Optional, Tuple
 
 from .ndarray import NDArray
+
+_HYBRID_MSG = (
+    "Monitor taps on a hybridized HybridBlock see nothing: the fused engine "
+    "path runs one compiled artifact and bypasses per-child forward hooks "
+    "(they only fire during the trace, with abstract values). Call "
+    "hybridize(active=False) on the monitored block, or install the monitor "
+    "on an un-hybridized copy for debugging.")
 
 
 class Monitor:
@@ -25,8 +33,20 @@ class Monitor:
         self.queue: List[Tuple[int, str, NDArray]] = []
         self.step = 0
         self.exes = []
+        self.blocks = []
+        self._warned_hybrid = False
         self.re_prog = re.compile(pattern)
         self.sort = sort
+
+    def _check_hybridized(self):
+        if self._warned_hybrid:
+            return
+        hyb = [type(b).__name__ for b in self.blocks
+               if getattr(b, "_active", False)]
+        if hyb:
+            self._warned_hybrid = True
+            warnings.warn(f"{_HYBRID_MSG} (hybridized: {hyb})", UserWarning,
+                          stacklevel=3)
 
     def install(self, exe):
         """Attach to an Executor (reference monitor.py:79 install_to_executor)."""
@@ -40,6 +60,7 @@ class Monitor:
 
     def tic(self):
         """Start collecting for this batch if due (reference monitor.py:87)."""
+        self._check_hybridized()
         if self.step % self.interval == 0:
             for exe in self.exes:
                 for arr in exe.arg_arrays:
@@ -78,9 +99,22 @@ class Monitor:
     def install_block(self, block):
         """Attach to a gluon Block via forward hooks: records the same
         mean-|x| statistics per child block output (the gluon-era analog of
-        install_to_executor; reference monitor only covered executors)."""
+        install_to_executor; reference monitor only covered executors).
+
+        NOTE: a hybridized HybridBlock's fused engine path bypasses forward
+        hooks (one compiled artifact per signature — children never run
+        eagerly), so taps see nothing; install/tic raise a UserWarning in
+        that case instead of silently returning empty stats."""
+        self.blocks.append(block)
+        self._check_hybridized()
+
         def hook(blk, inputs, output, _prefix=getattr(block, "_prefix", "")):
             if not self.activated:
+                return
+            from .gluon.block import in_trace
+            if in_trace():
+                # fused-path trace: outputs are abstract tracers; recording
+                # them would leak tracers into toc()/asnumpy
                 return
             name = getattr(blk, "_prefix", "") or type(blk).__name__
             if not self.re_prog.match(name):

@@ -252,6 +252,15 @@ class _UpdateKernel:
                 donate_argnums=self._donate if self._donating else ())
         if self._donating:
             _engine.record_donation(len(self._donate))
+            from .. import telemetry as _telem
+            if _telem._ENABLED:
+                # donation savings: bytes NOT double-allocated because the
+                # donated inputs alias their outputs in place
+                _telem.counter(
+                    "mx_donation_saved_bytes_total",
+                    "Buffer bytes aliased in place by donated updates") \
+                    .inc(sum(getattr(args[i], "nbytes", 0)
+                             for i in self._donate))
         return self._jit(*args)
 
 

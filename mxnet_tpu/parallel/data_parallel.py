@@ -21,7 +21,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .. import autograd
+from .. import engine as _engine
 from .. import random as _rng
+from .. import telemetry as _telem
 from ..gluon.block import HybridBlock, _AUX_STACK
 from ..gluon.parameter import Parameter
 from .. import optimizer as opt_mod
@@ -329,6 +331,11 @@ class DataParallelTrainer:
                            for w, t in zip(self._params_raw, self._trainable)]
         self._t = 0
         self._step_jit: Dict[Any, Callable] = {}
+        # telemetry: per-signature cost_analysis of the fused step (captured
+        # once, only while enabled) + the dp-degree for comm accounting
+        self._step_cost: Dict[Any, Dict[str, float]] = {}
+        self._dp_degree = int(dict(self.mesh.shape).get(batch_axis_name, 1))
+        self._ar_bytes: Optional[int] = None
 
         # shardings: params per their spec (default replicated)
         self._param_shardings = [
@@ -449,6 +456,29 @@ class DataParallelTrainer:
             return jax.device_put(arr, sharding)
         return jax.make_array_from_process_local_data(
             sharding, _np.asarray(arr))
+
+    # -- telemetry -----------------------------------------------------------
+    def _grad_allreduce_bytes(self) -> int:
+        """Wire bytes of the per-step gradient all-reduce over the dp axis
+        (ring estimate: 2*(n-1)/n of the trainable-param footprint)."""
+        if self._ar_bytes is None:
+            n = self._dp_degree
+            total = sum(int(w.nbytes) for w, t in
+                        zip(self._params_raw, self._trainable) if t)
+            self._ar_bytes = int(total * 2 * (n - 1) / n) if n > 1 else 0
+        return self._ar_bytes
+
+    def _record_telemetry(self, sig, examples, steps, flops_key=None):
+        cost = self._step_cost.get(flops_key if flops_key is not None
+                                   else sig, {})
+        flops = cost.get("flops")
+        if self._dp_degree > 1:
+            _telem.record_comm("allreduce_grads",
+                               self._grad_allreduce_bytes() * steps,
+                               store="mesh", calls=steps)
+        _telem.record_step(examples, source="data_parallel", steps=steps,
+                           flops_per_step=(flops / steps if flops else None),
+                           lr=float(self.optimizer.learning_rate))
 
     # -- loss plumbing -------------------------------------------------------
     def _loss_raw(self, pred_raw, label_raw):
@@ -757,10 +787,20 @@ class DataParallelTrainer:
             spec = P(None, *self.data_spec)
         xr = self._put_batch(xr, NamedSharding(self.mesh, P(*spec[:xr.ndim])))
         yr = self._put_batch(yr, NamedSharding(self.mesh, P(*spec[:yr.ndim])))
-        (self._params_raw, self._opt_state, self._comp_resid, losses,
-         finite, key_out, t_out) = fn(
-            self._params_raw, self._opt_state, self._comp_resid,
-            key_in, xr, yr, lr_in, t_in, scale_in)
+        cost_key = (sig, "multi", n)
+        if _telem._ENABLED and cost_key not in self._step_cost:
+            self._step_cost[cost_key] = _engine.estimate_cost(
+                fn, self._params_raw, self._opt_state, self._comp_resid,
+                key_in, xr, yr, lr_in, t_in, scale_in)
+        with _telem.annotate("mx.dp.run_steps"):
+            (self._params_raw, self._opt_state, self._comp_resid, losses,
+             finite, key_out, t_out) = fn(
+                self._params_raw, self._opt_state, self._comp_resid,
+                key_in, xr, yr, lr_in, t_in, scale_in)
+        if _telem._ENABLED:
+            per_step_batch = xr.shape[1] if stacked else xr.shape[0]
+            self._record_telemetry(sig, per_step_batch * n, n,
+                                   flops_key=cost_key)
         self._t += n
         if not self._is_multiprocess():
             self._key_dev, self._t_dev = key_out, t_out
@@ -787,17 +827,26 @@ class DataParallelTrainer:
             else P(*self.data_spec[:yr.ndim])
         yr = self._put_batch(yr, NamedSharding(self.mesh, y_spec))
         scale = _np.float32(self._scaler.loss_scale if self._scaler else 1.0)
-        if self._compression:
-            (self._params_raw, self._opt_state, self._comp_resid, lossv,
-             finite, aux) = fn(
-                self._params_raw, self._opt_state, self._comp_resid,
-                key, xr, yr, lr, _np.float32(self._t), scale)
-        else:
-            self._params_raw, self._opt_state, lossv, finite, aux = fn(
-                self._params_raw, self._opt_state, key, xr, yr, lr,
-                _np.float32(self._t), scale)
+        t_in = _np.float32(self._t)
+        call_args = ((self._params_raw, self._opt_state, self._comp_resid,
+                      key, xr, yr, lr, t_in, scale) if self._compression
+                     else (self._params_raw, self._opt_state, key, xr, yr,
+                           lr, t_in, scale))
+        if _telem._ENABLED and sig not in self._step_cost:
+            # cost_analysis FLOPs of the fused step, captured once per
+            # signature at artifact-build time (AOT lower shares XLA caches)
+            self._step_cost[sig] = _engine.estimate_cost(fn, *call_args)
+        with _telem.annotate("mx.dp.step"):
+            if self._compression:
+                (self._params_raw, self._opt_state, self._comp_resid, lossv,
+                 finite, aux) = fn(*call_args)
+            else:
+                self._params_raw, self._opt_state, lossv, finite, aux = fn(
+                    *call_args)
         if self._scaler is not None:
             self._scaler.update_scale(not bool(finite))
+        if _telem._ENABLED:
+            self._record_telemetry(sig, bs, 1)
         return lossv
 
     def sync(self):

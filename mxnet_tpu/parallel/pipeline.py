@@ -33,8 +33,10 @@ from jax.sharding import Mesh, NamedSharding
 
 from ..base import MXNetError
 from ..ndarray import NDArray
+from .. import engine as _engine
 from .. import optimizer as opt_mod
 from .. import random as _rng
+from .. import telemetry as _telem
 from .mesh import current_mesh, P
 
 __all__ = ["pipeline_spec", "pipeline_apply", "gpipe_schedule",
@@ -209,6 +211,7 @@ class PipelineTrainer:
                       for i in range(len(self._h_raw))]
         self._t = 0
         self._step_jit = {}
+        self._step_cost = {}
 
     # ------------------------------------------------------------------
     def _loss_raw(self, pred_raw, label_raw):
@@ -349,10 +352,26 @@ class PipelineTrainer:
             self.mesh, P(*data, *([None] * (xr.ndim - 2)))))
         yr = jax.device_put(yr, NamedSharding(
             self.mesh, P(*data, *([None] * (yr.ndim - 2)))))
-        (self._e_raw, self._s_raw, self._h_raw, self._opt_e, self._opt_s,
-         self._opt_h, lossv) = fn(
-            self._e_raw, self._s_raw, self._h_raw, self._opt_e, self._opt_s,
-            self._opt_h, key, xr, yr, lr, _np.float32(self._t))
+        call_args = (self._e_raw, self._s_raw, self._h_raw, self._opt_e,
+                     self._opt_s, self._opt_h, key, xr, yr, lr,
+                     _np.float32(self._t))
+        if _telem._ENABLED and sig not in self._step_cost:
+            self._step_cost[sig] = _engine.estimate_cost(fn, *call_args)
+        with _telem.annotate("mx.pp.step"):
+            (self._e_raw, self._s_raw, self._h_raw, self._opt_e, self._opt_s,
+             self._opt_h, lossv) = fn(*call_args)
+        if _telem._ENABLED:
+            # per-step collective volume: the embed/head grad psum over 'pp'
+            # (the stage-hop ppermute traffic is activation-shaped and
+            # schedule-dependent; the psum'd replicated params dominate)
+            if self.n_stages > 1:
+                rep_bytes = sum(int(w.nbytes) for w in
+                                self._e_raw + self._h_raw)
+                _telem.record_comm("pipeline_grad_psum", rep_bytes,
+                                   store="mesh")
+            flops = self._step_cost.get(sig, {}).get("flops")
+            _telem.record_step(B, source="pipeline", flops_per_step=flops,
+                               lr=float(self.optimizer.learning_rate))
         return lossv
 
     def sync(self):

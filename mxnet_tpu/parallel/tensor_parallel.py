@@ -19,6 +19,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..gluon.block import Block
 from ..gluon.parameter import Parameter
+from .. import telemetry as _telem
 
 
 def column_parallel_spec(axis: str = "tp") -> P:
@@ -43,6 +44,7 @@ def shard_params_megatron(block: Block, rules: Optional[Dict[str, P]] = None,
     rules = rules or default_rules
     compiled = [(re.compile(k), v) for k, v in rules.items()]
     n = 0
+    nbytes = 0
     # structural names ('encoder.layers.0.attn.qkv.weight') — stable and
     # pattern-matchable, unlike the global-counter flat names
     for name, p in block._collect_params_with_prefix().items():
@@ -50,5 +52,14 @@ def shard_params_megatron(block: Block, rules: Optional[Dict[str, P]] = None,
             if pat.match(name):
                 p.sharding = spec
                 n += 1
+                nbytes += _telem.payload_bytes(p._data)
                 break
+    if _telem._ENABLED:
+        # footprint that will ride the TP collectives (all-gather /
+        # reduce-scatter) once the specs take effect under jit
+        _telem.gauge("mx_tp_sharded_params",
+                     "Parameters carrying TP PartitionSpecs").set(n)
+        _telem.counter("mx_tp_sharded_bytes_total",
+                       "Bytes of parameters annotated for TP sharding") \
+            .inc(nbytes)
     return n

@@ -10,13 +10,17 @@ import json
 import os
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from contextlib import contextmanager
 from typing import Optional
 
 import jax
 
 from .base import MXNetError, env
+
+env.declare("MXNET_PROFILER_MAX_EVENTS", 100_000, int,
+            "Ring-buffer cap on retained chrome-trace events; oldest events "
+            "are evicted past the cap (0 disables event retention)")
 
 _config = {
     "filename": "profile.json",
@@ -27,10 +31,19 @@ _config = {
     "profile_api": False,
     "aggregate_stats": True,
 }
-_state = {"running": False, "trace_dir": None}
+_state = {"running": False, "trace_dir": None, "paused": False}
 _stats_lock = threading.Lock()
 _agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])  # count, total, min, max
-_events = []
+# bounded: unbounded growth across a long training run was the old behavior;
+# the deque evicts from the front once the cap is reached
+_events = deque(maxlen=env.get("MXNET_PROFILER_MAX_EVENTS"))
+
+
+def set_max_events(n: int):
+    """Re-cap the event ring buffer, keeping the most recent events."""
+    global _events
+    with _stats_lock:
+        _events = deque(_events, maxlen=max(int(n), 0))
 
 
 def set_config(**kwargs):
@@ -72,6 +85,8 @@ def set_state(state="stop", profile_process="worker"):
 
 
 def _record(name: str, category: str, start: float, end: float):
+    if _state["paused"]:
+        return
     dur_us = (end - start) * 1e6
     with _stats_lock:
         _events.append({"name": name, "cat": category, "ph": "X",
@@ -127,18 +142,27 @@ class Counter:
     def __init__(self, domain, name, value=0):
         self.domain, self.name, self.value = domain, name, value
 
-    def set_value(self, v):
-        self.value = v
-        with _stats_lock:
-            _events.append({"name": self.name, "cat": f"counter:{self.domain.name}",
-                            "ph": "C", "ts": time.perf_counter() * 1e6, "pid": 0,
-                            "args": {"value": v}})
+    def _emit_locked(self, v):
+        _events.append({"name": self.name, "cat": f"counter:{self.domain.name}",
+                        "ph": "C", "ts": time.perf_counter() * 1e6, "pid": 0,
+                        "args": {"value": v}})
 
+    def set_value(self, v):
+        with _stats_lock:
+            self.value = v
+            self._emit_locked(v)
+
+    # read-modify-write under _stats_lock: concurrent increments from
+    # data-loader / callback threads must not lose updates
     def increment(self, d=1):
-        self.set_value(self.value + d)
+        with _stats_lock:
+            self.value += d
+            self._emit_locked(self.value)
 
     def decrement(self, d=1):
-        self.set_value(self.value - d)
+        with _stats_lock:
+            self.value -= d
+            self._emit_locked(self.value)
 
 
 class Marker:
@@ -152,13 +176,21 @@ class Marker:
                             "s": "p"})
 
 
-def dumps(reset=False, format="table") -> str:
-    """Aggregate stats table (reference aggregate_stats.cc)."""
+def dumps(reset=False, format="table", reset_events=None) -> str:
+    """Aggregate stats table (reference aggregate_stats.cc).
+
+    reset=True clears the aggregate table; reset_events (default: follows
+    `reset`) also clears the chrome-trace event buffer, so a periodic
+    dumps(reset=True) no longer leaks events across the run."""
+    if reset_events is None:
+        reset_events = reset
     with _stats_lock:
         rows = [(cat, name, c, tot, tot / max(c, 1), mn, mx)
                 for (cat, name), (c, tot, mn, mx) in sorted(_agg.items())]
         if reset:
             _agg.clear()
+        if reset_events:
+            _events.clear()
     if format == "json":
         return json.dumps([dict(zip(("category", "name", "count", "total_us",
                                      "avg_us", "min_us", "max_us"), r)) for r in rows])
@@ -182,20 +214,26 @@ def compilation_stats(reset=False) -> dict:
     return st
 
 
-def dump(finished=True, profile_process="worker"):
-    """Write chrome://tracing JSON (reference DumpProfile profiler.h:299)."""
+def dump(finished=True, profile_process="worker", reset_events=False):
+    """Write chrome://tracing JSON (reference DumpProfile profiler.h:299).
+    reset_events=True truncates the event buffer after the write."""
     with _stats_lock:
         data = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
     with open(_config["filename"], "w") as f:
         json.dump(data, f)
+    if reset_events:
+        with _stats_lock:
+            _events.clear()
 
 
 def pause(profile_process="worker"):
-    pass
+    """Suppress host-side recording (reference MXProfilePause): scopes,
+    tasks and op-dispatch timings between pause() and resume() are dropped."""
+    _state["paused"] = True
 
 
 def resume(profile_process="worker"):
-    pass
+    _state["paused"] = False
 
 
 if env.get("MXNET_PROFILER_AUTOSTART"):

@@ -20,6 +20,7 @@ def _detect():
         "SHARDING": True,
         "DIST_KVSTORE": True,
         "PROFILER": True,
+        "TELEMETRY": True,
         "OPENMP": False,
         "CUDA": False,
         "CUDNN": False,

@@ -1,0 +1,748 @@
+"""Unified telemetry: a process-wide metrics registry every subsystem
+reports into, plus Prometheus-text / JSON export.
+
+The reference framework's runtime is legible through the profiler's
+aggregate-stats table and KVStore-level comms visibility; this module is the
+unified layer on top of those signals (ROADMAP: "as fast as the hardware
+allows" is unverifiable without them):
+
+  - **training-step metrics** — step time, examples/sec, and an MFU/roofline
+    estimate derived from ``cost_analysis()`` FLOPs captured when the engine
+    builds a compiled artifact (`engine.estimate_cost`). Fed by
+    ``gluon.Trainer.step``, ``Module.fit``, and the fused
+    ``parallel.*Trainer`` steps.
+  - **collective-comms accounting** — bytes moved / calls / wall seconds per
+    kvstore push/pull/pushpull and per fused-step gradient all-reduce, with
+    ``jax.profiler.TraceAnnotation`` regions so the same boundaries show up
+    inside xplane traces (TensorBoard/XProf).
+  - **memory watermarks** — live device-buffer bytes and the process peak,
+    sampled per step while enabled.
+  - **export** — ``scrape()`` (Prometheus text), ``scrape_json()``,
+    ``report()`` (human table unifying the profiler aggregate table and the
+    compilation-cache counters), and ``start_http_server()`` for a real
+    ``GET /metrics`` endpoint.
+
+The registry is OFF by default. Every instrumentation site guards on the
+module attribute ``_ENABLED`` (the same one-check-per-call idiom as
+``ops/registry.py:_profile_hook``), so the disabled path costs one dict
+lookup + branch; ``BENCH_SCENARIO=telemetry_overhead`` in bench.py proves
+the enabled path stays under 2% of eager step time.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import threading
+import time
+from bisect import bisect_left
+from collections import OrderedDict
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError, env
+
+__all__ = [
+    "enable", "disable", "is_enabled", "counter", "gauge", "histogram",
+    "get_metric", "reset", "collect", "scrape", "scrape_json", "report",
+    "record_step", "record_comm", "comm_scope", "instrument_comm",
+    "payload_bytes", "sample_memory", "peak_flops", "set_epoch", "timed",
+    "annotate", "start_http_server", "stop_http_server",
+]
+
+env.declare("MXNET_TELEMETRY", False, bool,
+            "Enable the telemetry registry at import")
+env.declare("MXNET_TELEMETRY_MAX_SERIES", 512, int,
+            "Max label combinations kept per metric family; excess series "
+            "are dropped and counted in mx_telemetry_dropped_series_total")
+env.declare("MXNET_TELEMETRY_PEAK_FLOPS", 0.0, float,
+            "Roofline peak FLOP/s used for the MFU gauge; overrides the "
+            "per-device-kind table (set this on CPU, where XLA's cost model "
+            "has no meaningful peak)")
+
+_LOCK = threading.RLock()
+_FAMILIES: "OrderedDict[str, MetricFamily]" = OrderedDict()
+
+# the one flag every instrumentation site checks (module-attribute lookup +
+# branch while disabled — the _profile_hook None-check idiom)
+_ENABLED = bool(env.get("MXNET_TELEMETRY"))
+
+
+def enable():
+    """Turn instrumentation on (all sites start reporting)."""
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+# ---------------------------------------------------------------------------
+# Metric model: family (name + label names) -> labeled series
+# ---------------------------------------------------------------------------
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+
+def _fmt_labels(names: Tuple[str, ...], values: Tuple[str, ...],
+                extra: str = "") -> str:
+    parts = [f'{n}="{_escape(v)}"' for n, v in zip(names, values)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _NullSeries:
+    """Returned past the cardinality cap: absorbs writes silently."""
+
+    def inc(self, n=1):
+        pass
+
+    def dec(self, n=1):
+        pass
+
+    def set(self, v):
+        pass
+
+    def set_max(self, v):
+        pass
+
+    def observe(self, v):
+        pass
+
+
+_NULL = _NullSeries()
+
+
+class _CounterSeries:
+    __slots__ = ("label_values", "value")
+
+    def __init__(self, label_values):
+        self.label_values = label_values
+        self.value = 0.0
+
+    def inc(self, n=1):
+        if n < 0:
+            raise MXNetError("counters only go up; use a gauge")
+        with _LOCK:
+            self.value += n
+
+
+class _GaugeSeries:
+    __slots__ = ("label_values", "value")
+
+    def __init__(self, label_values):
+        self.label_values = label_values
+        self.value = 0.0
+
+    def set(self, v):
+        with _LOCK:
+            self.value = float(v)
+
+    def set_max(self, v):
+        """Watermark update: keep the running maximum."""
+        with _LOCK:
+            self.value = max(self.value, float(v))
+
+    def inc(self, n=1):
+        with _LOCK:
+            self.value += n
+
+    def dec(self, n=1):
+        with _LOCK:
+            self.value -= n
+
+
+class _HistogramSeries:
+    __slots__ = ("label_values", "buckets", "counts", "sum", "count")
+
+    def __init__(self, label_values, buckets):
+        self.label_values = label_values
+        self.buckets = buckets            # sorted upper bounds, no +Inf
+        self.counts = [0] * (len(buckets) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v):
+        v = float(v)
+        with _LOCK:
+            self.counts[bisect_left(self.buckets, v)] += 1
+            self.sum += v
+            self.count += 1
+
+
+class MetricFamily:
+    kind = "untyped"
+    _series_cls = _GaugeSeries
+
+    def __init__(self, name: str, doc: str = "",
+                 labelnames: Sequence[str] = (),
+                 max_series: Optional[int] = None):
+        self.name = name
+        self.doc = doc
+        self.labelnames = tuple(labelnames)
+        self.max_series = max_series if max_series is not None \
+            else int(env.get("MXNET_TELEMETRY_MAX_SERIES"))
+        self._series: Dict[Tuple[str, ...], Any] = {}
+        self.dropped = 0
+
+    def labels(self, *values, **kv):
+        if kv:
+            if values:
+                raise MXNetError("pass label values positionally OR by name")
+            try:
+                values = tuple(str(kv[n]) for n in self.labelnames)
+            except KeyError as e:
+                raise MXNetError(
+                    f"metric {self.name} missing label {e}") from None
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise MXNetError(
+                f"metric {self.name} takes labels {self.labelnames}, "
+                f"got {values}")
+        s = self._series.get(values)
+        if s is None:
+            with _LOCK:
+                s = self._series.get(values)
+                if s is None:
+                    if len(self._series) >= self.max_series:
+                        # cap label cardinality: drop (and count) instead of
+                        # letting a runaway label explode scrape size
+                        self.dropped += 1
+                        return _NULL
+                    s = self._make_series(values)
+                    self._series[values] = s
+        return s
+
+    def _make_series(self, values):
+        return self._series_cls(values)
+
+    def _default(self):
+        return self.labels(*(("",) * len(self.labelnames))) \
+            if self.labelnames else self.labels()
+
+    # family-level convenience for label-less metrics
+    def inc(self, n=1):
+        self._default().inc(n)
+
+    def dec(self, n=1):
+        self._default().dec(n)
+
+    def set(self, v):
+        self._default().set(v)
+
+    def set_max(self, v):
+        self._default().set_max(v)
+
+    def observe(self, v):
+        self._default().observe(v)
+
+    def get(self, *values) -> float:
+        s = self._series.get(tuple(str(v) for v in values))
+        if s is None:
+            return 0.0
+        return getattr(s, "value", getattr(s, "sum", 0.0))
+
+    def _render(self, out: List[str]):
+        out.append(f"# HELP {self.name} {self.doc}")
+        out.append(f"# TYPE {self.name} {self.kind}")
+        with _LOCK:
+            series = list(self._series.values())
+        for s in series:
+            out.append(f"{self.name}"
+                       f"{_fmt_labels(self.labelnames, s.label_values)}"
+                       f" {s.value}")
+
+    def _as_dict(self):
+        with _LOCK:
+            return {
+                "type": self.kind, "doc": self.doc,
+                "series": [
+                    {"labels": dict(zip(self.labelnames, s.label_values)),
+                     "value": s.value}
+                    for s in self._series.values()],
+            }
+
+
+class CounterFamily(MetricFamily):
+    kind = "counter"
+    _series_cls = _CounterSeries
+
+
+class GaugeFamily(MetricFamily):
+    kind = "gauge"
+    _series_cls = _GaugeSeries
+
+
+# seconds-scale spacing: 50us .. ~100s
+_DEFAULT_BUCKETS = tuple(5e-5 * (2.5 ** i) for i in range(13))
+
+
+class HistogramFamily(MetricFamily):
+    kind = "histogram"
+
+    def __init__(self, name, doc="", labelnames=(), buckets=None,
+                 max_series=None):
+        super().__init__(name, doc, labelnames, max_series)
+        self.buckets = sorted(float(b) for b in (buckets or _DEFAULT_BUCKETS))
+
+    def _make_series(self, values):
+        return _HistogramSeries(values, self.buckets)
+
+    def _render(self, out: List[str]):
+        out.append(f"# HELP {self.name} {self.doc}")
+        out.append(f"# TYPE {self.name} histogram")
+        with _LOCK:
+            series = [(s.label_values, list(s.counts), s.sum, s.count)
+                      for s in self._series.values()]
+        for lv, counts, total, count in series:
+            acc = 0
+            for ub, c in zip(self.buckets, counts):
+                acc += c
+                le = 'le="%g"' % ub
+                out.append(f"{self.name}_bucket"
+                           f"{_fmt_labels(self.labelnames, lv, le)} {acc}")
+            inf = 'le="+Inf"'
+            out.append(f"{self.name}_bucket"
+                       f"{_fmt_labels(self.labelnames, lv, inf)} {count}")
+            out.append(f"{self.name}_sum"
+                       f"{_fmt_labels(self.labelnames, lv)} {total}")
+            out.append(f"{self.name}_count"
+                       f"{_fmt_labels(self.labelnames, lv)} {count}")
+
+    def _as_dict(self):
+        with _LOCK:
+            return {
+                "type": "histogram", "doc": self.doc,
+                "buckets": self.buckets,
+                "series": [
+                    {"labels": dict(zip(self.labelnames, s.label_values)),
+                     "counts": list(s.counts), "sum": s.sum, "count": s.count}
+                    for s in self._series.values()],
+            }
+
+
+def _family(cls, name, doc, labelnames, **kw):
+    with _LOCK:
+        fam = _FAMILIES.get(name)
+        if fam is None:
+            fam = _FAMILIES[name] = cls(name, doc, labelnames, **kw)
+        elif type(fam) is not cls:
+            raise MXNetError(
+                f"metric {name!r} already registered as {fam.kind}")
+        return fam
+
+
+def counter(name, doc="", labelnames=(), max_series=None) -> CounterFamily:
+    """Get-or-create a monotonically increasing counter family."""
+    return _family(CounterFamily, name, doc, labelnames,
+                   max_series=max_series)
+
+
+def gauge(name, doc="", labelnames=(), max_series=None) -> GaugeFamily:
+    return _family(GaugeFamily, name, doc, labelnames, max_series=max_series)
+
+
+def histogram(name, doc="", labelnames=(), buckets=None,
+              max_series=None) -> HistogramFamily:
+    with _LOCK:
+        fam = _FAMILIES.get(name)
+        if fam is None:
+            fam = _FAMILIES[name] = HistogramFamily(
+                name, doc, labelnames, buckets, max_series)
+        elif not isinstance(fam, HistogramFamily):
+            raise MXNetError(
+                f"metric {name!r} already registered as {fam.kind}")
+        return fam
+
+
+def get_metric(name) -> Optional[MetricFamily]:
+    return _FAMILIES.get(name)
+
+
+def reset():
+    """Drop every registered family and all step/memory bookkeeping
+    (tests; a long-lived server should scrape, not reset)."""
+    global _mem_peak
+    with _LOCK:
+        _FAMILIES.clear()
+        _STEP_ANCHOR.clear()
+        _mem_peak = 0.0
+
+
+# ---------------------------------------------------------------------------
+# Roofline peak for the MFU gauge
+# ---------------------------------------------------------------------------
+
+# nominal bf16 peak FLOP/s by device_kind substring (BASELINE.md / bench.py)
+_PEAK_TABLE = (
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 46e12), ("v6", 918e12),
+)
+# With no override and no recognized accelerator (CPU CI), MFU is reported
+# against this nominal anchor so the gauge exists and A/B deltas are
+# comparable — the absolute value is NOT a hardware utilization claim
+# (docs/observability.md, "MFU methodology").
+_FALLBACK_PEAK = 1e12
+_peak_cache: List[Optional[float]] = [None]
+
+
+def peak_flops() -> float:
+    """Peak FLOP/s the MFU gauge divides by: env override, else a
+    device_kind table, else a documented 1 TF/s CPU anchor."""
+    ov = float(env.get("MXNET_TELEMETRY_PEAK_FLOPS"))
+    if ov > 0:
+        return ov
+    if _peak_cache[0] is None:
+        peak = _FALLBACK_PEAK
+        try:
+            import jax
+            kind = jax.devices()[0].device_kind.lower()
+            for sub, p in _PEAK_TABLE:
+                if sub in kind:
+                    peak = p
+                    break
+        except Exception:
+            pass
+        _peak_cache[0] = peak
+    return _peak_cache[0]
+
+
+# ---------------------------------------------------------------------------
+# Training-step recording
+# ---------------------------------------------------------------------------
+
+# source -> (last perf_counter stamp, engine flops_executed at that stamp)
+_STEP_ANCHOR: Dict[str, Tuple[float, float]] = {}
+
+
+def _engine_flops() -> float:
+    try:
+        from .. import engine as _engine
+        return float(_engine.cache_stats().get("flops_executed", 0.0))
+    except Exception:
+        return 0.0
+
+
+def record_step(examples: int, source: str = "trainer", steps: int = 1,
+                seconds: Optional[float] = None,
+                flops_per_step: Optional[float] = None,
+                lr: Optional[float] = None):
+    """Record `steps` completed training steps covering `examples` examples.
+
+    With seconds=None the duration is the wall time since the previous
+    record_step for the same `source` (the first call only anchors the
+    clock) — the once-per-iteration sync point measures the WHOLE loop
+    (forward+backward+update), the way Speedometer does. flops_per_step
+    defaults to the engine's executed-FLOPs counter delta (compiled-artifact
+    cost_analysis accounting), which yields the MFU estimate.
+    """
+    now = time.perf_counter()
+    eng_flops = _engine_flops() if flops_per_step is None else 0.0
+    with _LOCK:
+        prev = _STEP_ANCHOR.get(source)
+        _STEP_ANCHOR[source] = (now, eng_flops)
+    if seconds is None:
+        if prev is None:
+            return
+        seconds = now - prev[0]
+    if flops_per_step is None:
+        flops = eng_flops - (prev[1] if prev else eng_flops)
+    else:
+        flops = flops_per_step * steps
+
+    counter("mx_train_steps_total", "Completed training steps",
+            ("source",)).labels(source).inc(steps)
+    counter("mx_train_examples_total", "Examples consumed by training",
+            ("source",)).labels(source).inc(examples)
+    histogram("mx_train_step_seconds", "Wall time per training step",
+              ("source",)).labels(source).observe(seconds / max(steps, 1))
+    if seconds > 0:
+        gauge("mx_train_examples_per_second",
+              "Training throughput over the last recorded window",
+              ("source",)).labels(source).set(examples / seconds)
+    if flops > 0:
+        counter("mx_flops_total",
+                "Estimated FLOPs executed (cost_analysis accounting)",
+                ("source",)).labels(source).inc(flops)
+        if seconds > 0:
+            fps = flops / seconds
+            gauge("mx_model_flops_per_second",
+                  "Estimated achieved FLOP/s", ("source",)).labels(source) \
+                .set(fps)
+            gauge("mx_mfu",
+                  "Estimated model FLOPs utilization vs peak_flops() "
+                  "(see docs/observability.md for CPU caveats)",
+                  ("source",)).labels(source).set(fps / peak_flops())
+    if lr is not None:
+        gauge("mx_learning_rate", "Optimizer learning rate",
+              ("source",)).labels(source).set(lr)
+    sample_memory()
+
+
+def set_epoch(epoch: int, source: str = "module"):
+    gauge("mx_epoch", "Current training epoch", ("source",)) \
+        .labels(source).set(epoch)
+
+
+@contextmanager
+def timed(phase: str, source: str = ""):
+    """Time a coarse phase (fit/eval/export) into mx_phase_seconds."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        histogram("mx_phase_seconds", "Coarse phase wall time",
+                  ("phase", "source"),
+                  buckets=tuple(1e-3 * (4 ** i) for i in range(10))) \
+            .labels(phase, source).observe(time.perf_counter() - t0)
+
+
+# ---------------------------------------------------------------------------
+# Collective-comms accounting
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+def payload_bytes(x) -> int:
+    """Bytes in an NDArray / raw array / (nested) list/tuple of them."""
+    if x is None:
+        return 0
+    if isinstance(x, (list, tuple)):
+        return sum(payload_bytes(v) for v in x)
+    size = getattr(x, "size", None)
+    dtype = getattr(x, "dtype", None)
+    if size is None or dtype is None:
+        return 0
+    try:
+        import numpy as _np
+        return int(size) * _np.dtype(str(dtype)).itemsize
+    except Exception:
+        return int(size) * 4
+
+
+def record_comm(op: str, nbytes: int, store: str = "",
+                seconds: Optional[float] = None, calls: int = 1):
+    """Account one collective/comm operation (bytes moved, calls, time)."""
+    counter("mx_comm_bytes_total", "Bytes moved by comm/collective ops",
+            ("op", "store")).labels(op, store).inc(max(int(nbytes), 0))
+    counter("mx_comm_calls_total", "Comm/collective operations",
+            ("op", "store")).labels(op, store).inc(calls)
+    if seconds is not None:
+        counter("mx_comm_seconds_total", "Wall seconds inside comm ops",
+                ("op", "store")).labels(op, store).inc(seconds)
+
+
+@contextmanager
+def comm_scope(op: str, nbytes: int, store: str = ""):
+    """Time + count a comm region and annotate it into the device trace
+    (jax.profiler.TraceAnnotation -> visible in xplane/TensorBoard).
+    Re-entrant: nested scopes (pushpull -> push -> pull) count once."""
+    if getattr(_tls, "in_comm", False):
+        yield
+        return
+    _tls.in_comm = True
+    ann = None
+    try:
+        import jax
+        ann = jax.profiler.TraceAnnotation(f"mx.comm.{op}")
+        ann.__enter__()
+    except Exception:
+        ann = None
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        t1 = time.perf_counter()
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        _tls.in_comm = False
+        record_comm(op, nbytes, store, seconds=t1 - t0)
+        try:
+            from .. import profiler as _profiler
+            _profiler._record(op, "comm", t0, t1)
+        except Exception:
+            pass
+
+
+def annotate(name: str):
+    """Device-trace region (jax.profiler.TraceAnnotation) when telemetry is
+    enabled — shows up inside the xplane timeline; nullcontext otherwise."""
+    if not _ENABLED:
+        return contextlib.nullcontext()
+    try:
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+def instrument_comm(op: str):
+    """Decorator for kvstore-style entry points `fn(self, key, value, ...)`:
+    bytes-moved + timing + trace annotation when telemetry is enabled, one
+    wrapper call + module-flag check when disabled."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kw):
+            if not _ENABLED:
+                return fn(self, *args, **kw)
+            # args[0] is the key; the payload is the value/out argument
+            payload = args[1] if len(args) > 1 \
+                else kw.get("value", kw.get("out"))
+            nbytes = payload_bytes(payload) or payload_bytes(kw.get("out"))
+            with comm_scope(op, nbytes, getattr(self, "type", "")):
+                return fn(self, *args, **kw)
+        return wrapper
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# Memory watermarks
+# ---------------------------------------------------------------------------
+
+_mem_peak = 0.0
+
+
+def sample_memory():
+    """Sample live device-buffer bytes (jax.live_arrays) into
+    mx_device_live_bytes / mx_device_peak_bytes. Called per recorded step;
+    no-op when the runtime can't enumerate arrays."""
+    global _mem_peak
+    try:
+        import jax
+        live = float(sum(a.nbytes for a in jax.live_arrays()))
+    except Exception:
+        return
+    _mem_peak = max(_mem_peak, live)
+    gauge("mx_device_live_bytes",
+          "Live device-buffer bytes at the last sample").set(live)
+    gauge("mx_device_peak_bytes",
+          "Peak sampled device-buffer bytes").set(_mem_peak)
+
+
+# ---------------------------------------------------------------------------
+# Export
+# ---------------------------------------------------------------------------
+
+def _sync_engine_stats():
+    """Mirror the compilation-engine counters (and donation savings) into
+    gauges at scrape time, so one scrape carries the whole picture."""
+    try:
+        from .. import engine as _engine
+        st = _engine.cache_stats()
+    except Exception:
+        return
+    for k, v in st.items():
+        if isinstance(v, (int, float)):
+            gauge(f"mx_compilation_{k}",
+                  "Compilation-engine counter (engine.cache_stats)").set(v)
+    total_dropped = sum(f.dropped for f in _FAMILIES.values())
+    if total_dropped:
+        gauge("mx_telemetry_dropped_series_total",
+              "Series dropped by the per-family cardinality cap") \
+            .set(total_dropped)
+
+
+def collect() -> Dict[str, Any]:
+    _sync_engine_stats()
+    with _LOCK:
+        fams = list(_FAMILIES.items())
+    return {name: fam._as_dict() for name, fam in fams}
+
+
+def scrape() -> str:
+    """Prometheus text exposition of every registered metric, including the
+    compilation-cache counters mirrored from engine.cache_stats()."""
+    _sync_engine_stats()
+    lines: List[str] = []
+    with _LOCK:
+        fams = list(_FAMILIES.values())
+    for fam in fams:
+        fam._render(lines)
+    return "\n".join(lines) + "\n"
+
+
+def scrape_json(indent=None) -> str:
+    return json.dumps(collect(), indent=indent, sort_keys=True)
+
+
+def report(reset_profiler: bool = False) -> str:
+    """Human-readable status: telemetry summary + the profiler aggregate
+    table + compilation stats — the unified `mx.telemetry.report()` view."""
+    from .. import profiler as _profiler
+    lines = ["=== telemetry ==="]
+    for name, d in sorted(collect().items()):
+        for s in d["series"]:
+            lab = ",".join(f"{k}={v}" for k, v in s["labels"].items() if v)
+            key = f"{name}{{{lab}}}" if lab else name
+            if d["type"] == "histogram":
+                cnt = s["count"]
+                avg = s["sum"] / cnt if cnt else 0.0
+                lines.append(f"{key:<56}count={cnt:<10}avg={avg:.6g}")
+            else:
+                lines.append(f"{key:<56}{s['value']:.6g}")
+    lines.append("")
+    lines.append("=== compilation (engine.cache_stats) ===")
+    lines.append(json.dumps(_profiler.compilation_stats(), sort_keys=True,
+                            default=str))
+    lines.append("")
+    lines.append("=== profiler aggregate stats ===")
+    lines.append(_profiler.dumps(reset=reset_profiler))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# HTTP /metrics endpoint (Prometheus scrape target)
+# ---------------------------------------------------------------------------
+
+_http_server = [None]
+
+
+def start_http_server(port: int = 0, addr: str = "127.0.0.1") -> int:
+    """Serve GET /metrics (Prometheus text) and /metrics.json on a daemon
+    thread; returns the bound port (port=0 picks a free one)."""
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.startswith("/metrics.json"):
+                body = scrape_json().encode()
+                ctype = "application/json"
+            elif self.path.startswith("/metrics"):
+                body = scrape().encode()
+                ctype = "text/plain; version=0.0.4"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer((addr, port), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="mx-telemetry-http")
+    t.start()
+    _http_server[0] = srv
+    return srv.server_address[1]
+
+
+def stop_http_server():
+    srv, _http_server[0] = _http_server[0], None
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()

@@ -1,0 +1,148 @@
+"""Profiler depth: scopes/Task/Counter/Marker, event ring-buffer cap,
+dumps/dump reset semantics, pause/resume, chrome-trace validity.
+
+Covers the PR-2 satellite fixes: bounded `_events` growth
+(MXNET_PROFILER_MAX_EVENTS / set_max_events), `dumps(reset=True)` clearing
+events, atomic Counter read-modify-write, and a real pause()/resume().
+"""
+import json
+import threading
+import time
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.base import env
+
+_DEFAULT_CAP = env.get("MXNET_PROFILER_MAX_EVENTS")
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler():
+    profiler._agg.clear()
+    profiler._events.clear()
+    profiler._state["paused"] = False
+    yield
+    profiler._agg.clear()
+    profiler._events.clear()
+    profiler._state["paused"] = False
+    profiler.set_max_events(_DEFAULT_CAP)
+
+
+def test_scope_records_aggregate_and_event():
+    with profiler.scope("myop", "operator"):
+        pass
+    table = profiler.dumps()
+    assert "myop" in table and "operator" in table
+    rows = json.loads(profiler.dumps(format="json", reset_events=False))
+    row = next(r for r in rows if r["name"] == "myop")
+    assert row["count"] == 1 and row["total_us"] >= 0
+    assert any(e["name"] == "myop" and e["ph"] == "X"
+               for e in profiler._events)
+
+
+def test_task_counter_marker():
+    d = profiler.Domain("dom")
+    t = d.new_task("work")
+    t.start()
+    t.stop()
+    c = d.new_counter("ctr", 5)
+    c.increment(2)
+    c.decrement()
+    assert c.value == 6
+    d.new_marker("mark").mark()
+    cats = {e["cat"] for e in profiler._events}
+    assert "task:dom" in cats
+    assert "counter:dom" in cats
+    assert "marker:dom" in cats
+    # Task appears in the aggregate table too
+    assert any(cat == "task:dom" for (cat, _n) in profiler._agg)
+
+
+def test_counter_increment_is_atomic():
+    c = profiler.Domain("dom").new_counter("shared", 0)
+
+    def worker():
+        for _ in range(500):
+            c.increment()
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert c.value == 2000  # lost updates would land below
+
+
+def test_event_ring_buffer_cap():
+    profiler.set_max_events(10)
+    for i in range(50):
+        with profiler.scope(f"op{i}"):
+            pass
+    assert len(profiler._events) == 10
+    # newest events survive, oldest evicted
+    names = [e["name"] for e in profiler._events]
+    assert "op49" in names and "op0" not in names
+    # aggregate table is NOT capped — all 50 ops counted
+    assert len(profiler._agg) == 50
+
+
+def test_dumps_reset_clears_events_by_default():
+    with profiler.scope("op"):
+        pass
+    assert profiler._events
+    profiler.dumps(reset=True)
+    assert not profiler._agg
+    assert not profiler._events  # the old leak: _agg cleared, _events kept
+
+
+def test_dumps_reset_events_opt_out():
+    with profiler.scope("op"):
+        pass
+    profiler.dumps(reset=True, reset_events=False)
+    assert not profiler._agg
+    assert profiler._events
+
+
+def test_pause_resume_suppresses_record():
+    profiler.pause()
+    with profiler.scope("hidden"):
+        pass
+    profiler.resume()
+    with profiler.scope("visible"):
+        pass
+    table = profiler.dumps()
+    assert "hidden" not in table
+    assert "visible" in table
+
+
+def test_dump_emits_valid_chrome_trace(tmp_path):
+    out = tmp_path / "trace.json"
+    profiler.set_config(filename=str(out))
+    try:
+        with profiler.scope("traced_op"):
+            time.sleep(0.001)
+        d = profiler.Domain("dom")
+        d.new_counter("c").increment()
+        profiler.dump()
+        data = json.loads(out.read_text())
+        assert data["displayTimeUnit"] == "ms"
+        evs = data["traceEvents"]
+        assert isinstance(evs, list) and evs
+        x = next(e for e in evs if e["ph"] == "X")
+        assert x["name"] == "traced_op" and x["dur"] >= 0
+        assert all("ph" in e and "ts" in e for e in evs)
+        # reset_events truncates after the write
+        profiler.dump(reset_events=True)
+        assert not profiler._events
+    finally:
+        profiler.set_config(filename="profile.json")
+
+
+def test_compilation_stats_keys():
+    st = profiler.compilation_stats()
+    for k in ("hits", "misses", "traces", "compiles", "compile_seconds",
+              "fwd_executions", "bwd_executions", "donated_updates",
+              "flops_executed", "artifacts"):
+        assert k in st, k

@@ -1,0 +1,161 @@
+#!/usr/bin/env python3
+"""Static observability lint: every public op-dispatch and collective entry
+point must route through the telemetry registry / profiler hook.
+
+AST-based (no framework import — runs in milliseconds, tier-1 via
+tests/test_telemetry.py), so a new kvstore method or trainer step path that
+forgets its instrumentation fails CI instead of silently escaping
+observability:
+
+  - kvstore push/pull/pushpull/row_sparse_pull/broadcast (base + dist
+    overrides) must carry the `@_telem.instrument_comm(...)` decorator;
+  - trainer step paths (gluon.Trainer, DataParallelTrainer, PipelineTrainer,
+    BaseModule.fit) must call telemetry's record_step (directly or via a
+    helper);
+  - the eager op-dispatch path must consult the profiler hook
+    (`_profile_hook`) — the reference's IsProfiling() check.
+
+Exit code 0 when clean; nonzero with one line per violation.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+PKG = ROOT / "mxnet_tpu"
+
+# (relative file, class name or None for module level, function name,
+#  accepted instrumentation names, mode)
+#   mode "decorator": one decorator must be <x>.NAME(...) / NAME(...)
+#   mode "call":      the body must call one of NAMES (name or attribute)
+METHOD_CHECKS = [
+    *[("kvstore/kvstore.py", "KVStore", m, {"instrument_comm"}, "decorator")
+      for m in ("push", "pull", "pushpull", "row_sparse_pull", "broadcast")],
+    *[("kvstore/kvstore.py", "KVStoreDist", m, {"instrument_comm"},
+       "decorator")
+      for m in ("push", "pull", "pushpull", "row_sparse_pull")],
+    ("gluon/trainer.py", "Trainer", "step", {"record_step"}, "call"),
+    ("parallel/data_parallel.py", "DataParallelTrainer", "step",
+     {"record_step", "_record_telemetry"}, "call"),
+    ("parallel/data_parallel.py", "DataParallelTrainer", "run_steps",
+     {"record_step", "_record_telemetry"}, "call"),
+    ("parallel/pipeline.py", "PipelineTrainer", "step",
+     {"record_step", "_record_telemetry"}, "call"),
+    ("parallel/tensor_parallel.py", None, "shard_params_megatron",
+     {"record_comm", "counter", "gauge"}, "call"),
+    ("module/base_module.py", "BaseModule", "fit", {"record_step"}, "call"),
+]
+
+# (relative file, required substring, rationale)
+TEXT_CHECKS = [
+    ("ndarray/ndarray.py", "_profile_hook",
+     "eager op dispatch must consult the profiler hook (profile_imperative)"),
+    ("ops/registry.py", "def set_profile_hook",
+     "the op registry must expose the profiler hook installer"),
+    ("gluon/block.py", "record_execution",
+     "the fused HybridBlock path must account executions with the engine"),
+    ("symbol/executor.py", "record_execution",
+     "the symbol Executor path must account executions with the engine"),
+]
+
+
+def _find_function(tree: ast.Module, classname, funcname):
+    scopes = [tree]
+    if classname is not None:
+        scopes = [n for n in tree.body
+                  if isinstance(n, ast.ClassDef) and n.name == classname]
+    for scope in scopes:
+        for n in scope.body:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n.name == funcname:
+                return n
+    return None
+
+
+def _call_name(node):
+    """Name of a called function: foo(...) -> 'foo', a.b.foo(...) -> 'foo'."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _decorator_names(fn):
+    out = set()
+    for d in fn.decorator_list:
+        node = d.func if isinstance(d, ast.Call) else d
+        if isinstance(node, ast.Attribute):
+            out.add(node.attr)
+        elif isinstance(node, ast.Name):
+            out.add(node.id)
+    return out
+
+
+def _called_names(fn):
+    return {name for node in ast.walk(fn)
+            if isinstance(node, ast.Call)
+            and (name := _call_name(node)) is not None}
+
+
+def check(pkg: Path = PKG):
+    violations = []
+    trees = {}
+    for rel, classname, funcname, names, mode in METHOD_CHECKS:
+        path = pkg / rel
+        if rel not in trees:
+            try:
+                trees[rel] = ast.parse(path.read_text())
+            except (OSError, SyntaxError) as e:
+                violations.append(f"{rel}: unreadable/unparseable ({e})")
+                trees[rel] = None
+        tree = trees[rel]
+        if tree is None:
+            continue
+        where = f"{rel}:{classname + '.' if classname else ''}{funcname}"
+        fn = _find_function(tree, classname, funcname)
+        if fn is None:
+            violations.append(f"{where}: entry point not found "
+                              "(update tools/check_instrumentation.py if it "
+                              "moved)")
+            continue
+        found = _decorator_names(fn) if mode == "decorator" \
+            else _called_names(fn)
+        if not (found & names):
+            need = "/".join(sorted(names))
+            violations.append(
+                f"{where}: not instrumented — expected "
+                f"{'decorator' if mode == 'decorator' else 'a call to'} "
+                f"{need} (telemetry must see every "
+                f"{'collective' if mode == 'decorator' else 'train step'} "
+                "entry point)")
+    for rel, needle, why in TEXT_CHECKS:
+        path = pkg / rel
+        try:
+            text = path.read_text()
+        except OSError as e:
+            violations.append(f"{rel}: unreadable ({e})")
+            continue
+        if needle not in text:
+            violations.append(f"{rel}: missing {needle!r} — {why}")
+    return violations
+
+
+def main(argv=None):
+    violations = check()
+    for v in violations:
+        print(f"check_instrumentation: {v}", file=sys.stderr)
+    if violations:
+        print(f"check_instrumentation: {len(violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    print("check_instrumentation: all observability entry points "
+          "instrumented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
