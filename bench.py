@@ -406,7 +406,37 @@ def bench_telemetry_overhead(steps, warmup):
     }
 
 
+def bench_lint_walltime():
+    """Static-analyzer cost over the whole package (tier-1 runs mxlint via
+    tests/test_lint_clean.py, so it must stay well under the suite budget:
+    pass bar < 10 s). No accelerator involved — pure AST walking."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.mxlint import run_lint, all_passes
+    t0 = time.perf_counter()
+    findings = run_lint()
+    warm = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run_lint()
+    best = min(warm, time.perf_counter() - t0)
+    return {
+        "metric": "lint_walltime",
+        "value": round(best, 3),
+        "unit": "s",
+        "vs_baseline": round(best / 10.0, 4),  # fraction of the 10 s budget
+        "extra": {
+            "pass_10s": best < 10.0,
+            "passes": len(all_passes()),
+            "findings_total": len(findings),
+            "first_run_s": round(warm, 3),
+        },
+    }
+
+
 def main():
+    if os.environ.get("BENCH_SCENARIO") == "lint_walltime":
+        # no backend init needed (and none wanted: this must run anywhere)
+        print(json.dumps(bench_lint_walltime()))
+        return
     _enable_compile_cache()
     if os.environ.get("BENCH_SCENARIO") == "train_step":
         print(json.dumps(bench_train_step(

@@ -115,12 +115,21 @@ _LAZY_SUBMODULES = {
     "library": ".library",
     "checkpoint": ".checkpoint",   # orbax costs ~2.6 s to import
     "predict": ".predict",
+    "sanitize": ".sanitize",
     "serialization": ".serialization",
 }
 _LAZY_ATTRS = {
     "FeedForward": (".model", "FeedForward"),
     "Monitor": (".monitor", "Monitor"),
 }
+
+# MXNET_TPU_SANITIZE=1 must arm the jax sanitizers (tracer-leak/NaN checks,
+# per-step transfer guards) at import, so it can't stay behind the lazy
+# table when the flag is set
+import os as _os
+if _os.environ.get("MXNET_TPU_SANITIZE", "").strip().lower() \
+        not in ("", "0", "false", "off"):
+    from . import sanitize  # noqa: F401
 
 
 def __getattr__(name):

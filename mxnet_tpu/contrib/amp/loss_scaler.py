@@ -41,6 +41,14 @@ class LossScaler:
             return False
         return not bool(self._all_finite(flats))
 
+    def update_from_step(self, finite):
+        """Designed sync point for the fused train step: reads the step's
+        all-finite device scalar (blocking by necessity — the next step's
+        loss scale is a host decision) and applies the reference policy.
+        Lives here, off the trainer hot path, so mxlint's host-sync rule
+        keeps the step functions themselves transfer-free."""
+        return self.update_scale(not bool(finite))
+
     def update_scale(self, overflow: bool):
         if overflow:
             self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)

@@ -205,13 +205,15 @@ def donation_enabled() -> bool:
     ov = os.environ.get("MXNET_TPU_DONATION")
     if ov is not None:
         return ov.lower() not in ("0", "false", "off")
-    if _donation_cache["value"] is None:
-        try:
-            import jax
-            _donation_cache["value"] = jax.default_backend() not in ("cpu",)
-        except Exception:
-            _donation_cache["value"] = False
-    return _donation_cache["value"]
+    with _LOCK:
+        if _donation_cache["value"] is None:
+            try:
+                import jax
+                _donation_cache["value"] = \
+                    jax.default_backend() not in ("cpu",)
+            except Exception:
+                _donation_cache["value"] = False
+        return _donation_cache["value"]
 
 
 def record_donation(n: int = 1):

@@ -36,20 +36,35 @@ def split_and_load(data, ctx_list: List[Context], batch_axis=0, even_split=True)
     return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
 
 
-def clip_global_norm(arrays: List[NDArray], max_norm: float, check_isfinite=True):
-    """reference utils.py clip_global_norm."""
+def _warn_if_not_finite(total):
+    """Designed sync point for clip_global_norm(check_isfinite=True): the
+    finiteness read is the ONE host transfer, isolated off the hot path."""
+    import jax.numpy as jnp
+    if not bool(jnp.isfinite(total)):
+        import warnings
+        warnings.warn("nan or inf in clip_global_norm")
+
+
+def clip_global_norm(arrays: List[NDArray], max_norm: float,
+                     check_isfinite=True):
+    """reference utils.py clip_global_norm — TPU-native: the norm and the
+    scale stay on device and the rescale applies unconditionally
+    (``min(1, max_norm/total)`` is the identity when under the norm), so
+    per-step clipping never blocks the dispatch queue. Pass
+    check_isfinite=False to skip the host finiteness read entirely; the
+    returned total is a device scalar that only syncs if inspected."""
     import jax.numpy as jnp
     total = jnp.sqrt(sum(jnp.sum(jnp.square(a._data.astype(jnp.float32)))
                          for a in arrays))
-    total_f = float(total)
-    if check_isfinite and not jnp.isfinite(total):
-        import warnings
-        warnings.warn("nan or inf in clip_global_norm")
-    scale = max_norm / (total_f + 1e-8)
-    if scale < 1.0:
-        for a in arrays:
-            a._set_data(a._data * scale)
-    return total_f
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-8))
+    # non-finite norm: leave the arrays untouched (the reference's
+    # `scale < 1.0` host branch was False for NaN), computed on device
+    scale = jnp.where(jnp.isfinite(scale), scale, 1.0)
+    for a in arrays:
+        a._set_data(a._data * scale.astype(a._data.dtype))
+    if check_isfinite:
+        _warn_if_not_finite(total)
+    return total
 
 
 def check_sha1(filename, sha1_hash):

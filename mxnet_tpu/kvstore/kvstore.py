@@ -424,8 +424,10 @@ class KVStoreDist(KVStore):
             # stype rides along so a row_sparse push keeps lazy semantics
             # at the server.
             from .ps import _pack
+            # ps protocol boundary: the payload is serialized over a
+            # socket, so the host copy is the transport, not a stray sync
             resp = self._ps_client.request(
-                self._home(k), ("push", k, _pack(merged.asnumpy()),
+                self._home(k), ("push", k, _pack(merged.asnumpy()),  # mxlint: disable=host-sync
                                 getattr(merged, "stype", "default")))
             if resp[0] != "ok":
                 raise MXNetError(
@@ -458,7 +460,8 @@ class KVStoreDist(KVStore):
         keys, outs = self._normalize(key, out)
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
         for k, o, r in zip(keys, outs, rids):
-            ids = _np.asarray(r.asnumpy(), dtype=_np.int64)
+            # ps protocol boundary: row ids ship host-side to the server
+            ids = _np.asarray(r.asnumpy(), dtype=_np.int64)  # mxlint: disable=host-sync
             resp = self._ps_client.request(self._home(k),
                                            ("pull_rows", k, ids))
             if resp[0] != "ok":

@@ -1,9 +1,19 @@
-"""Evaluation metrics (reference python/mxnet/metric.py:68-1798)."""
+"""Evaluation metrics (reference python/mxnet/metric.py:68-1798).
+
+Hot-path metrics (Accuracy/TopK/MAE/MSE/CrossEntropy/Loss) accumulate ON
+DEVICE: ``update()`` dispatches a tiny jax reduction per batch and adds the
+resulting device scalar into ``sum_metric`` asynchronously — no per-batch
+device->host transfer blocking the dispatch queue behind the train step
+(mxlint's host-sync rule enforces this). The one designed sync point is
+``get()``, which coerces the accumulated scalar to a python float — the
+same once-per-log-interval cadence Speedometer already implies.
+"""
 from __future__ import annotations
 
 import math
 from typing import List, Optional, Sequence
 
+import jax.numpy as jnp
 import numpy as _np
 
 from .base import MXNetError
@@ -21,6 +31,21 @@ def register(*names):
 
 def _as_numpy(x):
     return x.asnumpy() if hasattr(x, "asnumpy") else _np.asarray(x)
+
+
+def _raw_pair(label, pred):
+    """Device-resident raw arrays when both sides are framework NDArrays —
+    the no-host-transfer fast path; None falls back to numpy."""
+    lr = getattr(label, "_data", None)
+    pr = getattr(pred, "_data", None)
+    if lr is None or pr is None:
+        return None
+    return lr, pr
+
+
+def _host(v):
+    """The designed device->host sync point (get()/get_global() only)."""
+    return float(v)
 
 
 def check_label_shapes(labels, preds, shape=False):
@@ -63,12 +88,12 @@ class EvalMetric:
     def get(self):
         if self.num_inst == 0:
             return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
+        return (self.name, _host(self.sum_metric) / self.num_inst)
 
     def get_global(self):
         if self.global_num_inst == 0:
             return (self.name, float("nan"))
-        return (self.name, self.global_sum_metric / self.global_num_inst)
+        return (self.name, _host(self.global_sum_metric) / self.global_num_inst)
 
     def get_name_value(self):
         name, value = self.get()
@@ -122,6 +147,17 @@ class Accuracy(EvalMetric):
             labels, preds = [labels], [preds]
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
+            raw = _raw_pair(label, pred)
+            if raw is not None:
+                # device path: the count accumulates as an async device
+                # scalar; nothing blocks until get()
+                l, p = raw
+                if p.ndim > l.ndim:
+                    p = jnp.argmax(p, axis=self.axis)
+                p = p.astype(jnp.int32).reshape(-1)
+                l = l.astype(jnp.int32).reshape(-1)
+                self._update((p == l).sum(), int(l.shape[0]))
+                continue
             p = _as_numpy(pred)
             l = _as_numpy(label).astype("int64")
             if p.ndim > l.ndim:
@@ -129,7 +165,7 @@ class Accuracy(EvalMetric):
             p = p.astype("int64").reshape(-1)
             l = l.reshape(-1)
             correct = (p == l).sum()
-            self._update(float(correct), len(l))
+            self._update(_np.float64(correct), len(l))
 
 
 @register("top_k_accuracy", "topkaccuracy")
@@ -140,11 +176,19 @@ class TopKAccuracy(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
+            raw = _raw_pair(label, pred)
+            if raw is not None:
+                l, p = raw
+                l = l.astype(jnp.int32).reshape(-1)
+                topk = jnp.argsort(p, axis=-1)[:, -self.top_k:]
+                hit = (topk == l[:, None]).any(axis=1).sum()
+                self._update(hit, int(l.shape[0]))
+                continue
             p = _as_numpy(pred)
             l = _as_numpy(label).astype("int64").reshape(-1)
             topk = _np.argsort(p, axis=-1)[:, -self.top_k:]
             hit = (topk == l[:, None]).any(axis=1).sum()
-            self._update(float(hit), len(l))
+            self._update(_np.float64(hit), len(l))
 
 
 @register("f1")
@@ -182,8 +226,15 @@ class MAE(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
+            raw = _raw_pair(label, pred)
+            if raw is not None:
+                l, p = raw
+                self._update(jnp.abs(l.reshape(p.shape) - p).mean()
+                             * l.shape[0], int(l.shape[0]))
+                continue
             l, p = _as_numpy(label), _as_numpy(pred)
-            self._update(float(_np.abs(l.reshape(p.shape) - p).mean()) * l.shape[0], l.shape[0])
+            self._update(_np.abs(l.reshape(p.shape) - p).mean() * l.shape[0],
+                         l.shape[0])
 
 
 @register("mse")
@@ -193,8 +244,15 @@ class MSE(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
+            raw = _raw_pair(label, pred)
+            if raw is not None:
+                l, p = raw
+                self._update(((l.reshape(p.shape) - p) ** 2).mean()
+                             * l.shape[0], int(l.shape[0]))
+                continue
             l, p = _as_numpy(label), _as_numpy(pred)
-            self._update(float(((l.reshape(p.shape) - p) ** 2).mean()) * l.shape[0], l.shape[0])
+            self._update(((l.reshape(p.shape) - p) ** 2).mean() * l.shape[0],
+                         l.shape[0])
 
 
 @register("rmse")
@@ -205,7 +263,7 @@ class RMSE(MSE):
     def get(self):
         if self.num_inst == 0:
             return (self.name, float("nan"))
-        return (self.name, math.sqrt(self.sum_metric / self.num_inst))
+        return (self.name, math.sqrt(_host(self.sum_metric) / self.num_inst))
 
 
 @register("cross-entropy", "ce")
@@ -216,10 +274,18 @@ class CrossEntropy(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
+            raw = _raw_pair(label, pred)
+            if raw is not None:
+                l, p = raw
+                l = l.astype(jnp.int32).reshape(-1)
+                prob = p[jnp.arange(l.shape[0]), l]
+                self._update(-jnp.log(prob + self.eps).sum(),
+                             int(l.shape[0]))
+                continue
             l = _as_numpy(label).astype("int64").reshape(-1)
             p = _as_numpy(pred)
             prob = p[_np.arange(l.shape[0]), l]
-            self._update(float(-_np.log(prob + self.eps).sum()), l.shape[0])
+            self._update(-_np.log(prob + self.eps).sum(), l.shape[0])
 
 
 @register("nll_loss")
@@ -339,8 +405,12 @@ class Loss(EvalMetric):
         if not isinstance(preds, (list, tuple)):
             preds = [preds]
         for pred in preds:
-            loss = float(_as_numpy(pred).sum())
-            self._update(loss, int(_np.prod(_as_numpy(pred).shape)))
+            rawp = getattr(pred, "_data", None)
+            if rawp is not None:
+                self._update(rawp.sum(), int(_np.prod(rawp.shape)))
+                continue
+            p = _as_numpy(pred)
+            self._update(p.sum(), int(_np.prod(p.shape)))
 
 
 @register("custom")

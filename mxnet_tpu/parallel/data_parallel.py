@@ -23,6 +23,7 @@ from ..ndarray import NDArray
 from .. import autograd
 from .. import engine as _engine
 from .. import random as _rng
+from .. import sanitize as _sanitize
 from .. import telemetry as _telem
 from ..gluon.block import HybridBlock, _AUX_STACK
 from ..gluon.parameter import Parameter
@@ -454,8 +455,11 @@ class DataParallelTrainer:
                         or cur.is_equivalent_to(sharding, arr.ndim)):
                     return arr
             return jax.device_put(arr, sharding)
-        return jax.make_array_from_process_local_data(
-            sharding, _np.asarray(arr))
+        # multi-host feed: make_array_from_process_local_data requires the
+        # per-process batch shard as host numpy — a protocol boundary, not
+        # a stray sync
+        host = _np.asarray(arr)  # mxlint: disable=host-sync
+        return jax.make_array_from_process_local_data(sharding, host)
 
     # -- telemetry -----------------------------------------------------------
     def _grad_allreduce_bytes(self) -> int:
@@ -465,7 +469,7 @@ class DataParallelTrainer:
             n = self._dp_degree
             total = sum(int(w.nbytes) for w, t in
                         zip(self._params_raw, self._trainable) if t)
-            self._ar_bytes = int(total * 2 * (n - 1) / n) if n > 1 else 0
+            self._ar_bytes = (total * 2 * (n - 1)) // n if n > 1 else 0
         return self._ar_bytes
 
     def _record_telemetry(self, sig, examples, steps, flops_key=None):
@@ -682,7 +686,7 @@ class DataParallelTrainer:
         key = (sig, "multi", n)
         fn = self._step_jit.get(key)
         if fn is None:
-            compressed = bool(self._compression)
+            compressed = self._compression is not None
             body = self._build_step_compressed() if compressed \
                 else self._build_step(None, None)
 
@@ -792,7 +796,7 @@ class DataParallelTrainer:
             self._step_cost[cost_key] = _engine.estimate_cost(
                 fn, self._params_raw, self._opt_state, self._comp_resid,
                 key_in, xr, yr, lr_in, t_in, scale_in)
-        with _telem.annotate("mx.dp.run_steps"):
+        with _telem.annotate("mx.dp.run_steps"), _sanitize.guard():
             (self._params_raw, self._opt_state, self._comp_resid, losses,
              finite, key_out, t_out) = fn(
                 self._params_raw, self._opt_state, self._comp_resid,
@@ -807,7 +811,7 @@ class DataParallelTrainer:
             self._t_dev_val = self._t
         self.optimizer.num_update = self._t
         if self._scaler is not None:
-            self._scaler.update_scale(not bool(finite))
+            self._scaler.update_from_step(finite)
         return losses
 
     def step(self, x, y, batch_size=None):
@@ -828,6 +832,12 @@ class DataParallelTrainer:
         yr = self._put_batch(yr, NamedSharding(self.mesh, y_spec))
         scale = _np.float32(self._scaler.loss_scale if self._scaler else 1.0)
         t_in = _np.float32(self._t)
+        if not self._is_multiprocess():
+            # EXPLICIT placement of the per-step host scalars: the uploads
+            # happen either way, but implicit numpy->device transfers are
+            # exactly what sanitize mode's transfer guard rejects
+            key, lr, t_in, scale = jax.device_put(
+                (key, lr, t_in, scale), NamedSharding(self.mesh, P()))
         call_args = ((self._params_raw, self._opt_state, self._comp_resid,
                       key, xr, yr, lr, t_in, scale) if self._compression
                      else (self._params_raw, self._opt_state, key, xr, yr,
@@ -836,7 +846,7 @@ class DataParallelTrainer:
             # cost_analysis FLOPs of the fused step, captured once per
             # signature at artifact-build time (AOT lower shares XLA caches)
             self._step_cost[sig] = _engine.estimate_cost(fn, *call_args)
-        with _telem.annotate("mx.dp.step"):
+        with _telem.annotate("mx.dp.step"), _sanitize.guard():
             if self._compression:
                 (self._params_raw, self._opt_state, self._comp_resid, lossv,
                  finite, aux) = fn(*call_args)
@@ -844,7 +854,7 @@ class DataParallelTrainer:
                 self._params_raw, self._opt_state, lossv, finite, aux = fn(
                     *call_args)
         if self._scaler is not None:
-            self._scaler.update_scale(not bool(finite))
+            self._scaler.update_from_step(finite)
         if _telem._ENABLED:
             self._record_telemetry(sig, bs, 1)
         return lossv

@@ -36,6 +36,7 @@ from ..ndarray import NDArray
 from .. import engine as _engine
 from .. import optimizer as opt_mod
 from .. import random as _rng
+from .. import sanitize as _sanitize
 from .. import telemetry as _telem
 from .mesh import current_mesh, P
 
@@ -352,12 +353,16 @@ class PipelineTrainer:
             self.mesh, P(*data, *([None] * (xr.ndim - 2)))))
         yr = jax.device_put(yr, NamedSharding(
             self.mesh, P(*data, *([None] * (yr.ndim - 2)))))
+        # explicit placement of the per-step scalars (sanitize mode's
+        # transfer guard rejects implicit numpy->device uploads)
+        key, lr, t_in = jax.device_put(
+            (key, lr, _np.float32(self._t)),
+            NamedSharding(self.mesh, P()))
         call_args = (self._e_raw, self._s_raw, self._h_raw, self._opt_e,
-                     self._opt_s, self._opt_h, key, xr, yr, lr,
-                     _np.float32(self._t))
+                     self._opt_s, self._opt_h, key, xr, yr, lr, t_in)
         if _telem._ENABLED and sig not in self._step_cost:
             self._step_cost[sig] = _engine.estimate_cost(fn, *call_args)
-        with _telem.annotate("mx.pp.step"):
+        with _telem.annotate("mx.pp.step"), _sanitize.guard():
             (self._e_raw, self._s_raw, self._h_raw, self._opt_e, self._opt_s,
              self._opt_h, lossv) = fn(*call_args)
         if _telem._ENABLED:

@@ -47,7 +47,8 @@ def set_max_events(n: int):
 
 
 def set_config(**kwargs):
-    _config.update(kwargs)
+    with _stats_lock:
+        _config.update(kwargs)
 
 
 def _op_hook(name: str, start: float, end: float):
@@ -56,32 +57,36 @@ def _op_hook(name: str, start: float, end: float):
 
 def set_state(state="stop", profile_process="worker"):
     from .ops import registry as _registry
-    if state == "run":
-        if not _state["running"]:
-            d = os.path.splitext(_config["filename"])[0] + "_xplane"
-            os.makedirs(d, exist_ok=True)
-            try:
-                jax.profiler.start_trace(d)
-                _state["trace_dir"] = d
-            except Exception:
-                _state["trace_dir"] = None
-            # per-op eager dispatch timing (reference profile_imperative);
-            # the registry pays one None-check per call while off
-            if _config.get("profile_imperative", True) \
-                    or _config.get("profile_all", False):
-                _registry.set_profile_hook(_op_hook)
-            _state["running"] = True
-    elif state == "stop":
-        if _state["running"]:
-            _registry.set_profile_hook(None)
-            if _state["trace_dir"]:
-                try:
-                    jax.profiler.stop_trace()
-                except Exception:
-                    pass
-            _state["running"] = False
-    else:
+    if state not in ("run", "stop"):
         raise MXNetError(f"profiler state {state!r}")
+    # the whole start/stop transition runs under the stats lock so two
+    # threads toggling the profiler cannot interleave trace start/stop
+    # with the _state flag flips (_record never nests inside here)
+    with _stats_lock:
+        if state == "run":
+            if not _state["running"]:
+                d = os.path.splitext(_config["filename"])[0] + "_xplane"
+                os.makedirs(d, exist_ok=True)
+                try:
+                    jax.profiler.start_trace(d)
+                    _state["trace_dir"] = d
+                except Exception:
+                    _state["trace_dir"] = None
+                # per-op eager dispatch timing (reference profile_imperative);
+                # the registry pays one None-check per call while off
+                if _config.get("profile_imperative", True) \
+                        or _config.get("profile_all", False):
+                    _registry.set_profile_hook(_op_hook)
+                _state["running"] = True
+        elif state == "stop":
+            if _state["running"]:
+                _registry.set_profile_hook(None)
+                if _state["trace_dir"]:
+                    try:
+                        jax.profiler.stop_trace()
+                    except Exception:
+                        pass
+                _state["running"] = False
 
 
 def _record(name: str, category: str, start: float, end: float):
@@ -229,11 +234,13 @@ def dump(finished=True, profile_process="worker", reset_events=False):
 def pause(profile_process="worker"):
     """Suppress host-side recording (reference MXProfilePause): scopes,
     tasks and op-dispatch timings between pause() and resume() are dropped."""
-    _state["paused"] = True
+    with _stats_lock:
+        _state["paused"] = True
 
 
 def resume(profile_process="worker"):
-    _state["paused"] = False
+    with _stats_lock:
+        _state["paused"] = False
 
 
 if env.get("MXNET_PROFILER_AUTOSTART"):

@@ -29,18 +29,28 @@ def _global_key():
 
 # Inside a hybridize() trace the key must be a traced input, not a baked-in
 # constant: blocks push the trace's key here and next_key() splits from it.
-_trace_keys = []
+# Thread-LOCAL, not merely locked: a trace runs on one thread, and two
+# threads tracing different blocks concurrently must not interleave their
+# key stacks (a shared locked list would still corrupt the pairing).
+_trace_tls = threading.local()
+
+
+def _trace_keys():
+    keys = getattr(_trace_tls, "keys", None)
+    if keys is None:
+        keys = _trace_tls.keys = []
+    return keys
 
 
 def push_trace_key(raw_key):
     k = raw_key
     if not jnp.issubdtype(k.dtype, jax.dtypes.prng_key):
         k = jax.random.wrap_key_data(k.astype(jnp.uint32), impl="threefry2x32")
-    _trace_keys.append(k)
+    _trace_keys().append(k)
 
 
 def pop_trace_key():
-    _trace_keys.pop()
+    _trace_keys().pop()
 
 
 # Host-side pipeline RNG: the gluon vision transforms run as numpy on
@@ -90,9 +100,10 @@ def seed(seed_state: int, ctx="all"):
 
 def next_key():
     global _key
-    if _trace_keys:
-        k1, k2 = jax.random.split(_trace_keys[-1])
-        _trace_keys[-1] = k1
+    tk = _trace_keys()
+    if tk:
+        k1, k2 = jax.random.split(tk[-1])
+        tk[-1] = k1
         return k2
     with _lock:
         _key, sub = jax.random.split(_global_key())

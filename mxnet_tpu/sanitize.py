@@ -1,0 +1,90 @@
+"""Runtime sanitizers for the fused TPU hot path (``MXNET_TPU_SANITIZE=1``).
+
+The static side of this contract is ``tools/mxlint`` (host-sync /
+jit-purity / donation rules); this module is the dynamic side — jax's own
+debugging interlocks, wired to the framework's step boundaries:
+
+  - ``jax_check_tracer_leaks`` — a tracer escaping a traced step function
+    (stashed in module state, a Parameter, a closure) raises at trace time
+    instead of surfacing later as a cryptic ``UnexpectedTracerError``;
+  - ``jax_debug_nans`` — NaN outputs re-run un-jitted and raise at the
+    producing primitive;
+  - ``jax.transfer_guard("disallow")`` — scoped around each fused step
+    dispatch (``guard()``): any *implicit* host<->device transfer inside
+    the step raises, proving no stray ``float()``/numpy coercion snuck
+    into the hot path. Explicit ``jax.device_put`` remains allowed, which
+    is why the trainers place per-step scalars explicitly.
+
+Enable via the environment (read at import), ``mx.sanitize.enable()``, or
+``pytest --sanitize`` (tests/conftest.py). Off by default: every hook is a
+module-flag check, and ``guard()`` returns a nullcontext.
+
+The sanitizers change performance, not semantics — debug_nans in
+particular re-executes computations — so this is a test/debug mode, not a
+production default (docs/static_analysis.md, "Sanitizer mode").
+"""
+from __future__ import annotations
+
+import contextlib
+
+from .base import env
+
+__all__ = ["enabled", "enable", "disable", "guard"]
+
+env.declare("MXNET_TPU_SANITIZE", False, bool,
+            "Enable jax tracer-leak/NaN checks and the per-step transfer "
+            "guard (test/debug mode)")
+
+_enabled = False
+_saved = {}
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _set_jax_flags(on: bool):
+    import jax
+    global _saved
+    if on:
+        _saved = {
+            "jax_check_tracer_leaks": jax.config.jax_check_tracer_leaks,
+            "jax_debug_nans": jax.config.jax_debug_nans,
+        }
+        jax.config.update("jax_check_tracer_leaks", True)
+        jax.config.update("jax_debug_nans", True)
+    else:
+        for k, v in _saved.items():
+            jax.config.update(k, v)
+
+
+def enable():
+    """Turn the sanitizers on: global tracer-leak + NaN checks now, and
+    transfer guards at every subsequent fused-step dispatch."""
+    global _enabled
+    if not _enabled:
+        _set_jax_flags(True)
+        _enabled = True
+
+
+def disable():
+    global _enabled
+    if _enabled:
+        _set_jax_flags(False)
+        _enabled = False
+
+
+def guard():
+    """Transfer guard for one fused-step dispatch: ``with sanitize.guard():
+    fn(...)``. Rejects implicit transfers while active (jax_debug_nans'
+    own output inspection uses a private read path and still works);
+    nullcontext when sanitize mode is off — one flag check on the hot
+    path."""
+    if not _enabled:
+        return contextlib.nullcontext()
+    import jax
+    return jax.transfer_guard("disallow")
+
+
+if env.get("MXNET_TPU_SANITIZE"):
+    enable()

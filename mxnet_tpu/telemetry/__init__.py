@@ -401,19 +401,20 @@ def peak_flops() -> float:
     ov = float(env.get("MXNET_TELEMETRY_PEAK_FLOPS"))
     if ov > 0:
         return ov
-    if _peak_cache[0] is None:
-        peak = _FALLBACK_PEAK
-        try:
-            import jax
-            kind = jax.devices()[0].device_kind.lower()
-            for sub, p in _PEAK_TABLE:
-                if sub in kind:
-                    peak = p
-                    break
-        except Exception:
-            pass
-        _peak_cache[0] = peak
-    return _peak_cache[0]
+    with _LOCK:
+        if _peak_cache[0] is None:
+            peak = _FALLBACK_PEAK
+            try:
+                import jax
+                kind = jax.devices()[0].device_kind.lower()
+                for sub, p in _PEAK_TABLE:
+                    if sub in kind:
+                        peak = p
+                        break
+            except Exception:
+                pass
+            _peak_cache[0] = peak
+        return _peak_cache[0]
 
 
 # ---------------------------------------------------------------------------
@@ -622,11 +623,13 @@ def sample_memory():
         live = float(sum(a.nbytes for a in jax.live_arrays()))
     except Exception:
         return
-    _mem_peak = max(_mem_peak, live)
+    with _LOCK:  # max() is a read-modify-write; _LOCK is reentrant
+        _mem_peak = max(_mem_peak, live)
+        peak = _mem_peak
     gauge("mx_device_live_bytes",
           "Live device-buffer bytes at the last sample").set(live)
     gauge("mx_device_peak_bytes",
-          "Peak sampled device-buffer bytes").set(_mem_peak)
+          "Peak sampled device-buffer bytes").set(peak)
 
 
 # ---------------------------------------------------------------------------
@@ -737,12 +740,14 @@ def start_http_server(port: int = 0, addr: str = "127.0.0.1") -> int:
     t = threading.Thread(target=srv.serve_forever, daemon=True,
                          name="mx-telemetry-http")
     t.start()
-    _http_server[0] = srv
+    with _LOCK:
+        _http_server[0] = srv
     return srv.server_address[1]
 
 
 def stop_http_server():
-    srv, _http_server[0] = _http_server[0], None
+    with _LOCK:
+        srv, _http_server[0] = _http_server[0], None
     if srv is not None:
         srv.shutdown()
         srv.server_close()

@@ -31,9 +31,19 @@ def cpu_devices():
     return jax.devices("cpu")
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--sanitize", action="store_true", default=False,
+        help="run under the jax sanitizers: tracer-leak + NaN checks "
+             "globally, transfer_guard('disallow') around each fused step "
+             "(mxnet_tpu.sanitize; same switches as MXNET_TPU_SANITIZE=1)")
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running test (subprocess compiles, trainings)")
+    if config.getoption("--sanitize"):
+        mx.sanitize.enable()
 
 
 @pytest.fixture(autouse=True)

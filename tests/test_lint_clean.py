@@ -1,0 +1,50 @@
+"""Tier-1 lint gate: mxnet_tpu/ is clean under every mxlint pass modulo the
+checked-in baseline (ISSUE 3 acceptance: exit 0, baseline <= 10 entries).
+
+This is the CI "lint job" — running inside the normal test invocation the
+way tools/check_instrumentation.py already does, so a new host-sync /
+purity / donation violation fails the suite the commit it appears."""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run_mxlint(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", "--format=json", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+
+
+def test_package_is_clean_modulo_baseline():
+    proc = _run_mxlint()
+    assert proc.returncode == 0, \
+        f"mxlint found NEW violations:\n{proc.stdout}\n{proc.stderr}"
+    data = json.loads(proc.stdout)
+    assert data["new"] == [], data["new"]
+    # the baseline must not rot: every entry still matches a real finding
+    assert data["stale_baseline"] == [], (
+        "baseline entries no longer match (fixed code?) — regenerate with "
+        "python -m tools.mxlint --write-baseline: "
+        f"{data['stale_baseline']}")
+
+
+def test_baseline_is_small_and_documented():
+    baseline = json.loads(
+        (REPO / "tools" / "mxlint" / "baseline.json").read_text())
+    entries = baseline["findings"]
+    assert len(entries) <= 10, \
+        f"baseline grew to {len(entries)} entries; fix findings instead"
+    for e in entries:
+        assert e["rule"] and e["path"].startswith("mxnet_tpu/"), e
+
+
+def test_lint_walltime_budget():
+    """Analyzer cost over the whole package stays < 10 s (also exported as
+    BENCH_SCENARIO=lint_walltime in bench.py)."""
+    proc = _run_mxlint()
+    assert proc.returncode == 0
+    elapsed = json.loads(proc.stdout)["elapsed_seconds"]
+    assert elapsed < 10.0, f"mxlint took {elapsed}s over mxnet_tpu/"

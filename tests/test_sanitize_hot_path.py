@@ -1,0 +1,117 @@
+"""Runtime sanitizers over the fused train path (ISSUE 3 acceptance).
+
+The static side (tools/mxlint host-sync rule) proves no *source-level* sync
+sits on the hot path; these tests prove it DYNAMICALLY: a fused
+DataParallelTrainer step runs under
+
+  - ``jax_check_tracer_leaks`` during the trace (a tracer stashed in module
+    state / a Parameter / a closure would raise at trace time), and
+  - ``jax.transfer_guard("disallow")`` during dispatch (any implicit
+    host<->device transfer inside the step raises).
+
+Together they certify the step is pure and transfer-free end to end on the
+CPU backend — the same interlocks MXNET_TPU_SANITIZE=1 / pytest --sanitize
+arm for the whole suite.
+"""
+import contextlib
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+
+
+@contextlib.contextmanager
+def _jax_flag(name, value):
+    prev = getattr(jax.config, name)
+    jax.config.update(name, value)
+    try:
+        yield
+    finally:
+        jax.config.update(name, prev)
+
+
+def _make_trainer(optimizer="sgd", **opt_params):
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+    mx.random.seed(7)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize()
+    net(nd.zeros((2, 8)))
+
+    def loss(pred, label):
+        import jax.numpy as jnp
+        return jnp.mean((pred - label) ** 2)
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices("cpu")[:1])
+    opt_params.setdefault("learning_rate", 0.05)
+    return DataParallelTrainer(net, loss, optimizer=optimizer,
+                               optimizer_params=opt_params, mesh=mesh)
+
+
+def test_fused_step_traces_under_tracer_leak_checker():
+    """The first step (trace + compile) runs with jax_check_tracer_leaks:
+    the parameter-swap apply_fn must restore every Parameter before the
+    trace ends or this raises UnexpectedTracerError."""
+    tr = _make_trainer()
+    x, y = nd.ones((4, 8)), nd.ones((4, 4))
+    with _jax_flag("jax_check_tracer_leaks", True):
+        loss0 = tr.step(x, y)
+    assert np.isfinite(float(loss0))
+
+
+def test_fused_step_dispatch_under_transfer_guard():
+    """After warmup, a step dispatch is transfer-free: every per-step input
+    (batch, key, lr, t, scale) is either device-resident or explicitly
+    device_put, so transfer_guard('disallow') passes."""
+    tr = _make_trainer()
+    x, y = nd.ones((4, 8)), nd.ones((4, 4))
+    tr.step(x, y)  # trace+compile outside the guard
+    with jax.transfer_guard("disallow"):
+        lossv = tr.step(x, y)
+    assert np.isfinite(float(lossv))
+
+
+def test_fused_step_under_both_plus_debug_nans():
+    """The full MXNET_TPU_SANITIZE=1 combination via the module API:
+    tracer-leak + debug-nans global, transfer guard scoped by the trainer
+    itself (sanitize.guard() inside DataParallelTrainer.step)."""
+    from mxnet_tpu import sanitize
+    tr = _make_trainer(optimizer="adam")
+    x, y = nd.ones((4, 8)), nd.ones((4, 4))
+    sanitize.enable()
+    try:
+        assert sanitize.enabled()
+        first = tr.step(x, y)       # traced under the leak checker
+        second = tr.step(x, y)      # dispatched inside the trainer's guard
+    finally:
+        sanitize.disable()
+    assert np.isfinite(float(first)) and np.isfinite(float(second))
+    assert not sanitize.enabled()
+
+
+def test_transfer_guard_catches_planted_host_sync():
+    """Positive control: the guard actually fires — an implicit numpy
+    upload inside the guarded region must raise."""
+    tr = _make_trainer()
+    x, y = nd.ones((4, 8)), nd.ones((4, 4))
+    tr.step(x, y)
+    f = jax.jit(lambda a: a + 1)
+    f(np.zeros((3,), np.float32))  # warm outside
+    with jax.transfer_guard("disallow"):
+        with pytest.raises(Exception, match="[Dd]isallowed"):
+            f(np.zeros((3,), np.float32))
+
+
+def test_run_steps_dispatch_under_transfer_guard():
+    """The on-device loop (lax.scan multi-step) also dispatches clean:
+    lr/key/t/scale ride the device-resident caches."""
+    tr = _make_trainer()
+    x, y = nd.ones((4, 8)), nd.ones((4, 4))
+    tr.run_steps(x, y, n=2)  # compile + prime the scalar caches
+    with jax.transfer_guard("disallow"):
+        losses = tr.run_steps(x, y, n=2)
+    assert np.all(np.isfinite(np.asarray(losses)))

@@ -2,10 +2,11 @@
 """Static observability lint: every public op-dispatch and collective entry
 point must route through the telemetry registry / profiler hook.
 
-AST-based (no framework import — runs in milliseconds, tier-1 via
-tests/test_telemetry.py), so a new kvstore method or trainer step path that
-forgets its instrumentation fails CI instead of silently escaping
-observability:
+Registered as the mxlint ``instrumentation`` pass (tools/mxlint/) and still
+runnable standalone — ``python tools/check_instrumentation.py`` remains the
+tier-1 entry point tests/test_telemetry.py invokes. The AST walking, parsed
+-module model and finding type come from tools/mxlint/core; only the rule
+TABLE lives here:
 
   - kvstore push/pull/pushpull/row_sparse_pull/broadcast (base + dist
     overrides) must carry the `@_telem.instrument_comm(...)` decorator;
@@ -25,6 +26,18 @@ from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
 PKG = ROOT / "mxnet_tpu"
+
+
+def _mxlint_core():
+    """Shared AST infra (tools/mxlint/core); bootstrap sys.path when run as
+    a standalone script (sys.path[0] is tools/ then)."""
+    try:
+        from tools.mxlint import core
+    except ImportError:
+        sys.path.insert(0, str(ROOT))
+        from tools.mxlint import core
+    return core
+
 
 # (relative file, class name or None for module level, function name,
 #  accepted instrumentation names, mode)
@@ -61,87 +74,75 @@ TEXT_CHECKS = [
 ]
 
 
-def _find_function(tree: ast.Module, classname, funcname):
-    scopes = [tree]
-    if classname is not None:
-        scopes = [n for n in tree.body
-                  if isinstance(n, ast.ClassDef) and n.name == classname]
-    for scope in scopes:
-        for n in scope.body:
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and n.name == funcname:
-                return n
-    return None
-
-
-def _call_name(node):
-    """Name of a called function: foo(...) -> 'foo', a.b.foo(...) -> 'foo'."""
-    f = node.func
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    if isinstance(f, ast.Name):
-        return f.id
-    return None
-
-
-def _decorator_names(fn):
-    out = set()
-    for d in fn.decorator_list:
-        node = d.func if isinstance(d, ast.Call) else d
-        if isinstance(node, ast.Attribute):
-            out.add(node.attr)
-        elif isinstance(node, ast.Name):
-            out.add(node.id)
-    return out
-
-
 def _called_names(fn):
+    core = _mxlint_core()
     return {name for node in ast.walk(fn)
             if isinstance(node, ast.Call)
-            and (name := _call_name(node)) is not None}
+            and (name := core.call_name(node)) is not None}
 
 
-def check(pkg: Path = PKG):
-    violations = []
-    trees = {}
+def findings(pkg: Path = PKG):
+    """Structured results (mxlint Finding objects) — the mxlint
+    ``instrumentation`` pass consumes these directly."""
+    core = _mxlint_core()
+    pkg = Path(pkg)
+    out = []
+    mods = {}
     for rel, classname, funcname, names, mode in METHOD_CHECKS:
-        path = pkg / rel
-        if rel not in trees:
+        if rel not in mods:
             try:
-                trees[rel] = ast.parse(path.read_text())
-            except (OSError, SyntaxError) as e:
-                violations.append(f"{rel}: unreadable/unparseable ({e})")
-                trees[rel] = None
-        tree = trees[rel]
-        if tree is None:
+                mods[rel] = core.ModuleInfo(pkg / rel, root=pkg.parent)
+            except (OSError, SyntaxError, ValueError) as e:
+                out.append(core.Finding(
+                    "instrumentation", rel, 0, "",
+                    f"unreadable/unparseable ({e})"))
+                mods[rel] = None
+        mod = mods[rel]
+        if mod is None:
             continue
-        where = f"{rel}:{classname + '.' if classname else ''}{funcname}"
-        fn = _find_function(tree, classname, funcname)
+        symbol = f"{classname + '.' if classname else ''}{funcname}"
+        fn = next((f for f in mod.functions()
+                   if mod.qualname(f) == symbol), None)
         if fn is None:
-            violations.append(f"{where}: entry point not found "
-                              "(update tools/check_instrumentation.py if it "
-                              "moved)")
+            out.append(core.Finding(
+                "instrumentation", mod.relpath, 0, symbol,
+                "entry point not found (update tools/check_instrumentation"
+                ".py if it moved)"))
             continue
-        found = _decorator_names(fn) if mode == "decorator" \
+        found = core.decorator_names(fn) if mode == "decorator" \
             else _called_names(fn)
         if not (found & names):
             need = "/".join(sorted(names))
-            violations.append(
-                f"{where}: not instrumented — expected "
+            out.append(core.Finding(
+                "instrumentation", mod.relpath, fn.lineno, symbol,
+                f"not instrumented — expected "
                 f"{'decorator' if mode == 'decorator' else 'a call to'} "
                 f"{need} (telemetry must see every "
                 f"{'collective' if mode == 'decorator' else 'train step'} "
-                "entry point)")
+                "entry point)"))
     for rel, needle, why in TEXT_CHECKS:
         path = pkg / rel
         try:
             text = path.read_text()
         except OSError as e:
-            violations.append(f"{rel}: unreadable ({e})")
+            out.append(core.Finding("instrumentation", rel, 0, "",
+                                    f"unreadable ({e})"))
             continue
         if needle not in text:
-            violations.append(f"{rel}: missing {needle!r} — {why}")
-    return violations
+            out.append(core.Finding("instrumentation", rel, 0, "",
+                                    f"missing {needle!r} — {why}"))
+    return out
+
+
+def check(pkg: Path = PKG):
+    """Back-compat string form (the original standalone API)."""
+    out = []
+    for f in findings(pkg):
+        rel = f.path.split("mxnet_tpu/", 1)[-1] if "mxnet_tpu/" in f.path \
+            else f.path
+        where = f"{rel}:{f.symbol}" if f.symbol else rel
+        out.append(f"{where}: {f.message}")
+    return out
 
 
 def main(argv=None):

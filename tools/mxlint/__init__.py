@@ -1,0 +1,56 @@
+"""mxlint — framework-aware static analysis for mxnet_tpu.
+
+Multi-pass AST linter enforcing the invariants the fused TPU train path
+relies on (see docs/static_analysis.md):
+
+  host-sync          no device->host sync inside hot-path functions
+  retrace-hazard     stable jit signatures / deterministic cache keys
+  donation-safety    no read-after-donate of jit-donated buffers
+  jit-purity         no side effects inside traced functions
+  lock-discipline    module state mutated under the module's declared lock
+  mutable-default    no mutable default arguments
+  instrumentation    telemetry wiring on every collective/step entry point
+
+Use as a library::
+
+    from tools.mxlint import run_lint
+    findings = run_lint()          # lints mxnet_tpu/ with all passes
+
+or via the CLI (tier-1 runs this through tests/test_lint_clean.py)::
+
+    python -m tools.mxlint --format=json --baseline=tools/mxlint/baseline.json
+
+Per-site waivers: append ``# mxlint: disable=<rule>`` to the offending
+line. Legacy findings live in ``tools/mxlint/baseline.json``; regenerate it
+after intentional changes with ``--write-baseline``.
+"""
+from __future__ import annotations
+
+import importlib.util
+import sys
+from pathlib import Path
+
+from .core import (DEFAULT_BASELINE, DEFAULT_TARGET, REPO_ROOT,  # noqa: F401
+                   Finding, LintPass, ModuleInfo, all_passes, diff_baseline,
+                   load_baseline, register_pass, run_lint, write_baseline)
+
+__all__ = ["Finding", "LintPass", "ModuleInfo", "all_passes", "run_lint",
+           "register_pass", "load_baseline", "write_baseline",
+           "diff_baseline", "DEFAULT_BASELINE", "DEFAULT_TARGET"]
+
+
+def _load_check_instrumentation():
+    """The instrumentation rule set lives in tools/check_instrumentation.py
+    (still its own tier-1 entry point); load it package-relative first,
+    falling back to a file-path import for frozen/spec loaders."""
+    try:
+        from .. import check_instrumentation  # type: ignore
+        return check_instrumentation
+    except ImportError:
+        pass
+    path = Path(__file__).resolve().parent.parent / "check_instrumentation.py"
+    spec = importlib.util.spec_from_file_location("_mxlint_ci", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("_mxlint_ci", mod)
+    spec.loader.exec_module(mod)
+    return mod

@@ -1,0 +1,89 @@
+"""CLI: ``python -m tools.mxlint [paths...] [options]``.
+
+Exit codes: 0 = clean modulo baseline, 1 = new findings, 2 = bad usage.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from .core import (DEFAULT_BASELINE, DEFAULT_TARGET, all_passes,
+                   diff_baseline, load_baseline, run_lint, write_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mxlint",
+        description="framework-aware static analysis for mxnet_tpu")
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs to lint (default: {DEFAULT_TARGET})")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline JSON of tracked legacy findings "
+                         "('' disables)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from current findings "
+                         "and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to run "
+                         f"(default all: {','.join(sorted(all_passes()))})")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name, p in sorted(all_passes().items()):
+            print(f"{name:<18} [{p.scope}] {p.doc}")
+        return 0
+
+    rules = args.rules.split(",") if args.rules else None
+    targets = [Path(p) for p in args.paths] or [DEFAULT_TARGET]
+    t0 = time.perf_counter()
+    findings = []
+    try:
+        for target in targets:
+            findings.extend(run_lint(target, rules=rules))
+    except ValueError as e:
+        print(f"mxlint: {e}", file=sys.stderr)
+        return 2
+    elapsed = time.perf_counter() - t0
+
+    baseline_path = Path(args.baseline) if args.baseline else None
+    if args.write_baseline:
+        if baseline_path is None:
+            print("mxlint: --write-baseline needs --baseline",
+                  file=sys.stderr)
+            return 2
+        write_baseline(baseline_path, findings)
+        print(f"mxlint: wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    baseline = load_baseline(baseline_path) if baseline_path else []
+    new, waived, stale = diff_baseline(findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [f.as_dict() for f in new],
+            "baselined": [f.as_dict() for f in waived],
+            "stale_baseline": stale,
+            "elapsed_seconds": round(elapsed, 3),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.text())
+        if waived:
+            print(f"mxlint: {len(waived)} finding(s) waived by baseline "
+                  f"({baseline_path})")
+        for b in stale:
+            print("mxlint: stale baseline entry (fixed? run "
+                  f"--write-baseline): {b.get('path')}:{b.get('symbol')} "
+                  f"[{b.get('rule')}]")
+        print(f"mxlint: {len(new)} new finding(s), "
+              f"{len(findings)} total, {elapsed:.2f}s")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

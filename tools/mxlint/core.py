@@ -1,0 +1,317 @@
+"""mxlint core: shared AST infrastructure for framework-aware lint passes.
+
+Everything a pass needs lives here so individual passes stay declarative:
+
+  - ``ModuleInfo`` — parsed module with parent links, qualified names for
+    every function, and per-line waivers (``# mxlint: disable=<rule>[,rule]``
+    or a bare ``# mxlint: disable`` waiving every rule on that line);
+  - ``Finding`` — one violation, keyed *without* line numbers so the
+    checked-in baseline survives unrelated edits;
+  - ``LintPass`` registry — module-scoped passes see one ``ModuleInfo`` at a
+    time, package-scoped passes see the whole root (used by the
+    instrumentation pass, which checks cross-file invariants);
+  - baseline load/diff/write — new findings fail, baselined ones are
+    reported as waived, stale baseline entries are surfaced so the file
+    never rots.
+
+The one-off ``tools/check_instrumentation.py`` proved the enforce-by-AST
+pattern in tier-1; mxlint generalizes it (ISSUE 3).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+DEFAULT_TARGET = REPO_ROOT / "mxnet_tpu"
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+_WAIVER_RE = re.compile(r"#\s*mxlint:\s*disable(?:=([\w,\-]+))?")
+
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation. ``ident()`` deliberately excludes the line number so
+    baseline entries stay stable while unrelated code moves around."""
+    rule: str
+    path: str          # repo-relative posix path
+    line: int
+    symbol: str        # enclosing qualified name ('' for module level)
+    message: str
+
+    def ident(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.path, self.symbol, self.message)
+
+    def text(self) -> str:
+        where = f"{self.path}:{self.line}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{where}: {self.rule}{sym}: {self.message}"
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message}
+
+
+# ---------------------------------------------------------------------------
+# Parsed modules
+# ---------------------------------------------------------------------------
+
+class ModuleInfo:
+    """A parsed source file with parent links and waiver data."""
+
+    def __init__(self, path: Path, root: Path = REPO_ROOT):
+        self.path = path
+        try:
+            self.relpath = \
+                path.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            # outside the root (CLI pointed at an arbitrary path): keep the
+            # given path so suffix-based hot lists still match
+            self.relpath = path.as_posix()
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text)
+        self._link_parents()
+        self.waivers = self._parse_waivers()
+        self._qualnames: Dict[ast.AST, str] = {}
+
+    def _link_parents(self):
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._mxlint_parent = node  # type: ignore[attr-defined]
+
+    def _parse_waivers(self) -> Dict[int, Optional[Set[str]]]:
+        """line -> set of waived rules (None = every rule)."""
+        out: Dict[int, Optional[Set[str]]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _WAIVER_RE.search(line)
+            if not m:
+                continue
+            out[i] = set(m.group(1).split(",")) if m.group(1) else None
+        return out
+
+    def is_waived(self, rule: str, line: int) -> bool:
+        waived = self.waivers.get(line, False)
+        if waived is False:
+            return False
+        return waived is None or rule in waived
+
+    # -- navigation ---------------------------------------------------------
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_mxlint_parent", None)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_function(self, node: ast.AST):
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return a
+        return None
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted name of the enclosing scope chain, e.g.
+        ``DataParallelTrainer._build_step.step`` for a nested def."""
+        if node in self._qualnames:
+            return self._qualnames[node]
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parent(cur)
+        q = ".".join(reversed(parts))
+        self._qualnames[node] = q
+        return q
+
+    def functions(self) -> Iterable[ast.FunctionDef]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers
+# ---------------------------------------------------------------------------
+
+def call_target(node: ast.Call) -> str:
+    """Dotted source text of the called object: ``a.b.f(...)`` -> 'a.b.f'."""
+    try:
+        return ast.unparse(node.func)
+    except Exception:  # pragma: no cover - unparse of exotic nodes
+        return ""
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Terminal name of a call: ``f(...)`` / ``a.b.f(...)`` -> 'f'."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost name of an attribute/subscript chain: a.b[0].c -> 'a'."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return "<expr>"
+
+
+def decorator_names(fn) -> Set[str]:
+    out = set()
+    for d in fn.decorator_list:
+        node = d.func if isinstance(d, ast.Call) else d
+        if isinstance(node, ast.Attribute):
+            out.add(node.attr)
+        elif isinstance(node, ast.Name):
+            out.add(node.id)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pass registry
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LintPass:
+    name: str
+    doc: str
+    scope: str                      # 'module' | 'package'
+    fn: Callable[..., Iterable[Finding]]
+
+
+_PASSES: "Dict[str, LintPass]" = {}
+
+
+def register_pass(name: str, doc: str, scope: str = "module"):
+    """Decorator registering a pass. Module passes get fn(module: ModuleInfo);
+    package passes get fn(pkg_root: Path)."""
+    def deco(fn):
+        if scope not in ("module", "package"):
+            raise ValueError(f"bad scope {scope!r}")
+        _PASSES[name] = LintPass(name, doc, scope, fn)
+        return fn
+    return deco
+
+
+def all_passes() -> Dict[str, LintPass]:
+    _ensure_passes_loaded()
+    return dict(_PASSES)
+
+
+_passes_loaded = [False]
+
+
+def _ensure_passes_loaded():
+    if not _passes_loaded[0]:
+        from . import passes  # noqa: F401  (import registers the passes)
+        _passes_loaded[0] = True
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+def iter_source_files(target: Path) -> List[Path]:
+    if target.is_file():
+        return [target]
+    return sorted(p for p in target.rglob("*.py")
+                  if "__pycache__" not in p.parts)
+
+
+def run_lint(target: Optional[Path] = None,
+             rules: Optional[Sequence[str]] = None,
+             root: Path = REPO_ROOT) -> List[Finding]:
+    """Run the selected passes over `target` (file or package dir).
+    Returns per-line-waiver-filtered findings, sorted by location."""
+    _ensure_passes_loaded()
+    target = Path(target) if target is not None else DEFAULT_TARGET
+    selected = {n: p for n, p in _PASSES.items()
+                if rules is None or n in rules}
+    if rules is not None:
+        unknown = set(rules) - set(_PASSES)
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)}; "
+                             f"available: {sorted(_PASSES)}")
+    findings: List[Finding] = []
+    modules: List[ModuleInfo] = []
+    for path in iter_source_files(target):
+        try:
+            modules.append(ModuleInfo(path, root=root))
+        except (OSError, SyntaxError, ValueError) as e:
+            findings.append(Finding(
+                "parse-error", str(path), 0, "",
+                f"unreadable/unparseable: {e}"))
+    for p in selected.values():
+        if p.scope == "module":
+            for mod in modules:
+                for f in p.fn(mod):
+                    if not mod.is_waived(f.rule, f.line):
+                        findings.append(f)
+        else:
+            findings.extend(p.fn(target))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: Path) -> List[Dict[str, str]]:
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text())
+    return list(data.get("findings", []))
+
+
+def write_baseline(path: Path, findings: Sequence[Finding]):
+    payload = {
+        "version": 1,
+        "comment": "Tracked legacy findings; new violations fail. Regenerate "
+                   "with: python -m tools.mxlint --write-baseline",
+        "findings": [
+            {"rule": f.rule, "path": f.path, "symbol": f.symbol,
+             "message": f.message}
+            for f in sorted(findings, key=lambda f: (f.path, f.rule))],
+    }
+    path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+
+
+def diff_baseline(findings: Sequence[Finding],
+                  baseline: Sequence[Dict[str, str]]):
+    """Split findings into (new, waived_by_baseline); also return baseline
+    entries that no longer match anything (stale)."""
+    base_idents = {(b.get("rule", ""), b.get("path", ""),
+                    b.get("symbol", ""), b.get("message", ""))
+                   for b in baseline}
+    new = [f for f in findings if f.ident() not in base_idents]
+    waived = [f for f in findings if f.ident() in base_idents]
+    found_idents = {f.ident() for f in findings}
+    stale = [b for b in baseline
+             if (b.get("rule", ""), b.get("path", ""), b.get("symbol", ""),
+                 b.get("message", "")) not in found_idents]
+    return new, waived, stale

@@ -1,0 +1,114 @@
+"""host-sync: device->host synchronization inside hot-path functions.
+
+A stray ``float()`` / ``.asnumpy()`` / ``np.asarray`` on a device array
+inside a step function blocks the dispatch queue, serializes the device,
+and breaks XLA fusion (arXiv:2301.13062) — on TPU the *whole* point of the
+fused train path is that no value crosses the host boundary per step. The
+designed sync points (metric ``get()``, checkpoint ``sync()``, the loss
+scaler's overflow read) live in functions that are deliberately NOT on the
+hot list.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import (Finding, ModuleInfo, call_name, register_pass, unparse)
+
+# (path suffix, qualname regex searched with re.search). Nested defs carry
+# the outer function in their qualname (e.g. ``DataParallelTrainer.
+# _build_step.step``), so hot-listing a builder covers the traced bodies it
+# creates.
+HOT_FUNCTIONS = [
+    ("mxnet_tpu/gluon/trainer.py",
+     r"Trainer\.(step|update|_update|allreduce_grads|_allreduce_grads)\b"),
+    ("mxnet_tpu/parallel/data_parallel.py",
+     r"DataParallelTrainer\.(step|run_steps|_build_step|"
+     r"_build_step_compressed|_get_step|_get_multi|_record_telemetry|"
+     r"_loss_raw|_put_batch|_grad_allreduce_bytes)\b"),
+    ("mxnet_tpu/parallel/data_parallel.py", r"\b_make_apply_fn\b"),
+    ("mxnet_tpu/parallel/pipeline.py",
+     r"(PipelineTrainer\.(step|_build_step|_loss_raw)\b|\bpipeline_apply\b)"),
+    ("mxnet_tpu/kvstore/kvstore.py",
+     r"KVStore(Dist)?\.(push|pull|pushpull|row_sparse_pull|broadcast)\b"),
+    ("mxnet_tpu/optimizer/optimizer.py",
+     r"(Optimizer\.(update|update_multi_precision|_update_list|_preprocess)"
+     r"\b|\w+\.update\b|Updater\.__call__\b)"),
+    ("mxnet_tpu/engine/__init__.py",
+     r"\b(lookup|insert|record_execution|record_trace)\b"),
+    # per-batch metric updates: accumulation must stay on device; the one
+    # designed host sync is get()/get_global(), which are not hot-listed
+    ("mxnet_tpu/metric.py",
+     r"(Accuracy|TopKAccuracy|MAE|MSE|RMSE|CrossEntropy|"
+     r"NegativeLogLikelihood|Loss|EvalMetric)\.(update|_update)\b"),
+    ("mxnet_tpu/gluon/utils.py", r"\bclip_global_norm\b"),
+]
+
+# host reads of *python* scalars that merely look like syncs. Matched
+# against the unparsed argument of float()/int()/bool()/np.asarray().
+ALLOWED_ARG = re.compile(
+    r"learning_rate|loss_scale|num_update|\.shape\b|\.ndim\b|\.nbytes\b|"
+    r"perf_counter|len\(|\blrs?\b|next_key_raw|batch_size|wd_mult|"
+    r"rescale_grad|\.get\(|self\._t\b|_np\.prod")
+
+_COERCIONS = {"float", "int", "bool"}
+_NUMPY_ROOTS = {"np", "_np", "numpy", "onp"}
+
+
+def _is_hot(mod: ModuleInfo, fn) -> bool:
+    qn = mod.qualname(fn)
+    for suffix, pattern in HOT_FUNCTIONS:
+        if mod.relpath.endswith(suffix) and re.search(pattern, qn):
+            return True
+    return False
+
+
+@register_pass(
+    "host-sync",
+    "device->host sync (float()/.item()/.asnumpy()/np.asarray) on a hot path")
+def check(mod: ModuleInfo):
+    hot = [fn for fn in mod.functions() if _is_hot(mod, fn)]
+    seen = set()
+    for fn in hot:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            # findings belong to the INNERMOST enclosing def (a nested
+            # step fn inside a hot builder reports as builder.step)
+            encl = mod.enclosing_function(node)
+            qn = mod.qualname(encl) if encl is not None else mod.qualname(fn)
+            name = call_name(node)
+            if name == "asnumpy":
+                seen.add(id(node))
+                yield Finding(
+                    "host-sync", mod.relpath, node.lineno, qn,
+                    f".asnumpy() blocks on device transfer: "
+                    f"`{unparse(node)[:60]}`")
+            elif name == "item" and not node.args:
+                seen.add(id(node))
+                yield Finding(
+                    "host-sync", mod.relpath, node.lineno, qn,
+                    f".item() blocks on device transfer: "
+                    f"`{unparse(node)[:60]}`")
+            elif (name in _COERCIONS and isinstance(node.func, ast.Name)
+                    and len(node.args) == 1
+                    and not isinstance(node.args[0], ast.Constant)):
+                arg = unparse(node.args[0])
+                if ALLOWED_ARG.search(arg):
+                    continue
+                seen.add(id(node))
+                yield Finding(
+                    "host-sync", mod.relpath, node.lineno, qn,
+                    f"{name}() on a (potential) device value forces a "
+                    f"blocking sync: `{name}({arg[:50]})`")
+            elif (name == "asarray" and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in _NUMPY_ROOTS and node.args):
+                arg = unparse(node.args[0])
+                if ALLOWED_ARG.search(arg):
+                    continue
+                seen.add(id(node))
+                yield Finding(
+                    "host-sync", mod.relpath, node.lineno, qn,
+                    f"np.asarray() copies device data to host: "
+                    f"`asarray({arg[:50]})`")

@@ -1,0 +1,25 @@
+"""instrumentation: every collective/step entry point routes through
+telemetry (the PR 2 invariant, previously a standalone script).
+
+The actual checks — which methods need ``@instrument_comm``, which step
+paths must call ``record_step``, which files must consult the profiler
+hook — live in ``tools/check_instrumentation.py``, which remains the
+tier-1 entry point; this wrapper registers them as a package-scoped
+mxlint pass so ``python -m tools.mxlint`` runs the full rule set.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+from ..core import register_pass
+
+
+@register_pass("instrumentation",
+               "observability entry points missing their telemetry wiring",
+               scope="package")
+def check(pkg_root: Path):
+    if pkg_root.is_file() or pkg_root.name != "mxnet_tpu":
+        return  # the instrumentation invariants are package-wide
+    from .. import _load_check_instrumentation
+    ci = _load_check_instrumentation()
+    yield from ci.findings(pkg_root)

@@ -1,0 +1,157 @@
+"""lock-discipline: module-level mutable state mutated off-lock.
+
+The bug class behind PR 2's ``Counter.increment`` fix: a module declares a
+lock (``_LOCK = threading.RLock()``) guarding its shared dicts/lists, but
+one code path mutates the state without taking it, racing a concurrent
+reader/writer. The pass only fires in modules that DECLARE a module-level
+lock — lock-free modules are presumed single-threaded by design.
+
+Checked mutations of module-level containers (dict/list/set/OrderedDict/
+defaultdict/deque displays or constructor calls):
+
+  - subscript store / delete (``_CACHE[k] = v``, ``del _CACHE[k]``);
+  - mutating method calls (append/update/clear/pop/...);
+  - read-modify-write of module-level scalars via ``global`` + AugAssign or
+    self-referential assignment (``x = max(x, v)``) — a plain overwrite of
+    a flag is atomic under the GIL and is NOT flagged.
+
+A mutation is lock-covered when an enclosing ``with`` takes one of the
+module's locks; helpers named ``*_locked`` or documented "caller holds the
+lock" are trusted callees.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from ..core import (Finding, ModuleInfo, call_name, register_pass, root_name,
+                    unparse)
+
+_LOCK_NAME = re.compile(r"(^|_)(lock|mutex)s?$", re.IGNORECASE)
+_LOCK_CTOR = re.compile(r"\b[RL]?Lock\b|\bCondition\b|\bSemaphore\b")
+_MUTATORS = {"append", "extend", "insert", "clear", "update", "pop",
+             "popitem", "setdefault", "remove", "discard", "add",
+             "appendleft", "popleft"}
+_CONTAINER_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict",
+                    "deque", "Counter"}
+_HELD_DOC = re.compile(r"caller holds|held by caller|with .*lock held",
+                       re.IGNORECASE)
+
+
+def _module_locks(mod: ModuleInfo) -> Set[str]:
+    locks: Set[str] = set()
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            if _LOCK_CTOR.search(unparse(stmt.value.func)):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        locks.add(t.id)
+    return locks
+
+
+def _module_state(mod: ModuleInfo) -> Dict[str, str]:
+    """name -> kind ('container' | 'scalar') for module-level assignments."""
+    state: Dict[str, str] = {}
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets = [stmt.target]
+        else:
+            continue
+        for t in targets:
+            if not isinstance(t, ast.Name) or _LOCK_NAME.search(t.id):
+                continue
+            v = stmt.value
+            if isinstance(v, (ast.Dict, ast.List, ast.Set)) or (
+                    isinstance(v, ast.Call)
+                    and call_name(v) in _CONTAINER_CTORS):
+                state[t.id] = "container"
+            elif isinstance(v, ast.Constant) \
+                    and isinstance(v.value, (int, float)):
+                state[t.id] = "scalar"
+    return state
+
+
+def _under_lock(mod: ModuleInfo, node: ast.AST, locks: Set[str]) -> bool:
+    for anc in mod.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                expr = unparse(item.context_expr)
+                if any(lk in expr for lk in locks):
+                    return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if anc.name.endswith("_locked"):
+                return True
+            doc = ast.get_docstring(anc) or ""
+            if _HELD_DOC.search(doc):
+                return True
+    return False
+
+
+@register_pass(
+    "lock-discipline",
+    "module-level mutable state mutated without the module's declared lock")
+def check(mod: ModuleInfo):
+    locks = _module_locks(mod)
+    if not locks:
+        return
+    state = _module_state(mod)
+    if not state:
+        return
+
+    def finding(node, name, what):
+        qn_fn = mod.enclosing_function(node)
+        qn = mod.qualname(qn_fn) if qn_fn is not None else ""
+        lk = sorted(locks)[0]
+        return Finding(
+            "lock-discipline", mod.relpath, node.lineno, qn,
+            f"{what} of module state `{name}` outside `with {lk}` — racy "
+            "read-modify-write (the Counter.increment bug class)")
+
+    for fn in mod.functions():
+        globals_declared: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                globals_declared.update(node.names)
+        for node in ast.walk(fn):
+            # container: subscript store/delete (tuple targets unpacked)
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+                targets = (node.targets if isinstance(node, (ast.Assign,
+                                                             ast.Delete))
+                           else [node.target])
+                flat = []
+                for t in targets:
+                    flat.extend(t.elts if isinstance(t, (ast.Tuple, ast.List))
+                                else [t])
+                for t in flat:
+                    if isinstance(t, ast.Subscript):
+                        rn = root_name(t)
+                        if rn and state.get(rn) == "container" \
+                                and not _under_lock(mod, node, locks):
+                            yield finding(node, rn, "subscript write")
+            # container: mutating method call
+            if isinstance(node, ast.Call) and call_name(node) in _MUTATORS \
+                    and isinstance(node.func, ast.Attribute):
+                rn = root_name(node.func.value)
+                if rn and state.get(rn) == "container" \
+                        and not _under_lock(mod, node, locks):
+                    yield finding(node, rn, f".{call_name(node)}()")
+            # scalar: read-modify-write via global
+            if isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.target.id in globals_declared \
+                    and state.get(node.target.id) == "scalar" \
+                    and not _under_lock(mod, node, locks):
+                yield finding(node, node.target.id, "augmented assignment")
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) \
+                            and t.id in globals_declared \
+                            and state.get(t.id) == "scalar" \
+                            and any(isinstance(n, ast.Name) and n.id == t.id
+                                    for n in ast.walk(node.value)) \
+                            and not _under_lock(mod, node, locks):
+                        yield finding(node, t.id,
+                                      "self-referential assignment")

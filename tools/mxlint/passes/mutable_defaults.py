@@ -1,0 +1,35 @@
+"""mutable-default: mutable default argument values.
+
+A ``def f(x, cache={})`` default is created once at def time and shared by
+every call — state leaks across calls (and across *processes'* expectations
+when the function feeds a cache fingerprint). Package-wide mechanical rule;
+``None``-defaulting with an in-body fill is the fix.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, ModuleInfo, call_name, register_pass, unparse
+
+_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict", "deque"}
+
+
+def _mutable(node) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set)):
+        return True
+    return isinstance(node, ast.Call) and call_name(node) in _CTORS
+
+
+@register_pass("mutable-default",
+               "mutable default argument shared across calls")
+def check(mod: ModuleInfo):
+    for fn in mod.functions():
+        defaults = list(fn.args.defaults) + \
+            [d for d in fn.args.kw_defaults if d is not None]
+        for d in defaults:
+            if _mutable(d):
+                yield Finding(
+                    "mutable-default", mod.relpath, d.lineno,
+                    mod.qualname(fn),
+                    f"mutable default `{unparse(d)[:40]}` is shared across "
+                    "calls; default to None and fill inside the body")

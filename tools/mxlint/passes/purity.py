@@ -1,0 +1,115 @@
+"""jit-purity: side effects inside functions that jax traces.
+
+A traced function runs ONCE per signature; anything impure inside it either
+bakes a stale value into the compiled artifact (``time.time()``, Python
+``random``) or silently stops firing after the first call (telemetry,
+profiler, prints, mutation of module state). Telemetry must wrap the
+*dispatch* of a compiled step, never live inside it — the contract PR 2
+established (`with _telem.annotate(...)` around the jit call).
+
+Traced candidates are found two ways:
+
+  - defs decorated with ``@jax.jit`` / ``@partial(jax.jit, ...)``;
+  - defs whose *name* is later handed to a tracing entry point
+    (``jax.jit``, ``jax.vjp``, ``pjit``, ``jax.grad``/``value_and_grad``,
+    ``shard_map``, ``lax.scan``, ``jax.checkpoint``).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from ..core import (Finding, ModuleInfo, call_name, call_target,
+                    decorator_names, register_pass, unparse)
+
+# callables that trace their (first) function argument
+_TRACING_ENTRY = {"jit", "pjit", "vjp", "grad", "value_and_grad",
+                  "shard_map", "scan", "checkpoint", "remat", "custom_vjp"}
+
+# call targets that must not execute inside a traced region
+_BANNED_TIME = {"time.time", "time.perf_counter", "time.monotonic",
+                "time.process_time", "datetime.now", "datetime.utcnow"}
+_TELEMETRY_ROOTS = {"telemetry", "_telem", "_telemetry"}
+_PROFILER_ROOTS = {"profiler", "_profiler"}
+_RANDOM_ROOTS = {"random"}        # python stdlib; np.random handled below
+
+
+def _traced_defs(mod: ModuleInfo) -> Set[ast.AST]:
+    traced_names: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if call_name(node) in _TRACING_ENTRY and node.args:
+            first = node.args[0]
+            if isinstance(first, ast.Name):
+                traced_names.add(first.id)
+    out: Set[ast.AST] = set()
+    for fn in mod.functions():
+        decs = decorator_names(fn)
+        if decs & {"jit", "pjit"}:
+            out.add(fn)
+            continue
+        # @partial(jax.jit, ...) — partial's first arg is the tracer
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call) and call_name(dec) == "partial" \
+                    and dec.args and unparse(dec.args[0]).endswith("jit"):
+                out.add(fn)
+        if fn.name in traced_names:
+            out.add(fn)
+    return out
+
+
+def _banned_call(node: ast.Call):
+    target = call_target(node)
+    if target in _BANNED_TIME:
+        return (f"`{target}()` is frozen at trace time — the compiled step "
+                "replays one stale value forever")
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        root = f.value.id
+        if root in _RANDOM_ROOTS:
+            return (f"Python `random.{f.attr}` draws once at trace time; use "
+                    "jax.random with a traced key")
+        if root in _TELEMETRY_ROOTS:
+            return (f"telemetry call `{target}` inside a traced function "
+                    "fires only at trace time — record around the jit "
+                    "dispatch instead")
+        if root in _PROFILER_ROOTS:
+            return (f"profiler call `{target}` inside a traced function "
+                    "fires only at trace time")
+    if target.startswith(("np.random.", "numpy.random.", "_np.random.",
+                          "onp.random.")):
+        return (f"`{target}` produces a trace-time constant; use jax.random "
+                "with a traced key")
+    if isinstance(f, ast.Name) and f.id == "print":
+        return ("print() inside a traced function fires only at trace time; "
+                "use jax.debug.print for runtime values")
+    return None
+
+
+@register_pass(
+    "jit-purity",
+    "side effects (time/random/telemetry/global mutation) in traced code")
+def check(mod: ModuleInfo):
+    for fn in _traced_defs(mod):
+        qn = mod.qualname(fn)
+        globals_declared: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                globals_declared.update(node.names)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                msg = _banned_call(node)
+                if msg:
+                    yield Finding("jit-purity", mod.relpath, node.lineno,
+                                  qn, msg)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id in globals_declared:
+                        yield Finding(
+                            "jit-purity", mod.relpath, node.lineno, qn,
+                            f"mutation of nonlocal/module state `{t.id}` "
+                            "inside a traced function happens at trace time "
+                            "only — the compiled step never repeats it")

@@ -1,0 +1,127 @@
+"""retrace-hazard: patterns that silently recompile per step or break
+cache-key determinism.
+
+Full-program TPU compilation (arXiv:1810.09868) assumes the step function
+traces once per signature; ``tests/test_retrace_stability.py`` checks the
+invariant dynamically, this pass extends it statically:
+
+  - **unsorted dict iteration in a fingerprint/cache-key context** — dict
+    order is insertion order, so two semantically identical configs built in
+    different orders fingerprint differently and compile twice;
+  - **id() in a fingerprint context** — ``id()`` changes across processes,
+    so persistent/compile caches keyed on it never hit across runs;
+  - **value-dependent static jit args** — marking a hyperparameter
+    (lr/scale/step/...) static retraces on every value change; hyperparams
+    must be *traced* scalars (the invariant
+    test_scalar_hyperparam_change_does_not_retrace_optimizer checks).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import (Finding, ModuleInfo, call_name, call_target,
+                    register_pass, unparse)
+
+# a function (or assignment target) is "key-building" when its name says so
+_KEY_CONTEXT = re.compile(r"fingerprint|cache_key|_key\b|\bkey\b|\bsig"
+                          r"|signature|stable_value", re.IGNORECASE)
+
+# hyperparameters that change per step / per schedule tick: marking these
+# static means one XLA compile per distinct value
+_VALUE_DEPENDENT = re.compile(
+    r"^(lr|learning_rate|loss_scale|scale|t|step|num_update|epoch|"
+    r"momentum|wd|beta\d*|eps|epsilon|rescale_grad|clip.*)$")
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _in_key_context(mod: ModuleInfo, node: ast.AST) -> bool:
+    fn = mod.enclosing_function(node)
+    if fn is not None and _KEY_CONTEXT.search(fn.name):
+        return True
+    for anc in mod.ancestors(node):
+        if isinstance(anc, ast.Assign):
+            for t in anc.targets:
+                if _KEY_CONTEXT.search(unparse(t)):
+                    return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+    return False
+
+
+def _dict_iter_unsorted(mod: ModuleInfo, node: ast.Call) -> bool:
+    """X.items()/keys()/values() not directly wrapped in sorted(...)."""
+    if call_name(node) not in ("items", "keys", "values"):
+        return False
+    parent = mod.parent(node)
+    return not (isinstance(parent, ast.Call)
+                and call_name(parent) == "sorted")
+
+
+def _static_params(node: ast.Call):
+    """Names marked static in a jit/pjit call, resolved from
+    static_argnames directly or static_argnums + a local def."""
+    names = []
+    argnums = []
+    for kw in node.keywords:
+        if kw.arg == "static_argnames":
+            for elt in ast.walk(kw.value):
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    names.append(elt.value)
+        elif kw.arg == "static_argnums":
+            for elt in ast.walk(kw.value):
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                    argnums.append(elt.value)
+    return names, argnums
+
+
+@register_pass(
+    "retrace-hazard",
+    "unstable jit signatures / nondeterministic compile-cache fingerprints")
+def check(mod: ModuleInfo):
+    # local defs, for resolving static_argnums positionally
+    defs = {}
+    for fn in mod.functions():
+        defs.setdefault(fn.name, fn)
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        qn_fn = mod.enclosing_function(node)
+        qn = mod.qualname(qn_fn) if qn_fn is not None else ""
+
+        if _dict_iter_unsorted(mod, node) and _in_key_context(mod, node):
+            yield Finding(
+                "retrace-hazard", mod.relpath, node.lineno, qn,
+                f"dict-order-dependent cache fingerprint: wrap "
+                f"`{unparse(node)[:50]}` in sorted() so semantically equal "
+                "configs key identically")
+
+        if (isinstance(node.func, ast.Name) and node.func.id == "id"
+                and len(node.args) == 1 and _in_key_context(mod, node)):
+            yield Finding(
+                "retrace-hazard", mod.relpath, node.lineno, qn,
+                f"id() in a cache fingerprint is process-local: "
+                f"`id({unparse(node.args[0])[:40]})` never matches across "
+                "runs, defeating the persistent compilation cache")
+
+        if call_name(node) in _JIT_NAMES:
+            target = call_target(node)
+            if target not in ("jax.jit", "jit", "pjit", "jax.pjit") \
+                    and not target.endswith(".jit"):
+                continue
+            static_names, argnums = _static_params(node)
+            if argnums and node.args and isinstance(node.args[0], ast.Name):
+                f = defs.get(node.args[0].id)
+                if f is not None:
+                    params = [a.arg for a in f.args.args]
+                    static_names += [params[i] for i in argnums
+                                     if i < len(params)]
+            for n in static_names:
+                if _VALUE_DEPENDENT.match(n):
+                    yield Finding(
+                        "retrace-hazard", mod.relpath, node.lineno, qn,
+                        f"value-dependent static jit arg {n!r}: every new "
+                        "value recompiles — pass it as a traced scalar "
+                        "instead")
