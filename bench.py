@@ -406,6 +406,139 @@ def bench_telemetry_overhead(steps, warmup):
     }
 
 
+def bench_zero_dp(steps, warmup):
+    """A/B: replicated weight update vs the ZeRO-style sharded update
+    (DataParallelTrainer(zero_update=True), arXiv:2004.13336) on the
+    ResNet-50 and wide-conv configs. Reports per-variant step time,
+    per-step collective bytes by kind (ring estimates, the same
+    accounting telemetry books), optimizer-state bytes per replica, and
+    live device bytes per replica.
+
+    A single chip cannot host >1 data-parallel replica, so the mesh runs
+    over virtual host devices (XLA_FLAGS set by main() before backend
+    init) unless the process already sees >= BENCH_ZERO_DP real devices;
+    the A/B is about the relative update/collective structure, and the
+    configs are scaled down (BENCH_ZERO_IMAGE/BENCH_ZERO_BATCH) so the
+    CPU mesh finishes in bench time."""
+    import gc
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, gluon
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+    from mxnet_tpu.parallel import zero as zero_mod
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    ndp = int(os.environ.get("BENCH_ZERO_DP", 8))
+    devs = jax.devices()
+    if len(devs) < ndp:
+        devs = jax.devices("cpu")
+    assert len(devs) >= ndp, f"need {ndp} devices for the dp mesh"
+    mesh = make_mesh({"dp": ndp}, devices=devs[:ndp])
+    rs = np.random.RandomState(0)
+
+    # local batch = batch/dp; keep it >= 4 — the shard_map body runs
+    # per-device BatchNorm, and ResNet-50's 50+ BN layers diverge on the
+    # statistics of 2-sample tiles (docs/data_parallel.md "when not to")
+    image = int(os.environ.get("BENCH_ZERO_IMAGE", 32))
+    batch = int(os.environ.get("BENCH_ZERO_BATCH", 32))
+
+    def resnet():
+        net = resnet50_v1()
+        with mx.cpu():
+            net.initialize(ctx=mx.cpu())
+            net(nd.zeros((1, 3, image, image), ctx=mx.cpu()))
+        x = nd.array(rs.uniform(-1, 1, (batch, 3, image, image))
+                     .astype(np.float32))
+        y = nd.array(rs.randint(0, 1000, (batch,)), dtype="int32")
+        return net, x, y
+
+    def wide_conv(ch=256, hw=14):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(ch, 3, padding=1, activation="relu"),
+                gluon.nn.Conv2D(ch, 3, padding=1, activation="relu"),
+                gluon.nn.GlobalAvgPool2D(), gluon.nn.Flatten(),
+                gluon.nn.Dense(1000))
+        with mx.cpu():
+            net.initialize(ctx=mx.cpu())
+            net(nd.zeros((1, 3, hw, hw), ctx=mx.cpu()))
+        x = nd.array(rs.uniform(-1, 1, (batch, 3, hw, hw))
+                     .astype(np.float32))
+        y = nd.array(rs.randint(0, 1000, (batch,)), dtype="int32")
+        return net, x, y
+
+    def run(make_cfg, zero):
+        mx.random.seed(0)
+        net, x, y = make_cfg()
+        # momentum so the sharded state shrink is visible; conservative lr —
+        # the shard_map paths normalize BN over each replica's LOCAL batch
+        # (2-8 samples here), and an aggressive lr diverges on that noise
+        tr = DataParallelTrainer(
+            net, _loss_tokens, optimizer="sgd",
+            optimizer_params={
+                "learning_rate": float(os.environ.get("BENCH_ZERO_LR",
+                                                      0.005)),
+                "momentum": 0.9},
+            mesh=mesh, zero_update=zero,
+            comm_dtype=os.environ.get("MXNET_TPU_COMM_DTYPE") or None
+            if zero else None)
+        float(tr.run_steps(x, y, max(warmup, 1))[-1])
+        best = float("inf")
+        loss = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            losses = tr.run_steps(x, y, steps)
+            loss = float(losses[-1])
+            best = min(best, time.perf_counter() - t0)
+        if zero:
+            comm = {
+                "reduce_scatter": zero_mod.reduce_scatter_wire_bytes(
+                    tr._zero_plan, ndp, tr._comm_dtype),
+                "all_gather": zero_mod.all_gather_wire_bytes(
+                    tr._zero_plan, ndp),
+                "buckets": len(tr._zero_plan),
+            }
+        else:
+            comm = {"allreduce": tr._grad_allreduce_bytes()}
+        out = {
+            "step_ms": round(best / steps * 1e3, 3),
+            "collective_bytes_per_step": comm,
+            "opt_state_bytes_per_replica": tr._opt_state_replica_bytes(),
+            # per-replica live footprint: sharded leaves count their local
+            # shard only (same accounting as the telemetry gauge)
+            "live_bytes_per_replica": zero_mod.per_replica_state_bytes(
+                jax.live_arrays()),
+            "final_loss": round(loss, 4),
+        }
+        del tr, net, x, y
+        gc.collect()
+        return out
+
+    configs = {"resnet50": resnet, "wide_conv": wide_conv}
+    if os.environ.get("BENCH_QUICK") == "1":
+        configs.pop("resnet50")
+    extra = {"dp": ndp, "batch": batch, "image": image}
+    for name, cfg in configs.items():
+        rep = run(cfg, zero=False)
+        zro = run(cfg, zero=True)
+        extra[name] = {
+            "replicated": rep,
+            "zero": zro,
+            "step_time_ratio": round(zro["step_ms"]
+                                     / max(rep["step_ms"], 1e-9), 3),
+            "opt_state_shrink": round(
+                zro["opt_state_bytes_per_replica"]
+                / max(rep["opt_state_bytes_per_replica"], 1), 4),
+        }
+    key = "wide_conv" if "wide_conv" in extra else "resnet50"
+    return {
+        "metric": "zero_dp_step_time_ratio",
+        "value": extra[key]["step_time_ratio"],
+        "unit": "zero/replicated",
+        "vs_baseline": extra[key]["opt_state_shrink"],  # ~1/dp target
+        "extra": extra,
+    }
+
+
 def bench_lint_walltime():
     """Static-analyzer cost over the whole package (tier-1 runs mxlint via
     tests/test_lint_clean.py, so it must stay well under the suite budget:
@@ -436,6 +569,19 @@ def main():
     if os.environ.get("BENCH_SCENARIO") == "lint_walltime":
         # no backend init needed (and none wanted: this must run anywhere)
         print(json.dumps(bench_lint_walltime()))
+        return
+    if os.environ.get("BENCH_SCENARIO") == "zero_dp":
+        # the dp mesh needs >1 device; request virtual host devices BEFORE
+        # the CPU backend initializes (no-op when real devices suffice)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                + os.environ.get("BENCH_ZERO_DP", "8")).strip()
+        _enable_compile_cache()
+        print(json.dumps(bench_zero_dp(
+            int(os.environ.get("BENCH_TRAIN_STEPS", 5)),
+            int(os.environ.get("BENCH_TRAIN_WARMUP", 2)))))
         return
     _enable_compile_cache()
     if os.environ.get("BENCH_SCENARIO") == "train_step":

@@ -30,7 +30,8 @@ from typing import Any, Dict, Optional, Tuple
 __all__ = ["lookup", "insert", "clear_compilation_cache", "cache_stats",
            "reset_stats", "donation_enabled", "record_donation",
            "compile_timer", "record_trace", "record_execution",
-           "estimate_cost", "structural_fingerprint", "graph_fingerprint"]
+           "estimate_cost", "structural_fingerprint", "graph_fingerprint",
+           "config_fingerprint"]
 
 
 _LOCK = threading.RLock()
@@ -285,3 +286,13 @@ def structural_fingerprint(block) -> str:
 def graph_fingerprint(text: str) -> str:
     """Digest of an explicit graph serialization (Symbol.tojson)."""
     return hashlib.sha1(text.encode()).hexdigest()
+
+
+def config_fingerprint(**config) -> Tuple:
+    """Deterministic token tuple for a trainer/executor configuration.
+    Values go through ``_stable_value`` (scalars and containers by value,
+    opaque objects by identity). The fused-step caches key on this so two
+    configurations that must compile apart — e.g. distinct
+    zero-update/bucket-size/comm-dtype settings — never share an artifact,
+    while N instances of one configuration share a single executable."""
+    return tuple((k, _stable_value(config[k])) for k in sorted(config))

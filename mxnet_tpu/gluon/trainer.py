@@ -129,14 +129,28 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        if not self._update_on_kvstore_flag:
+            live = [(i, p) for i, p in enumerate(self._params)
+                    if p.grad_req != "null"]
+            if len(live) > 1 and (self._kvstore.type.startswith("dist")
+                                  or self._kvstore.type in ("tpu", "nccl")):
+                # one batched pushpull: grads ride the kvstore's bucketed
+                # reduce path (parallel/zero.py fusion buckets — one
+                # collective per bucket instead of one per key) and come
+                # back globally reduced, so the local updater then applies
+                # the same update on every worker
+                grads = [p.grad() for _, p in live]
+                self._kvstore.pushpull([i for i, _ in live], grads,
+                                       out=grads)
+                return
+            for i, p in live:
+                self._kvstore.push(i, p.grad())
+            return
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
-            if self._update_on_kvstore_flag:
-                # weights live on the store: fused pushpull applies update there
-                self._kvstore.pushpull(i, p.grad(), out=p.data())
-            else:
-                self._kvstore.push(i, p.grad())
+            # weights live on the store: fused pushpull applies update there
+            self._kvstore.pushpull(i, p.grad(), out=p.data())
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

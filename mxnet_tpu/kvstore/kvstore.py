@@ -129,7 +129,18 @@ class KVStore:
 
     @_telem.instrument_comm("pushpull")
     def pushpull(self, key, value, out=None, priority=0):
-        """Fused allreduce-style op (reference MXKVStorePushPullEx)."""
+        """Fused allreduce-style op (reference MXKVStorePushPullEx).
+
+        A LIST of keys with no store-side updater rides the bucketed path:
+        the merged values are flattened into dtype-homogeneous fusion
+        buckets (parallel/zero.py planner, MXNET_TPU_BUCKET_BYTES) and
+        cross-reduced with one collective per bucket instead of one per
+        key — the same bucketed reduce-scatter the ZeRO-style fused step
+        uses, so gluon Trainer's batched allreduce_grads benefits too."""
+        if (self._updater is None and not self._compression
+                and isinstance(key, (list, tuple)) and len(key) > 1
+                and self._pushpull_bucketed(key, value, out)):
+            return
         keys, values = self._normalize(key, value)
         for idx, (k, v) in enumerate(zip(keys, values)):
             vlist = v if isinstance(v, (list, tuple)) else [v]
@@ -150,6 +161,75 @@ class KVStore:
                 olist = o if isinstance(o, (list, tuple)) else [o]
                 for t in olist:
                     t._set_data(src._data.astype(t.dtype))
+
+    def _pushpull_bucketed(self, keys, values, out=None):
+        """Bucketed pushpull body: returns False when any key is unsuitable
+        (row_sparse / non-float values) so the caller falls back to the
+        per-key path. The local device reduce runs per BUCKET when every
+        key carries the same contributor count (the Trainer case: one grad
+        per device for every param) — the contributors' flat buckets stack
+        into one fused fp32 reduction (``zero._k_bucket_reduce``) instead
+        of one reduction per key; then one cross reduction per bucket
+        (``_cross_bucket``), then the per-key store/out write-back with
+        the same semantics as the per-key loop."""
+        keys, vals = self._normalize(keys, values)
+        vlists = []
+        for v in vals:
+            vlist = list(v) if isinstance(v, (list, tuple)) else [v]
+            if any(getattr(x, "stype", "default") != "default" or
+                   not jnp.issubdtype(x._data.dtype, jnp.floating)
+                   for x in vlist):
+                return False
+            vlists.append(vlist)
+        from ..parallel import zero as _zero
+        from ..base import env as _env
+        buckets = _zero.plan_buckets(
+            [(i, v[0]._data.shape, v[0]._data.dtype)
+             for i, v in enumerate(vlists)],
+            ndp=1, bucket_bytes=int(_env.get("MXNET_TPU_BUCKET_BYTES")))
+        dtypes = [v[0]._data.dtype for v in vlists]
+        counts = {len(v) for v in vlists}
+        reduced = [None] * len(keys)
+        if counts == {1}:
+            raws = [v[0]._data for v in vlists]
+            for b in buckets:
+                flat = self._cross_bucket(_zero.flatten_bucket(b, raws))
+                for i, arr in _zero.unflatten_bucket(b, flat):
+                    reduced[i] = arr.astype(dtypes[i])
+        elif len(counts) == 1:
+            n = counts.pop()
+            for b in buckets:
+                stacked = jnp.stack(
+                    [_zero.flatten_bucket(b, [v[c]._data for v in vlists])
+                     for c in range(n)])
+                flat = self._cross_bucket(_zero._k_bucket_reduce(stacked))
+                for i, arr in _zero.unflatten_bucket(b, flat):
+                    reduced[i] = arr.astype(dtypes[i])
+        else:
+            # ragged contributor counts: per-key local reduce, bucketed
+            # cross reduction only
+            raws = [self._reduce(v)._data for v in vlists]
+            for b in buckets:
+                flat = self._cross_bucket(_zero.flatten_bucket(b, raws))
+                for i, arr in _zero.unflatten_bucket(b, flat):
+                    reduced[i] = arr.astype(dtypes[i])
+        for idx_k, (k, v0, r) in enumerate(zip(keys, vlists, reduced)):
+            src = type(v0[0])(r, v0[0].ctx)
+            if k in self._store:
+                # push-then-pull: persist the merged value like push does
+                self._store[k]._set_data(r.astype(self._store[k].dtype))
+            if out is not None:
+                o = out[idx_k] if isinstance(out, (list, tuple)) else out
+                olist = o if isinstance(o, (list, tuple)) else [o]
+                for t in olist:
+                    t._set_data(src._data.astype(t.dtype))
+        return True
+
+    def _cross_bucket(self, flat):
+        """Cross-worker reduction of one flat fusion bucket; identity for
+        single-process stores (the per-key ``_reduce`` already summed the
+        device list), one fused collective per bucket in KVStoreDist."""
+        return flat
 
     @staticmethod
     def _fill_rows_out(t, rows, idx, table_shape):
@@ -552,7 +632,9 @@ class KVStoreDist(KVStore):
         reduce-scatter + all-gather on the wire): ~2x tensor bytes per
         worker instead of the N x full-tensor allgather — the collective
         analog of the reference's key-sharded server transfer
-        (kvstore_dist.h:606 EncodeDefaultKey + BIGARRAY_BOUND)."""
+        (kvstore_dist.h:606 EncodeDefaultKey + BIGARRAY_BOUND).
+        Accumulates in float32 (and returns float32) so a bf16-compressed
+        wire dtype never degrades the sum; callers cast back."""
         import numpy as _np
         from jax.sharding import NamedSharding, PartitionSpec as P
         key = (tuple(x.shape), str(x.dtype))
@@ -561,7 +643,7 @@ class KVStoreDist(KVStore):
             mesh = self._proc_mesh()
             sh_in = NamedSharding(mesh, P("proc"))
             sh_out = NamedSharding(mesh, P())
-            fn = jax.jit(lambda a: jnp.sum(a, axis=0),
+            fn = jax.jit(lambda a: jnp.sum(a.astype(jnp.float32), axis=0),
                          out_shardings=sh_out)
             cached = (fn, sh_in)
             self._allreduce_cache[key] = cached
@@ -570,6 +652,22 @@ class KVStoreDist(KVStore):
             sh_in, _np.asarray(x)[None])
         out = fn(xg)
         return jnp.asarray(out.addressable_data(0))
+
+    def _cross_bucket(self, flat):
+        """One fused cross-process reduction per fusion bucket. The wire
+        dtype honors MXNET_TPU_COMM_DTYPE='bfloat16' (half the DCN bytes;
+        accumulation stays fp32 inside _allreduce_xla). int8 is only
+        offered by the fused zero step, whose chunk scales live inside the
+        same jit — an eager per-bucket requantization here would cost more
+        than it saves."""
+        if not (self._sync and jax.process_count() > 1):
+            return flat
+        from ..parallel import zero as _zero
+        comm = _zero.canonical_comm_dtype(
+            os.environ.get("MXNET_TPU_COMM_DTYPE") or None)
+        if comm == "bfloat16":
+            flat = flat.astype(jnp.bfloat16)
+        return self._allreduce_xla(flat)
 
     def _cross(self, merged):
         if self._sync and jax.process_count() > 1:

@@ -1,9 +1,9 @@
 from .optimizer import (Optimizer, Updater, get_updater, create, register,
                         SGD, NAG, SGLD, Signum, DCASGD, FTML, LARS, LAMB, LBSGD,
                         Adam, AdamW, AdaGrad, AdaDelta, RMSProp, Ftrl, Adamax,
-                        Nadam, Test)
+                        Nadam, Test, init_functional_state)
 
 __all__ = ["Optimizer", "Updater", "get_updater", "create", "register",
            "SGD", "NAG", "SGLD", "Signum", "DCASGD", "FTML", "LARS", "LAMB",
            "LBSGD", "Adam", "AdamW", "AdaGrad", "AdaDelta", "RMSProp", "Ftrl",
-           "Adamax", "Nadam", "Test"]
+           "Adamax", "Nadam", "Test", "init_functional_state"]

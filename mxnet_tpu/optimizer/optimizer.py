@@ -273,6 +273,25 @@ def _update_kernel(*donate):
     return wrap
 
 
+def init_functional_state(init_fn, weight, sharding=None):
+    """Materialize a functional-optimizer state tree for ``weight``
+    (``init_fn`` from ``parallel.functional_optimizer``).
+
+    With ``sharding`` — the ZeRO-style sharded weight update
+    (arXiv:2004.13336) passes the per-shard ``NamedSharding`` over the dp
+    axis — every state leaf is CREATED under that sharding: the init runs
+    as a jit with ``out_shardings``, so each replica materializes only its
+    1/N shard instead of allocating the full state and resharding it (which
+    would momentarily hold the replicated footprint the sharding exists to
+    avoid)."""
+    if sharding is None:
+        return init_fn(weight)
+    template = jax.eval_shape(init_fn, weight)
+    if not jax.tree_util.tree_leaves(template):
+        return init_fn(weight)  # stateless (plain SGD): nothing to place
+    return jax.jit(init_fn, out_shardings=sharding)(weight)
+
+
 @_update_kernel(0)
 def _k_sgd(w, g, lr, wd, rescale, clip):
     return _oo.sgd_update(w, g, lr, wd=wd, rescale_grad=rescale,

@@ -4,8 +4,13 @@ SURVEY.md §3.5: Trainer.step → kvstore pushpull → Comm/NCCL/ps-lite).
 One `jax.jit` computes forward + backward + allreduce + optimizer update:
 batch enters sharded over the 'dp' mesh axis, parameters stay replicated (or
 sharded per their Parameter.sharding spec for TP), and XLA inserts the grad
-all-reduce over ICI. Weight update runs replicated (or sharded — ZeRO-style —
-when the optimizer state spec says so).
+all-reduce over ICI. Weight update runs replicated, or sharded — ZeRO-style
+(arXiv:2004.13336) — with ``zero_update=True``/``MXNET_TPU_ZERO=1``:
+gradients flatten into fusion buckets (parallel/zero.py), reduce-scatter
+over dp (optionally bf16/int8-compressed, ``MXNET_TPU_COMM_DTYPE``), each
+replica updates its 1/N shard against 1/N of the optimizer state, and the
+updated shards all-gather back into the replicated weights inside the same
+jit so XLA can overlap the gather with the next forward.
 """
 from __future__ import annotations
 
@@ -18,7 +23,7 @@ import jax.numpy as jnp
 import numpy as _np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..base import MXNetError
+from ..base import MXNetError, env
 from ..ndarray import NDArray
 from .. import autograd
 from .. import engine as _engine
@@ -28,6 +33,7 @@ from .. import telemetry as _telem
 from ..gluon.block import HybridBlock, _AUX_STACK
 from ..gluon.parameter import Parameter
 from .. import optimizer as opt_mod
+from . import zero as _zero
 from .mesh import current_mesh, P
 
 
@@ -275,7 +281,8 @@ class DataParallelTrainer:
     def __init__(self, net: HybridBlock, loss, optimizer="sgd",
                  optimizer_params=None, mesh: Optional[Mesh] = None,
                  batch_axis_name: str = "dp", dtype=None, data_spec=None,
-                 compression=None):
+                 compression=None, zero_update=None, bucket_bytes=None,
+                 comm_dtype=None):
         self.net = net
         # Mixed precision: dtype="bfloat16" (or "float16") runs forward/backward
         # in low precision with fp32 master weights + fp32 optimizer math —
@@ -328,8 +335,6 @@ class DataParallelTrainer:
                       getattr(p, "grad_stype", "default") == "row_sparse"
                       for p in self._plist]
         self._params_raw = [p._data._data for p in self._plist]
-        self._opt_state = [self._init_fn(w) if t else ()
-                           for w, t in zip(self._params_raw, self._trainable)]
         self._t = 0
         self._step_jit: Dict[Any, Callable] = {}
         # telemetry: per-signature cost_analysis of the fused step (captured
@@ -337,29 +342,47 @@ class DataParallelTrainer:
         self._step_cost: Dict[Any, Dict[str, float]] = {}
         self._dp_degree = int(dict(self.mesh.shape).get(batch_axis_name, 1))
         self._ar_bytes: Optional[int] = None
+        self._rs_bytes: Optional[int] = None   # zero: reduce-scatter wire
+        self._ag_bytes: Optional[int] = None   # zero: all-gather wire
+        self._opt_bytes: Optional[int] = None  # per-replica state footprint
+        self._wds = [self.optimizer._get_wd(i)
+                     for i in range(len(self._plist))]
+
+        # ZeRO-style sharded weight update (arXiv:2004.13336; parallel/zero)
+        if zero_update is None:
+            zero_update = bool(env.get("MXNET_TPU_ZERO"))
+        self._zero = bool(zero_update)
+        self._bucket_bytes = int(bucket_bytes if bucket_bytes is not None
+                                 else env.get("MXNET_TPU_BUCKET_BYTES"))
+        if comm_dtype is None:
+            comm_dtype = env.get("MXNET_TPU_COMM_DTYPE") or None
+        self._comm_dtype = _zero.canonical_comm_dtype(comm_dtype) \
+            if self._zero else None
 
         # shardings: params per their spec (default replicated)
         self._param_shardings = [
             NamedSharding(self.mesh, p.sharding if p.sharding is not None else P())
             for p in self._plist]
-        # copy=True: the step jit donates these buffers, and without a copy
-        # donation would delete the gluon Parameters' own arrays (breaking any
-        # later use of the net or a second trainer on it)
-        self._params_raw = [self._put_replicated(jnp.array(w, copy=True), s)
-                            for w, s in zip(self._params_raw, self._param_shardings)]
-        # opt_state was initialized from the params BEFORE placement (nets
-        # deferred-init on CPU), so it must be lifted onto the mesh exactly
-        # like the params — single-process included: the step jit requires
+        self._params_raw = [self._place_param(w, s)
+                            for w, s in zip(self._params_raw,
+                                            self._param_shardings)]
+        # Optimizer state is created from the PLACED master weights, so each
+        # leaf is born with its final placement (zeros_like inherits the
+        # NamedSharding) — single-process included: the step jit requires
         # params and opt_state co-located, and net init under mx.cpu() on a
         # TPU-visible process otherwise leaves the state on the host. In
         # multi-controller SPMD this doubles as the global-array lift
         # (identical-per-process seeded state, the reference's rank-0
-        # broadcast contract).
-        self._opt_state = [
-            jax.tree_util.tree_map(
-                lambda l: self._put_replicated(l, s), st) if t else st
-            for st, s, t in zip(self._opt_state, self._param_shardings,
-                                self._trainable)]
+        # broadcast contract). Zero mode instead shards the state 1/dp over
+        # flat fusion buckets.
+        if self._zero:
+            self._validate_zero(compression)
+            self._init_zero_state()
+        else:
+            self._zero_plan = ()
+            self._opt_state = [self._init_fn(w) if t else ()
+                               for w, t in zip(self._params_raw,
+                                               self._trainable)]
 
         # 2-bit gradient compression with per-device error feedback
         # (reference src/kvstore/gradient_compression.cc:60). Each device
@@ -418,6 +441,98 @@ class DataParallelTrainer:
         else:
             self._comp_resid = []
 
+        # process-wide engine-cache key base: N trainers over one model
+        # structure and configuration share compiled step artifacts, while
+        # any change to the zero/bucket/comm-dtype (or precision, mesh,
+        # optimizer, compression) configuration compiles apart
+        # (docs/compilation.md "fused-step fingerprints")
+        self._step_key_base = (
+            "dp_step",
+            _engine.structural_fingerprint(net),
+            _engine.config_fingerprint(
+                optimizer=type(self.optimizer).__name__,
+                opt_conf=tuple(sorted(
+                    (k, repr(v)) for k, v in vars(self.optimizer).items()
+                    if isinstance(v, (int, float, bool, str, type(None))))),
+                wds=tuple(float(w) for w in self._wds),
+                loss=self.loss,
+                mesh=tuple(sorted(dict(self.mesh.shape).items())),
+                axis_order=tuple(self.mesh.axis_names),
+                devices=tuple(int(d.id) for d in self.mesh.devices.flat),
+                batch_axis=self.batch_axis,
+                data_spec=tuple(str(a) for a in self.data_spec),
+                param_specs=tuple(str(s.spec) for s in self._param_shardings),
+                trainable=tuple(self._trainable),
+                lazy=tuple(self._lazy),
+                compute_dtype=str(self.compute_dtype),
+                scaled=self._scaler is not None,
+                compression=tuple(sorted(self._compression.items()))
+                if self._compression else None,
+                zero=self._zero,
+                bucket_bytes=self._bucket_bytes if self._zero else None,
+                comm_dtype=self._comm_dtype))
+
+    # -- ZeRO-style sharded update setup ------------------------------------
+    def _validate_zero(self, compression):
+        """zero_update preconditions: the flat-shard update is only defined
+        for pure data parallelism with dense gradients and an elementwise
+        optimizer."""
+        if compression:
+            raise MXNetError(
+                "zero_update is incompatible with 2-bit gradient "
+                "compression; use comm_dtype='bfloat16'/'int8' for "
+                "compressed collectives instead")
+        bad = [p.name for p, s in zip(self._plist, self._param_shardings)
+               if any(ax is not None for ax in s.spec)]
+        if bad or tuple(self.data_spec) != (self.batch_axis,):
+            raise MXNetError(
+                "zero_update requires pure data parallelism (replicated "
+                "parameters, data sharded over the batch axis only); "
+                f"offending params={bad[:3]} data_spec={self.data_spec}")
+        sparse = [p.name for p, lz in zip(self._plist, self._lazy) if lz]
+        if sparse:
+            raise MXNetError(
+                "zero_update is incompatible with row_sparse lazy-update "
+                f"parameters ({sparse[:3]}): absent rows have no meaning "
+                "inside a flattened bucket shard")
+        from ..optimizer.optimizer import LAMB, LARS
+        if isinstance(self.optimizer, (LAMB, LARS)):
+            raise MXNetError(
+                f"zero_update does not support "
+                f"{type(self.optimizer).__name__}: its per-tensor "
+                "trust-ratio norms do not decompose over flat bucket "
+                "shards; use sgd/adam/adamw/...")
+
+    def _init_zero_state(self):
+        """Plan fusion buckets over the trainable master weights and create
+        the optimizer state SHARDED: every bucket-state leaf lives under a
+        per-shard NamedSharding over the dp axis, so each replica holds
+        ~1/dp of the optimizer footprint (the
+        mx_optimizer_state_per_replica_bytes gauge reports it). The
+        per-bucket carry is (wd_vector, state_tree); the per-element wd
+        vector rides the carry — sharded and donated through the step —
+        instead of being baked into the trace as a full-size constant."""
+        dp_sh = NamedSharding(self.mesh, P(self.batch_axis))
+        entries = [(i, w.shape, w.dtype)
+                   for i, (w, t) in enumerate(zip(self._params_raw,
+                                                  self._trainable))
+                   if t and jnp.issubdtype(w.dtype, jnp.floating)]
+        self._zero_plan = _zero.plan_buckets(entries, self._dp_degree,
+                                             self._bucket_bytes)
+        in_bucket = frozenset(i for b in self._zero_plan for i in b.indices)
+        carry = []
+        for b in self._zero_plan:
+            flat_w = _zero.flatten_bucket(b, self._params_raw)
+            state = opt_mod.init_functional_state(self._init_fn, flat_w,
+                                                  sharding=dp_sh)
+            wd_dev = self._put_replicated(_zero.wd_vector(b, self._wds),
+                                          dp_sh)
+            carry.append((wd_dev, state))
+        extra = tuple(self._init_fn(w) if (t and i not in in_bucket) else ()
+                      for i, (w, t) in enumerate(zip(self._params_raw,
+                                                     self._trainable)))
+        self._opt_state = (tuple(carry), extra)
+
     # -- multi-process placement --------------------------------------------
     def _is_multiprocess(self):
         return self._multiprocess
@@ -432,6 +547,27 @@ class DataParallelTrainer:
         host = _np.asarray(arr)
         return jax.make_array_from_callback(
             host.shape, sharding, lambda idx: host[idx])
+
+    def _place_param(self, w, sharding):
+        """Donation-safe master-weight placement. The step jit donates these
+        buffers, so the gluon Parameter's own array must never alias them.
+        A host (numpy) value — or, multi-process, any value: the feed goes
+        through a host round-trip — lands in fresh device buffers, as does
+        a jax.Array resident on devices DISJOINT from the target mesh; no
+        defensive copy needed for those (the old unconditional
+        ``jnp.array(copy=True)`` round-tripped every parameter through an
+        extra full copy at construction). An array already living on ANY
+        target device does need the copy first: device_put passes a
+        same-sharding array through as-is, and even a resharding
+        device_put shares the overlapping device's shard buffer with its
+        output — donating the placed array would then delete the
+        Parameter's own buffer (tests/test_zero_dp.py regression)."""
+        if not self._is_multiprocess() and isinstance(w, jax.Array):
+            cur = getattr(w, "sharding", None)
+            if cur is not None and \
+                    set(cur.device_set) & set(sharding.device_set):
+                w = jnp.array(w, copy=True)
+        return self._put_replicated(w, sharding)
 
     def _put_batch(self, arr, sharding):
         """Batch input: in multi-process SPMD each process passes its LOCAL
@@ -472,14 +608,46 @@ class DataParallelTrainer:
             self._ar_bytes = (total * 2 * (n - 1)) // n if n > 1 else 0
         return self._ar_bytes
 
+    def _record_zero_telemetry(self, steps):
+        """Zero-mode collective accounting: distinct per-kind counters
+        (reduce_scatter of the gradient buckets, all_gather of the updated
+        shards — ring estimates over the fusion-bucket plan)."""
+        if self._rs_bytes is None:
+            self._rs_bytes = _zero.reduce_scatter_wire_bytes(
+                self._zero_plan, self._dp_degree, self._comm_dtype)
+            self._ag_bytes = _zero.all_gather_wire_bytes(
+                self._zero_plan, self._dp_degree)
+        nb = len(self._zero_plan)
+        _telem.record_comm("reduce_scatter", self._rs_bytes * steps,
+                           store="mesh", calls=steps * nb)
+        _telem.record_comm("all_gather", self._ag_bytes * steps,
+                           store="mesh", calls=steps * nb)
+
+    def _opt_state_replica_bytes(self) -> int:
+        if self._opt_bytes is None:
+            tree = self._opt_state
+            if self._zero:
+                # the wd vector riding each bucket carry is a hyperparameter
+                # constant, not optimizer state — the gauge compares the
+                # state footprint against the replicated trainer's
+                carry, extra = self._opt_state
+                tree = ([st for _, st in carry], extra)
+            self._opt_bytes = _zero.per_replica_state_bytes(tree)
+        return self._opt_bytes
+
     def _record_telemetry(self, sig, examples, steps, flops_key=None):
         cost = self._step_cost.get(flops_key if flops_key is not None
                                    else sig, {})
         flops = cost.get("flops")
         if self._dp_degree > 1:
-            _telem.record_comm("allreduce_grads",
-                               self._grad_allreduce_bytes() * steps,
-                               store="mesh", calls=steps)
+            if self._zero:
+                self._record_zero_telemetry(steps)
+            else:
+                _telem.record_comm("allreduce",
+                                   self._grad_allreduce_bytes() * steps,
+                                   store="mesh", calls=steps)
+        _telem.record_optimizer_state(self._opt_state_replica_bytes(),
+                                      source="data_parallel")
         _telem.record_step(examples, source="data_parallel", steps=steps,
                            flops_per_step=(flops / steps if flops else None),
                            lr=float(self.optimizer.learning_rate))
@@ -665,20 +833,142 @@ class DataParallelTrainer:
 
         dp = P(ax)
         rep = P()
-        return jax.shard_map(
+        return _zero.shard_map_compat(
             body, mesh=mesh,
             in_specs=(rep, rep, dp, rep, dp, dp, rep, rep, rep),
-            out_specs=(rep, rep, dp, rep, rep, rep),
-            check_vma=False)
+            out_specs=(rep, rep, dp, rep, rep, rep))
+
+    def _build_step_zero(self):
+        """Fused step with the ZeRO-style sharded weight update
+        (arXiv:2004.13336): local gradients flatten into dtype-homogeneous
+        fusion buckets, each bucket is reduce-scattered over the dp axis
+        (optionally bf16/int8-compressed on the wire, EQuARX-style), every
+        replica runs the functional optimizer on its contiguous 1/N shard
+        against 1/N of the optimizer state, and the updated shards are
+        all-gathered back into the replicated weights — one shard_map body
+        inside the single jitted step, so XLA can overlap the all-gather
+        with the next forward. Same call/return contract as _build_step."""
+        aux_order: List[Parameter] = []
+        apply_fn = _make_apply_fn(self.net, self._plist, train=True,
+                                  aux_order_out=aux_order)
+        plist = self._plist
+        update_fn = self._update_fn
+        loss_raw = self._loss_raw
+        wds = self._wds
+        trainable = self._trainable
+        mesh = self.mesh
+        ax = self.batch_axis
+        ndp = self._dp_degree
+        buckets = self._zero_plan
+        in_bucket = frozenset(i for b in buckets for i in b.indices)
+        comm = self._comm_dtype
+        cdt = self.compute_dtype
+        scaled = self._scaler is not None
+
+        def _low(a):
+            if cdt is not None and jnp.issubdtype(a.dtype, jnp.floating):
+                return a.astype(cdt)
+            return a
+
+        def body(params, opt_state, key, x, y, lr, t, loss_scale):
+            # x/y are the device-local batch tiles; params replicated
+            bucket_carry, extra_state = opt_state
+            pos = lax.axis_index(ax)
+            kk = jax.random.wrap_key_data(key.astype(jnp.uint32),
+                                          impl="threefry2x32")
+            key_local = jax.random.key_data(jax.random.fold_in(kk, pos))
+
+            def lossf(ps):
+                out, aux = apply_fn(key_local, [_low(p) for p in ps], _low(x))
+                pred = out if not isinstance(out, tuple) else out[0]
+                lossv = loss_raw(pred, y)  # mean over the LOCAL batch
+                return lossv * loss_scale, (lossv, aux)
+
+            (_, (lossv, aux)), grads = jax.value_and_grad(
+                lossf, has_aux=True)(params)
+            if scaled:
+                inv = 1.0 / loss_scale
+                grads = [g * inv if jnp.issubdtype(g.dtype, jnp.floating)
+                         else g for g in grads]
+                fin = jnp.bool_(True)
+                for i, g in enumerate(grads):
+                    if trainable[i] and jnp.issubdtype(g.dtype, jnp.floating):
+                        fin = jnp.logical_and(
+                            fin, jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+                finite = lax.pmin(fin.astype(jnp.int32), ax).astype(jnp.bool_)
+            else:
+                finite = jnp.bool_(True)
+
+            def _gate(new, old):
+                # fp16 overflow step: keep the old buffer contents
+                return jnp.where(finite, new, old) if scaled else new
+
+            new_params = list(params)
+            new_extra = list(extra_state)
+            # trainables outside every bucket (non-float dtypes): replicated
+            # update on the pmean'd gradient — the plain step's math
+            for i, (g, w, s) in enumerate(zip(grads, params, extra_state)):
+                if not trainable[i] or i in in_bucket:
+                    continue
+                gg = lax.pmean(g, ax)
+                w2, s2 = update_fn(gg, w, s, t, lr, jnp.float32(wds[i]))
+                new_params[i] = _gate(w2.astype(w.dtype), w)
+                new_extra[i] = jax.tree_util.tree_map(_gate, s2, s) \
+                    if scaled else s2
+            # buckets: reduce-scatter -> 1/N sharded update -> all-gather
+            new_carry = []
+            for b, (wd_vec, st) in zip(buckets, bucket_carry):
+                flat_g = _zero.flatten_bucket(b, grads)
+                g_shard = _zero.reduce_scatter_bucket(flat_g, ax, ndp,
+                                                      comm) / ndp
+                w_shard = _zero.shard_slice(
+                    b, _zero.flatten_bucket(b, params), pos)
+                w2, s2 = update_fn(g_shard.astype(w_shard.dtype), w_shard,
+                                   st, t, lr, wd_vec)
+                w2 = _gate(w2.astype(w_shard.dtype), w_shard)
+                s2 = jax.tree_util.tree_map(_gate, s2, st) if scaled else s2
+                full = _zero.all_gather_bucket(w2, ax)
+                for i, arr in _zero.unflatten_bucket(b, full):
+                    new_params[i] = arr.astype(params[i].dtype)
+                new_carry.append((wd_vec, s2))
+            glob_loss = lax.pmean(lossv, ax)
+            aux = jax.tree_util.tree_map(
+                lambda v: lax.pmean(v, ax)
+                if jnp.issubdtype(v.dtype, jnp.floating) else v, aux)
+            # cross-device-averaged BN running stats flow through the carry
+            idx_of = {id(p): i for i, p in enumerate(plist)}
+            for p, v in zip(aux_order, aux):
+                j = idx_of.get(id(p))
+                if j is not None and not trainable[j]:
+                    new_params[j] = v.astype(new_params[j].dtype)
+            return (new_params, (tuple(new_carry), tuple(new_extra)),
+                    glob_loss, finite, aux)
+
+        dp = P(ax)
+        rep = P()
+        return _zero.shard_map_compat(
+            body, mesh=mesh,
+            in_specs=(rep, (P(ax), rep), rep, dp, dp, rep, rep, rep),
+            out_specs=(rep, (P(ax), rep), rep, rep, rep))
+
+    def _build_any_step(self):
+        """Pick the step body for this trainer's configuration."""
+        if self._compression:
+            return self._build_step_compressed()
+        if self._zero:
+            return self._build_step_zero()
+        return self._build_step(None, None)
 
     def _get_step(self, sig):
         fn = self._step_jit.get(sig)
         if fn is None:
-            if self._compression:
-                fn = jax.jit(self._build_step_compressed(),
-                             donate_argnums=(0, 1, 2))
-            else:
-                fn = jax.jit(self._build_step(None, None), donate_argnums=(0, 1))
+            ck = self._step_key_base + (sig,)
+            fn = _engine.lookup(ck)
+            if fn is None:
+                donate = (0, 1, 2) if self._compression else (0, 1)
+                fn = _engine.insert(
+                    ck, jax.jit(self._build_any_step(),
+                                donate_argnums=donate))
             self._step_jit[sig] = fn
         return fn
 
@@ -686,9 +976,13 @@ class DataParallelTrainer:
         key = (sig, "multi", n)
         fn = self._step_jit.get(key)
         if fn is None:
+            ck = self._step_key_base + (sig, "multi", n)
+            cached = _engine.lookup(ck)
+            if cached is not None:
+                self._step_jit[key] = cached
+                return cached
             compressed = self._compression is not None
-            body = self._build_step_compressed() if compressed \
-                else self._build_step(None, None)
+            body = self._build_any_step()
 
             @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
             def multi(params, opt_state, resid, key_raw, x, y, lr, t0,
@@ -724,7 +1018,7 @@ class DataParallelTrainer:
                 key_next = jax.random.key_data(
                     jax.random.fold_in(kk, jnp.int32(n)))
                 return p, s, r, losses, jnp.all(finites), key_next, t_out
-            fn = multi
+            fn = _engine.insert(ck, multi)
             self._step_jit[key] = fn
         return fn
 

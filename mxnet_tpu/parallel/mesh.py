@@ -17,6 +17,14 @@ P = PartitionSpec
 _DEFAULT_MESH: Optional[Mesh] = None
 
 
+def axis_size(name: str) -> int:
+    """Static size of a mapped mesh axis, inside shard_map/pmap bodies:
+    ``jax.lax.axis_size`` where it exists, else the constant-folding
+    ``psum(1, name)`` idiom (returns a Python int on both)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    return fn(name) if fn is not None else jax.lax.psum(1, name)
+
+
 def make_mesh(axes: Union[Dict[str, int], Sequence[int]], names: Optional[Sequence[str]] = None,
               devices=None) -> Mesh:
     """make_mesh({'dp': 4, 'tp': 2}) or make_mesh((4, 2), ('dp', 'tp'))."""

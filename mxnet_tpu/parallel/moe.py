@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .mesh import axis_size as _axis_size
+
 
 def topk_gating(logits, top_k: int, capacity: int):
     """Top-k capacity gating (Switch/GShard style).
@@ -85,7 +87,7 @@ def expert_parallel_moe(x, gate_w, w1_local, w2_local, *, axis_name: str,
 
     Same math as moe_ffn on the gathered arrays (up to capacity rounding).
     """
-    n_dev = lax.axis_size(axis_name)
+    n_dev = _axis_size(axis_name)
     Nl, D = x.shape
     El = w1_local.shape[0]
     E = El * n_dev

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as _np
 from jax import lax
+
+from .mesh import axis_size as _axis_size
 from jax.sharding import Mesh, NamedSharding
 
 from ..base import MXNetError
@@ -66,7 +68,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_stack,
     pipeline schedule with weight-gradient accumulation (see module
     docstring) — callers get pipeline backward for free from jax.grad.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     M = x_stack.shape[0]
     steps = M + n - 1
@@ -318,11 +320,11 @@ class PipelineTrainer:
 
         rep, stk = P(), P(ppax)
         data = P(None, dpax) if dpax is not None else P(None)
-        return jax.shard_map(
+        from .zero import shard_map_compat
+        return shard_map_compat(
             body, mesh=mesh,
             in_specs=(rep, stk, rep, rep, stk, rep, rep, data, data, rep, rep),
-            out_specs=(rep, stk, rep, rep, stk, rep, rep),
-            check_vma=False)
+            out_specs=(rep, stk, rep, rep, stk, rep, rep))
 
     def step(self, x, y):
         """One fused pipeline-parallel training step on a global batch."""

@@ -46,8 +46,9 @@ __all__ = [
     "enable", "disable", "is_enabled", "counter", "gauge", "histogram",
     "get_metric", "reset", "collect", "scrape", "scrape_json", "report",
     "record_step", "record_comm", "comm_scope", "instrument_comm",
-    "payload_bytes", "sample_memory", "peak_flops", "set_epoch", "timed",
-    "annotate", "start_http_server", "stop_http_server",
+    "record_optimizer_state", "payload_bytes", "sample_memory", "peak_flops",
+    "set_epoch", "timed", "annotate", "start_http_server",
+    "stop_http_server",
 ]
 
 env.declare("MXNET_TELEMETRY", False, bool,
@@ -541,6 +542,16 @@ def record_comm(op: str, nbytes: int, store: str = "",
     if seconds is not None:
         counter("mx_comm_seconds_total", "Wall seconds inside comm ops",
                 ("op", "store")).labels(op, store).inc(seconds)
+
+
+def record_optimizer_state(nbytes: int, source: str = "trainer"):
+    """Per-replica optimizer-state footprint gauge. The replicated update
+    reports the full state; the ZeRO-style sharded update
+    (DataParallelTrainer(zero_update=True)) reports ~1/dp of it — the
+    memory-side acceptance signal of arXiv:2004.13336."""
+    gauge("mx_optimizer_state_per_replica_bytes",
+          "Optimizer-state bytes held per replica",
+          ("source",)).labels(source).set(int(nbytes))
 
 
 @contextmanager

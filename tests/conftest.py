@@ -46,6 +46,22 @@ def pytest_configure(config):
         mx.sanitize.enable()
 
 
+@pytest.fixture
+def host_mesh8():
+    """8-way 'dp' mesh over the virtual host devices this conftest spawns
+    via ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (set above,
+    before the CPU backend initializes — it cannot be changed afterwards).
+    The multi-device trainer tests (tests/test_zero_dp.py's sharded weight
+    update in particular) depend on real cross-device collectives, so fail
+    loudly if the flag did not take."""
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, (
+        "need 8 virtual CPU devices — XLA_FLAGS was set too late "
+        f"(have {len(devs)})")
+    from mxnet_tpu.parallel import make_mesh
+    return make_mesh({"dp": 8}, devices=devs[:8])
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything(request):
     """with_seed parity (reference tests/python/unittest/common.py:161):

@@ -196,6 +196,41 @@ def update(self, weight, grad, state):
     assert len(out) == 1 and "state._data" in out[0].message
 
 
+def test_donation_understands_sharded_update_kernel(tmp_path):
+    # parallel/zero.py's flat-bucket kernels donate like @_update_kernel;
+    # a view sliced out of the donated bucket is a read of the bucket
+    src = '''
+import jax.numpy as jnp
+
+@_sharded_update_kernel(0)
+def _k_bucket_reduce(stacked):
+    return jnp.sum(stacked, axis=0)
+
+def reduce_bucket(stacked):
+    flat = _k_bucket_reduce(stacked)
+    view = stacked[0]     # read-after-donate through a bucket view
+    return flat + view
+'''
+    out = _lint(tmp_path, "mxnet_tpu/x.py", src, ["donation-safety"])
+    assert len(out) == 1 and "`stacked`" in out[0].message
+
+
+def test_donation_sharded_kernel_rebind_is_clean(tmp_path):
+    # the safe carry pattern: the donated bucket is rebound by the call
+    src = '''
+import jax.numpy as jnp
+
+@_sharded_update_kernel(0)
+def _k_bucket_reduce(stacked):
+    return jnp.sum(stacked, axis=0)
+
+def reduce_bucket(stacked):
+    stacked = _k_bucket_reduce(stacked)
+    return stacked * 2
+'''
+    assert _lint(tmp_path, "mxnet_tpu/x.py", src, ["donation-safety"]) == []
+
+
 def test_donation_donor_names_are_scoped(tmp_path):
     # a donor binding named `fn` in one function must not poison an
     # unrelated local `fn` elsewhere (the false positive the real

@@ -8,7 +8,10 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.4.38 jax: experimental home, same signature
+    from jax.experimental.shard_map import shard_map
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd, gluon

@@ -54,6 +54,13 @@ METHOD_CHECKS = [
      {"record_step", "_record_telemetry"}, "call"),
     ("parallel/data_parallel.py", "DataParallelTrainer", "run_steps",
      {"record_step", "_record_telemetry"}, "call"),
+    # zero-update (ZeRO-style sharded weight update) path: per-kind
+    # collective counters + the per-replica optimizer-state gauge must be
+    # booked for every step that runs the sharded update
+    ("parallel/data_parallel.py", "DataParallelTrainer",
+     "_record_zero_telemetry", {"record_comm"}, "call"),
+    ("parallel/data_parallel.py", "DataParallelTrainer",
+     "_record_telemetry", {"record_optimizer_state"}, "call"),
     ("parallel/pipeline.py", "PipelineTrainer", "step",
      {"record_step", "_record_telemetry"}, "call"),
     ("parallel/tensor_parallel.py", None, "shard_params_megatron",
@@ -71,6 +78,9 @@ TEXT_CHECKS = [
      "the fused HybridBlock path must account executions with the engine"),
     ("symbol/executor.py", "record_execution",
      "the symbol Executor path must account executions with the engine"),
+    ("telemetry/__init__.py", "def record_optimizer_state",
+     "the registry must expose the per-replica optimizer-state gauge "
+     "(the zero-update memory acceptance signal)"),
 ]
 
 

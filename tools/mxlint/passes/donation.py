@@ -10,7 +10,11 @@ donate from two sources:
   - local ``name = jax.jit(f, donate_argnums=(...))`` bindings (also
     ``@functools.partial(jax.jit, donate_argnums=...)`` decorators);
   - the framework's own ``@_update_kernel(a, b, ...)`` optimizer-kernel
-    decorator (optimizer/optimizer.py), whose positions ARE donate_argnums.
+    decorator (optimizer/optimizer.py) and its flat-bucket analog
+    ``@_sharded_update_kernel(a, ...)`` (parallel/zero.py), whose positions
+    ARE donate_argnums. A read of the donated bucket — or of any view
+    sliced out of it, since a subscript read loads the base name — after
+    the call is flagged.
 
 At each call of a known donor it records the argument expressions sitting in
 donated positions, then flags any later *read* of the same expression in the
@@ -59,6 +63,7 @@ def _collect_donors(mod: ModuleInfo) -> Dict[str, Dict[str, Tuple[int, ...]]]:
                         if isinstance(t, ast.Name):
                             donors.setdefault(_scope_of(node), {})[t.id] = pos
         # @partial(jax.jit, donate_argnums=...) / @_update_kernel(0, 2)
+        # / @_sharded_update_kernel(0)
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for dec in node.decorator_list:
                 if not isinstance(dec, ast.Call):
@@ -68,7 +73,7 @@ def _collect_donors(mod: ModuleInfo) -> Dict[str, Dict[str, Tuple[int, ...]]]:
                 if name == "partial" and dec.args \
                         and unparse(dec.args[0]).endswith("jit"):
                     pos = _donated_positions(dec)
-                elif name == "_update_kernel":
+                elif name in ("_update_kernel", "_sharded_update_kernel"):
                     pos = tuple(a.value for a in dec.args
                                 if isinstance(a, ast.Constant)
                                 and isinstance(a.value, int))
