@@ -31,7 +31,25 @@ __all__ = ["lookup", "insert", "clear_compilation_cache", "cache_stats",
            "reset_stats", "donation_enabled", "record_donation",
            "compile_timer", "record_trace", "record_execution",
            "estimate_cost", "structural_fingerprint", "graph_fingerprint",
-           "config_fingerprint"]
+           "config_fingerprint", "async_feed", "DeviceFeed",
+           "DispatchWindow", "PendingScalar"]
+
+
+def __getattr__(name):
+    # the async feed pulls in jax/ndarray machinery; keep it off the
+    # import path of the light engine counters (PEP 562, same idiom as
+    # the package root)
+    if name == "async_feed":
+        import importlib
+        mod = importlib.import_module(".async_feed", __name__)
+        globals()[name] = mod
+        return mod
+    if name in ("DeviceFeed", "DispatchWindow", "PendingScalar"):
+        from . import async_feed as _af
+        val = getattr(_af, name)
+        globals()[name] = val
+        return val
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 _LOCK = threading.RLock()

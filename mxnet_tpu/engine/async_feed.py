@@ -1,0 +1,528 @@
+"""Async device feed + bounded in-flight step dispatch.
+
+The reference framework's heart is its asynchronous dependency engine
+(src/engine/threaded_engine.h): Python pushes operations and never blocks;
+reads/writes are versioned so the device pipeline stays full. On TPU the
+XLA runtime already gives us async dispatch per computation — what is
+missing is the *loop around the step*: host batch assembly, `device_put`,
+and eager loss/metric reads each iteration serialize the pipeline
+(arXiv:2301.13062 measures the dispatch-overlap this throws away). This
+module is the TPU-native analog of that engine, in three parts:
+
+  - **DeviceFeed** — wraps any ``DataIter`` / gluon ``DataLoader`` /
+    iterable of batches, runs one background producer thread, and delivers
+    batches already ``jax.device_put`` with the consumer's input sharding
+    (replicated, or dp-sharded to match a ``DataParallelTrainer``), so the
+    host->device copy of batch i+1 overlaps the compute of batch i. Queue
+    depth is ``MXNET_TPU_FEED_DEPTH`` (default 2). The ``device_put`` is
+    *explicit*, so ``sanitize.guard()``'s ``transfer_guard("disallow")``
+    stays clean in the dispatch path. Batch order is exactly the wrapped
+    iterator's order (single producer, FIFO queue), including across
+    ``reset()`` and a mid-epoch ``StopIteration``.
+  - **DispatchWindow** — the bounded in-flight window: trainers ``admit()``
+    each dispatched step's output handle and the window blocks
+    (``block_until_ready``) on the (i-K)th step once more than
+    ``MXNET_TPU_INFLIGHT_STEPS`` (default 2) are outstanding. Backpressure
+    instead of unbounded queueing; ``drain()`` is the epoch/eval-boundary
+    sync point.
+  - **PendingScalar** — a lazy handle for per-step losses/metrics that stay
+    on device: ``float()`` / ``.item()`` / ``.asnumpy()`` sync on *read*,
+    so a fit loop can collect losses without a host round-trip per step and
+    drain them at the boundary.
+
+Telemetry (only while ``mx.telemetry`` is enabled): the feed exports
+``mx_feed_queue_depth`` and ``mx_feed_stall_seconds_total`` (consumer time
+spent waiting on an empty queue — nonzero stall means the producer, not
+the device, is the bottleneck), and the window exports
+``mx_inflight_steps``. Step timing in the trainers is recorded *after*
+window admission, i.e. at completion pace under backpressure, so
+instrumentation never re-serializes the pipeline (docs/input_pipeline.md).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+import numpy as _np
+
+from ..base import MXNetError, env
+
+__all__ = ["DeviceFeed", "DispatchWindow", "PendingScalar", "drain",
+           "feed_depth", "inflight_steps", "maybe_wrap"]
+
+env.declare("MXNET_TPU_FEED_DEPTH", 2, int,
+            "DeviceFeed prefetch queue depth (batches staged on device "
+            "ahead of the consumer); 0 disables the async feed wrap in "
+            "fit loops")
+env.declare("MXNET_TPU_INFLIGHT_STEPS", 2, int,
+            "Max dispatched-but-incomplete training steps before the "
+            "trainer blocks on the oldest one (0 = fully synchronous)")
+env.declare("MXNET_TPU_FEED_GIL_INTERVAL", 0.001, float,
+            "sys.setswitchinterval applied when a DeviceFeed producer "
+            "starts (never raised, only lowered): the default 5 ms GIL "
+            "switch interval makes the consumer wait up to 5 ms behind a "
+            "producer mid-batch on few-core hosts; 0 leaves the "
+            "interpreter setting untouched")
+
+
+def feed_depth() -> int:
+    return int(env.get("MXNET_TPU_FEED_DEPTH"))
+
+
+def inflight_steps() -> int:
+    return int(env.get("MXNET_TPU_INFLIGHT_STEPS"))
+
+
+# ---------------------------------------------------------------------------
+# Lazy scalar handles
+# ---------------------------------------------------------------------------
+
+def _raw_of(v):
+    """Unwrap NDArray/PendingScalar to the underlying jax.Array."""
+    if isinstance(v, PendingScalar):
+        return v._raw
+    data = getattr(v, "_data", None)
+    return data if data is not None and hasattr(data, "block_until_ready") \
+        else v
+
+
+class PendingScalar:
+    """A device-resident scalar (a step's loss/metric) that syncs lazily.
+
+    Returned by the fused trainers' ``step()``: holding it costs nothing;
+    ``float()`` / ``.item()`` / ``.asnumpy()`` / ``np.asarray`` block on the
+    value. ``repr()`` deliberately does NOT sync, so logging a handle does
+    not serialize the pipeline — read it at a drain point instead.
+    """
+
+    __slots__ = ("_raw",)
+
+    def __init__(self, raw):
+        self._raw = _raw_of(raw)
+
+    @property
+    def raw(self):
+        """The underlying device array (no sync)."""
+        return self._raw
+
+    def value(self):
+        return self._raw
+
+    def block_until_ready(self):
+        if hasattr(self._raw, "block_until_ready"):
+            self._raw.block_until_ready()
+        return self
+
+    def __float__(self):
+        return float(self._raw)
+
+    def item(self):
+        return float(self._raw)
+
+    def asnumpy(self):
+        return _np.asarray(self._raw)
+
+    def __array__(self, dtype=None):
+        a = _np.asarray(self._raw)
+        return a.astype(dtype) if dtype is not None else a
+
+    @property
+    def shape(self):
+        return tuple(getattr(self._raw, "shape", ()))
+
+    @property
+    def dtype(self):
+        return getattr(self._raw, "dtype", None)
+
+    def __repr__(self):
+        return (f"PendingScalar(shape={self.shape}, dtype={self.dtype}, "
+                "pending)")
+
+
+def drain(values):
+    """Block on a (possibly nested) collection of pending step outputs and
+    return the scalar values as floats where they are 0-d. The designated
+    epoch/eval-boundary sync point for a loop that collected
+    ``PendingScalar`` handles."""
+    if isinstance(values, (list, tuple)):
+        return type(values)(drain(v) for v in values)
+    raw = _raw_of(values)
+    if hasattr(raw, "block_until_ready"):
+        raw.block_until_ready()
+    if getattr(raw, "ndim", None) == 0 or isinstance(values, PendingScalar):
+        return float(raw)
+    return raw
+
+
+# ---------------------------------------------------------------------------
+# Bounded in-flight dispatch window
+# ---------------------------------------------------------------------------
+
+class DispatchWindow:
+    """Backpressure for async step dispatch: keep at most ``depth`` steps in
+    flight; ``admit()`` the newly dispatched step's output handle and block
+    on the (i-depth)th step's outputs once the window is full — the
+    TPU-native equivalent of the reference engine's bounded pending-op
+    queue. ``depth=0`` degrades to a fully synchronous loop (every admit
+    blocks immediately); depth defaults to ``MXNET_TPU_INFLIGHT_STEPS``.
+    """
+
+    def __init__(self, depth: Optional[int] = None, name: str = "step"):
+        self.depth = inflight_steps() if depth is None else int(depth)
+        self.name = name
+        self._pending: "deque[Any]" = deque()
+        self.retired = 0
+        self.wait_seconds = 0.0
+        self.max_inflight = 0
+
+    def __len__(self):
+        return len(self._pending)
+
+    @staticmethod
+    def _block(handles):
+        if isinstance(handles, (list, tuple)):
+            for h in handles:
+                DispatchWindow._block(h)
+            return
+        raw = _raw_of(handles)
+        if hasattr(raw, "block_until_ready"):
+            raw.block_until_ready()
+
+    def admit(self, handles):
+        """Register one dispatched step; blocks on the oldest in-flight step
+        when the window exceeds its depth (never on the current one)."""
+        self._pending.append(handles)
+        while len(self._pending) > max(self.depth, 0):
+            old = self._pending.popleft()
+            t0 = time.perf_counter()
+            self._block(old)
+            self.wait_seconds += time.perf_counter() - t0
+            self.retired += 1
+        self.max_inflight = max(self.max_inflight, len(self._pending))
+        from .. import telemetry as _telem
+        if _telem._ENABLED:
+            _telem.record_inflight(len(self._pending), source=self.name)
+
+    def drain(self):
+        """Block until every admitted step completed (epoch/eval boundary)."""
+        while self._pending:
+            old = self._pending.popleft()
+            t0 = time.perf_counter()
+            self._block(old)
+            self.wait_seconds += time.perf_counter() - t0
+            self.retired += 1
+        from .. import telemetry as _telem
+        if _telem._ENABLED:
+            _telem.record_inflight(0, source=self.name)
+
+
+# ---------------------------------------------------------------------------
+# Sharding-aware background device feed
+# ---------------------------------------------------------------------------
+
+_END = object()
+
+
+def _bounded_put(q: "queue.Queue", item, stop: threading.Event) -> bool:
+    """put() that gives up when the consumer asked the producer to stop —
+    a blocking put into a full queue with a departed consumer is exactly
+    the thread leak the reference prefetcher's shutdown path avoids."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+class DeviceFeed:
+    """Wrap a batch source; deliver batches already placed on device.
+
+    ``source`` may be a ``DataIter`` (``next()``/``reset()``/
+    ``provide_data``), a gluon ``DataLoader``, or any re-iterable of
+    batches. Each yielded item keeps its structure (``DataBatch`` fields,
+    tuples, single arrays) with every array leaf explicitly
+    ``jax.device_put`` by the producer thread:
+
+      - with ``mesh``+``data_spec`` (what ``for_trainer`` passes), leaf
+        placement is ``NamedSharding(mesh, P(*spec[:arr.ndim]))`` — the
+        same rule ``DataParallelTrainer._put_batch`` applies, so the
+        trainer's placement check finds the batch already resident and the
+        guarded dispatch is transfer-free;
+      - with ``sharding``, that sharding is used for every leaf;
+      - with neither, a plain ``jax.device_put`` to the default device.
+
+    The producer starts lazily on first ``next()`` (construction has no
+    side effects on the wrapped iterator), preserves source order exactly,
+    propagates exceptions, and is joined by ``reset()``/``close()``/GC.
+    Only single-process meshes are supported — multi-host feeds go through
+    ``make_array_from_process_local_data`` in the trainer instead.
+    """
+
+    def __init__(self, source, sharding=None, mesh=None, data_spec=None,
+                 depth: Optional[int] = None, name: str = "feed"):
+        self._source = source
+        self._sharding = sharding
+        self._mesh = mesh
+        self._data_spec = data_spec
+        if sharding is not None and mesh is not None:
+            raise MXNetError("pass sharding OR mesh+data_spec, not both")
+        self._depth = max(feed_depth() if depth is None else int(depth), 1)
+        self.name = name
+        self.batch_size = getattr(source, "batch_size", 0)
+        self._q: Optional[queue.Queue] = None
+        self._stop: Optional[threading.Event] = None
+        self._producer: Optional[threading.Thread] = None
+        self._eof = False
+        self._peek = None
+        self.stall_seconds = 0.0
+        self.batches_delivered = 0
+
+    @classmethod
+    def for_trainer(cls, source, trainer, depth: Optional[int] = None,
+                    name: str = "feed"):
+        """A feed whose leaves land with the trainer's input sharding
+        (``trainer.mesh`` + ``trainer.data_spec`` — replicated, dp-sharded,
+        or context-parallel, whatever the trainer was configured with)."""
+        if getattr(trainer, "_is_multiprocess", lambda: False)():
+            raise MXNetError(
+                "DeviceFeed targets single-process meshes; multi-host "
+                "batch feeding stays on the trainer's "
+                "make_array_from_process_local_data path")
+        return cls(source, mesh=trainer.mesh,
+                   data_spec=getattr(trainer, "data_spec", None),
+                   depth=depth, name=name)
+
+    # -- placement -----------------------------------------------------------
+    @staticmethod
+    def _already_placed(raw, sharding) -> bool:
+        """Skip the no-op device_put when the array already satisfies the
+        target placement — same rule as DataParallelTrainer._put_batch
+        (through a tunneled backend even a no-op put round-trips the
+        buffer)."""
+        import jax
+        if not isinstance(raw, jax.Array):
+            return False
+        cur = getattr(raw, "sharding", None)
+        if cur is None:
+            return False
+        dev = set(cur.device_set)
+        want = set(sharding.device_set)
+        return dev == want and (
+            len(want) == 1 or cur.is_equivalent_to(sharding, raw.ndim))
+
+    def _put_raw(self, raw):
+        import jax
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            spec = self._data_spec if self._data_spec is not None \
+                else PartitionSpec()
+            ndim = getattr(raw, "ndim", None)
+            if ndim is None:
+                ndim = _np.asarray(raw).ndim
+            clipped = PartitionSpec(*tuple(spec)[:ndim])
+            target = NamedSharding(self._mesh, clipped)
+            if self._already_placed(raw, target):
+                return raw
+            return jax.device_put(raw, target)
+        if self._sharding is not None:
+            if self._already_placed(raw, self._sharding):
+                return raw
+            return jax.device_put(raw, self._sharding)
+        return jax.device_put(raw)
+
+    def _place_leaf(self, v):
+        from ..ndarray import NDArray
+        if isinstance(v, NDArray):
+            if type(v) is not NDArray:
+                # sparse (CSR/row-sparse) and other subclasses carry their
+                # own payload layout — pass through unplaced
+                return v
+            return NDArray(self._put_raw(v._data), v.ctx)
+        if v is None or isinstance(v, (int, float, str, bytes)):
+            return v
+        return self._put_raw(v)
+
+    def _place(self, item):
+        from ..io.io import DataBatch
+        if isinstance(item, DataBatch):
+            out = DataBatch(
+                [self._place_leaf(d) for d in (item.data or [])] or None,
+                [self._place_leaf(l) for l in (item.label or [])] or None,
+                pad=item.pad, index=item.index, bucket_key=item.bucket_key,
+                provide_data=item.provide_data,
+                provide_label=item.provide_label)
+            return out
+        if isinstance(item, (list, tuple)):
+            return type(item)(self._place_leaf(v) for v in item)
+        return self._place_leaf(item)
+
+    # -- producer ------------------------------------------------------------
+    def _produce(self, stop: threading.Event, q: "queue.Queue"):
+        try:
+            it = iter(self._source)
+            while not stop.is_set():
+                try:
+                    item = next(it)
+                except StopIteration:
+                    _bounded_put(q, _END, stop)
+                    return
+                if not _bounded_put(q, self._place(item), stop):
+                    return
+        except Exception as e:  # surfaced at the consumer's next()
+            _bounded_put(q, e, stop)
+
+    def _ensure_producer(self):
+        if self._producer is not None and self._producer.is_alive():
+            return
+        if self._q is None or self._producer is None:
+            import sys
+            iv = float(env.get("MXNET_TPU_FEED_GIL_INTERVAL"))
+            if iv > 0 and sys.getswitchinterval() > iv:
+                # producer and consumer interleave on the GIL; the default
+                # 5 ms switch interval stalls the dispatch loop behind a
+                # producer mid-batch (measured ~2 ms/step on a 1-core
+                # host). Lowered once, process-wide, documented in
+                # docs/input_pipeline.md; MXNET_TPU_FEED_GIL_INTERVAL=0
+                # opts out.
+                sys.setswitchinterval(iv)
+            self._stop = threading.Event()
+            self._q = queue.Queue(maxsize=self._depth)
+            self._producer = threading.Thread(
+                target=self._produce, args=(self._stop, self._q),
+                daemon=True, name=f"mx-device-feed-{self.name}")
+            self._producer.start()
+
+    def _stop_producer(self):
+        if self._producer is not None and self._stop is not None:
+            self._stop.set()
+            # unblock a producer stuck in put(), then join; drain again in
+            # case it completed one more put before seeing the stop flag
+            for _ in range(2):
+                try:
+                    while True:
+                        self._q.get_nowait()
+                except queue.Empty:
+                    pass
+                self._producer.join(timeout=5)
+                if not self._producer.is_alive():
+                    break
+        self._producer = None
+        self._q = None
+        self._stop = None
+
+    # -- consumer protocol ---------------------------------------------------
+    def next(self):
+        if self._eof:
+            raise StopIteration
+        self._ensure_producer()
+        t0 = None
+        try:
+            item = self._q.get_nowait()
+        except queue.Empty:
+            t0 = time.perf_counter()
+            while True:
+                try:
+                    item = self._q.get(timeout=1.0)
+                    break
+                except queue.Empty:
+                    if self._producer is None or \
+                            not self._producer.is_alive():
+                        raise MXNetError(
+                            "DeviceFeed producer thread died without "
+                            "delivering a batch or an error")
+            self.stall_seconds += time.perf_counter() - t0
+        from .. import telemetry as _telem
+        if _telem._ENABLED:
+            if t0 is not None:
+                _telem.record_feed_stall(self.stall_seconds, source=self.name)
+            _telem.record_feed_depth(self._q.qsize(), source=self.name)
+        if item is _END:
+            self._eof = True
+            # producer exited on its own; forget it so reset() restarts
+            self._producer = None
+            self._q = None
+            self._stop = None
+            raise StopIteration
+        if isinstance(item, Exception):
+            self._stop_producer()
+            raise item
+        self.batches_delivered += 1
+        return item
+
+    def __next__(self):
+        return self.next()
+
+    def __iter__(self):
+        return self
+
+    def iter_next(self):
+        if self._peek is not None:
+            return True
+        try:
+            self._peek = self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def getdata(self):
+        return self._peek.data if self._peek is not None else None
+
+    def getlabel(self):
+        return self._peek.label if self._peek is not None else None
+
+    def getpad(self):
+        return getattr(self._peek, "pad", 0) if self._peek is not None else 0
+
+    def reset(self):
+        """Stop + join the producer, reset the wrapped source, start a fresh
+        epoch. Exactly one inner ``reset()`` per call, so seeded shuffles
+        advance the same way they would without the wrapper."""
+        self._stop_producer()
+        self._peek = None
+        self._eof = False
+        if hasattr(self._source, "reset"):
+            self._source.reset()
+
+    def close(self):
+        self._stop_producer()
+
+    def __del__(self):
+        try:
+            self._stop_producer()
+        except Exception:
+            pass
+
+    def __len__(self):
+        return len(self._source)
+
+    # -- DataIter surface passthrough ---------------------------------------
+    @property
+    def provide_data(self):
+        return getattr(self._source, "provide_data", None)
+
+    @property
+    def provide_label(self):
+        return getattr(self._source, "provide_label", None)
+
+
+def maybe_wrap(source, sharding=None, mesh=None, data_spec=None,
+               name: str = "feed"):
+    """Wrap ``source`` in a DeviceFeed when the async feed is enabled
+    (``MXNET_TPU_FEED_DEPTH`` > 0), the source is not already wrapped, and
+    the process is single-controller. Used by the fit loops; returns the
+    source unchanged otherwise."""
+    if isinstance(source, DeviceFeed) or feed_depth() <= 0:
+        return source
+    try:
+        import jax
+        if jax.process_count() > 1:
+            return source
+    except Exception:
+        return source
+    return DeviceFeed(source, sharding=sharding, mesh=mesh,
+                      data_spec=data_spec, name=name)

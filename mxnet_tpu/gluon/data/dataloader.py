@@ -63,6 +63,19 @@ class DataLoader:
     def __len__(self):
         return len(self._batch_sampler)
 
+    def device_feed(self, sharding=None, mesh=None, data_spec=None,
+                    depth=None, trainer=None):
+        """Wrap this loader in an ``engine.async_feed.DeviceFeed``: a
+        background producer runs the batchify pipeline AND the explicit
+        ``jax.device_put`` (replicated, or sharded per ``mesh``+
+        ``data_spec`` / a ``DataParallelTrainer`` via ``trainer=``), so
+        H2D transfer overlaps step compute (docs/input_pipeline.md)."""
+        from ...engine.async_feed import DeviceFeed
+        if trainer is not None:
+            return DeviceFeed.for_trainer(self, trainer, depth=depth)
+        return DeviceFeed(self, sharding=sharding, mesh=mesh,
+                          data_spec=data_spec, depth=depth)
+
     def __iter__(self):
         if self._num_workers == 0:
             for batch_idx in self._batch_sampler:

@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 
 from ..base import MXNetError
 from .. import engine as _engine
+from ..engine import async_feed as _feed
 from .. import optimizer as opt_mod
 from .. import kvstore as kvs_mod
 from .. import telemetry as _telem
@@ -43,6 +44,11 @@ class Trainer:
         self._kv_initialized = False
         self._params_to_init: List[Parameter] = []
         self._contains_sparse_weight = False
+        # bounded in-flight dispatch: the eager loop's updates are async
+        # jax dispatches; the window back-pressures on the (i-K)th step's
+        # updated weights so dispatch can run up to MXNET_TPU_INFLIGHT_STEPS
+        # ahead without queueing unboundedly (engine/async_feed)
+        self._window = _feed.DispatchWindow(name="trainer")
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -110,6 +116,16 @@ class Trainer:
         t0 = time.perf_counter() if _profiler._state["running"] else None
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        # admit this step's last updated weight into the in-flight window:
+        # per-device dispatch order means its readiness implies every
+        # earlier-dispatched update of this step completed too
+        h = None
+        for p in reversed(self._params):
+            if p.grad_req != "null":
+                h = p.data()._data
+                break
+        if h is not None:
+            self._window.admit(h)
         if t0 is not None:
             _profiler._record("trainer.step", "trainer", t0,
                               time.perf_counter())
@@ -170,6 +186,11 @@ class Trainer:
     def zero_grad(self):
         for p in self._params:
             p.zero_grad()
+
+    def drain(self):
+        """Block until every dispatched step's updates completed (epoch /
+        checkpoint boundary drain point)."""
+        self._window.drain()
 
     # -- states ----------------------------------------------------------------
     def save_states(self, fname):

@@ -212,6 +212,20 @@ class ResizeIter(DataIter):
         return self.current_batch.label
 
 
+def _bounded_put(q: "queue.Queue", item, stop: threading.Event) -> bool:
+    """put() that gives up once the consumer signalled stop — a blocking
+    put into a full queue whose consumer left is a permanent thread leak
+    (the reference prefetcher's shutdown path drains before joining for
+    the same reason)."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
 class PrefetchingIter(DataIter):
     """Background-thread prefetch (reference src/io/iter_prefetcher.h:47)."""
 
@@ -220,7 +234,8 @@ class PrefetchingIter(DataIter):
             iters = [iters]
         super().__init__(iters[0].batch_size)
         self.iters = iters
-        self._q: queue.Queue = queue.Queue(maxsize=prefetch_depth)
+        self._prefetch_depth = max(int(prefetch_depth), 1)
+        self._q: queue.Queue = queue.Queue(maxsize=self._prefetch_depth)
         self._stop = threading.Event()
         self._thread = None
         self._start()
@@ -234,22 +249,27 @@ class PrefetchingIter(DataIter):
         return sum([i.provide_label for i in self.iters], [])
 
     def _start(self):
+        stop, q = self._stop, self._q
+
         def worker():
             try:
-                while not self._stop.is_set():
+                while not stop.is_set():
                     batches = []
                     try:
                         for it in self.iters:
                             batches.append(it.next())
                     except StopIteration:
-                        self._q.put(None)
+                        _bounded_put(q, None, stop)
                         return
                     data = sum([b.data for b in batches], [])
                     label = sum([(b.label or []) for b in batches], [])
-                    self._q.put(DataBatch(data, label, batches[0].pad))
+                    if not _bounded_put(q, DataBatch(data, label,
+                                                     batches[0].pad), stop):
+                        return
             except Exception as e:  # propagate to consumer
-                self._q.put(e)
-        self._thread = threading.Thread(target=worker, daemon=True)
+                _bounded_put(q, e, stop)
+        self._thread = threading.Thread(target=worker, daemon=True,
+                                        name="mx-io-prefetch")
         self._thread.start()
 
     def reset(self):
@@ -264,7 +284,9 @@ class PrefetchingIter(DataIter):
         for it in self.iters:
             it.reset()
         self._stop = threading.Event()
-        self._q = queue.Queue(maxsize=2)
+        # regression (ISSUE 5 satellite): the rebuilt queue must keep the
+        # constructor's prefetch_depth, not a hardcoded maxsize
+        self._q = queue.Queue(maxsize=self._prefetch_depth)
         self._start()
 
     def next(self):
@@ -578,7 +600,7 @@ class ImageRecordIter(DataIter):
                             break
                         recs.append(rec)
                     if not recs:
-                        q.put(None)
+                        _bounded_put(q, None, stop)
                         return
                     xs = ys = None
                     if self._native_jpeg is not None:
@@ -594,14 +616,12 @@ class ImageRecordIter(DataIter):
                     batch = DataBatch(data=[array(_np.stack(xs))],
                                       label=[array(_np.asarray(ys, "float32"))],
                                       pad=pad)
-                    while not stop.is_set():
-                        try:
-                            q.put(batch, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-        except Exception as e:  # surface errors at next()
-            q.put(e)
+                    if not _bounded_put(q, batch, stop):
+                        return
+        except Exception as e:  # surface errors at next(); bounded so an
+            # interrupted epoch (full queue, consumer gone) can't wedge
+            # the thread in a blocking put forever
+            _bounded_put(q, e, stop)
 
     def _native_batch(self, recs):
         """Decode a record batch through the C++ JPEG pipeline. Returns
@@ -631,7 +651,8 @@ class ImageRecordIter(DataIter):
                 self._batch_q = queue.Queue(maxsize=self._prefetch)
                 self._producer = threading.Thread(
                     target=self._produce,
-                    args=(self._stop_flag, self._batch_q), daemon=True)
+                    args=(self._stop_flag, self._batch_q), daemon=True,
+                    name="mx-io-producer")
                 self._producer.start()
 
     def _next_record_batch(self):
@@ -651,14 +672,28 @@ class ImageRecordIter(DataIter):
     def _stop_producer(self):
         if self._producer is not None:
             self._stop_flag.set()
-            try:
-                while True:
-                    self._batch_q.get_nowait()
-            except queue.Empty:
-                pass
-            self._producer.join(timeout=5)
+            # drain -> join -> drain: the producer may complete one more
+            # put between our drain and its stop-flag check; a second
+            # round guarantees it unblocks and the join lands
+            for _ in range(2):
+                try:
+                    while True:
+                        self._batch_q.get_nowait()
+                except queue.Empty:
+                    pass
+                self._producer.join(timeout=5)
+                if not self._producer.is_alive():
+                    break
             self._producer = None
         self._batch_q = None
+
+    def __del__(self):
+        # interrupted epochs must not leak the decode/prefetch thread
+        try:
+            if getattr(self, "_producer", None) is not None:
+                self._stop_producer()
+        except Exception:
+            pass
 
     @property
     def provide_data(self):

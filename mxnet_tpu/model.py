@@ -126,7 +126,10 @@ class FeedForward:
 
     def predict(self, X, num_batch=None, return_data=False, reset=True):
         from .module import Module
-        data = self._as_iter(X)
+        from .engine import async_feed as _feed
+        # forward-only loops overlap too: stage device-resident batches
+        # ahead of the executor (fit gets this inside BaseModule.fit)
+        data = _feed.maybe_wrap(self._as_iter(X), name="predict")
         if self._module is None:
             data_names = [d[0] if isinstance(d, (tuple, list)) else d.name
                           for d in data.provide_data]

@@ -171,6 +171,18 @@ class BaseModule:
         eval_metric = _as_metric(eval_metric)
         validation_metric = validation_metric or eval_metric
 
+        # Async input pipeline + bounded in-flight dispatch
+        # (engine/async_feed, docs/input_pipeline.md): batches arrive
+        # already device_put by a background producer, the loop dispatches
+        # up to MXNET_TPU_INFLIGHT_STEPS steps ahead, and per-step metric
+        # accumulation stays on device — the epoch boundary below is the
+        # drain point. MXNET_TPU_FEED_DEPTH=0 restores the sync loop.
+        from ..engine import async_feed as _feed
+        train_data = _feed.maybe_wrap(train_data, name="module")
+        if eval_data is not None:
+            eval_data = _feed.maybe_wrap(eval_data, name="module-eval")
+        window = _feed.DispatchWindow(name="module")
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -183,7 +195,17 @@ class BaseModule:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                try:
+                    # bound the dispatch pipeline on this step's outputs;
+                    # on per-device dispatch order their readiness implies
+                    # the whole step (fwd+bwd+update) retired
+                    outs = self.get_outputs()
+                    window.admit([getattr(o, "_data", o) for o in outs])
+                except Exception:
+                    pass  # modules without materialized outputs stay sync
                 if _telem._ENABLED:
+                    # recorded after window admission: interval timing runs
+                    # at completion pace under backpressure (no added sync)
                     d = getattr(data_batch, "data", None)
                     _telem.record_step(int(d[0].shape[0]) if d else 0,
                                        source="module")
@@ -194,6 +216,9 @@ class BaseModule:
                     _invoke_callbacks(batch_end_callback,
                                       BatchEndParam(epoch, nbatch, eval_metric))
                 nbatch += 1
+            # epoch-boundary drain point: retire every in-flight step
+            # before the (syncing) metric read and the epoch callbacks
+            window.drain()  # mxlint: disable=sync-in-loop
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,

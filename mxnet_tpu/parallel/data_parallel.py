@@ -27,6 +27,7 @@ from ..base import MXNetError, env
 from ..ndarray import NDArray
 from .. import autograd
 from .. import engine as _engine
+from ..engine import async_feed as _feed
 from .. import random as _rng
 from .. import sanitize as _sanitize
 from .. import telemetry as _telem
@@ -336,6 +337,11 @@ class DataParallelTrainer:
                       for p in self._plist]
         self._params_raw = [p._data._data for p in self._plist]
         self._t = 0
+        # bounded in-flight dispatch (MXNET_TPU_INFLIGHT_STEPS): step()
+        # returns without blocking and the window back-pressures on the
+        # (i-K)th step's outputs — the reference dependency engine's
+        # pending-op bound, realized over jax async dispatch
+        self._window = _feed.DispatchWindow(name="dp")
         self._step_jit: Dict[Any, Callable] = {}
         # telemetry: per-signature cost_analysis of the fused step (captured
         # once, only while enabled) + the dp-degree for comm accounting
@@ -1095,6 +1101,9 @@ class DataParallelTrainer:
              finite, key_out, t_out) = fn(
                 self._params_raw, self._opt_state, self._comp_resid,
                 key_in, xr, yr, lr_in, t_in, scale_in)
+        # one run_steps call = one in-flight entry (n fused steps inside a
+        # single executable); telemetry after admission, as in step()
+        self._window.admit(losses)
         if _telem._ENABLED:
             per_step_batch = xr.shape[1] if stacked else xr.shape[0]
             self._record_telemetry(sig, per_step_batch * n, n,
@@ -1148,13 +1157,29 @@ class DataParallelTrainer:
                 self._params_raw, self._opt_state, lossv, finite, aux = fn(
                     *call_args)
         if self._scaler is not None:
+            # fp16 dynamic loss scaling reads the finite flag per step —
+            # the one sync the overlap window cannot remove (documented in
+            # docs/input_pipeline.md "when overlap cannot help")
             self._scaler.update_from_step(finite)
+        # non-blocking dispatch: admit the step into the bounded window
+        # (blocks on the (i-K)th step, never this one), THEN record
+        # telemetry — the interval-based step timing thereby runs at
+        # completion pace under backpressure instead of dispatch pace, and
+        # never adds a sync of its own
+        self._window.admit(lossv)
         if _telem._ENABLED:
             self._record_telemetry(sig, bs, 1)
-        return lossv
+        return _feed.PendingScalar(lossv)
+
+    def drain(self):
+        """Block until every dispatched step completed — the designed
+        epoch/eval-boundary sync point for an overlapped loop that
+        collected PendingScalar losses."""
+        self._window.drain()
 
     def sync(self):
         """Write device params back into the gluon Parameters."""
+        self.drain()
         for p, w in zip(self._plist, self._params_raw):
             p._data._set_data(w)
 

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .. import engine as _engine
+from ..engine import async_feed as _feed
 from .. import optimizer as opt_mod
 from .. import random as _rng
 from .. import sanitize as _sanitize
@@ -213,6 +214,9 @@ class PipelineTrainer:
         self._wd_h = [self.optimizer._get_wd(nE + nS + i)
                       for i in range(len(self._h_raw))]
         self._t = 0
+        # bounded in-flight dispatch window (engine/async_feed), same
+        # contract as DataParallelTrainer: step() stays non-blocking
+        self._window = _feed.DispatchWindow(name="pp")
         self._step_jit = {}
         self._step_cost = {}
 
@@ -367,6 +371,9 @@ class PipelineTrainer:
         with _telem.annotate("mx.pp.step"), _sanitize.guard():
             (self._e_raw, self._s_raw, self._h_raw, self._opt_e, self._opt_s,
              self._opt_h, lossv) = fn(*call_args)
+        # non-blocking dispatch + backpressure on the (i-K)th step;
+        # telemetry after admission (completion-paced, sync-free)
+        self._window.admit(lossv)
         if _telem._ENABLED:
             # per-step collective volume: the embed/head grad psum over 'pp'
             # (the stage-hop ppermute traffic is activation-shaped and
@@ -379,11 +386,17 @@ class PipelineTrainer:
             flops = self._step_cost.get(sig, {}).get("flops")
             _telem.record_step(B, source="pipeline", flops_per_step=flops,
                                lr=float(self.optimizer.learning_rate))
-        return lossv
+        return _feed.PendingScalar(lossv)
+
+    def drain(self):
+        """Block until every dispatched step completed (epoch/eval
+        boundary drain point)."""
+        self._window.drain()
 
     def sync(self):
         """Write device params back into the gluon Parameters (unstacking
         the layerwise cell stacks)."""
+        self.drain()
         for p, w in zip(self._embed_plist, self._e_raw):
             p._data._set_data(w)
         for p, w in zip(self._head_plist, self._h_raw):

@@ -47,6 +47,7 @@ __all__ = [
     "get_metric", "reset", "collect", "scrape", "scrape_json", "report",
     "record_step", "record_comm", "comm_scope", "instrument_comm",
     "record_optimizer_state", "payload_bytes", "sample_memory", "peak_flops",
+    "record_feed_depth", "record_feed_stall", "record_inflight",
     "set_epoch", "timed", "annotate", "start_http_server",
     "stop_http_server",
 ]
@@ -552,6 +553,35 @@ def record_optimizer_state(nbytes: int, source: str = "trainer"):
     gauge("mx_optimizer_state_per_replica_bytes",
           "Optimizer-state bytes held per replica",
           ("source",)).labels(source).set(int(nbytes))
+
+
+# ---------------------------------------------------------------------------
+# Input-pipeline / dispatch-overlap instrumentation (engine/async_feed)
+# ---------------------------------------------------------------------------
+
+def record_feed_depth(depth: int, source: str = "feed"):
+    """Batches currently staged on device by a DeviceFeed. A depth pinned
+    at 0 while the device is busy means the producer keeps up exactly; a
+    full queue means H2D is fully hidden behind compute."""
+    gauge("mx_feed_queue_depth",
+          "Device-resident batches staged ahead by the async feed",
+          ("source",)).labels(source).set(int(depth))
+
+
+def record_feed_stall(total_seconds: float, source: str = "feed"):
+    """Cumulative consumer time spent waiting on an empty feed queue.
+    Rendered as a counter (monotone per feed instance): nonzero growth
+    means the input pipeline, not the device, bounds throughput."""
+    gauge("mx_feed_stall_seconds_total",
+          "Cumulative seconds the consumer stalled on an empty feed queue",
+          ("source",)).labels(source).set(float(total_seconds))
+
+
+def record_inflight(n: int, source: str = "step"):
+    """Dispatched-but-incomplete training steps in a DispatchWindow."""
+    gauge("mx_inflight_steps",
+          "Training steps dispatched but not yet retired by the bounded "
+          "in-flight window", ("source",)).labels(source).set(int(n))
 
 
 @contextmanager

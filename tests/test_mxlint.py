@@ -373,6 +373,73 @@ def good(x, cache=None, items=(), n=3):
 
 
 # ---------------------------------------------------------------------------
+# sync-in-loop
+# ---------------------------------------------------------------------------
+
+LOOP_FILE = "mxnet_tpu/module/base_module.py"
+
+
+def test_sync_in_loop_flags_sync_on_step_outputs(tmp_path):
+    src = '''
+import numpy as np
+
+class BaseModule:
+    def fit(self, train_data, trainer):
+        losses = []
+        for batch in train_data:
+            loss = trainer.step(batch.data, batch.label)
+            losses.append(float(loss))          # sync on the CURRENT step
+            a = loss.item()
+            b = loss.asnumpy()
+            loss.block_until_ready()
+            c = np.asarray(loss)
+            d = float(trainer.step(batch.data, batch.label))  # direct wrap
+'''
+    out = _lint(tmp_path, LOOP_FILE, src, ["sync-in-loop"])
+    assert len(out) == 6, out
+    assert _rules_of(out) == {"sync-in-loop"}
+    assert all(f.symbol == "BaseModule.fit" for f in out)
+
+
+def test_sync_in_loop_allows_pending_and_boundary_drain(tmp_path):
+    src = '''
+class BaseModule:
+    def fit(self, train_data, trainer):
+        pending = []
+        for batch in train_data:
+            loss = trainer.step(batch.data, batch.label)   # stays pending
+            pending.append(loss)
+            lr = float(trainer.learning_rate)   # python scalar, not a step output
+        trainer.drain()                          # boundary: outside the loop
+        return [float(p) for p in pending]       # drained after the loop
+'''
+    assert _lint(tmp_path, LOOP_FILE, src, ["sync-in-loop"]) == []
+
+
+def test_sync_in_loop_waivable_at_drain_points(tmp_path):
+    src = '''
+class BaseModule:
+    def fit(self, train_data, trainer):
+        for epoch in range(2):
+            for batch in train_data:
+                loss = trainer.step(batch.data, batch.label)
+            last = float(loss)  # designed per-epoch drain  # mxlint: disable=sync-in-loop
+'''
+    assert _lint(tmp_path, LOOP_FILE, src, ["sync-in-loop"]) == []
+
+
+def test_sync_in_loop_ignores_cold_functions(tmp_path):
+    src = '''
+class Helper:
+    def run(self, train_data, trainer):
+        for batch in train_data:
+            loss = trainer.step(batch.data, batch.label)
+            print(float(loss))   # not a hot-listed loop driver
+'''
+    assert _lint(tmp_path, LOOP_FILE, src, ["sync-in-loop"]) == []
+
+
+# ---------------------------------------------------------------------------
 # baseline + driver mechanics
 # ---------------------------------------------------------------------------
 
@@ -401,7 +468,7 @@ def test_unknown_rule_raises(tmp_path):
 def test_all_passes_registered():
     names = set(all_passes())
     assert {"host-sync", "retrace-hazard", "donation-safety", "jit-purity",
-            "lock-discipline", "mutable-default",
+            "lock-discipline", "mutable-default", "sync-in-loop",
             "instrumentation"} <= names
 
 

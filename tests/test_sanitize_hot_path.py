@@ -115,3 +115,72 @@ def test_run_steps_dispatch_under_transfer_guard():
     with jax.transfer_guard("disallow"):
         losses = tr.run_steps(x, y, n=2)
     assert np.all(np.isfinite(np.asarray(losses)))
+
+
+def test_fed_overlapped_loop_under_transfer_guard():
+    """ISSUE 5 acceptance: a DeviceFeed-fed, overlapped loop dispatches
+    with NO host sync between consecutive steps under
+    transfer_guard('disallow') — the feed's device_put is explicit (and
+    runs in the producer thread), batches arrive pre-placed with the
+    trainer's input sharding so _put_batch takes the no-op path, and the
+    per-step losses stay pending until the drain point after the guard."""
+    from mxnet_tpu.engine.async_feed import DeviceFeed, PendingScalar
+    from mxnet_tpu.io import NDArrayIter
+
+    tr = _make_trainer()
+    rs = np.random.RandomState(0)
+    x = rs.uniform(-1, 1, (24, 8)).astype(np.float32)
+    y = rs.uniform(-1, 1, (24, 4)).astype(np.float32)
+
+    def fresh_feed():
+        return DeviceFeed.for_trainer(
+            NDArrayIter(x, y, batch_size=4, shuffle=False), tr)
+
+    feed = fresh_feed()
+    for b in feed:  # trace + compile outside the guard
+        tr.step(b.data[0], b.label[0])
+    tr.drain()
+    feed.close()
+
+    feed = fresh_feed()
+    pend = []
+    with jax.transfer_guard("disallow"):
+        for b in feed:
+            pend.append(tr.step(b.data[0], b.label[0]))
+    tr.drain()  # the designed boundary sync point
+    feed.close()
+    assert len(pend) == 6
+    assert all(isinstance(p, PendingScalar) for p in pend)
+    assert all(np.isfinite(float(p)) for p in pend)
+
+
+def test_fed_overlapped_run_steps_under_transfer_guard():
+    """Same contract for the compiled multi-step path: feed-delivered,
+    device-resident batches drive run_steps under the guard."""
+    from mxnet_tpu.engine.async_feed import DeviceFeed
+    from mxnet_tpu.io import NDArrayIter
+
+    tr = _make_trainer()
+    rs = np.random.RandomState(1)
+    x = rs.uniform(-1, 1, (8, 8)).astype(np.float32)
+    y = rs.uniform(-1, 1, (8, 4)).astype(np.float32)
+
+    def fresh_feed():
+        return DeviceFeed.for_trainer(
+            NDArrayIter(x, y, batch_size=4, shuffle=False), tr)
+
+    feed = fresh_feed()
+    for b in feed:  # compile + prime the device-resident scalar caches
+        tr.run_steps(b.data[0], b.label[0], n=2)
+    tr.drain()
+    feed.close()
+
+    feed = fresh_feed()
+    all_losses = []
+    with jax.transfer_guard("disallow"):
+        for b in feed:
+            all_losses.append(tr.run_steps(b.data[0], b.label[0], n=2))
+    tr.drain()
+    feed.close()
+    assert len(all_losses) == 2
+    assert np.all(np.isfinite(np.asarray(all_losses)))

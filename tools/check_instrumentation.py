@@ -66,6 +66,15 @@ METHOD_CHECKS = [
     ("parallel/tensor_parallel.py", None, "shard_params_megatron",
      {"record_comm", "counter", "gauge"}, "call"),
     ("module/base_module.py", "BaseModule", "fit", {"record_step"}, "call"),
+    # async feed + bounded in-flight dispatch (ISSUE 5): the overlap layer
+    # must stay observable — feed stalls/queue depth at every delivery,
+    # in-flight depth at every window transition
+    ("engine/async_feed.py", "DeviceFeed", "next",
+     {"record_feed_stall", "record_feed_depth"}, "call"),
+    ("engine/async_feed.py", "DispatchWindow", "admit",
+     {"record_inflight"}, "call"),
+    ("engine/async_feed.py", "DispatchWindow", "drain",
+     {"record_inflight"}, "call"),
 ]
 
 # (relative file, required substring, rationale)
@@ -81,6 +90,13 @@ TEXT_CHECKS = [
     ("telemetry/__init__.py", "def record_optimizer_state",
      "the registry must expose the per-replica optimizer-state gauge "
      "(the zero-update memory acceptance signal)"),
+    ("telemetry/__init__.py", "mx_feed_queue_depth",
+     "the registry must export the async-feed queue-depth gauge"),
+    ("telemetry/__init__.py", "mx_feed_stall_seconds_total",
+     "the registry must export the feed-stall accounting metric "
+     "(nonzero growth = input-bound, not device-bound)"),
+    ("telemetry/__init__.py", "mx_inflight_steps",
+     "the registry must export the bounded in-flight window depth gauge"),
 ]
 
 

@@ -1,3 +1,3 @@
 """Importing this package registers every built-in mxlint pass."""
 from . import (donation, host_sync, instrumentation,  # noqa: F401
-               locks, mutable_defaults, purity, retrace)
+               locks, mutable_defaults, purity, retrace, sync_in_loop)
