@@ -737,6 +737,172 @@ def bench_async_feed(steps, warmup):
     }
 
 
+def bench_serving():
+    """Latency-vs-throughput curves for the continuous-batching serving
+    path (mxnet_tpu.serving, docs/serving.md): ResNet-50 and BERT-base
+    registered on one serving.Server (per-bucket artifacts warmed at
+    registration), then 1/8/64 closed-loop concurrent streams each firing
+    single-row requests back-to-back. Reports per-config p50/p99 latency,
+    request+row throughput, batch occupancy (real vs padded rows), sampled
+    queue-depth peak, and the batch-formation histogram by bucket — the
+    numbers the max-wait/bucket-set tuning loop in docs/serving.md reads.
+
+    Model scale is env-tunable so the scenario also runs on CPU hosts:
+    BENCH_SERVING_IMAGE (default 224), BENCH_SERVING_SEQ (128),
+    BENCH_SERVING_VOCAB (8192), BENCH_SERVING_BUCKETS (1,8,64),
+    BENCH_SERVING_STREAMS (1,8,64), BENCH_SERVING_REQUESTS (16/stream),
+    BENCH_SERVING_MAX_WAIT_MS (5), BENCH_SERVING_MODELS
+    (resnet50,bert_base)."""
+    import tempfile
+    import threading
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, serving, telemetry
+
+    image = int(os.environ.get("BENCH_SERVING_IMAGE", 224))
+    seq = int(os.environ.get("BENCH_SERVING_SEQ", 128))
+    vocab = int(os.environ.get("BENCH_SERVING_VOCAB", 8192))
+    buckets = tuple(int(b) for b in os.environ.get(
+        "BENCH_SERVING_BUCKETS", "1,8,64").split(","))
+    streams_list = tuple(int(s) for s in os.environ.get(
+        "BENCH_SERVING_STREAMS", "1,8,64").split(","))
+    reqs_per_stream = int(os.environ.get("BENCH_SERVING_REQUESTS", 16))
+    max_wait_ms = float(os.environ.get("BENCH_SERVING_MAX_WAIT_MS", 5.0))
+    which = os.environ.get("BENCH_SERVING_MODELS",
+                           "resnet50,bert_base").split(",")
+    tmp = tempfile.mkdtemp(prefix="mx_serving_bench_")
+
+    def export_resnet50():
+        from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+        net = resnet50_v1()
+        with mx.cpu():
+            net.initialize(ctx=mx.cpu())
+            net.hybridize()
+            net(nd.zeros((1, 3, image, image), ctx=mx.cpu()))
+        prefix = os.path.join(tmp, "resnet50")
+        net.export(prefix)
+        return prefix, {"data": (3, image, image)}, "float32"
+
+    def export_bert_base():
+        from mxnet_tpu.models import bert_base
+        net = bert_base(vocab_size=vocab)
+        with mx.cpu():
+            net.initialize(ctx=mx.cpu())
+            net.hybridize()
+            net(nd.zeros((1, seq), ctx=mx.cpu(), dtype="int32"))
+        prefix = os.path.join(tmp, "bert_base")
+        net.export(prefix)
+        return prefix, {"data": (seq,)}, "int32"
+
+    exporters = {"resnet50": export_resnet50, "bert_base": export_bert_base}
+
+    def run_config(srv, name, row_shape, dtype, n_streams):
+        telemetry.reset()
+        telemetry.enable()
+        latencies = []
+        lat_lock = threading.Lock()
+        errors = []
+
+        def client(k):
+            rs = np.random.RandomState(k)
+            if dtype == "int32":
+                x = rs.randint(0, vocab, (1,) + row_shape).astype(np.int32)
+            else:
+                x = rs.uniform(-1, 1, (1,) + row_shape).astype(np.float32)
+            mine = []
+            try:
+                for _ in range(reqs_per_stream):
+                    t0 = time.perf_counter()
+                    srv.predict(name, data=x, timeout=600.0)
+                    mine.append(time.perf_counter() - t0)
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+            with lat_lock:
+                latencies.extend(mine)
+
+        depth_peak = [0.0]
+        stop = threading.Event()
+
+        def monitor():
+            while not stop.is_set():
+                fam = telemetry.get_metric("mx_serving_queue_depth")
+                if fam is not None:
+                    depth_peak[0] = max(depth_peak[0], fam.get(name))
+                stop.wait(0.002)
+
+        mon = threading.Thread(target=monitor, daemon=True)
+        mon.start()
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(n_streams)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        stop.set()
+        mon.join()
+        assert not errors, errors[:3]
+        latencies.sort()
+
+        def pct(p):
+            return latencies[min(int(p * len(latencies)),
+                                 len(latencies) - 1)]
+
+        rows_fam = telemetry.get_metric("mx_serving_batch_rows_total")
+        pad_fam = telemetry.get_metric("mx_serving_padded_rows_total")
+        batch_fam = telemetry.get_metric("mx_serving_batches_total")
+        real = sum(s.value for s in rows_fam._series.values()) \
+            if rows_fam else 0.0
+        padded = sum(s.value for s in pad_fam._series.values()) \
+            if pad_fam else 0.0
+        by_bucket = {s.label_values[1]: int(s.value)
+                     for s in batch_fam._series.values()} \
+            if batch_fam else {}
+        telemetry.disable()
+        n = len(latencies)
+        return {
+            "streams": n_streams,
+            "requests": n,
+            "p50_ms": round(pct(0.50) * 1e3, 2),
+            "p99_ms": round(pct(0.99) * 1e3, 2),
+            "req_s": round(n / wall, 2),
+            "occupancy": round(real / max(real + padded, 1.0), 4),
+            "queue_depth_peak": int(depth_peak[0]),
+            "batches_by_bucket": by_bucket,
+        }
+
+    extra = {"buckets": list(buckets), "max_wait_ms": max_wait_ms,
+             "requests_per_stream": reqs_per_stream, "host_cores":
+             os.cpu_count()}
+    for name in which:
+        name = name.strip()
+        prefix, row_shapes, dtype = exporters[name]()
+        srv = serving.Server(max_wait_ms=max_wait_ms)
+        try:
+            t0 = time.perf_counter()
+            srv.register(name, prefix + "-symbol.json",
+                         prefix + "-0000.params", input_shapes=row_shapes,
+                         buckets=buckets, dtype=dtype)
+            warm_s = time.perf_counter() - t0
+            row_shape = row_shapes["data"]
+            extra[name] = {
+                "warmup_s": round(warm_s, 2),
+                "curves": [run_config(srv, name, row_shape, dtype, s)
+                           for s in streams_list],
+            }
+        finally:
+            srv.close()
+    key = which[0].strip()
+    mid = extra[key]["curves"][min(1, len(extra[key]["curves"]) - 1)]
+    return {
+        "metric": "serving_p99_ms",
+        "value": mid["p99_ms"],
+        "unit": f"ms @ {mid['streams']} streams ({key})",
+        "vs_baseline": mid["occupancy"],  # real-row fraction at that load
+        "extra": extra,
+    }
+
+
 def bench_lint_walltime():
     """Static-analyzer cost over the whole package (tier-1 runs mxlint via
     tests/test_lint_clean.py, so it must stay well under the suite budget:
@@ -793,6 +959,10 @@ def main():
         print(json.dumps(bench_zero_dp(
             int(os.environ.get("BENCH_TRAIN_STEPS", 5)),
             int(os.environ.get("BENCH_TRAIN_WARMUP", 2)))))
+        return
+    if os.environ.get("BENCH_SCENARIO") == "serving":
+        _enable_compile_cache()
+        print(json.dumps(bench_serving()))
         return
     _enable_compile_cache()
     if os.environ.get("BENCH_SCENARIO") == "train_step":

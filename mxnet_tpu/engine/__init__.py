@@ -31,8 +31,8 @@ __all__ = ["lookup", "insert", "clear_compilation_cache", "cache_stats",
            "reset_stats", "donation_enabled", "record_donation",
            "compile_timer", "record_trace", "record_execution",
            "estimate_cost", "structural_fingerprint", "graph_fingerprint",
-           "config_fingerprint", "async_feed", "DeviceFeed",
-           "DispatchWindow", "PendingScalar"]
+           "config_fingerprint", "pin", "unpin", "pinned_count",
+           "async_feed", "DeviceFeed", "DispatchWindow", "PendingScalar"]
 
 
 def __getattr__(name):
@@ -54,6 +54,10 @@ def __getattr__(name):
 
 _LOCK = threading.RLock()
 _CACHE: Dict[Tuple, Any] = {}
+# serving/predict artifacts pin their cache entries (refcounted) so a
+# fingerprint-scoped invalidation — e.g. one model's clear_cache — cannot
+# evict an executable another live Predictor/serving bucket depends on
+_PINS: Dict[Tuple, int] = {}
 
 _STATS = {
     "hits": 0,            # shared-cache lookups that returned an artifact
@@ -122,16 +126,52 @@ def insert(key: Tuple, artifact):
     return artifact
 
 
-def clear_compilation_cache(fingerprint=None):
+def clear_compilation_cache(fingerprint=None, force=False):
     """Drop shared executables — all of them, or only the entries whose key
     carries `fingerprint` (HybridBlock.clear_cache uses the latter so one
-    block's invalidation doesn't flush unrelated models)."""
+    block's invalidation doesn't flush unrelated models). Entries pinned by
+    live Predictor/serving artifacts survive unless ``force=True`` (tests
+    that must reset the world completely)."""
     with _LOCK:
         if fingerprint is None:
-            _CACHE.clear()
+            victims = list(_CACHE)
         else:
-            for k in [k for k in _CACHE if fingerprint in k]:
-                del _CACHE[k]
+            victims = [k for k in _CACHE if fingerprint in k]
+        for k in victims:
+            if not force and _PINS.get(k):
+                continue
+            del _CACHE[k]
+        if force:
+            if fingerprint is None:
+                _PINS.clear()
+            else:
+                for k in [k for k in _PINS if fingerprint in k]:
+                    del _PINS[k]
+
+
+def pin(key: Tuple) -> None:
+    """Refcount-pin a cache entry against non-forced invalidation. A serving
+    artifact holds one pin per bucket; ``Predictor.reshape`` releases the
+    old shape's pin when it rebinds (never leaks it)."""
+    with _LOCK:
+        if key in _CACHE:
+            _PINS[key] = _PINS.get(key, 0) + 1
+
+
+def unpin(key: Tuple) -> None:
+    """Release one pin; the entry becomes evictable at refcount zero."""
+    with _LOCK:
+        n = _PINS.get(key, 0)
+        if n <= 1:
+            _PINS.pop(key, None)
+        else:
+            _PINS[key] = n - 1
+
+
+def pinned_count() -> int:
+    """Number of distinct pinned cache entries (serving-resident artifacts)."""
+    with _LOCK:
+        return len(_PINS)
 
 
 def cache_size() -> int:
@@ -143,6 +183,7 @@ def cache_stats() -> Dict[str, Any]:
     with _LOCK:
         st = dict(_STATS)
         st["artifacts"] = len(_CACHE)
+        st["pinned"] = len(_PINS)
         st["persistent_cache_dir"] = _persistent_dir
         return st
 

@@ -22,6 +22,19 @@ __all__ = ["TransformerEncoderCell", "BertEncoder", "BertModel", "bert_base",
            "bert_large", "bert_tiny"]
 
 
+def _position_ids(F, token_ids):
+    """Position indices (T,) for BOTH the eager/traced NDArray path and
+    symbolic export: a Symbol has no concrete ``.shape``, so the exported
+    graph builds positions with ``arange_like`` over the sequence axis
+    (static under trace, serializable — what lets ``net.export`` produce a
+    servable BERT artifact for mxnet_tpu.serving)."""
+    shape = getattr(token_ids, "shape", None)
+    if shape is not None:
+        from .. import ndarray as nd
+        return nd.arange(0, shape[1], dtype="int32", ctx=token_ids.ctx)
+    return F.arange_like(token_ids, axis=1)
+
+
 class SelfAttention(HybridBlock):
     """Q/K/V ride ONE (C -> 3C) projection by default — the shape-widening
     fusion the reference hand-writes for GPUs in its interleaved-QKV kernels
@@ -57,45 +70,62 @@ class SelfAttention(HybridBlock):
         self.dropout = nn.Dropout(dropout) if dropout else None
 
     def hybrid_forward(self, F, x, mask=None):
-        # x: (B, T, C)
-        B, T, C = x.shape
+        # x: (B, T, C). Shapes are expressed through MXNet reshape codes
+        # (0 copy, -1 infer, -3 merge, -4 split) so the SAME code runs
+        # eagerly, under jit trace, AND symbolically for export — a Symbol
+        # has no concrete .shape (serving needs the exported graph).
         H = self._heads
-        d = C // H
+        d = self._units // H
         if self._fused_qkv:
             qkv = self.qkv(x)  # (B, T, 3C)
             if self._head_major:
-                qkv = qkv.reshape((B, T, H, 3, d)).transpose((3, 0, 2, 1, 4))
+                qkv = F.reshape(qkv, shape=(0, 0, H, 3, d))
+                slice_ax, merge = 3, (0, 0, 0, -3)      # merge the 1*d tail
             else:
-                qkv = qkv.reshape((B, T, 3, H, d)).transpose((2, 0, 3, 1, 4))
-            q, k, v = qkv[0], qkv[1], qkv[2]  # (B, H, T, d)
+                qkv = F.reshape(qkv, shape=(0, 0, 3, H, d))
+                slice_ax, merge = 2, (0, 0, -3, 0)      # merge the 1*H pair
+            q, k, v = (
+                F.transpose(                            # (B, H, T, d)
+                    F.reshape(                          # (B, T, H, d)
+                        F.slice_axis(qkv, axis=slice_ax, begin=i, end=i + 1),
+                        shape=merge),
+                    axes=(0, 2, 1, 3))
+                for i in range(3))
         else:
-            q = self.q_proj(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))
-            k = self.k_proj(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))
-            v = self.v_proj(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))
+            q, k, v = (
+                F.transpose(                            # split C -> (H, d)
+                    F.reshape(proj(x), shape=(0, 0, -4, H, -1)),
+                    axes=(0, 2, 1, 3))
+                for proj in (self.q_proj, self.k_proj, self.v_proj))
         # Length-adaptive: at short T the O(T^2) scores tensor is cheap and
         # XLA fuses the plain path onto the MXU far better than the tiled
         # flash kernel (measured on v5e, BERT-base T=512: 151k tok/s plain
         # vs 106k blockwise — 46% vs 32% MFU); flash attention's tiling
         # only pays once activation memory actually matters. Override the
-        # crossover with MXNET_FLASH_ATTENTION_MIN_SEQ.
+        # crossover with MXNET_FLASH_ATTENTION_MIN_SEQ. Symbolic export
+        # (no concrete shape) always lowers the plain path.
         import os as _os
         min_t = int(_os.environ.get("MXNET_FLASH_ATTENTION_MIN_SEQ", 1024))
-        if self._use_blockwise and mask is None and T >= min_t:
+        shape = getattr(x, "shape", None)
+        if shape is not None and self._use_blockwise and mask is None \
+                and shape[1] >= min_t:
             # registered-op form: dispatches to the Pallas kernel on TPU and
             # records the VJP on the eager autograd tape (raw-array calls
             # would silently detach attention from loss.backward())
             from .. import ndarray as _nd
             out = _nd._contrib_flash_attention(q, k, v, causal=False)
         else:
-            scores = F.batch_dot(q.reshape((B * H, T, d)),
-                                 k.reshape((B * H, T, d)), transpose_b=True)
-            scores = scores / math.sqrt(d)
+            q2 = F.reshape(q, shape=(-3, 0, 0))         # (B*H, T, d)
+            k2 = F.reshape(k, shape=(-3, 0, 0))
+            v2 = F.reshape(v, shape=(-3, 0, 0))
+            scores = F.batch_dot(q2, k2, transpose_b=True) / math.sqrt(d)
             if mask is not None:
                 scores = scores + (1.0 - mask) * -1e9
             att = F.softmax(scores, axis=-1)
-            out = F.batch_dot(att, v.reshape((B * H, T, d)))
-            out = out.reshape((B, H, T, d))
-        out = out.transpose((0, 2, 1, 3)).reshape((B, T, C))
+            out = F.batch_dot(att, v2)
+            out = F.reshape(out, shape=(-4, -1, H, 0, 0))  # (B, H, T, d)
+        out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)),
+                        shape=(0, 0, -3))               # (B, T, C)
         out = self.proj(out)
         if self.dropout:
             out = self.dropout(out)
@@ -180,10 +210,8 @@ class BertModel(HybridBlock):
         return _BertEmbedStage(self), cells, _BertHeadStage(self)
 
     def hybrid_forward(self, F, token_ids, segment_ids=None):
-        B, T = token_ids.shape
-        from .. import ndarray as nd
-        pos = nd.arange(0, T, dtype="int32", ctx=token_ids.ctx)
-        x = self.word_embed(token_ids) + self.pos_embed(pos).expand_dims(0)
+        pos = _position_ids(F, token_ids)
+        x = self.word_embed(token_ids) + self.pos_embed(pos).expand_dims(axis=0)
         if segment_ids is not None:
             x = x + self.seg_embed(segment_ids)
         x = self.embed_ln(x)
@@ -207,10 +235,8 @@ class _BertEmbedStage(HybridBlock):
         self.drop = bert.embed_drop
 
     def hybrid_forward(self, F, token_ids):
-        B, T = token_ids.shape
-        from .. import ndarray as nd
-        pos = nd.arange(0, T, dtype="int32", ctx=token_ids.ctx)
-        x = self.word_embed(token_ids) + self.pos_embed(pos).expand_dims(0)
+        pos = _position_ids(F, token_ids)
+        x = self.word_embed(token_ids) + self.pos_embed(pos).expand_dims(axis=0)
         x = self.embed_ln(x)
         if self.drop:
             x = self.drop(x)

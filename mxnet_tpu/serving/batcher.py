@@ -1,0 +1,314 @@
+"""Continuous batcher: thread-safe request queue -> bucketed padded batches.
+
+The TPU-native continuous/dynamic batching policy (ROADMAP item 3): XLA
+artifacts are fixed-shape, so instead of arbitrary dynamic batches the
+batcher aggregates in-flight requests into the SMALLEST covering bucket
+from the model's configured bucket set (e.g. 1/8/64), pads the tail rows,
+and slices real rows back per request at completion. Latency is bounded:
+a batch dispatches as soon as (a) it fills the largest bucket, (b) the next
+queued request can no longer fit, or (c) the OLDEST queued request has
+waited ``max_wait_ms`` — the knob that trades batch occupancy (throughput)
+against p99 (docs/serving.md).
+
+The dispatch loop mirrors the training loops' overlap discipline
+(engine/async_feed): request tensors go to device via the model's explicit
+``place_input`` (``device_put`` with the registered sharding — DeviceFeed's
+rule), the compiled per-bucket artifact is invoked WITHOUT a host sync, and
+a ``DispatchWindow`` keeps up to K batches in flight with backpressure. A
+separate completion thread performs the single designed host sync, slices
+per-request rows out of the padded outputs, resolves futures, and records
+end-to-end latency. mxlint's ``sync-in-loop`` pass gates the dispatch loop
+the same way it gates the trainers' fit loops.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..engine.async_feed import DispatchWindow
+from .registry import RegisteredModel
+
+__all__ = ["ServingFuture", "ContinuousBatcher"]
+
+
+class ServingFuture:
+    """Handle for one in-flight request: ``result(timeout)`` blocks until
+    the completion thread resolves it (numpy outputs, per-request rows)."""
+
+    __slots__ = ("_event", "_result", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise MXNetError("serving request timed out")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def _set_result(self, value):
+        self._result = value
+        self._event.set()
+
+    def _set_error(self, err: BaseException):
+        self._error = err
+        self._event.set()
+
+
+class _Request:
+    __slots__ = ("inputs", "rows", "future", "t_enqueue")
+
+    def __init__(self, inputs: Dict[str, _np.ndarray], rows: int):
+        self.inputs = inputs
+        self.rows = rows
+        self.future = ServingFuture()
+        self.t_enqueue = time.perf_counter()
+
+
+class ContinuousBatcher:
+    """Aggregates submitted requests into padded bucket batches for one
+    ``RegisteredModel`` and keeps up to ``max_inflight`` batches in flight.
+
+    ``submit()`` never blocks on the device; ``close()`` drains in-flight
+    work (pending requests are still served) and joins both worker threads.
+    """
+
+    def __init__(self, model: RegisteredModel, max_wait_ms: float = 5.0,
+                 max_inflight: int = 2, name: Optional[str] = None):
+        self._model = model
+        self._name = name or model.name
+        self._max_wait = max(float(max_wait_ms), 0.0) / 1e3
+        self._pending: "deque[_Request]" = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._window = DispatchWindow(depth=max_inflight,
+                                      name=f"serving:{self._name}")
+        # bounded: a slow completion sync backpressures dispatch in
+        # addition to the window's device-side bound
+        self._done_q: "queue.Queue" = queue.Queue(
+            maxsize=max(int(max_inflight), 1) + 1)
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name=f"mx-serving-dispatch-{self._name}")
+        self._completer = threading.Thread(
+            target=self._complete_loop, daemon=True,
+            name=f"mx-serving-complete-{self._name}")
+        self._dispatcher.start()
+        self._completer.start()
+
+    # -- enqueue -------------------------------------------------------------
+    def _validate(self, named: Dict[str, Any]) -> Tuple[Dict[str,
+                                                             _np.ndarray],
+                                                        int]:
+        model = self._model
+        unknown = [n for n in named if n not in model.input_names]
+        if unknown:
+            raise MXNetError(
+                f"submit: unknown inputs {unknown}; model "
+                f"{model.name!r} takes {model.input_names}")
+        missing = [n for n in model.input_names if n not in named]
+        if missing:
+            raise MXNetError(
+                f"submit: missing inputs {missing}; model "
+                f"{model.name!r} takes {model.input_names}")
+        arrays = {}
+        rows = None
+        for n in model.input_names:
+            a = _np.asarray(named[n], dtype=model.input_dtype(n))
+            want = model.row_shape(n)
+            if a.shape == want:  # a single row: auto-lift to batch 1
+                a = a[None]
+            if a.ndim != len(want) + 1 or a.shape[1:] != want:
+                raise MXNetError(
+                    f"submit: input {n!r} has shape {a.shape}; expected "
+                    f"(rows,)+{want}")
+            if rows is None:
+                rows = a.shape[0]
+            elif a.shape[0] != rows:
+                raise MXNetError(
+                    f"submit: inputs disagree on rows "
+                    f"({rows} vs {a.shape[0]} for {n!r})")
+            arrays[n] = a
+        if rows is None or rows < 1:
+            raise MXNetError("submit: empty request")
+        if rows > model.max_bucket:
+            raise MXNetError(
+                f"submit: {rows} rows exceed the largest bucket "
+                f"{model.max_bucket} of model {model.name!r}; split the "
+                "request or register a larger bucket")
+        return arrays, rows
+
+    def submit(self, inputs: Optional[Dict[str, Any]] = None,
+               **named) -> ServingFuture:
+        """Enqueue one request (dict or kwargs of input name -> array with
+        leading batch dim, or a bare row). Returns immediately."""
+        merged = dict(inputs or {})
+        merged.update(named)
+        arrays, rows = self._validate(merged)
+        req = _Request(arrays, rows)
+        with self._cond:
+            if self._closed:
+                raise MXNetError(
+                    f"serving queue for {self._name!r} is closed")
+            self._pending.append(req)
+            depth = len(self._pending)
+            self._cond.notify_all()
+        from .. import telemetry as _telem
+        if _telem._ENABLED:
+            _telem.record_serving_enqueue(self._name, rows)
+            _telem.record_serving_queue_depth(self._name, depth)
+        return req.future
+
+    # -- batch formation -----------------------------------------------------
+    def _take_locked(self) -> Tuple[List[_Request], int]:
+        """Pop the longest request prefix fitting the largest bucket.
+        Caller holds the lock."""
+        take: List[_Request] = []
+        rows = 0
+        while self._pending and \
+                rows + self._pending[0].rows <= self._model.max_bucket:
+            req = self._pending.popleft()
+            take.append(req)
+            rows += req.rows
+        return take, rows
+
+    def _next_batch(self) -> Optional[Tuple[List[_Request], int, int, int]]:
+        """Block until a batch is ready under the dispatch policy; None on
+        shutdown with an empty queue."""
+        with self._cond:
+            while True:
+                if self._pending:
+                    head_rows = 0
+                    n_fit = 0
+                    for req in self._pending:
+                        if head_rows + req.rows > self._model.max_bucket:
+                            break
+                        head_rows += req.rows
+                        n_fit += 1
+                    deadline = self._pending[0].t_enqueue + self._max_wait
+                    now = time.perf_counter()
+                    full = head_rows >= self._model.max_bucket or \
+                        n_fit < len(self._pending)
+                    if full or self._closed or now >= deadline:
+                        take, rows = self._take_locked()
+                        depth = len(self._pending)
+                        bucket = self._model.smallest_bucket(rows)
+                        return take, bucket, rows, depth
+                    self._cond.wait(timeout=deadline - now)
+                elif self._closed:
+                    return None
+                else:
+                    self._cond.wait()
+
+    def _assemble(self, reqs: List[_Request], bucket: int) -> Dict[str, Any]:
+        """Concatenate + zero-pad the requests' host arrays to the bucket
+        shape and place each tensor on device with the model's explicit
+        sharding (the one H2D transfer, off the compiled call)."""
+        feed = {}
+        for n in self._model.input_names:
+            parts = [r.inputs[n] for r in reqs]
+            rows = sum(p.shape[0] for p in parts)
+            if rows < bucket:
+                pad = _np.zeros((bucket - rows,) + self._model.row_shape(n),
+                                dtype=parts[0].dtype)
+                parts.append(pad)
+            host = parts[0] if len(parts) == 1 \
+                else _np.concatenate(parts, axis=0)
+            feed[n] = self._model.place_input(n, host)
+        return feed
+
+    # -- dispatch / completion ----------------------------------------------
+    def _dispatch_loop(self):
+        from .. import telemetry as _telem
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                break
+            reqs, bucket, rows, depth = batch
+            try:
+                feed = self._assemble(reqs, bucket)
+                outs = self._model.forward(bucket, feed)
+            except BaseException as e:  # fail THIS batch, keep serving
+                for r in reqs:
+                    r.future._set_error(e)
+                if _telem._ENABLED:
+                    for r in reqs:
+                        _telem.record_serving_completion(
+                            self._name,
+                            time.perf_counter() - r.t_enqueue,
+                            r.rows, status="error")
+                continue
+            # bounded in-flight: blocks on the OLDEST batch when > K are
+            # outstanding — backpressure, never a sync on `outs`
+            self._window.admit(outs)
+            if _telem._ENABLED:
+                _telem.record_serving_dispatch(self._name, bucket, rows)
+                _telem.record_serving_queue_depth(self._name, depth)
+            self._done_q.put((reqs, outs))
+        self._done_q.put(None)
+
+    def _complete_loop(self):
+        while True:
+            item = self._done_q.get()
+            if item is None:
+                break
+            self._complete(*item)
+
+    def _complete(self, reqs: List[_Request], outs):
+        """The designed host sync: read the padded outputs back, slice each
+        request's real rows, resolve futures, record end-to-end latency."""
+        from .. import telemetry as _telem
+        try:
+            host = [_np.asarray(o) for o in outs]
+        except BaseException as e:
+            for r in reqs:
+                r.future._set_error(e)
+                if _telem._ENABLED:
+                    _telem.record_serving_completion(
+                        self._name, time.perf_counter() - r.t_enqueue,
+                        r.rows, status="error")
+            return
+        off = 0
+        for r in reqs:
+            sl = [h[off:off + r.rows] for h in host]
+            off += r.rows
+            r.future._set_result(sl[0] if len(sl) == 1 else sl)
+            if _telem._ENABLED:
+                _telem.record_serving_completion(
+                    self._name, time.perf_counter() - r.t_enqueue, r.rows)
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._pending)
+
+    def close(self, timeout: float = 30.0):
+        """Stop accepting requests, serve everything already queued, join
+        the workers."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._dispatcher.join(timeout=timeout)
+        self._completer.join(timeout=timeout)
+        self._window.drain()
+
+    def __del__(self):
+        try:
+            self.close(timeout=1.0)
+        except Exception:
+            pass

@@ -1,0 +1,197 @@
+"""serving.Server: the front door over registry + continuous batchers.
+
+In-process API (futures)::
+
+    srv = serving.Server(max_wait_ms=3.0)
+    srv.register("resnet", "model-symbol.json", "model-0000.params",
+                 input_shapes={"data": (3, 224, 224)}, buckets=(1, 8, 64))
+    fut = srv.submit("resnet", data=batch)      # non-blocking
+    out = fut.result(timeout=30)                # numpy, request's own rows
+    out = srv.predict("resnet", data=batch)     # submit+result shorthand
+
+HTTP API (stdlib ``http.server``, daemon thread)::
+
+    port = srv.start_http(8000)
+    # POST /v1/models/<name>:predict   {"inputs": {"data": [[...], ...]}}
+    #   -> {"model": ..., "output_names": [...], "outputs": [[...], ...]}
+    # GET  /v1/models                  registry listing + memory budget
+    # GET  /metrics                    Prometheus text (mx.telemetry.scrape)
+
+Every worker thread funnels into the same continuous batcher, so HTTP and
+in-process callers share buckets, artifacts, and SLO metrics.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as _np
+
+from ..base import MXNetError
+from .batcher import ContinuousBatcher, ServingFuture
+from .registry import ModelRegistry, RegisteredModel
+
+__all__ = ["Server"]
+
+
+class Server:
+    """Multi-model serving front door (registry + per-model batcher)."""
+
+    def __init__(self, max_wait_ms: float = 5.0, max_inflight: int = 2,
+                 mesh=None, data_spec=None):
+        self.registry = ModelRegistry()
+        self._batchers: Dict[str, ContinuousBatcher] = {}
+        self._max_wait_ms = float(max_wait_ms)
+        self._max_inflight = int(max_inflight)
+        self._mesh = mesh
+        self._data_spec = data_spec
+        self._http = None
+        self._lock = threading.RLock()
+
+    # -- registration --------------------------------------------------------
+    def register(self, name: str, symbol_file: str,
+                 param_file: Optional[str] = None,
+                 input_shapes: Optional[Dict[str, Sequence[int]]] = None,
+                 buckets: Sequence[int] = (1, 8, 64),
+                 dtype: str = "float32",
+                 dtypes: Optional[Dict[str, str]] = None,
+                 max_wait_ms: Optional[float] = None) -> RegisteredModel:
+        """Load + warm a model (one compiled artifact per bucket, eagerly,
+        possibly straight from the persistent XLA cache) and start its
+        batcher. Returns the RegisteredModel."""
+        model = self.registry.register(
+            name, symbol_file, param_file, input_shapes=input_shapes,
+            buckets=buckets, dtype=dtype, dtypes=dtypes,
+            mesh=self._mesh, data_spec=self._data_spec)
+        with self._lock:
+            self._batchers[name] = ContinuousBatcher(
+                model,
+                max_wait_ms=self._max_wait_ms if max_wait_ms is None
+                else max_wait_ms,
+                max_inflight=self._max_inflight)
+        return model
+
+    def unregister(self, name: str):
+        with self._lock:
+            batcher = self._batchers.pop(name, None)
+        if batcher is not None:
+            batcher.close()
+        self.registry.unregister(name)
+
+    def models(self) -> List[Dict[str, Any]]:
+        out = []
+        for name in self.registry.names():
+            m = self.registry.get(name)
+            out.append({"name": name, "buckets": list(m.buckets),
+                        "inputs": {n: list(m.row_shape(n))
+                                   for n in m.input_names},
+                        "outputs": m.output_names,
+                        "param_bytes": m.param_bytes})
+        return out
+
+    # -- inference -----------------------------------------------------------
+    def _batcher(self, name: str) -> ContinuousBatcher:
+        with self._lock:
+            try:
+                return self._batchers[name]
+            except KeyError:
+                raise MXNetError(
+                    f"unknown model {name!r}; registered: "
+                    f"{list(self._batchers)}") from None
+
+    def submit(self, model: str, inputs: Optional[Dict[str, Any]] = None,
+               **named) -> ServingFuture:
+        """Enqueue a request; returns a future immediately."""
+        return self._batcher(model).submit(inputs, **named)
+
+    def predict(self, model: str, inputs: Optional[Dict[str, Any]] = None,
+                timeout: float = 60.0, **named):
+        """Blocking submit+result convenience."""
+        return self.submit(model, inputs, **named).result(timeout)
+
+    # -- HTTP front door -----------------------------------------------------
+    def start_http(self, port: int = 0, addr: str = "127.0.0.1") -> int:
+        """Serve the JSON predict API + /metrics on a daemon thread;
+        returns the bound port (0 picks a free one)."""
+        import http.server
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/metrics"):
+                    from .. import telemetry as _telem
+                    self._send(200, _telem.scrape().encode(),
+                               "text/plain; version=0.0.4")
+                elif self.path.startswith("/v1/models"):
+                    body = json.dumps({
+                        "models": server.models(),
+                        "total_param_bytes":
+                            server.registry.total_param_bytes(),
+                    }).encode()
+                    self._send(200, body)
+                else:
+                    self._send(404, b'{"error": "not found"}')
+
+            def do_POST(self):
+                path = self.path
+                if not (path.startswith("/v1/models/")
+                        and path.endswith(":predict")):
+                    self._send(404, b'{"error": "not found"}')
+                    return
+                name = path[len("/v1/models/"):-len(":predict")]
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    inputs = payload.get("inputs", payload)
+                    out = server.predict(name, inputs)
+                    outs = out if isinstance(out, list) else [out]
+                    model = server.registry.get(name)
+                    body = json.dumps({
+                        "model": name,
+                        "output_names": model.output_names,
+                        "outputs": [_np.asarray(o).tolist() for o in outs],
+                    }).encode()
+                    self._send(200, body)
+                except Exception as e:
+                    self._send(400, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode())
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer((addr, port), Handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True,
+                             name="mx-serving-http")
+        t.start()
+        with self._lock:
+            self._http = srv
+        return srv.server_address[1]
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self):
+        """Stop HTTP, drain + join every batcher, release artifact pins."""
+        with self._lock:
+            http_srv, self._http = self._http, None
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        if http_srv is not None:
+            http_srv.shutdown()
+            http_srv.server_close()
+        for b in batchers:
+            b.close()
+        self.registry.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -49,7 +49,9 @@ __all__ = [
     "record_optimizer_state", "payload_bytes", "sample_memory", "peak_flops",
     "record_feed_depth", "record_feed_stall", "record_inflight",
     "set_epoch", "timed", "annotate", "start_http_server",
-    "stop_http_server",
+    "stop_http_server", "DEFAULT_LATENCY_BUCKETS", "record_serving_enqueue",
+    "record_serving_queue_depth", "record_serving_dispatch",
+    "record_serving_completion",
 ]
 
 env.declare("MXNET_TELEMETRY", False, bool,
@@ -582,6 +584,75 @@ def record_inflight(n: int, source: str = "step"):
     gauge("mx_inflight_steps",
           "Training steps dispatched but not yet retired by the bounded "
           "in-flight window", ("source",)).labels(source).set(int(n))
+
+
+# ---------------------------------------------------------------------------
+# Serving SLO instrumentation (mxnet_tpu/serving — docs/serving.md)
+# ---------------------------------------------------------------------------
+
+# The documented default request-latency ladder: 1 ms .. 10 s, roughly
+# log-spaced, so the cumulative `_bucket` exposition supports real
+# histogram_quantile() p50/p99 queries for interactive inference. The
+# serving layer records END-TO-END latency (enqueue -> result ready on
+# host) into this ladder; pass ``buckets=`` to ``histogram()`` for a
+# different SLO range.
+DEFAULT_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                           0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def record_serving_enqueue(model: str, rows: int = 1):
+    """Account one request admitted to a model's serving queue."""
+    counter("mx_serving_requests_total", "Inference requests enqueued",
+            ("model",)).labels(model).inc()
+    counter("mx_serving_request_rows_total",
+            "Rows (examples) across enqueued inference requests",
+            ("model",)).labels(model).inc(max(int(rows), 0))
+
+
+def record_serving_queue_depth(model: str, depth: int):
+    """Requests waiting in the continuous batcher (set on every enqueue and
+    every batch take, so scrapes see the live depth)."""
+    gauge("mx_serving_queue_depth",
+          "Requests waiting in the serving queue",
+          ("model",)).labels(model).set(int(depth))
+
+
+def record_serving_dispatch(model: str, bucket: int, rows: int):
+    """Account one padded batch handed to the compiled per-bucket artifact:
+    occupancy (real vs padded rows) is the batch-formation efficiency
+    signal the bucket-set tuning loop reads (docs/serving.md)."""
+    bucket = max(int(bucket), 1)
+    rows = max(int(rows), 0)
+    counter("mx_serving_batches_total", "Batches dispatched to the device",
+            ("model", "bucket")).labels(model, str(bucket)).inc()
+    counter("mx_serving_batch_rows_total",
+            "Real (non-padding) rows dispatched",
+            ("model", "bucket")).labels(model, str(bucket)).inc(rows)
+    counter("mx_serving_padded_rows_total",
+            "Padding rows dispatched (bucket size minus real rows)",
+            ("model", "bucket")).labels(model, str(bucket)) \
+        .inc(max(bucket - rows, 0))
+    gauge("mx_serving_batch_occupancy",
+          "Real-row fraction of the last dispatched bucket",
+          ("model", "bucket")).labels(model, str(bucket)) \
+        .set(rows / bucket)
+
+
+def record_serving_completion(model: str, seconds: float, rows: int = 1,
+                              status: str = "ok"):
+    """Account one completed request: end-to-end latency (enqueue ->
+    result on host) into the DEFAULT_LATENCY_BUCKETS histogram — p50/p99
+    derive from the cumulative `_bucket` lines — plus response/row
+    counters (per-model throughput = rate(mx_serving_response_rows_total))."""
+    histogram("mx_serving_request_seconds",
+              "End-to-end request latency (enqueue to result on host)",
+              ("model",), buckets=DEFAULT_LATENCY_BUCKETS) \
+        .labels(model).observe(float(seconds))
+    counter("mx_serving_responses_total", "Completed inference requests",
+            ("model", "status")).labels(model, status).inc()
+    counter("mx_serving_response_rows_total",
+            "Rows returned across completed requests",
+            ("model",)).labels(model).inc(max(int(rows), 0))
 
 
 @contextmanager

@@ -1,0 +1,523 @@
+"""Continuous-batching serving on the compiled artifact cache (ISSUE 6).
+
+Covers the serving acceptance criteria end to end:
+
+  - `Predictor` compiles through the shared engine cache under pinned
+    ``("predict", graph_fp, config_fingerprint)`` keys — N predictors over
+    one exported model compile once, `reshape` swaps pins without leaking;
+  - padding-invariant inference: a batch-b request dispatched inside a
+    bucket B > b returns BITWISE-identical outputs to a standalone batch-b
+    `Predictor.predict` (conv + BN + softmax model, replicated AND
+    dp-sharded over the 8-device host mesh);
+  - the two-model / 64-concurrent-request end-to-end: bitwise outputs,
+    zero recompiles after warmup, and a Prometheus scrape carrying latency
+    histogram buckets, queue depth, and batch occupancy for both models;
+  - batch-formation policy: smallest covering bucket, max-wait deadline,
+    occupancy accounting;
+  - the HTTP front door and the cumulative histogram exposition the SLO
+    queries depend on.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, gluon, nd, serving, telemetry
+from mxnet_tpu.predict import Predictor
+
+
+class _SoftmaxConvNet(gluon.HybridBlock):
+    """conv + BN + softmax — every op is per-sample, so bucket padding must
+    not perturb the real rows (the padding-invariance model of ISSUE 6)."""
+
+    def __init__(self, classes=7, **kw):
+        super().__init__(**kw)
+        self.body = gluon.nn.HybridSequential()
+        self.body.add(gluon.nn.Conv2D(8, 3, padding=1),
+                      gluon.nn.BatchNorm(),
+                      gluon.nn.Activation("relu"),
+                      gluon.nn.Conv2D(classes, 1),
+                      gluon.nn.GlobalAvgPool2D(),
+                      gluon.nn.Flatten())
+
+    def hybrid_forward(self, F, x):
+        return self.body(x).softmax()
+
+
+class _SoftmaxMLP(gluon.HybridBlock):
+    def __init__(self, classes=5, **kw):
+        super().__init__(**kw)
+        self.body = gluon.nn.HybridSequential()
+        self.body.add(gluon.nn.Dense(16, activation="relu"),
+                      gluon.nn.Dense(classes))
+
+    def hybrid_forward(self, F, x):
+        return self.body(x).softmax()
+
+
+ROW_CONV = (3, 8, 8)
+ROW_MLP = (6,)
+
+
+def _export(tmp_path, net, row_shape, name, seed):
+    mx.random.seed(seed)
+    net.initialize()
+    net.hybridize()
+    net(nd.zeros((1,) + row_shape))
+    prefix = str(tmp_path / name)
+    net.export(prefix)
+    return prefix
+
+
+@pytest.fixture
+def conv_prefix(tmp_path):
+    return _export(tmp_path, _SoftmaxConvNet(), ROW_CONV, "conv", 3)
+
+
+@pytest.fixture
+def mlp_prefix(tmp_path):
+    return _export(tmp_path, _SoftmaxMLP(), ROW_MLP, "mlp", 4)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_clean():
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+def _conv_batch(rows, seed=0):
+    return _rng(seed).uniform(-1, 1, (rows,) + ROW_CONV).astype(np.float32)
+
+
+def _mlp_batch(rows, seed=0):
+    return _rng(seed).uniform(-1, 1, (rows,) + ROW_MLP).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Predictor on the shared engine cache
+# ---------------------------------------------------------------------------
+
+def test_predictor_shares_engine_artifact(conv_prefix):
+    p1 = Predictor(conv_prefix + "-symbol.json", conv_prefix + "-0000.params",
+                   input_shapes={"data": (2,) + ROW_CONV})
+    st0 = engine.cache_stats()
+    p2 = Predictor(conv_prefix + "-symbol.json", conv_prefix + "-0000.params",
+                   input_shapes={"data": (2,) + ROW_CONV})
+    st1 = engine.cache_stats()
+    # the second predictor must ADOPT the first one's executable: a cache
+    # hit, zero fresh compiles — N serving replicas in one process
+    assert st1["compiles"] == st0["compiles"]
+    assert st1["hits"] > st0["hits"]
+    x = _conv_batch(2)
+    np.testing.assert_array_equal(p1.predict(x), p2.predict(x))
+    p1.close()
+    p2.close()
+
+
+def test_predictor_reshape_swaps_pin_without_leak(conv_prefix):
+    before = engine.cache_stats()["pinned"]
+    p = Predictor(conv_prefix + "-symbol.json", conv_prefix + "-0000.params",
+                  input_shapes={"data": (2,) + ROW_CONV})
+    assert engine.cache_stats()["pinned"] == before + 1
+    p.reshape({"data": (4,) + ROW_CONV})
+    # the old shape's pin was RELEASED, the new one acquired: still one
+    assert engine.cache_stats()["pinned"] == before + 1
+    out = p.predict(_conv_batch(4))
+    assert out.shape[0] == 4
+    p.close()
+    assert engine.cache_stats()["pinned"] == before
+
+
+def test_pinned_artifacts_survive_cache_clear(conv_prefix):
+    p = Predictor(conv_prefix + "-symbol.json", conv_prefix + "-0000.params",
+                  input_shapes={"data": (2,) + ROW_CONV})
+    x = _conv_batch(2)
+    want = p.predict(x)
+    st0 = engine.cache_stats()
+    engine.clear_compilation_cache()          # pinned entries survive
+    np.testing.assert_array_equal(p.predict(x), want)
+    assert engine.cache_stats()["compiles"] == st0["compiles"]
+    p.close()
+    engine.clear_compilation_cache(force=True)
+    assert engine.cache_stats()["pinned"] == 0
+
+
+def test_predictor_fixed_shape_contract(conv_prefix):
+    p = Predictor(conv_prefix + "-symbol.json", conv_prefix + "-0000.params",
+                  input_shapes={"data": (2,) + ROW_CONV})
+    with pytest.raises(mx.MXNetError, match="reshape"):
+        p.predict(_conv_batch(3))
+    p.close()
+
+
+# ---------------------------------------------------------------------------
+# Padding-invariant inference
+# ---------------------------------------------------------------------------
+
+def test_padding_invariant_replicated(conv_prefix):
+    """batch b served inside bucket B > b == standalone batch-b predict,
+    bitwise (conv + BN + softmax)."""
+    rows = 3
+    x = _conv_batch(rows, seed=7)
+    ref = Predictor(conv_prefix + "-symbol.json",
+                    conv_prefix + "-0000.params",
+                    input_shapes={"data": (rows,) + ROW_CONV})
+    want = ref.predict(x)
+    ref.close()
+    srv = serving.Server(max_wait_ms=1.0)
+    try:
+        srv.register("conv", conv_prefix + "-symbol.json",
+                      conv_prefix + "-0000.params",
+                      input_shapes={"data": ROW_CONV}, buckets=(8, 16))
+        got = srv.predict("conv", data=x)     # 3 rows -> bucket 8, padded
+        np.testing.assert_array_equal(got, want)
+    finally:
+        srv.close()
+
+
+def test_padding_invariant_dp_sharded(conv_prefix):
+    """Same invariance with the request batch dp-sharded over the 8-device
+    host mesh (explicit NamedSharding device_put, params replicated)."""
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8
+    mesh = Mesh(np.array(devs[:8]).reshape(8,), ("dp",))
+    rows = 5
+    x = _conv_batch(rows, seed=9)
+    ref = Predictor(conv_prefix + "-symbol.json",
+                    conv_prefix + "-0000.params",
+                    input_shapes={"data": (rows,) + ROW_CONV})
+    want = ref.predict(x)
+    ref.close()
+    srv = serving.Server(max_wait_ms=1.0, mesh=mesh, data_spec=P("dp"))
+    try:
+        srv.register("conv", conv_prefix + "-symbol.json",
+                      conv_prefix + "-0000.params",
+                      input_shapes={"data": ROW_CONV}, buckets=(8, 16))
+        got = srv.predict("conv", data=x)     # 5 rows -> sharded bucket 8
+        np.testing.assert_array_equal(got, want)
+    finally:
+        srv.close()
+
+
+def test_sharded_buckets_must_divide_mesh(conv_prefix):
+    mesh = Mesh(np.array(jax.devices("cpu")[:8]).reshape(8,), ("dp",))
+    srv = serving.Server(mesh=mesh, data_spec=P("dp"))
+    try:
+        with pytest.raises(mx.MXNetError, match="divide"):
+            srv.register("conv", conv_prefix + "-symbol.json",
+                         conv_prefix + "-0000.params",
+                         input_shapes={"data": ROW_CONV}, buckets=(1, 8))
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Batch-formation policy
+# ---------------------------------------------------------------------------
+
+def test_smallest_covering_bucket_and_occupancy(conv_prefix):
+    telemetry.reset()
+    telemetry.enable()
+    srv = serving.Server(max_wait_ms=1.0)
+    try:
+        srv.register("conv", conv_prefix + "-symbol.json",
+                      conv_prefix + "-0000.params",
+                      input_shapes={"data": ROW_CONV}, buckets=(1, 4, 8))
+        srv.predict("conv", data=_conv_batch(1))   # -> bucket 1
+        srv.predict("conv", data=_conv_batch(3))   # -> bucket 4
+        srv.predict("conv", data=_conv_batch(6))   # -> bucket 8
+        batches = telemetry.get_metric("mx_serving_batches_total")
+        assert batches.get("conv", "1") == 1
+        assert batches.get("conv", "4") == 1
+        assert batches.get("conv", "8") == 1
+        occ = telemetry.get_metric("mx_serving_batch_occupancy")
+        assert occ.get("conv", "4") == pytest.approx(3 / 4)
+        assert occ.get("conv", "8") == pytest.approx(6 / 8)
+        padded = telemetry.get_metric("mx_serving_padded_rows_total")
+        assert padded.get("conv", "4") == 1
+        assert padded.get("conv", "8") == 2
+    finally:
+        srv.close()
+
+
+def test_full_bucket_dispatches_before_deadline(conv_prefix):
+    """A request filling the largest bucket must NOT wait out max_wait."""
+    srv = serving.Server(max_wait_ms=30_000.0)
+    try:
+        srv.register("conv", conv_prefix + "-symbol.json",
+                      conv_prefix + "-0000.params",
+                      input_shapes={"data": ROW_CONV}, buckets=(1, 4))
+        t0 = time.perf_counter()
+        srv.predict("conv", data=_conv_batch(4), timeout=60.0)
+        assert time.perf_counter() - t0 < 20.0
+    finally:
+        srv.close()
+
+
+def test_max_wait_deadline_bounds_small_requests(conv_prefix):
+    """An underfull batch dispatches at the max-wait deadline — bounded
+    p99 — and two requests inside one window aggregate into one bucket."""
+    telemetry.reset()
+    telemetry.enable()
+    srv = serving.Server(max_wait_ms=250.0)
+    try:
+        srv.register("conv", conv_prefix + "-symbol.json",
+                      conv_prefix + "-0000.params",
+                      input_shapes={"data": ROW_CONV}, buckets=(8,))
+        # warm the timing path (first dispatch may hit lazy jax imports)
+        srv.predict("conv", data=_conv_batch(1))
+        t0 = time.perf_counter()
+        f1 = srv.submit("conv", data=_conv_batch(1, seed=1))
+        f2 = srv.submit("conv", data=_conv_batch(2, seed=2))
+        f1.result(30.0)
+        f2.result(30.0)
+        elapsed = time.perf_counter() - t0
+        assert elapsed >= 0.24, f"dispatched before the deadline: {elapsed}"
+        batches = telemetry.get_metric("mx_serving_batches_total")
+        # 1-row warmup batch + ONE aggregated 3-row batch
+        assert batches.get("conv", "8") == 2
+        rows = telemetry.get_metric("mx_serving_batch_rows_total")
+        assert rows.get("conv", "8") == 4
+    finally:
+        srv.close()
+
+
+def test_oversized_request_is_rejected(conv_prefix):
+    srv = serving.Server()
+    try:
+        srv.register("conv", conv_prefix + "-symbol.json",
+                      conv_prefix + "-0000.params",
+                      input_shapes={"data": ROW_CONV}, buckets=(1, 4))
+        with pytest.raises(mx.MXNetError, match="largest bucket"):
+            srv.submit("conv", data=_conv_batch(5))
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: two models, 64 concurrent mixed-size requests
+# ---------------------------------------------------------------------------
+
+def test_end_to_end_two_models_64_concurrent(conv_prefix, mlp_prefix):
+    telemetry.reset()
+    telemetry.enable()
+    sizes = [1, 2, 5]
+    refs = {}
+    for rows in sizes:
+        pc = Predictor(conv_prefix + "-symbol.json",
+                       conv_prefix + "-0000.params",
+                       input_shapes={"data": (rows,) + ROW_CONV})
+        pm = Predictor(mlp_prefix + "-symbol.json",
+                       mlp_prefix + "-0000.params",
+                       input_shapes={"data": (rows,) + ROW_MLP})
+        refs[("conv", rows)] = pc
+        refs[("mlp", rows)] = pm
+
+    srv = serving.Server(max_wait_ms=3.0)
+    try:
+        srv.register("conv", conv_prefix + "-symbol.json",
+                      conv_prefix + "-0000.params",
+                      input_shapes={"data": ROW_CONV}, buckets=(1, 4, 8))
+        srv.register("mlp", mlp_prefix + "-symbol.json",
+                      mlp_prefix + "-0000.params",
+                      input_shapes={"data": ROW_MLP}, buckets=(1, 4, 8))
+        # ---- warmup complete at registration: snapshot compile counters
+        warm = engine.cache_stats()
+
+        plan = []
+        for i in range(64):
+            model = "conv" if i % 2 == 0 else "mlp"
+            rows = sizes[i % len(sizes)]
+            x = (_conv_batch if model == "conv" else _mlp_batch)(
+                rows, seed=100 + i)
+            plan.append((model, rows, x))
+
+        futs = [None] * len(plan)
+        errors = []
+
+        def fire(lo, hi):
+            try:
+                for i in range(lo, hi):
+                    model, rows, x = plan[i]
+                    futs[i] = srv.submit(model, data=x)
+            except Exception as e:  # pragma: no cover - fails the assert
+                errors.append(e)
+
+        threads = [threading.Thread(target=fire, args=(k * 8, k * 8 + 8))
+                   for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
+        for i, (model, rows, x) in enumerate(plan):
+            got = futs[i].result(timeout=120.0)
+            want = refs[(model, rows)].predict(x)
+            # (a) every response bitwise-matches the standalone Predictor
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"request {i} ({model}, rows={rows})")
+
+        # (b) zero recompiles after warmup: compiles AND misses flat
+        after = engine.cache_stats()
+        assert after["compiles"] == warm["compiles"]
+        assert after["misses"] == warm["misses"]
+
+        # (c) the scrape exposes the SLO signals for BOTH models
+        scrape = telemetry.scrape()
+        for model in ("conv", "mlp"):
+            assert (f'mx_serving_request_seconds_bucket{{model="{model}"'
+                    in scrape), scrape[:2000]
+            assert f'mx_serving_queue_depth{{model="{model}"}}' in scrape
+            assert (f'mx_serving_batch_occupancy{{model="{model}"'
+                    in scrape)
+        resp = telemetry.get_metric("mx_serving_responses_total")
+        assert resp.get("conv", "ok") == 32
+        assert resp.get("mlp", "ok") == 32
+    finally:
+        srv.close()
+        for p in refs.values():
+            p.close()
+
+
+def test_bert_exports_and_serves(tmp_path):
+    """BERT is now symbolically exportable (position ids via arange_like,
+    attention reshapes via MXNet shape codes) — the serving bench's
+    bert_base path in miniature, padded bucket included."""
+    from mxnet_tpu.models import bert_tiny
+    mx.random.seed(0)
+    net = bert_tiny(vocab_size=200)
+    net.initialize()
+    net.hybridize()
+    x = _rng(0).randint(0, 200, (2, 12)).astype(np.int32)
+    want = net(nd.array(x, dtype="int32")).asnumpy()
+    prefix = str(tmp_path / "bert")
+    net.export(prefix)
+    srv = serving.Server(max_wait_ms=1.0)
+    try:
+        srv.register("bert", prefix + "-symbol.json",
+                      prefix + "-0000.params",
+                      input_shapes={"data": (12,)}, buckets=(4,),
+                      dtypes={"data": "int32"})
+        got = srv.predict("bert", data=x, timeout=120.0)
+        np.testing.assert_array_equal(got, want)
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP front door + registry bookkeeping
+# ---------------------------------------------------------------------------
+
+def test_http_predict_models_and_metrics(mlp_prefix):
+    telemetry.reset()
+    telemetry.enable()
+    srv = serving.Server(max_wait_ms=1.0)
+    try:
+        srv.register("mlp", mlp_prefix + "-symbol.json",
+                      mlp_prefix + "-0000.params",
+                      input_shapes={"data": ROW_MLP}, buckets=(1, 4))
+        port = srv.start_http(0)
+        x = _mlp_batch(2, seed=5)
+        ref = srv.predict("mlp", data=x)
+
+        body = json.dumps({"inputs": {"data": x.tolist()}}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/mlp:predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            payload = json.loads(r.read())
+        np.testing.assert_array_equal(
+            np.asarray(payload["outputs"][0], np.float32), ref)
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/models", timeout=30) as r:
+            listing = json.loads(r.read())
+        assert listing["models"][0]["name"] == "mlp"
+        assert listing["total_param_bytes"] > 0
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert "mx_serving_request_seconds_bucket" in text
+    finally:
+        srv.close()
+
+
+def test_registry_unregister_releases_pins(mlp_prefix):
+    before = engine.cache_stats()["pinned"]
+    srv = serving.Server()
+    try:
+        srv.register("mlp", mlp_prefix + "-symbol.json",
+                      mlp_prefix + "-0000.params",
+                      input_shapes={"data": ROW_MLP}, buckets=(1, 4))
+        assert engine.cache_stats()["pinned"] == before + 2  # one per bucket
+        assert srv.registry.get("mlp").param_bytes > 0
+        srv.unregister("mlp")
+        assert engine.cache_stats()["pinned"] == before
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Cumulative histogram exposition (the p50/p99 SLO contract)
+# ---------------------------------------------------------------------------
+
+def test_latency_histogram_cumulative_exposition():
+    telemetry.reset()
+    telemetry.enable()
+    for s in (0.002, 0.002, 0.03, 0.2, 4.0):
+        telemetry.record_serving_completion("m", s)
+    scrape = telemetry.scrape()
+    lines = [ln for ln in scrape.splitlines()
+             if ln.startswith("mx_serving_request_seconds")]
+    buckets = [ln for ln in lines if "_bucket" in ln]
+    # one line per ladder bound plus +Inf, cumulative and monotone
+    assert len(buckets) == len(telemetry.DEFAULT_LATENCY_BUCKETS) + 1
+    counts = [float(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts)
+    assert 'le="+Inf"' in buckets[-1] and counts[-1] == 5
+    # spot-check the ladder: 2 observations <= 2.5 ms, 3 <= 50 ms
+    by_le = {ln.split('le="')[1].split('"')[0]: float(ln.rsplit(" ", 1)[1])
+             for ln in buckets}
+    assert by_le["0.0025"] == 2
+    assert by_le["0.05"] == 3
+    sum_line = [ln for ln in lines if "_sum" in ln][0]
+    assert float(sum_line.rsplit(" ", 1)[1]) == pytest.approx(4.234)
+    count_line = [ln for ln in lines if "_count" in ln][0]
+    assert float(count_line.rsplit(" ", 1)[1]) == 5
+
+
+def test_serving_instrumentation_gate_covers_batcher():
+    """The CI gate must demand telemetry on every serving entry point —
+    removing the dispatch-loop instrumentation has to produce a finding."""
+    import shutil
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    try:
+        from tools import check_instrumentation as ci
+    finally:
+        sys.path.pop(0)
+    assert ci.check() == []
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        pkg = Path(td) / "mxnet_tpu"
+        shutil.copytree(Path(ci.PKG), pkg)
+        bat = pkg / "serving" / "batcher.py"
+        bat.write_text(bat.read_text().replace(
+            "_telem.record_serving_dispatch", "_noop_dispatch"))
+        msgs = ci.check(pkg)
+        assert any("_dispatch_loop" in m for m in msgs), msgs

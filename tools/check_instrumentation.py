@@ -75,6 +75,16 @@ METHOD_CHECKS = [
      {"record_inflight"}, "call"),
     ("engine/async_feed.py", "DispatchWindow", "drain",
      {"record_inflight"}, "call"),
+    # continuous-batching serving (ISSUE 6): every serving entry point —
+    # enqueue, dispatch, completion — must route through the SLO telemetry
+    # (latency histogram, queue depth, batch occupancy); a serving path
+    # that silently skips them is invisible to the p99 dashboards
+    ("serving/batcher.py", "ContinuousBatcher", "submit",
+     {"record_serving_enqueue"}, "call"),
+    ("serving/batcher.py", "ContinuousBatcher", "_dispatch_loop",
+     {"record_serving_dispatch"}, "call"),
+    ("serving/batcher.py", "ContinuousBatcher", "_complete",
+     {"record_serving_completion"}, "call"),
 ]
 
 # (relative file, required substring, rationale)
@@ -97,6 +107,17 @@ TEXT_CHECKS = [
      "(nonzero growth = input-bound, not device-bound)"),
     ("telemetry/__init__.py", "mx_inflight_steps",
      "the registry must export the bounded in-flight window depth gauge"),
+    ("telemetry/__init__.py", "DEFAULT_LATENCY_BUCKETS",
+     "the registry must declare the documented serving-latency bucket "
+     "ladder (docs/serving.md; p50/p99 derive from the cumulative "
+     "histogram exposition)"),
+    ("telemetry/__init__.py", "mx_serving_request_seconds",
+     "the registry must export the end-to-end serving latency histogram"),
+    ("telemetry/__init__.py", "mx_serving_queue_depth",
+     "the registry must export the serving queue-depth gauge"),
+    ("telemetry/__init__.py", "mx_serving_batch_occupancy",
+     "the registry must export the batch-occupancy (real vs padded rows) "
+     "gauge — the bucket-set tuning signal"),
 ]
 
 

@@ -42,6 +42,14 @@ HOT_FUNCTIONS = [
      r"(Accuracy|TopKAccuracy|MAE|MSE|RMSE|CrossEntropy|"
      r"NegativeLogLikelihood|Loss|EvalMetric)\.(update|_update)\b"),
     ("mxnet_tpu/gluon/utils.py", r"\bclip_global_norm\b"),
+    # serving hot path (ISSUE 6): the compiled-artifact call and the
+    # dispatch loop must stay sync-free; `_complete` (the designed sync)
+    # and `_assemble` (host numpy padding) are deliberately NOT hot
+    ("mxnet_tpu/serving/batcher.py",
+     r"ContinuousBatcher\.(_dispatch_loop|_next_batch)\b"),
+    ("mxnet_tpu/serving/registry.py",
+     r"RegisteredModel\.(forward|place_input)\b"),
+    ("mxnet_tpu/predict.py", r"ForwardArtifact\.__call__\b"),
 ]
 
 # host reads of *python* scalars that merely look like syncs. Matched

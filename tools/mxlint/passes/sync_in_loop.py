@@ -30,6 +30,10 @@ LOOP_FUNCTIONS = [
      r"DataParallelTrainer\.(run_steps|step)\b"),
     ("mxnet_tpu/parallel/pipeline.py", r"PipelineTrainer\.step\b"),
     ("mxnet_tpu/gluon/trainer.py", r"Trainer\.step\b"),
+    # serving dispatch loop (ISSUE 6): forming/dispatching batch i+1 must
+    # never sync on batch i's outputs — the completion thread owns the one
+    # designed host sync (`ContinuousBatcher._complete`)
+    ("mxnet_tpu/serving/batcher.py", r"ContinuousBatcher\._dispatch_loop\b"),
 ]
 
 # calls whose result is a step output: loss/metric/output handles the loop
