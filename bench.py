@@ -903,6 +903,193 @@ def bench_serving():
     }
 
 
+def bench_roofline(steps, warmup):
+    """Per-region roofline ledger for ResNet-50 bs32 and BERT-base
+    (ISSUE 7 / ROADMAP item 1): run the model as a CHAIN of hybridized
+    sub-blocks — each one its own compiled artifact, hence its own ledger
+    region — through a full forward+backward+update loop, then read the
+    attribution: achieved-vs-peak FLOPs and bytes per region,
+    compute/memory-bound classification against the ridge point, and the
+    top-3 underutilized ResNet-50 regions ranked by lost FLOP-seconds (the
+    action list for the space-to-depth stem PR). Also asserts the ledger's
+    per-region FLOPs sum reconciles with the aggregate flops_executed
+    account (<= 5%) and A/Bs the loop with telemetry+ledger off vs on
+    (overhead must stay <= 2%).
+
+    Env knobs so the scenario also finishes on CPU hosts:
+    BENCH_ROOFLINE_BATCH (32), BENCH_ROOFLINE_IMAGE (224),
+    BENCH_ROOFLINE_BERT_BATCH (8), BENCH_ROOFLINE_SEQ (128),
+    BENCH_ROOFLINE_VOCAB (8192), BENCH_ROOFLINE_MODELS, and
+    BENCH_ROOFLINE_JSON=path to dump the full ledger JSON."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, gluon, autograd, telemetry
+    from mxnet_tpu import engine
+    from mxnet_tpu.telemetry import roofline
+
+    batch = int(os.environ.get("BENCH_ROOFLINE_BATCH", 32))
+    image = int(os.environ.get("BENCH_ROOFLINE_IMAGE", 224))
+    bert_batch = int(os.environ.get("BENCH_ROOFLINE_BERT_BATCH", 8))
+    seq = int(os.environ.get("BENCH_ROOFLINE_SEQ", 128))
+    vocab = int(os.environ.get("BENCH_ROOFLINE_VOCAB", 8192))
+    which = os.environ.get("BENCH_ROOFLINE_MODELS",
+                           "resnet50,bert_base").split(",")
+    rs = np.random.RandomState(0)
+
+    def resnet_chain():
+        from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+        net = resnet50_v1()
+        with mx.cpu():
+            net.initialize(ctx=mx.cpu())
+            net(nd.zeros((1, 3, image, image), ctx=mx.cpu()))
+        net.hybridize()
+        blocks = [(f"features[{i}]:{type(b).__name__}", b)
+                  for i, b in enumerate(net.features._children.values())]
+        blocks.append(("output:Dense", net.output))
+        x = nd.array(rs.uniform(-1, 1, (batch, 3, image, image))
+                     .astype(np.float32))
+        return net, blocks, (x,)
+
+    def bert_chain():
+        from mxnet_tpu.models import bert_base
+        net = bert_base(vocab_size=vocab)
+        with mx.cpu():
+            net.initialize(ctx=mx.cpu())
+            net(nd.zeros((1, seq), ctx=mx.cpu(), dtype="int32"))
+        embed, cells, head = net.pipeline_split()
+        blocks = [("embed", embed)]
+        blocks += [(f"encoder[{i}]:TransformerEncoderCell", c)
+                   for i, c in enumerate(cells)]
+        blocks.append(("mlm_head", head))
+        for _, b in blocks:
+            b.hybridize()
+        x = nd.array(rs.randint(0, vocab, (bert_batch, seq)), dtype="int32")
+        return net, blocks, (x,)
+
+    def region_of(b, bwd=False):
+        # the same row-key formula the gluon cached path uses, so the
+        # bench can map ledger regions back onto chain positions
+        base = f"gluon:{type(b).__name__}#{b._fingerprint()[:6]}"
+        return base + ("/bwd" if bwd else "")
+
+    def run(make_chain):
+        telemetry.disable()
+        telemetry.reset()
+        net, blocks, inputs = make_chain()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01, "momentum": 0.9})
+        n_examples = inputs[0].shape[0]
+
+        def chain_step():
+            with autograd.record():
+                h = inputs[0]
+                for _, b in blocks:
+                    h = b(h)
+                loss = (h * h).mean()
+            loss.backward()
+            trainer.step(n_examples)
+            return loss
+
+        def loop(n):
+            loss = None
+            for _ in range(n):
+                loss = chain_step()
+            loss.asnumpy()  # boundary sync for honest wall time
+
+        loop(max(warmup, 2))                      # compiles, telemetry off
+        t0 = time.perf_counter()
+        loop(steps)
+        dt_off = time.perf_counter() - t0         # disabled baseline
+
+        telemetry.enable()
+        loop(2)                                   # one-time cost captures
+        telemetry.reset()                         # measured ledger only
+        flops0 = engine.cache_stats()["flops_executed"]
+        t0 = time.perf_counter()
+        loop(steps)
+        dt_on = time.perf_counter() - t0
+        agg_flops = engine.cache_stats()["flops_executed"] - flops0
+        ledger = roofline.as_dict()
+        report = roofline.report()
+        telemetry.disable()
+
+        # map ledger regions back to human chain positions (structurally
+        # identical blocks share a row: the name aggregates their count)
+        names = {}
+        for name, b in blocks:
+            for bwd in (False, True):
+                key = region_of(b, bwd)
+                suffix = "/bwd" if bwd else ""
+                if key in names:
+                    base, cnt = names[key]
+                    names[key] = (base, cnt + (0 if bwd else 1))
+                else:
+                    names[key] = (name + suffix, 1)
+        rows = []
+        for r in ledger["regions"]:
+            label, cnt = names.get(r["region"], (r["region"], 1))
+            rows.append({
+                "region": label if cnt == 1 else f"{label} x{cnt}",
+                "kind": r["kind"],
+                "executions": r["executions"],
+                "gflops": round(r["flops"] / 1e9, 3),
+                "gbytes": round(r["bytes"] / 1e9, 3),
+                "seconds": round(r["seconds"], 4),
+                "achieved_flops_ratio": round(r["achieved_flops_ratio"], 4),
+                "achieved_bytes_ratio": round(r["achieved_bytes_ratio"], 4),
+                "arithmetic_intensity": round(r["arithmetic_intensity"], 2)
+                if r["arithmetic_intensity"] != float("inf") else -1,
+                "bound": r["bound"],
+                "lost_gflop_seconds": round(r["lost_flop_seconds"] / 1e9, 2),
+                "estimated": r["estimated"],
+            })
+        ledger_flops = ledger["total_flops"]
+        return {
+            "rows": rows,
+            "report": report,
+            "ledger_flops": ledger_flops,
+            "aggregate_flops_executed": agg_flops,
+            # acceptance: per-region sum within 5% of the aggregate account
+            "flops_sum_ratio": round(ledger_flops / max(agg_flops, 1.0), 4),
+            "step_ms_disabled": round(dt_off / steps * 1e3, 2),
+            "step_ms_enabled": round(dt_on / steps * 1e3, 2),
+            "overhead_pct": round((dt_on / dt_off - 1.0) * 100.0, 2),
+            "ridge_point_flops_per_byte":
+                ledger["ridge_point_flops_per_byte"],
+            "peak_flops": ledger["peak_flops_per_second"],
+            "peak_bytes_per_second": ledger["peak_bytes_per_second"],
+        }
+
+    chains = {"resnet50": resnet_chain, "bert_base": bert_chain}
+    extra = {"batch": batch, "image": image, "bert_batch": bert_batch,
+             "seq": seq, "host_cores": os.cpu_count()}
+    for name in which:
+        name = name.strip()
+        extra[name] = run(chains[name])
+        print(f"# --- {name} ---\n{extra[name].pop('report')}",
+              file=sys.stderr)
+    if "resnet50" in extra and isinstance(extra["resnet50"], dict):
+        # the action list: top-3 underutilized compute-carrying regions by
+        # lost FLOP-seconds (zero-FLOP bookkeeping rows such as the eager
+        # optimizer-update slice can't be "underutilized compute")
+        extra["resnet50"]["top3_underutilized"] = [
+            {k: r[k] for k in ("region", "kind", "achieved_flops_ratio",
+                               "bound", "lost_gflop_seconds")}
+            for r in extra["resnet50"]["rows"]
+            if r["gflops"] > 0 and r["bound"] != "unknown"][:3]
+    dump = os.environ.get("BENCH_ROOFLINE_JSON")
+    if dump:
+        with open(dump, "w") as f:
+            json.dump(extra, f, indent=2)
+    key = which[0].strip()
+    return {
+        "metric": "roofline_ledger_vs_aggregate_flops",
+        "value": extra[key]["flops_sum_ratio"],
+        "unit": "ledger/aggregate (pass: within 5% of 1.0)",
+        "vs_baseline": extra[key]["overhead_pct"],  # <= 2% acceptance
+        "extra": extra,
+    }
+
+
 def bench_lint_walltime():
     """Static-analyzer cost over the whole package (tier-1 runs mxlint via
     tests/test_lint_clean.py, so it must stay well under the suite budget:
@@ -963,6 +1150,12 @@ def main():
     if os.environ.get("BENCH_SCENARIO") == "serving":
         _enable_compile_cache()
         print(json.dumps(bench_serving()))
+        return
+    if os.environ.get("BENCH_SCENARIO") == "roofline":
+        _enable_compile_cache()
+        print(json.dumps(bench_roofline(
+            int(os.environ.get("BENCH_TRAIN_STEPS", 4)),
+            int(os.environ.get("BENCH_TRAIN_WARMUP", 2)))))
         return
     _enable_compile_cache()
     if os.environ.get("BENCH_SCENARIO") == "train_step":

@@ -68,9 +68,14 @@ _STATS = {
     "fwd_executions": 0,  # compiled forward invocations (gluon cached path)
     "bwd_executions": 0,  # compiled pullback invocations (no fwd recompute)
     "donated_updates": 0, # optimizer update calls that donated buffers
+    "step_executions": 0, # fused trainer-step artifact invocations
     "flops_executed": 0.0,  # cost_analysis FLOPs of executed artifacts
                             # (telemetry's MFU numerator; 0 when telemetry
                             # is off — costs are only captured then)
+    "bytes_executed": 0.0,  # cost_analysis bytes-accessed of executed
+                            # artifacts (the roofline ledger's bytes axis)
+    "cost_capture_failures": 0,  # estimate_cost lowerings that failed
+                                 # (mirrored to mx_cost_capture_failures_total)
 }
 
 
@@ -203,32 +208,101 @@ def record_trace():
     _bump("traces")
 
 
-def record_execution(kind: str, flops: float = 0.0):
+def record_execution(kind: str, flops: float = 0.0,
+                     bytes_accessed: float = 0.0, region: str = None,
+                     steps: int = 1, estimated: bool = False,
+                     cost: Dict[str, float] = None):
+    """Account ``steps`` executions of a compiled artifact.
+
+    This is the ONE funnel both FLOPs accounts flow through: the aggregate
+    ``flops_executed``/``bytes_executed`` counters (telemetry's MFU
+    numerator) and — when ``region`` is given and telemetry is enabled —
+    the per-region roofline ledger (telemetry/roofline.py), so the
+    ledger's per-region sum always reconciles with the aggregate.
+    ``estimated`` flags heuristic costs (the gluon bwd=2x-fwd fallback) so
+    ledger rows built on them render distinguishably. Host arithmetic
+    only; hot-path safe."""
     with _LOCK:
-        _STATS["fwd_executions" if kind == "fwd" else "bwd_executions"] += 1
+        if kind == "fwd":
+            _STATS["fwd_executions"] += steps
+        elif kind == "step":
+            _STATS["step_executions"] += steps
+        else:
+            _STATS["bwd_executions"] += steps
         if flops:
             _STATS["flops_executed"] += flops
+        if bytes_accessed:
+            _STATS["bytes_executed"] += bytes_accessed
+    if region is not None:
+        from .. import telemetry as _telem
+        if _telem._ENABLED:
+            _telem.roofline.record(region, flops=flops,
+                                   bytes_accessed=bytes_accessed,
+                                   steps=steps, kind=kind,
+                                   estimated=estimated, cost=cost)
 
 
-def estimate_cost(jitted, *args) -> Dict[str, float]:
-    """XLA cost-model estimate for a jitted callable at example args:
-    ``{"flops": ..., "bytes_accessed": ...}`` (empty when the backend has no
-    cost model). Captured ONCE per artifact at build time while telemetry is
-    enabled — the AOT lower+compile shares XLA's compilation caches, and the
-    result feeds the MFU/roofline gauges (mx_mfu, mx_model_flops_per_second).
-    """
+# cost_analysis keys -> estimate_cost fields (operand-level "bytes
+# accessedN{}" keys are folded into bytes_in/bytes_out below)
+_COST_KEYS = (("flops", "flops"), ("bytes accessed", "bytes_accessed"),
+              ("transcendentals", "transcendentals"))
+
+
+def estimate_cost(jitted, *args, kind: str = "artifact") -> Dict[str, float]:
+    """XLA cost-model + memory estimate for a jitted callable at example
+    args: ``{"flops", "bytes_accessed", "bytes_in", "bytes_out",
+    "transcendentals", "peak_memory_bytes", "temp_memory_bytes"}`` (keys
+    present when the backend reports them; empty dict when it has no cost
+    model). Captured ONCE per artifact at build time while telemetry is
+    enabled — the AOT lower+compile shares XLA's compilation caches, and
+    the result feeds the MFU gauge and the per-region roofline ledger.
+
+    Lowering failures are COUNTED, not swallowed: the engine's
+    ``cost_capture_failures`` stat and the ``mx_cost_capture_failures_total``
+    counter (labeled by artifact kind) both tick, so a backend that stops
+    reporting costs shows up on the dashboard instead of silently zeroing
+    every ledger row."""
     try:
-        c = jitted.lower(*args).compile().cost_analysis()
+        compiled = jitted.lower(*args).compile()
+        c = compiled.cost_analysis()
         if isinstance(c, (list, tuple)):
             c = c[0] if c else {}
         out = {}
-        for src, dst in (("flops", "flops"),
-                         ("bytes accessed", "bytes_accessed")):
+        for src, dst in _COST_KEYS:
             v = c.get(src)
             if v is not None and float(v) >= 0:
                 out[dst] = float(v)
+        bytes_in = bytes_out = 0.0
+        for k, v in c.items():
+            if k.startswith("bytes accessed") and k != "bytes accessed":
+                if "out" in k:
+                    bytes_out += float(v)
+                else:
+                    bytes_in += float(v)
+        if bytes_in:
+            out["bytes_in"] = bytes_in
+        if bytes_out:
+            out["bytes_out"] = bytes_out
+        try:
+            m = compiled.memory_analysis()
+            if m is not None:
+                temp = float(getattr(m, "temp_size_in_bytes", 0) or 0)
+                out["temp_memory_bytes"] = temp
+                out["peak_memory_bytes"] = temp + float(
+                    getattr(m, "argument_size_in_bytes", 0) or 0) + float(
+                    getattr(m, "output_size_in_bytes", 0) or 0)
+        except Exception:
+            pass  # memory analysis is best-effort extra detail
         return out
     except Exception:
+        _bump("cost_capture_failures")
+        from .. import telemetry as _telem
+        if _telem._ENABLED:
+            _telem.counter(
+                "mx_cost_capture_failures_total",
+                "estimate_cost lowerings that raised (regions fall back "
+                "to zero/heuristic costs — see engine.cache_stats)",
+                ("kind",)).labels(kind).inc()
         return {}
 
 

@@ -319,7 +319,7 @@ class _CachedGraph:
 
     __slots__ = ("fwd", "fwd_res", "bwd", "bwd_recompute", "out_treedef",
                  "res_treedef", "aux_paths", "aux_params_builder",
-                 "builder_id", "cost")
+                 "builder_id", "cost", "bwd_cost")
 
     def __init__(self):
         self.fwd = None
@@ -332,6 +332,10 @@ class _CachedGraph:
         self.aux_params_builder = None
         self.builder_id = None
         self.cost = None               # cost_analysis capture (telemetry on)
+        self.bwd_cost = None           # pullback cost: real cost_analysis of
+                                       # the compiled vjp where available,
+                                       # else the 2x-fwd heuristic (flagged
+                                       # "estimated" in the roofline ledger)
 
 
 class HybridBlock(Block):
@@ -523,14 +527,22 @@ class HybridBlock(Block):
         if _telem._ENABLED and graph.cost is None:
             # artifact-build-time FLOPs capture for the MFU/roofline gauges
             # (one AOT lower+compile per artifact, shared with jax's caches)
-            graph.cost = _engine.estimate_cost(graph.fwd, key, *all_raw)
+            graph.cost = _engine.estimate_cost(graph.fwd, key, *all_raw,
+                                               kind="gluon_fwd")
         res = None
         if recording and not remat:
             outs_flat, aux_vals, res = graph.fwd_res(key, *all_raw)
         else:
             outs_flat, aux_vals = graph.fwd(key, *all_raw)
         fwd_flops = (graph.cost or {}).get("flops", 0.0)
-        _engine.record_execution("fwd", fwd_flops)
+        # roofline region: one row per shared artifact (structural
+        # fingerprint), so N instances of one block aggregate together
+        region = (f"gluon:{type(self).__name__}#{self._fingerprint()[:6]}"
+                  if _telem._ENABLED else None)
+        _engine.record_execution(
+            "fwd", fwd_flops,
+            bytes_accessed=(graph.cost or {}).get("bytes_accessed", 0.0),
+            region=region, cost=graph.cost)
         if entry[1] is None:
             aux_params = self._resolve_aux_params(graph)
             if aux_params is None:
@@ -546,8 +558,8 @@ class HybridBlock(Block):
                     _engine.insert(cache_key, graph)
                 entry[0] = graph
                 if _telem._ENABLED and graph.cost is None:
-                    graph.cost = _engine.estimate_cost(graph.fwd, key,
-                                                       *all_raw)
+                    graph.cost = _engine.estimate_cost(
+                        graph.fwd, key, *all_raw, kind="gluon_fwd")
                     fwd_flops = (graph.cost or {}).get("flops", 0.0)
                 if recording and not remat:
                     outs_flat, aux_vals, res = graph.fwd_res(key, *all_raw)
@@ -566,14 +578,29 @@ class HybridBlock(Block):
             param_nds = [p._data for p in plist]
             out_dtypes = [o.dtype for o in outs_flat]
 
-            # backward FLOPs ~ 2x forward (the standard roofline convention;
-            # docs/observability.md) — exact per-artifact pullback costs
-            # would need a second lower at first-backward time
-            bwd_flops = 2.0 * fwd_flops
+            def _bwd_cost_of(_graph, capture, _ffl=fwd_flops):
+                """Pullback cost: real cost_analysis of the compiled vjp
+                artifact, captured once at first backward (the AOT lower
+                shares XLA's caches); falls back to the 2x-forward
+                roofline convention, flagged 'estimated' so ledger rows
+                built on it render distinguishably."""
+                if _graph.bwd_cost is None and _telem._ENABLED:
+                    c = capture()
+                    if not c.get("flops"):
+                        c = {"flops": 2.0 * _ffl, "estimated": 1.0}
+                    _graph.bwd_cost = c
+                return _graph.bwd_cost or {"flops": 2.0 * _ffl,
+                                           "estimated": 1.0}
+
+            def _record_bwd(c, _region=region):
+                _engine.record_execution(
+                    "bwd", c.get("flops", 0.0),
+                    bytes_accessed=c.get("bytes_accessed", 0.0),
+                    region=f"{_region}/bwd" if _region else None,
+                    estimated=bool(c.get("estimated")), cost=c)
 
             if res is not None:
-                def vjp_fn(cots, _graph=graph, _res=res, _dts=out_dtypes,
-                           _fl=bwd_flops):
+                def vjp_fn(cots, _graph=graph, _res=res, _dts=out_dtypes):
                     cots_t = cots if isinstance(cots, tuple) else (cots,)
                     # the compiled pullback's cotangent avals are fixed;
                     # cast mismatched head grads instead of tripping a
@@ -582,17 +609,22 @@ class HybridBlock(Block):
                         c if getattr(c, "dtype", None) == dt else
                         jnp.asarray(c, dt)
                         for c, dt in zip(cots_t, _dts))
-                    _engine.record_execution("bwd", _fl)
+                    _record_bwd(_bwd_cost_of(
+                        _graph, lambda: _engine.estimate_cost(
+                            _graph.bwd, _res, cots_t, kind="gluon_bwd")))
                     return _graph.bwd(_res, cots_t)
             else:
                 def vjp_fn(cots, _graph=graph, _key=key, _all_raw=all_raw,
-                           _dts=out_dtypes, _fl=bwd_flops):
+                           _dts=out_dtypes):
                     cots_t = cots if isinstance(cots, tuple) else (cots,)
                     cots_t = tuple(
                         c if getattr(c, "dtype", None) == dt else
                         jnp.asarray(c, dt)
                         for c, dt in zip(cots_t, _dts))
-                    _engine.record_execution("bwd", _fl)
+                    _record_bwd(_bwd_cost_of(
+                        _graph, lambda: _engine.estimate_cost(
+                            _graph.bwd_recompute, _key, _all_raw, cots_t,
+                            kind="gluon_bwd_recompute")))
                     return _graph.bwd_recompute(_key, _all_raw, cots_t)
 
             autograd.record_op(vjp_fn, input_nds + param_nds, out_nds,

@@ -130,6 +130,12 @@ class Trainer:
             _profiler._record("trainer.step", "trainer", t0,
                               time.perf_counter())
         if _telem._ENABLED:
+            # roofline ledger: the eager allreduce+update slice gets its own
+            # region, so interval pacing attributes the optimizer's wall
+            # time here instead of blaming the NEXT forward region for it
+            _engine.record_execution(
+                "step", 0.0,
+                region=f"trainer.update[{type(self._optimizer).__name__}]")
             # step() is the once-per-iteration sync point: the inter-step
             # interval telemetry derives here covers the WHOLE eager loop
             # (forward + backward + update), and the engine's executed-FLOPs

@@ -346,6 +346,7 @@ class DataParallelTrainer:
         # telemetry: per-signature cost_analysis of the fused step (captured
         # once, only while enabled) + the dp-degree for comm accounting
         self._step_cost: Dict[Any, Dict[str, float]] = {}
+        self._region_cache: Dict[Any, str] = {}  # sig -> roofline row key
         self._dp_degree = int(dict(self.mesh.shape).get(batch_axis_name, 1))
         self._ar_bytes: Optional[int] = None
         self._rs_bytes: Optional[int] = None   # zero: reduce-scatter wire
@@ -641,9 +642,24 @@ class DataParallelTrainer:
             self._opt_bytes = _zero.per_replica_state_bytes(tree)
         return self._opt_bytes
 
+    def _region_name(self, cost_key) -> str:
+        """Roofline-ledger row key for this trainer's fused step artifact:
+        a readable net-class prefix plus a digest of the full compile key
+        (structural fingerprint + config_fingerprint + signature) — two
+        configs that compile apart ledger apart, N same-config trainers
+        aggregate into one row."""
+        name = self._region_cache.get(cost_key)
+        if name is None:
+            import hashlib
+            digest = hashlib.sha1(
+                repr((self._step_key_base, cost_key)).encode()).hexdigest()
+            name = f"dp.step[{type(self.net).__name__}]#{digest[:6]}"
+            self._region_cache[cost_key] = name
+        return name
+
     def _record_telemetry(self, sig, examples, steps, flops_key=None):
-        cost = self._step_cost.get(flops_key if flops_key is not None
-                                   else sig, {})
+        cost_key = flops_key if flops_key is not None else sig
+        cost = self._step_cost.get(cost_key, {})
         flops = cost.get("flops")
         if self._dp_degree > 1:
             if self._zero:
@@ -654,6 +670,12 @@ class DataParallelTrainer:
                                    store="mesh", calls=steps)
         _telem.record_optimizer_state(self._opt_state_replica_bytes(),
                                       source="data_parallel")
+        # roofline ledger + aggregate flops/bytes through the ONE engine
+        # funnel (called after window admission: completion-paced, no sync)
+        _engine.record_execution(
+            "step", flops or 0.0,
+            bytes_accessed=cost.get("bytes_accessed", 0.0),
+            region=self._region_name(cost_key), steps=steps, cost=cost)
         _telem.record_step(examples, source="data_parallel", steps=steps,
                            flops_per_step=(flops / steps if flops else None),
                            lr=float(self.optimizer.learning_rate))
@@ -1095,7 +1117,7 @@ class DataParallelTrainer:
         if _telem._ENABLED and cost_key not in self._step_cost:
             self._step_cost[cost_key] = _engine.estimate_cost(
                 fn, self._params_raw, self._opt_state, self._comp_resid,
-                key_in, xr, yr, lr_in, t_in, scale_in)
+                key_in, xr, yr, lr_in, t_in, scale_in, kind="dp_multi")
         with _telem.annotate("mx.dp.run_steps"), _sanitize.guard():
             (self._params_raw, self._opt_state, self._comp_resid, losses,
              finite, key_out, t_out) = fn(
@@ -1148,7 +1170,8 @@ class DataParallelTrainer:
         if _telem._ENABLED and sig not in self._step_cost:
             # cost_analysis FLOPs of the fused step, captured once per
             # signature at artifact-build time (AOT lower shares XLA caches)
-            self._step_cost[sig] = _engine.estimate_cost(fn, *call_args)
+            self._step_cost[sig] = _engine.estimate_cost(fn, *call_args,
+                                                         kind="dp_step")
         with _telem.annotate("mx.dp.step"), _sanitize.guard():
             if self._compression:
                 (self._params_raw, self._opt_state, self._comp_resid, lossv,

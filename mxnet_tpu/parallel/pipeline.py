@@ -219,6 +219,7 @@ class PipelineTrainer:
         self._window = _feed.DispatchWindow(name="pp")
         self._step_jit = {}
         self._step_cost = {}
+        self._region_cache = {}  # sig -> roofline ledger row key
 
     # ------------------------------------------------------------------
     def _loss_raw(self, pred_raw, label_raw):
@@ -367,7 +368,8 @@ class PipelineTrainer:
         call_args = (self._e_raw, self._s_raw, self._h_raw, self._opt_e,
                      self._opt_s, self._opt_h, key, xr, yr, lr, t_in)
         if _telem._ENABLED and sig not in self._step_cost:
-            self._step_cost[sig] = _engine.estimate_cost(fn, *call_args)
+            self._step_cost[sig] = _engine.estimate_cost(fn, *call_args,
+                                                         kind="pp_step")
         with _telem.annotate("mx.pp.step"), _sanitize.guard():
             (self._e_raw, self._s_raw, self._h_raw, self._opt_e, self._opt_s,
              self._opt_h, lossv) = fn(*call_args)
@@ -383,7 +385,21 @@ class PipelineTrainer:
                                 self._e_raw + self._h_raw)
                 _telem.record_comm("pipeline_grad_psum", rep_bytes,
                                    store="mesh")
-            flops = self._step_cost.get(sig, {}).get("flops")
+            cost = self._step_cost.get(sig, {})
+            flops = cost.get("flops")
+            region = self._region_cache.get(sig)
+            if region is None:
+                import hashlib
+                digest = hashlib.sha1(repr(("pp_step", self.n_stages,
+                                            self.num_microbatch,
+                                            sig)).encode()).hexdigest()
+                region = self._region_cache[sig] = f"pp.step#{digest[:6]}"
+            # roofline ledger + aggregate flops/bytes through the one
+            # engine funnel (after window admission: completion-paced)
+            _engine.record_execution(
+                "step", flops or 0.0,
+                bytes_accessed=cost.get("bytes_accessed", 0.0),
+                region=region, cost=cost)
             _telem.record_step(B, source="pipeline", flops_per_step=flops,
                                lr=float(self.optimizer.learning_rate))
         return _feed.PendingScalar(lossv)

@@ -66,21 +66,30 @@ class ForwardArtifact:
     """
 
     __slots__ = ("key", "fn", "arg_names", "aux_names", "num_outputs",
-                 "flops", "_rng_key")
+                 "flops", "cost", "region", "_rng_key")
 
     def __init__(self, key, fn, arg_names, aux_names, num_outputs, rng_key,
-                 flops: float = 0.0):
+                 flops: float = 0.0, cost=None):
         self.key = key
         self.fn = fn
         self.arg_names = arg_names
         self.aux_names = aux_names
         self.num_outputs = num_outputs
         self.flops = flops
+        self.cost = cost or {}
+        # roofline-ledger row key: the graph fingerprint inside the engine
+        # cache key, so every Predictor/serving bucket over one exported
+        # model aggregates into one row per compiled signature
+        self.region = f"predict#{key[1][:6]}" if len(key) > 1 else "predict"
         self._rng_key = rng_key
 
     def __call__(self, arg_vals: Sequence, aux_vals: Sequence = ()):
         outs, _ = self.fn(tuple(arg_vals), tuple(aux_vals), self._rng_key)
-        _engine.record_execution("fwd", self.flops)
+        from . import telemetry as _telem
+        _engine.record_execution(
+            "fwd", self.flops,
+            bytes_accessed=self.cost.get("bytes_accessed", 0.0),
+            region=self.region if _telem._ENABLED else None, cost=self.cost)
         return outs
 
     def release(self):
@@ -142,15 +151,16 @@ def acquire_forward(symbol, arg_avals: Dict[str, Tuple[Tuple[int, ...], str]],
 
             warm_args = tuple(zero(n, arg_avals) for n in arg_names)
             warm_aux = tuple(zero(n, aux_avals) for n in aux_names)
-            flops = 0.0
+            cost = {}
             from . import telemetry as _telem
             if _telem._ENABLED:
-                flops = _engine.estimate_cost(
-                    jitted, warm_args, warm_aux, rng_key).get("flops", 0.0)
+                cost = _engine.estimate_cost(
+                    jitted, warm_args, warm_aux, rng_key, kind="predict")
             outs, _ = jitted(warm_args, warm_aux, rng_key)
             jax.block_until_ready(outs)  # the single compile, at bind time
             art = ForwardArtifact(key, jitted, arg_names, aux_names,
-                                  len(outs), rng_key, flops)
+                                  len(outs), rng_key,
+                                  cost.get("flops", 0.0), cost=cost)
             _engine.insert(key, art)
     _engine.pin(key)
     return art

@@ -93,7 +93,8 @@ class _VjpArtifact:
     ``fwd_res`` emits (outputs, aux updates, residuals); ``bwd`` applies the
     pullback to saved residuals without re-running the forward."""
 
-    __slots__ = ("fwd_res", "bwd", "arg_nodes", "aux_nodes")
+    __slots__ = ("fwd_res", "bwd", "arg_nodes", "aux_nodes", "cost",
+                 "bwd_cost")
 
     def __init__(self, symbol: Symbol, wrt_names: Tuple[str, ...]):
         run, arg_nodes, aux_nodes, _ = _graph_runner(symbol, True)
@@ -125,6 +126,8 @@ class _VjpArtifact:
         self.bwd = jax.jit(bwd)
         self.arg_nodes = arg_nodes
         self.aux_nodes = aux_nodes
+        self.cost = None      # fwd cost_analysis, captured at first forward
+        self.bwd_cost = None  # pullback cost, captured at first backward
 
 
 class Executor:
@@ -319,12 +322,25 @@ class Executor:
         if is_train:
             self._last_key = key
         if use_vjp:
+            from .. import telemetry as _telem
+            if _telem._ENABLED and art.cost is None:
+                # one AOT lower+compile per artifact (shares XLA caches):
+                # FLOPs+bytes for the MFU gauge and the roofline ledger
+                art.cost = _engine.estimate_cost(
+                    art.fwd_res, arg_vals, aux_vals, key,
+                    kind="executor_fwd")
             outs, aux_upd, res = art.fwd_res(arg_vals, aux_vals, key)
             self._residuals = (art, res,
                                tuple((tuple(o.shape), o.dtype) for o in outs))
+            c = art.cost or {}
+            _engine.record_execution(
+                "fwd", c.get("flops", 0.0),
+                bytes_accessed=c.get("bytes_accessed", 0.0),
+                region=f"executor#{self._fingerprint()[:6]}"
+                if _telem._ENABLED else None, cost=c)
         else:
             outs, aux_upd = fn(arg_vals, aux_vals, key)
-        _engine.record_execution("fwd")
+            _engine.record_execution("fwd")
         if is_train:
             for node, newv in zip(aux_nodes, aux_upd):
                 self.aux_dict[node.name]._set_data(newv)
@@ -361,8 +377,23 @@ class Executor:
             # last training forward
             art, res, out_avals = self._residuals
             heads = self._head_cotangents(out_grads, out_avals)
+            from .. import telemetry as _telem
+            if _telem._ENABLED and art.bwd_cost is None:
+                c = _engine.estimate_cost(art.bwd, res, heads,
+                                          kind="executor_bwd")
+                if not c.get("flops"):
+                    # 2x-forward roofline convention, flagged estimated
+                    c = {"flops": 2.0 * (art.cost or {}).get("flops", 0.0),
+                         "estimated": 1.0}
+                art.bwd_cost = c
             grads = art.bwd(res, heads)
-            _engine.record_execution("bwd")
+            c = art.bwd_cost or {}
+            _engine.record_execution(
+                "bwd", c.get("flops", 0.0),
+                bytes_accessed=c.get("bytes_accessed", 0.0),
+                region=f"executor#{self._fingerprint()[:6]}/bwd"
+                if _telem._ENABLED else None,
+                estimated=bool(c.get("estimated")), cost=c)
         else:
             grads = self._backward_recompute(wrt_names, out_grads)
         for name, g in zip(wrt_names, grads):

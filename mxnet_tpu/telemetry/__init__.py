@@ -47,6 +47,8 @@ __all__ = [
     "get_metric", "reset", "collect", "scrape", "scrape_json", "report",
     "record_step", "record_comm", "comm_scope", "instrument_comm",
     "record_optimizer_state", "payload_bytes", "sample_memory", "peak_flops",
+    "peak_bytes_per_second", "ridge_point", "roofline", "trace_steps",
+    "trace_active",
     "record_feed_depth", "record_feed_stall", "record_inflight",
     "set_epoch", "timed", "annotate", "start_http_server",
     "stop_http_server", "DEFAULT_LATENCY_BUCKETS", "record_serving_enqueue",
@@ -63,6 +65,13 @@ env.declare("MXNET_TELEMETRY_PEAK_FLOPS", 0.0, float,
             "Roofline peak FLOP/s used for the MFU gauge; overrides the "
             "per-device-kind table (set this on CPU, where XLA's cost model "
             "has no meaningful peak)")
+env.declare("MXNET_TELEMETRY_PEAK_BYTES", 0.0, float,
+            "Roofline peak memory bandwidth (bytes/s) for the per-region "
+            "ledger; overrides the per-device-kind HBM table (set this on "
+            "CPU, where the 50 GB/s anchor is only an A/B reference)")
+env.declare("MXNET_TPU_TRACE_DIR", "", str,
+            "Default logdir for telemetry.trace_steps() device-trace "
+            "capture (xplane, viewable in TensorBoard/XProf)")
 
 _LOCK = threading.RLock()
 _FAMILIES: "OrderedDict[str, MetricFamily]" = OrderedDict()
@@ -373,13 +382,16 @@ def get_metric(name) -> Optional[MetricFamily]:
 
 
 def reset():
-    """Drop every registered family and all step/memory bookkeeping
-    (tests; a long-lived server should scrape, not reset)."""
+    """Drop every registered family and all step/memory bookkeeping,
+    including the per-region roofline ledger (tests; a long-lived server
+    should scrape, not reset)."""
     global _mem_peak
     with _LOCK:
         _FAMILIES.clear()
         _STEP_ANCHOR.clear()
         _mem_peak = 0.0
+    from . import roofline as _roofline
+    _roofline.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -419,6 +431,106 @@ def peak_flops() -> float:
                 pass
             _peak_cache[0] = peak
         return _peak_cache[0]
+
+
+# nominal HBM bandwidth (bytes/s) by device_kind substring — the roofline
+# denominator for the bytes axis (same resolution order as peak_flops)
+_BW_TABLE = (
+    ("v5 lite", 819e9), ("v5e", 819e9), ("v5p", 2765e9),
+    ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9), ("v6", 1640e9),
+)
+# documented CPU anchor: ~DDR-class bandwidth so the ledger's ratios and
+# ridge point stay meaningful for A/B deltas on CI hosts (with the 1 TF/s
+# FLOPs anchor the ridge sits at 20 FLOP/byte; not a hardware claim —
+# docs/observability.md, "Peak overrides")
+_FALLBACK_BYTES_PER_S = 50e9
+_peak_bw_cache: List[Optional[float]] = [None]
+
+
+def peak_bytes_per_second() -> float:
+    """Peak memory bandwidth the per-region roofline ledger divides by:
+    ``MXNET_TELEMETRY_PEAK_BYTES`` override, else a device_kind HBM table,
+    else the documented 50 GB/s CPU anchor."""
+    ov = float(env.get("MXNET_TELEMETRY_PEAK_BYTES"))
+    if ov > 0:
+        return ov
+    with _LOCK:
+        if _peak_bw_cache[0] is None:
+            bw = _FALLBACK_BYTES_PER_S
+            try:
+                import jax
+                kind = jax.devices()[0].device_kind.lower()
+                for sub, b in _BW_TABLE:
+                    if sub in kind:
+                        bw = b
+                        break
+            except Exception:
+                pass
+            _peak_bw_cache[0] = bw
+        return _peak_bw_cache[0]
+
+
+def ridge_point() -> float:
+    """Arithmetic intensity (FLOP/byte) where the roofline's bandwidth
+    slope meets the compute ceiling; regions below it are memory-bound."""
+    return peak_flops() / peak_bytes_per_second()
+
+
+# ---------------------------------------------------------------------------
+# Programmatic device-trace capture (xplane timeline)
+# ---------------------------------------------------------------------------
+
+# [steps remaining, active logdir]; armed by trace_steps(), decremented by
+# record_step() so the capture stops itself after n recorded steps without
+# any extra sync point in the loop
+_TRACE = [0, None]
+
+
+def trace_steps(n: int, logdir: Optional[str] = None) -> str:
+    """Start a ``jax.profiler`` device trace (xplane; TensorBoard/XProf)
+    and stop it automatically after the next ``n`` recorded training steps.
+    ``logdir`` defaults to ``MXNET_TPU_TRACE_DIR``, else a temp directory.
+    The existing ``TraceAnnotation`` region names (``mx.dp.step``,
+    ``mx.comm.*``) land inside the captured timeline, so ledger rows map
+    onto trace spans by name. Returns the logdir."""
+    import tempfile
+
+    import jax
+    d = logdir or str(env.get("MXNET_TPU_TRACE_DIR")) or None
+    if not d:
+        d = tempfile.mkdtemp(prefix="mx_trace_")
+    import os as _os
+    _os.makedirs(d, exist_ok=True)
+    with _LOCK:
+        if _TRACE[1] is not None:
+            raise MXNetError(f"a trace is already active in {_TRACE[1]}")
+        jax.profiler.start_trace(d)
+        _TRACE[0], _TRACE[1] = max(int(n), 1), d
+    return d
+
+
+def trace_active() -> Optional[str]:
+    """The active capture's logdir, or None."""
+    return _TRACE[1]
+
+
+def _trace_tick(steps: int = 1):
+    """Count recorded steps against an armed capture; stops the trace when
+    the budget is spent. Host-side bookkeeping only."""
+    stop = False
+    with _LOCK:
+        if _TRACE[1] is None:
+            return
+        _TRACE[0] -= steps
+        if _TRACE[0] <= 0:
+            _TRACE[1] = None
+            stop = True
+    if stop:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -470,6 +582,16 @@ def record_step(examples: int, source: str = "trainer", steps: int = 1,
             ("source",)).labels(source).inc(examples)
     histogram("mx_train_step_seconds", "Wall time per training step",
               ("source",)).labels(source).observe(seconds / max(steps, 1))
+    # the SLO-ladder twin of mx_train_step_seconds: same documented
+    # DEFAULT_LATENCY_BUCKETS exposition as serving, so training p50/p99
+    # step latency is a real histogram_quantile() query too. Recorded at
+    # the same window-admission pace (completion-paced, sync-free).
+    histogram("mx_step_seconds",
+              "Training-step latency on the documented "
+              "DEFAULT_LATENCY_BUCKETS ladder",
+              ("source",), buckets=DEFAULT_LATENCY_BUCKETS) \
+        .labels(source).observe(seconds / max(steps, 1))
+    _trace_tick(steps)
     if seconds > 0:
         gauge("mx_train_examples_per_second",
               "Training throughput over the last recorded window",
@@ -750,7 +872,10 @@ def sample_memory():
 
 def _sync_engine_stats():
     """Mirror the compilation-engine counters (and donation savings) into
-    gauges at scrape time, so one scrape carries the whole picture."""
+    gauges at scrape time, so one scrape carries the whole picture; the
+    per-region roofline ledger refreshes its gauges here too."""
+    from . import roofline as _roofline
+    _roofline.export_metrics()
     try:
         from .. import engine as _engine
         st = _engine.cache_stats()
@@ -863,3 +988,8 @@ def stop_http_server():
     if srv is not None:
         srv.shutdown()
         srv.server_close()
+
+
+# the per-region roofline ledger (mx.telemetry.roofline.report() / rows();
+# imported last — it only pulls stdlib at module scope)
+from . import roofline  # noqa: E402

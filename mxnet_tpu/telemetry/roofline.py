@@ -1,0 +1,305 @@
+"""Per-region roofline ledger: attribute achieved-vs-peak FLOPs AND bytes
+to every compiled artifact (fused region) in the process.
+
+The aggregate ``mx_mfu`` gauge says *that* half the chip is idle; this
+ledger says *where*. Every compiled artifact the engine executes — gluon
+cached graphs (fwd and the compiled vjp pullback separately), the fused
+``DataParallelTrainer``/``PipelineTrainer`` steps, Predictor/serving
+forwards — reports into one table keyed on the artifact's fingerprint
+("region"), carrying the XLA cost model's FLOPs and ``bytes accessed``
+captured once at artifact-build time (``engine.estimate_cost``).
+
+Analysis frame ("Operator Fusion in XLA", arXiv:2301.13062): each region is
+placed on the roofline by its arithmetic intensity ``AI = flops / bytes``
+against the ridge point ``peak_flops / peak_bytes_per_second`` —
+compute-bound above the ridge, memory-bound below — and its *attainable*
+ceiling is ``min(peak_flops, AI * peak_bw)``. The headline ranking metric
+is **lost FLOP-seconds** = ``ceiling * seconds - flops``: how much compute
+the region left on the table relative to what the roofline says its own
+shape could sustain. This per-region compute/memory classification is the
+input signal a TVM-style cost-model-driven schedule search (arXiv:
+1802.04799) consumes.
+
+Timing is **completion-paced and sync-free**: each recorded execution is
+stamped with the host wall-interval since the *previous* recorded
+execution event (a process-global anchor), the same interval convention as
+``telemetry.record_step``. Under the bounded in-flight window
+(``DispatchWindow`` backpressure) dispatch pace equals completion pace, so
+intervals sum to wall time and each interval is attributed to the artifact
+that retired in it — no ``block_until_ready``, no host sync, ever
+(enforced by the mxlint ``host-sync``/``sync-in-loop`` hot lists).
+
+Exports, all OFF until telemetry is enabled:
+
+- Prometheus — ``mx_region_achieved_flops_ratio{region,kind}``,
+  ``mx_region_bytes_per_second{region,kind}`` (+ flops/s, arithmetic
+  intensity, lost-FLOP-seconds, executions) refreshed at every scrape;
+- ``report()`` — human table sorted by lost FLOP-seconds (worst first);
+- ``as_dict()`` / ``dump_json()`` — machine-readable dump for bench
+  (``BENCH_SCENARIO=roofline`` writes it into BENCHMARKS.md).
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = [
+    "record", "register_cost", "rows", "report", "as_dict", "dump_json",
+    "reset", "classify", "wrap", "total_flops",
+]
+
+# region key -> _Region; guarded by telemetry's registry lock (the ledger
+# is part of the same process-wide registry lifecycle: reset() clears both)
+_LEDGER: "Dict[str, _Region]" = {}
+# perf_counter stamp of the last recorded execution event (process-global):
+# interval pacing attributes inter-completion gaps to the retiring region
+_ANCHOR: List[Optional[float]] = [None]
+
+
+def _lock():
+    from . import _LOCK
+    return _LOCK
+
+
+class _Region:
+    """One ledger row: cumulative FLOPs/bytes/seconds for one artifact."""
+
+    __slots__ = ("name", "kind", "execs", "flops", "bytes", "seconds",
+                 "estimated", "cost")
+
+    def __init__(self, name: str, kind: str = ""):
+        self.name = name
+        self.kind = kind
+        self.execs = 0
+        self.flops = 0.0
+        self.bytes = 0.0
+        self.seconds = 0.0
+        # True while any contribution used a heuristic cost (e.g. the
+        # gluon "bwd = 2x fwd" fallback) rather than a captured one
+        self.estimated = False
+        self.cost: Dict[str, float] = {}
+
+
+def register_cost(region: str, cost: Dict[str, float], kind: str = ""):
+    """Attach the artifact's build-time cost detail (estimate_cost output:
+    flops / bytes_accessed / bytes_in / bytes_out / peak_memory_bytes /
+    transcendentals) to its ledger row without booking an execution."""
+    with _lock():
+        row = _LEDGER.get(region)
+        if row is None:
+            row = _LEDGER[region] = _Region(region, kind)
+        if cost:
+            row.cost = dict(cost)
+        if kind:
+            row.kind = kind
+
+
+def record(region: str, flops: float = 0.0, bytes_accessed: float = 0.0,
+           steps: int = 1, kind: str = "", seconds: Optional[float] = None,
+           estimated: bool = False, cost: Optional[Dict[str, float]] = None):
+    """Book ``steps`` executions of ``region`` covering ``flops``/``bytes``
+    total. ``seconds=None`` uses interval pacing against the global anchor
+    (the first event only anchors the clock); an explicit ``seconds`` also
+    re-anchors, so mixed callers stay consistent. Arguments must be host
+    scalars (cost-model floats) — this path is on the mxlint host-sync hot
+    list precisely so no device value can ever sneak in."""
+    now = time.perf_counter()
+    with _lock():
+        row = _LEDGER.get(region)
+        if row is None:
+            row = _LEDGER[region] = _Region(region, kind)
+        elif kind and not row.kind:
+            row.kind = kind
+        if cost:
+            row.cost = dict(cost)
+        row.execs += steps
+        row.flops += flops
+        row.bytes += bytes_accessed
+        row.estimated = row.estimated or estimated
+        prev, _ANCHOR[0] = _ANCHOR[0], now
+        if seconds is None:
+            seconds = (now - prev) if prev is not None else 0.0
+        row.seconds += seconds
+
+
+def total_flops() -> float:
+    """Sum of FLOPs across every ledger row — must agree with the engine's
+    aggregate ``flops_executed`` counter (both are fed by the same
+    ``engine.record_execution`` funnel; BENCH_SCENARIO=roofline asserts
+    the two accounts within 5%)."""
+    with _lock():
+        return sum(r.flops for r in _LEDGER.values())
+
+
+def reset():
+    with _lock():
+        _LEDGER.clear()
+        _ANCHOR[0] = None
+
+
+# ---------------------------------------------------------------------------
+# Derived roofline placement
+# ---------------------------------------------------------------------------
+
+def classify(flops: float, bytes_accessed: float) -> str:
+    """'compute' when the region's arithmetic intensity sits at/above the
+    ridge point (peak_flops / peak_bytes_per_second), 'memory' below it,
+    'unknown' without a bytes figure."""
+    from . import peak_bytes_per_second, peak_flops
+    if bytes_accessed <= 0:
+        return "unknown"
+    ridge = peak_flops() / peak_bytes_per_second()
+    return "compute" if flops / bytes_accessed >= ridge else "memory"
+
+
+def rows() -> List[Dict[str, Any]]:
+    """Ledger rows with derived roofline fields, sorted by lost
+    FLOP-seconds (the attribution ranking: worst waste first)."""
+    from . import peak_bytes_per_second, peak_flops
+    pf, pb = peak_flops(), peak_bytes_per_second()
+    with _lock():
+        snap = [(r.name, r.kind, r.execs, r.flops, r.bytes, r.seconds,
+                 r.estimated, dict(r.cost)) for r in _LEDGER.values()]
+    out = []
+    for name, kind, execs, flops, nbytes, secs, est, cost in snap:
+        ai = flops / nbytes if nbytes > 0 else float("inf") if flops else 0.0
+        ceiling = min(pf, ai * pb) if nbytes > 0 else pf
+        fps = flops / secs if secs > 0 else 0.0
+        bps = nbytes / secs if secs > 0 else 0.0
+        out.append({
+            "region": name,
+            "kind": kind,
+            "executions": execs,
+            "flops": flops,
+            "bytes": nbytes,
+            "seconds": secs,
+            "achieved_flops_per_second": fps,
+            "achieved_bytes_per_second": bps,
+            "achieved_flops_ratio": fps / pf if pf else 0.0,
+            "achieved_bytes_ratio": bps / pb if pb else 0.0,
+            "arithmetic_intensity": ai,
+            "bound": classify(flops, nbytes),
+            "roofline_ceiling_flops_per_second": ceiling,
+            "lost_flop_seconds": max(ceiling * secs - flops, 0.0),
+            "estimated": est,
+            "cost": cost,
+        })
+    out.sort(key=lambda r: r["lost_flop_seconds"], reverse=True)
+    return out
+
+
+def as_dict() -> Dict[str, Any]:
+    from . import peak_bytes_per_second, peak_flops
+    pf, pb = peak_flops(), peak_bytes_per_second()
+    return {
+        "peak_flops_per_second": pf,
+        "peak_bytes_per_second": pb,
+        "ridge_point_flops_per_byte": pf / pb if pb else 0.0,
+        "total_flops": total_flops(),
+        "regions": rows(),
+    }
+
+
+def dump_json(path: Optional[str] = None, indent=None) -> str:
+    """JSON dump of the ledger (bench/BENCHMARKS.md vehicle); writes to
+    ``path`` when given, returns the text either way."""
+    text = json.dumps(as_dict(), indent=indent, sort_keys=True)
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+def report() -> str:
+    """Human table sorted by lost FLOP-seconds: the action list for "where
+    is the MFU going" (docs/observability.md, "Reading the ledger")."""
+    d = as_dict()
+    lines = [
+        "=== roofline ledger (peak %.3g FLOP/s, %.3g B/s, ridge %.1f "
+        "FLOP/B) ===" % (d["peak_flops_per_second"],
+                         d["peak_bytes_per_second"],
+                         d["ridge_point_flops_per_byte"]),
+        f"{'region':<44}{'kind':<6}{'execs':>6}{'GFLOP':>9}{'GB':>8}"
+        f"{'sec':>8}{'fl/s%':>7}{'B/s%':>6}{'AI':>8} {'bound':<8}"
+        f"{'lostFLOPs':>10}",
+    ]
+    for r in d["regions"]:
+        est = "~" if r["estimated"] else ""
+        lines.append(
+            f"{est + r['region']:<44}{r['kind']:<6}{r['executions']:>6}"
+            f"{r['flops'] / 1e9:>9.2f}{r['bytes'] / 1e9:>8.2f}"
+            f"{r['seconds']:>8.3f}"
+            f"{100 * r['achieved_flops_ratio']:>7.2f}"
+            f"{100 * r['achieved_bytes_ratio']:>6.1f}"
+            f"{r['arithmetic_intensity']:>8.1f} {r['bound']:<8}"
+            f"{r['lost_flop_seconds'] / 1e9:>10.2f}")
+    lines.append("('~' prefix = row contains heuristic-estimated costs; "
+                 "lostFLOPs = GFLOP-seconds below the region's own "
+                 "roofline ceiling)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus export (refreshed per scrape by telemetry._sync_engine_stats)
+# ---------------------------------------------------------------------------
+
+def export_metrics():
+    """Mirror the ledger into labeled gauges. Label cardinality is bounded
+    by the artifact count (itself bounded by the compilation cache), far
+    under the per-family series cap."""
+    from . import gauge
+    for r in rows():
+        lab = (r["region"], r["kind"])
+        gauge("mx_region_achieved_flops_ratio",
+              "Per-region achieved FLOP/s over peak_flops() "
+              "(docs/observability.md, roofline ledger)",
+              ("region", "kind")).labels(*lab).set(r["achieved_flops_ratio"])
+        gauge("mx_region_bytes_per_second",
+              "Per-region achieved memory bandwidth",
+              ("region", "kind")).labels(*lab) \
+            .set(r["achieved_bytes_per_second"])
+        gauge("mx_region_flops_per_second",
+              "Per-region achieved FLOP/s",
+              ("region", "kind")).labels(*lab) \
+            .set(r["achieved_flops_per_second"])
+        gauge("mx_region_arithmetic_intensity",
+              "Per-region FLOPs per byte accessed (vs the ridge point)",
+              ("region", "kind")).labels(*lab).set(
+                  r["arithmetic_intensity"]
+                  if r["arithmetic_intensity"] != float("inf") else 0.0)
+        gauge("mx_region_lost_flop_seconds",
+              "FLOPs the region left below its own roofline ceiling",
+              ("region", "kind")).labels(*lab).set(r["lost_flop_seconds"])
+        gauge("mx_region_executions",
+              "Recorded executions of the region's compiled artifact",
+              ("region", "kind")).labels(*lab).set(r["executions"])
+
+
+# ---------------------------------------------------------------------------
+# Instrumenting ad-hoc jitted callables (bench / user kernels)
+# ---------------------------------------------------------------------------
+
+def wrap(jitted, region: str, kind: str = "custom") -> Callable:
+    """Instrument a jitted callable as a ledger region: the first call
+    (while telemetry is enabled) captures its cost via
+    ``engine.estimate_cost``, and every call books one execution through
+    the same ``engine.record_execution`` funnel the framework artifacts
+    use — so wrapped kernels land in the same table AND the same aggregate
+    ``flops_executed`` account."""
+    from .. import engine as _engine
+    from . import is_enabled
+    state = {"cost": None}
+
+    def call(*args, **kw):
+        if is_enabled() and state["cost"] is None:
+            state["cost"] = _engine.estimate_cost(jitted, *args, kind=kind)
+        out = jitted(*args, **kw)
+        c = state["cost"] or {}
+        _engine.record_execution(kind, c.get("flops", 0.0),
+                                 bytes_accessed=c.get("bytes_accessed", 0.0),
+                                 region=region, cost=c)
+        return out
+
+    call.__name__ = f"roofline[{region}]"
+    return call

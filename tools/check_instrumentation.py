@@ -85,6 +85,16 @@ METHOD_CHECKS = [
      {"record_serving_dispatch"}, "call"),
     ("serving/batcher.py", "ContinuousBatcher", "_complete",
      {"record_serving_completion"}, "call"),
+    # roofline ledger (ISSUE 7): every fused-step driver must book its
+    # executions through the ONE engine funnel (engine.record_execution
+    # with a region), so the per-region ledger always reconciles with the
+    # aggregate flops_executed account
+    ("parallel/data_parallel.py", "DataParallelTrainer",
+     "_record_telemetry", {"record_execution"}, "call"),
+    ("parallel/pipeline.py", "PipelineTrainer", "step",
+     {"record_execution"}, "call"),
+    ("predict.py", "ForwardArtifact", "__call__",
+     {"record_execution"}, "call"),
 ]
 
 # (relative file, required substring, rationale)
@@ -118,6 +128,27 @@ TEXT_CHECKS = [
     ("telemetry/__init__.py", "mx_serving_batch_occupancy",
      "the registry must export the batch-occupancy (real vs padded rows) "
      "gauge — the bucket-set tuning signal"),
+    # roofline ledger + trace capture (ISSUE 7)
+    ("telemetry/__init__.py", "def peak_bytes_per_second",
+     "the registry must expose the roofline bandwidth peak (env override "
+     "-> device_kind HBM table -> documented CPU anchor)"),
+    ("telemetry/__init__.py", "def trace_steps",
+     "the registry must expose programmatic xplane trace capture "
+     "(start_trace + stop after n recorded steps)"),
+    ("telemetry/__init__.py", "mx_step_seconds",
+     "training must record the step-latency histogram on the documented "
+     "DEFAULT_LATENCY_BUCKETS ladder (serving parity)"),
+    ("telemetry/roofline.py", "mx_region_achieved_flops_ratio",
+     "the roofline ledger must export per-region achieved-vs-peak FLOPs"),
+    ("telemetry/roofline.py", "mx_region_bytes_per_second",
+     "the roofline ledger must export per-region achieved bandwidth"),
+    ("telemetry/roofline.py", "lost_flop_seconds",
+     "the ledger report must rank regions by lost FLOP-seconds (the "
+     "attribution signal the stem/layout PRs act on)"),
+    ("engine/__init__.py", "mx_cost_capture_failures_total",
+     "estimate_cost lowering failures must be counted, not swallowed"),
+    ("engine/__init__.py", "cost_capture_failures",
+     "engine.cache_stats must carry the cost-capture failure count"),
 ]
 
 

@@ -36,6 +36,14 @@ HOT_FUNCTIONS = [
      r"\b|\w+\.update\b|Updater\.__call__\b)"),
     ("mxnet_tpu/engine/__init__.py",
      r"\b(lookup|insert|record_execution|record_trace)\b"),
+    # roofline ledger recording (ISSUE 7): per-region timing capture is
+    # interval-based host arithmetic — a block_until_ready/float() here
+    # would reintroduce exactly the per-step sync the ledger must observe,
+    # not cause. register_cost/export paths included for completeness.
+    ("mxnet_tpu/telemetry/roofline.py",
+     r"\b(record|register_cost|total_flops|wrap)\b"),
+    ("mxnet_tpu/telemetry/__init__.py",
+     r"\b(record_step|_trace_tick)\b"),
     # per-batch metric updates: accumulation must stay on device; the one
     # designed host sync is get()/get_global(), which are not hot-listed
     ("mxnet_tpu/metric.py",

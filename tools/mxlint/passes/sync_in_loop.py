@@ -34,6 +34,12 @@ LOOP_FUNCTIONS = [
     # never sync on batch i's outputs — the completion thread owns the one
     # designed host sync (`ContinuousBatcher._complete`)
     ("mxnet_tpu/serving/batcher.py", r"ContinuousBatcher\._dispatch_loop\b"),
+    # roofline ledger recording paths (ISSUE 7): timing capture must stay
+    # interval-paced — syncing on a step output inside these would turn
+    # the observer into a serializer
+    ("mxnet_tpu/telemetry/roofline.py", r"\b(record|wrap)\b"),
+    ("mxnet_tpu/parallel/data_parallel.py",
+     r"DataParallelTrainer\.(_record_telemetry|_region_name)\b"),
 ]
 
 # calls whose result is a step output: loss/metric/output handles the loop
