@@ -539,6 +539,111 @@ def bench_zero_dp(steps, warmup):
     }
 
 
+def bench_pipeline(steps, warmup):
+    """A/B: GPipe (grad-of-scan transpose) vs the hand-scheduled 1F1B
+    pipeline schedule (docs/pipeline_parallel.md) on BERT-base-shaped
+    stages over a pp mesh. Reports per-schedule step time, analytic vs
+    measured bubble fraction, and the compiled temp/peak memory from
+    XLA's memory_analysis — the bounded-activation-memory claim: 1F1B's
+    temp allocation stays ~flat as the microbatch count doubles while
+    GPipe's residual stash grows with it.
+
+    The measured bubble derives from two microbatch counts per schedule:
+    with t(M) ~= (M + k) * t_tick, the slope t_tick = (t(2M) - t(M)) / M
+    and bubble(M) = 1 - M * t_tick / t(M). Config is scaled down
+    (BENCH_PP_LAYERS/UNITS/SEQ/MB) so the CPU mesh finishes in bench
+    time; on a real slice raise them toward BERT-base (12 x 768 x 512)."""
+    import gc
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, telemetry as telem
+    from mxnet_tpu.models.bert import BertModel
+    from mxnet_tpu.parallel import PipelineTrainer, make_mesh
+
+    pp = int(os.environ.get("BENCH_PP", 4))
+    devs = jax.devices()
+    if len(devs) < pp:
+        devs = jax.devices("cpu")
+    assert len(devs) >= pp, f"need {pp} devices for the pp mesh"
+    mesh = make_mesh({"pp": pp}, devices=devs[:pp])
+    quick = os.environ.get("BENCH_QUICK") == "1"
+    layers = int(os.environ.get("BENCH_PP_LAYERS", 4 if quick else 8))
+    units = int(os.environ.get("BENCH_PP_UNITS", 128 if quick else 256))
+    seq = int(os.environ.get("BENCH_PP_SEQ", 64 if quick else 128))
+    vocab = int(os.environ.get("BENCH_PP_VOCAB", 2048))
+    mb = int(os.environ.get("BENCH_PP_MB", 2))       # rows per microbatch
+    M = int(os.environ.get("BENCH_PP_MICRO", 2 * pp))
+    telem.enable()
+    rs = np.random.RandomState(0)
+
+    def run(sched, m):
+        mx.random.seed(0)
+        net = BertModel(vocab_size=vocab, num_layers=layers, units=units,
+                        hidden_size=4 * units,
+                        num_heads=max(units // 64, 2), max_length=seq,
+                        dropout=0.0)
+        with mx.cpu():
+            net.initialize(ctx=mx.cpu())
+            net(nd.zeros((1, seq), ctx=mx.cpu(), dtype="int32"))
+        tr = PipelineTrainer(net, _loss_tokens, optimizer="adamw",
+                             optimizer_params={"learning_rate": 1e-4},
+                             mesh=mesh, num_microbatch=m, schedule=sched)
+        B = mb * m  # fixed microbatch size: B scales with m (weak scaling)
+        x = nd.array(rs.randint(0, vocab, (B, seq)), dtype="int32")
+        y = nd.array(rs.randint(0, vocab, (B, seq)), dtype="int32")
+        pending = None
+        for _ in range(max(warmup, 1)):
+            pending = tr.step(x, y)
+        tr.drain()
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                pending = tr.step(x, y)
+            tr.drain()
+            best = min(best, time.perf_counter() - t0)
+        cost = next(iter(tr._program._costs.values()), {}) \
+            if tr._program._costs else {}
+        out = {
+            "step_ms": round(best / steps * 1e3, 3),
+            "temp_memory_bytes": cost.get("temp_memory_bytes"),
+            "peak_memory_bytes": cost.get("peak_memory_bytes"),
+            "final_loss": round(float(pending), 4),
+        }
+        del tr, net, x, y
+        gc.collect()
+        return out
+
+    extra = {"pp": pp, "layers": layers, "units": units, "seq": seq,
+             "microbatch_rows": mb, "num_microbatch": M}
+    for sched, bubble_ticks in (("gpipe", pp - 1), ("1f1b", 2 * (pp - 1))):
+        a = run(sched, M)
+        b = run(sched, 2 * M)
+        t_tick = max((b["step_ms"] - a["step_ms"]) / M, 1e-9)
+        extra[sched] = {
+            **a,
+            "step_ms_2x_microbatches": b["step_ms"],
+            "temp_memory_bytes_2x_microbatches": b["temp_memory_bytes"],
+            "bubble_analytic": round(bubble_ticks / (M + bubble_ticks), 4),
+            "bubble_measured": round(
+                max(1 - M * t_tick / a["step_ms"], 0.0), 4),
+        }
+        if a["temp_memory_bytes"] and b["temp_memory_bytes"]:
+            extra[sched]["temp_memory_growth_2x"] = round(
+                b["temp_memory_bytes"] / a["temp_memory_bytes"], 3)
+    return {
+        "metric": "pipeline_1f1b_step_time_ratio",
+        "value": round(extra["1f1b"]["step_ms"]
+                       / max(extra["gpipe"]["step_ms"], 1e-9), 3),
+        "unit": "1f1b/gpipe",
+        # the memory headline: 1F1B temp per GPipe temp at the same M
+        "vs_baseline": round(
+            (extra["1f1b"]["temp_memory_bytes"] or 0)
+            / max(extra["gpipe"]["temp_memory_bytes"] or 1, 1), 3),
+        "extra": extra,
+    }
+
+
 def bench_async_feed(steps, warmup):
     """A/B: synchronous loop (host batch assembly + inline device_put +
     per-step float(loss)) vs the overlapped loop (DeviceFeed staging
@@ -1144,6 +1249,19 @@ def main():
                 + os.environ.get("BENCH_ZERO_DP", "8")).strip()
         _enable_compile_cache()
         print(json.dumps(bench_zero_dp(
+            int(os.environ.get("BENCH_TRAIN_STEPS", 5)),
+            int(os.environ.get("BENCH_TRAIN_WARMUP", 2)))))
+        return
+    if os.environ.get("BENCH_SCENARIO") == "pipeline":
+        # the pp mesh needs >1 device; request virtual host devices BEFORE
+        # the CPU backend initializes (no-op when real devices suffice)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                + os.environ.get("BENCH_PP", "4")).strip()
+        _enable_compile_cache()
+        print(json.dumps(bench_pipeline(
             int(os.environ.get("BENCH_TRAIN_STEPS", 5)),
             int(os.environ.get("BENCH_TRAIN_WARMUP", 2)))))
         return

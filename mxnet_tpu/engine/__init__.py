@@ -31,7 +31,8 @@ __all__ = ["lookup", "insert", "clear_compilation_cache", "cache_stats",
            "reset_stats", "donation_enabled", "record_donation",
            "compile_timer", "record_trace", "record_execution",
            "estimate_cost", "structural_fingerprint", "graph_fingerprint",
-           "config_fingerprint", "pin", "unpin", "pinned_count",
+           "config_fingerprint", "region_digest", "pin", "unpin",
+           "pinned_count",
            "async_feed", "DeviceFeed", "DispatchWindow", "PendingScalar"]
 
 
@@ -429,3 +430,10 @@ def config_fingerprint(**config) -> Tuple:
     zero-update/bucket-size/comm-dtype settings — never share an artifact,
     while N instances of one configuration share a single executable."""
     return tuple((k, _stable_value(config[k])) for k in sorted(config))
+
+
+def region_digest(*parts) -> str:
+    """Stable short digest of a compile-key tuple, used for roofline-ledger
+    region names (parallel/step_program.py): two configurations that compile
+    apart ledger apart, N same-config trainers share one row."""
+    return hashlib.sha1(repr(parts).encode()).hexdigest()[:6]

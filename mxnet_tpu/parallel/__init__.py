@@ -13,21 +13,25 @@ by sharding annotations:
 Capability uplift vs the reference (which had none of TP/PP/SP — SURVEY §2.4).
 """
 from .mesh import (make_mesh, local_mesh, replicate, shard_batch, P,
-                   current_mesh, set_default_mesh)
+                   current_mesh, set_default_mesh, require_axis)
 from .data_parallel import DataParallelTrainer, functional_optimizer
 from .ring_attention import ring_attention, blockwise_attention
 from .tensor_parallel import (column_parallel_spec, row_parallel_spec,
-                              shard_params_megatron)
+                              shard_params_megatron, tp_shard_dim,
+                              gather_tp, slice_tp)
 from .pipeline import (pipeline_spec, pipeline_apply, gpipe_schedule,
-                       PipelineTrainer)
+                       schedule_1f1b, PipelineTrainer)
+from .step_program import StepProgram
 from .moe import (moe_ffn, expert_parallel_moe, topk_gating,
                   load_balancing_loss)
 
 __all__ = ["make_mesh", "local_mesh", "replicate", "shard_batch", "P",
-           "current_mesh", "set_default_mesh", "DataParallelTrainer",
+           "current_mesh", "set_default_mesh", "require_axis",
+           "DataParallelTrainer",
            "functional_optimizer", "ring_attention", "blockwise_attention",
            "column_parallel_spec", "row_parallel_spec", "shard_params_megatron",
+           "tp_shard_dim", "gather_tp", "slice_tp",
            "pipeline_spec", "pipeline_apply", "gpipe_schedule",
-           "PipelineTrainer",
+           "schedule_1f1b", "PipelineTrainer", "StepProgram",
            "moe_ffn", "expert_parallel_moe", "topk_gating",
            "load_balancing_loss"]

@@ -36,6 +36,7 @@ from ..gluon.parameter import Parameter
 from .. import optimizer as opt_mod
 from . import zero as _zero
 from .mesh import current_mesh, P
+from .step_program import StepProgram
 
 
 # ---------------------------------------------------------------------------
@@ -342,11 +343,6 @@ class DataParallelTrainer:
         # (i-K)th step's outputs — the reference dependency engine's
         # pending-op bound, realized over jax async dispatch
         self._window = _feed.DispatchWindow(name="dp")
-        self._step_jit: Dict[Any, Callable] = {}
-        # telemetry: per-signature cost_analysis of the fused step (captured
-        # once, only while enabled) + the dp-degree for comm accounting
-        self._step_cost: Dict[Any, Dict[str, float]] = {}
-        self._region_cache: Dict[Any, str] = {}  # sig -> roofline row key
         self._dp_degree = int(dict(self.mesh.shape).get(batch_axis_name, 1))
         self._ar_bytes: Optional[int] = None
         self._rs_bytes: Optional[int] = None   # zero: reduce-scatter wire
@@ -478,6 +474,11 @@ class DataParallelTrainer:
                 zero=self._zero,
                 bucket_bytes=self._bucket_bytes if self._zero else None,
                 comm_dtype=self._comm_dtype))
+        # executables, cost captures and roofline regions live in the
+        # PROCESS-WIDE engine cache behind this program (parallel/
+        # step_program.py) — same-config trainers share compiles
+        self._program = StepProgram(
+            f"dp.step[{type(self.net).__name__}]", self._step_key_base)
 
     # -- ZeRO-style sharded update setup ------------------------------------
     def _validate_zero(self, compression):
@@ -647,19 +648,12 @@ class DataParallelTrainer:
         a readable net-class prefix plus a digest of the full compile key
         (structural fingerprint + config_fingerprint + signature) — two
         configs that compile apart ledger apart, N same-config trainers
-        aggregate into one row."""
-        name = self._region_cache.get(cost_key)
-        if name is None:
-            import hashlib
-            digest = hashlib.sha1(
-                repr((self._step_key_base, cost_key)).encode()).hexdigest()
-            name = f"dp.step[{type(self.net).__name__}]#{digest[:6]}"
-            self._region_cache[cost_key] = name
-        return name
+        aggregate into one row (StepProgram.region)."""
+        return self._program.region(cost_key)
 
     def _record_telemetry(self, sig, examples, steps, flops_key=None):
         cost_key = flops_key if flops_key is not None else sig
-        cost = self._step_cost.get(cost_key, {})
+        cost = self._program.cost(cost_key)
         flops = cost.get("flops")
         if self._dp_degree > 1:
             if self._zero:
@@ -988,27 +982,13 @@ class DataParallelTrainer:
         return self._build_step(None, None)
 
     def _get_step(self, sig):
-        fn = self._step_jit.get(sig)
-        if fn is None:
-            ck = self._step_key_base + (sig,)
-            fn = _engine.lookup(ck)
-            if fn is None:
-                donate = (0, 1, 2) if self._compression else (0, 1)
-                fn = _engine.insert(
-                    ck, jax.jit(self._build_any_step(),
-                                donate_argnums=donate))
-            self._step_jit[sig] = fn
-        return fn
+        donate = (0, 1, 2) if self._compression else (0, 1)
+        return self._program.get(
+            (sig,),
+            lambda: jax.jit(self._build_any_step(), donate_argnums=donate))
 
     def _get_multi(self, sig, n, stacked):
-        key = (sig, "multi", n)
-        fn = self._step_jit.get(key)
-        if fn is None:
-            ck = self._step_key_base + (sig, "multi", n)
-            cached = _engine.lookup(ck)
-            if cached is not None:
-                self._step_jit[key] = cached
-                return cached
+        def build():
             compressed = self._compression is not None
             body = self._build_any_step()
 
@@ -1046,9 +1026,8 @@ class DataParallelTrainer:
                 key_next = jax.random.key_data(
                     jax.random.fold_in(kk, jnp.int32(n)))
                 return p, s, r, losses, jnp.all(finites), key_next, t_out
-            fn = _engine.insert(ck, multi)
-            self._step_jit[key] = fn
-        return fn
+            return multi
+        return self._program.get((sig, "multi", n), build)
 
     def run_steps(self, x, y, n, stacked=False):
         """Run `n` fused steps in ONE compiled computation (lax.scan over
@@ -1114,10 +1093,10 @@ class DataParallelTrainer:
         xr = self._put_batch(xr, NamedSharding(self.mesh, P(*spec[:xr.ndim])))
         yr = self._put_batch(yr, NamedSharding(self.mesh, P(*spec[:yr.ndim])))
         cost_key = (sig, "multi", n)
-        if _telem._ENABLED and cost_key not in self._step_cost:
-            self._step_cost[cost_key] = _engine.estimate_cost(
-                fn, self._params_raw, self._opt_state, self._comp_resid,
-                key_in, xr, yr, lr_in, t_in, scale_in, kind="dp_multi")
+        self._program.capture_cost(
+            cost_key, fn, self._params_raw, self._opt_state,
+            self._comp_resid, key_in, xr, yr, lr_in, t_in, scale_in,
+            kind="dp_multi")
         with _telem.annotate("mx.dp.run_steps"), _sanitize.guard():
             (self._params_raw, self._opt_state, self._comp_resid, losses,
              finite, key_out, t_out) = fn(
@@ -1167,11 +1146,9 @@ class DataParallelTrainer:
                       key, xr, yr, lr, t_in, scale) if self._compression
                      else (self._params_raw, self._opt_state, key, xr, yr,
                            lr, t_in, scale))
-        if _telem._ENABLED and sig not in self._step_cost:
-            # cost_analysis FLOPs of the fused step, captured once per
-            # signature at artifact-build time (AOT lower shares XLA caches)
-            self._step_cost[sig] = _engine.estimate_cost(fn, *call_args,
-                                                         kind="dp_step")
+        # cost_analysis FLOPs of the fused step, captured once per
+        # signature at artifact-build time (AOT lower shares XLA caches)
+        self._program.capture_cost(sig, fn, *call_args, kind="dp_step")
         with _telem.annotate("mx.dp.step"), _sanitize.guard():
             if self._compression:
                 (self._params_raw, self._opt_state, self._comp_resid, lossv,

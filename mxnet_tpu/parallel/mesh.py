@@ -25,6 +25,15 @@ def axis_size(name: str) -> int:
     return fn(name) if fn is not None else jax.lax.psum(1, name)
 
 
+def require_axis(mesh: Mesh, name: str, role: str = "this trainer") -> int:
+    """Validate that `name` is an axis of `mesh`; returns its size."""
+    if name not in mesh.shape:
+        from ..base import MXNetError
+        raise MXNetError(
+            f"mesh has no {name!r} axis for {role}: {dict(mesh.shape)}")
+    return mesh.shape[name]
+
+
 def make_mesh(axes: Union[Dict[str, int], Sequence[int]], names: Optional[Sequence[str]] = None,
               devices=None) -> Mesh:
     """make_mesh({'dp': 4, 'tp': 2}) or make_mesh((4, 2), ('dp', 'tp'))."""

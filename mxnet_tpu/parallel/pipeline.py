@@ -1,39 +1,55 @@
-"""Pipeline parallelism — circular GPipe schedule with full backward.
+"""Pipeline parallelism — 1F1B (default) and circular GPipe schedules.
 
 Capability uplift over the reference (SURVEY.md §2.4: the reference has no
 pipeline parallelism; its model-parallel story stops at per-layer ctx
 placement, reference example/model-parallel-lstm). TPU-native design:
 
-  - the schedule is ONE `lax.scan` inside `shard_map` over the 'pp' mesh
+  - both schedules are ONE `lax.scan` inside `shard_map` over the 'pp' mesh
     axis; activations hop stages with `lax.ppermute` (ICI neighbor traffic);
-  - backward is NOT hand-written: differentiating through the scheduled scan
-    runs the transposed schedule — scan's transpose replays the steps in
-    reverse and ppermute's transpose carries activation cotangents
-    last→first stage, while the loop-invariant stage parameters accumulate
-    their microbatch-summed weight gradients through scan's cotangent
-    accumulation. Forward GPipe + reverse-schedule backward + weight-grad
-    accumulation all land in a single XLA computation;
-  - per-stage calls run under `jax.checkpoint` by default, so the stashed
-    residuals are one activation per (stage, microbatch) — GPipe's memory
-    profile — instead of every intermediate inside the stage.
+  - **GPipe** (`pipeline_apply`): backward is NOT hand-written —
+    differentiating through the scheduled scan runs the transposed schedule.
+    Simple, but the transpose stashes one residual per (stage, microbatch):
+    peak activation memory grows O(M) with the microbatch count;
+  - **1F1B** (`schedule_1f1b`): warmup / steady 1-forward-1-backward /
+    cooldown with hand-scheduled per-tick `jax.vjp` segments (plain
+    grad-of-scan would replay GPipe order). A microbatch's backward starts
+    as soon as its forward clears the last stage, so each stage keeps at
+    most 2·pp·v−1 stashed stage inputs regardless of M — peak live
+    activations are bounded O(pp) instead of O(M). The optional interleaved
+    variant (`virtual_stages=v>1`) gives each device v non-contiguous layer
+    chunks (logical stage c·pp+idx), shrinking the bubble fraction from
+    (pp−1)/(M+pp−1) toward (pp−1)/(v·M+pp−1) at the cost of v× ppermute
+    traffic.
 
-`PipelineTrainer` fuses embed -> pipeline -> head -> loss -> backward ->
-optimizer update into one jit over a mesh with a 'pp' axis (optionally
-composed with a 'dp' axis for pipeline+data parallelism).
+`PipelineTrainer` fuses embed -> schedule -> head -> loss -> backward ->
+optimizer update into one jitted shard_map over a mesh with a 'pp' axis,
+optionally composed with:
+
+  - a 'dp' axis (pipeline+data parallelism, with `zero_update=True`
+    extending the ZeRO-style sharded update + bf16/int8 comm wire of
+    parallel/zero.py over the dp axis of the stacked stage params);
+  - a 'tp' axis (manual weight-sharded tensor parallelism: leaves carrying
+    `Parameter.sharding` specs over 'tp' are stored sharded, all-gathered
+    once per step OUTSIDE the differentiated region, and their — then
+    rank-identical — grads sliced back for the local update lane).
+
+Executables live in the process-wide engine cache behind a
+`StepProgram` keyed on `engine.config_fingerprint()` (parallel/
+step_program.py): same-config trainers share compiles and roofline rows.
 """
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as _np
 from jax import lax
 
-from .mesh import axis_size as _axis_size
+from .mesh import axis_size as _axis_size, require_axis
 from jax.sharding import Mesh, NamedSharding
 
-from ..base import MXNetError
+from ..base import MXNetError, env
 from ..ndarray import NDArray
 from .. import engine as _engine
 from ..engine import async_feed as _feed
@@ -41,10 +57,17 @@ from .. import optimizer as opt_mod
 from .. import random as _rng
 from .. import sanitize as _sanitize
 from .. import telemetry as _telem
+from . import zero as _zero
 from .mesh import current_mesh, P
+from .step_program import StepProgram
+from .tensor_parallel import gather_tp, slice_tp, tp_shard_dim
 
 __all__ = ["pipeline_spec", "pipeline_apply", "gpipe_schedule",
-           "PipelineTrainer"]
+           "schedule_1f1b", "PipelineTrainer"]
+
+env.declare("MXNET_TPU_PP_SCHEDULE", "1f1b", str,
+            "Default PipelineTrainer schedule: '1f1b' (bounded activation "
+            "memory) or 'gpipe' (grad-of-scan transpose)")
 
 
 def pipeline_spec(num_stages: int, axis: str = "pp"):
@@ -67,7 +90,9 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_stack,
 
     Reverse-mode differentiation through this function yields the reverse
     pipeline schedule with weight-gradient accumulation (see module
-    docstring) — callers get pipeline backward for free from jax.grad.
+    docstring) — callers get pipeline backward for free from jax.grad, at
+    GPipe's O(M) residual memory. For the bounded-memory hand-scheduled
+    alternative see `schedule_1f1b`.
     """
     n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -96,52 +121,213 @@ def gpipe_schedule(stage_fn: Callable, n_microbatch: int, axis_name: str):
     return run
 
 
+def schedule_1f1b(embed_fn: Callable, stage_fn: Callable,
+                  head_loss_fn: Callable, eparams, sparams, hparams,
+                  x_stack, y_stack, axis_name: str = "pp",
+                  n_chunks: int = 1):
+    """Hand-scheduled 1F1B/interleaved pipeline. Call INSIDE shard_map over
+    `axis_name` (pp). One `lax.scan` over M + 2(pp·v − 1) combined ticks;
+    every tick runs one forward lane and one backward lane per chunk, so a
+    microbatch's backward begins the tick after its forward clears the last
+    logical stage — the steady state is exactly 1-forward-1-backward.
+
+      embed_fn(eparams, x_mb, m)        -> act        (stage-0 entry)
+      stage_fn(chunk_leaves, act, tick) -> act        (shape-preserving)
+      head_loss_fn(hparams, act, y_mb, m) -> scalar   (mean over microbatch)
+
+    `sparams` leaves are this device's stacked layers (L_local, ...); with
+    `n_chunks=v>1` chunk c (rows [c·L_local/v, (c+1)·L_local/v)) acts as
+    logical stage c·pp+idx (interleaved schedule — the trainer's
+    `_stack_order` lays cell params out in this order). Backward re-derives
+    each tick's vjp from the stashed stage INPUT (ring buffer of
+    S = 2·pp·v − 1 slots per chunk), so the scan carries O(pp·v) activations
+    independent of M — the bounded-memory property GPipe's transposed scan
+    lacks. Gradients are masked `jnp.where` sums over microbatches; inactive
+    lanes compute on zeros/clamped indices and contribute nothing.
+
+    Returns (loss_sum, grads_embed, grads_stages, grads_head) as
+    MICROBATCH SUMS, nonzero only on the owning stage (loss/head: last
+    stage; embed: stage 0; stages: local rows). Caller divides by M and
+    psums the replicated groups over pp.
+    """
+    n = _axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    v = n_chunks
+    nv = n * v
+    M = x_stack.shape[0]
+    T = M + 2 * (nv - 1)
+    S = 2 * nv - 1
+    Lc = sparams[0].shape[0] // v
+
+    def chunk(c):
+        return [w[c * Lc:(c + 1) * Lc] for w in sparams]
+
+    # activation template: one embed fixes shape/dtype for the carries (the
+    # value itself is dead — XLA removes the computation)
+    act0 = embed_fn(eparams, x_stack[0], jnp.int32(0))
+    zact = jnp.zeros(act0.shape, act0.dtype)
+
+    def tick(carry, t):
+        fwd_recv, bwd_recv, stash, ge, gs, gh, lsum = carry
+        ys_f, new_stash = [], []
+        # ---- forward lane: one microbatch per chunk enters/advances ----
+        for c in range(v):
+            ls = c * n + idx          # logical stage of this chunk
+            mf = t - ls               # microbatch in this chunk's forward
+            f_act = jnp.logical_and(mf >= 0, mf < M)
+            mf_cl = jnp.clip(mf, 0, M - 1)
+            if c == 0:
+                h_emb = embed_fn(eparams, x_stack[mf_cl], mf_cl)
+                x_in = jnp.where(idx == 0, h_emb, fwd_recv[0])
+            else:
+                x_in = jnp.where(idx == 0, fwd_recv[c - 1], fwd_recv[c])
+            yc = stage_fn(chunk(c), x_in, mf_cl + ls)
+            ys_f.append(jnp.where(f_act, yc, jnp.zeros_like(yc)))
+            upd = lax.dynamic_update_index_in_dim(stash[c], x_in,
+                                                  mf_cl % S, 0)
+            new_stash.append(jnp.where(f_act, upd, stash[c]))
+        # ---- backward lane (reads new_stash: the last stage turns a
+        # microbatch around forward->backward within one tick) ----
+        dxs = []
+        gs2 = [list(g) for g in gs]
+        ge2, gh2, lsum2 = list(ge), list(gh), lsum
+        for c in range(v):
+            ls = c * n + idx
+            mb = t - 2 * (nv - 1) + ls  # microbatch in this chunk's backward
+            b_act = jnp.logical_and(mb >= 0, mb < M)
+            mb_cl = jnp.clip(mb, 0, M - 1)
+            x_saved = lax.dynamic_index_in_dim(new_stash[c], mb_cl % S, 0,
+                                               keepdims=False)
+            if c == v - 1:
+                # last chunk: the head+loss vjp seeds the cotangent on the
+                # last stage; other stages take the ring-received cotangent
+                lv, pull = jax.vjp(
+                    lambda hp, h: head_loss_fn(hp, h, y_stack[mb_cl], mb_cl),
+                    hparams, ys_f[v - 1])
+                gh_c, seed = pull(jnp.ones_like(lv))
+                on_last = jnp.logical_and(b_act, idx == n - 1)
+                gh2 = [a + jnp.where(on_last, g, 0)
+                       for a, g in zip(gh2, gh_c)]
+                lsum2 = lsum2 + jnp.where(on_last, lv, jnp.zeros_like(lv))
+                out_cot = jnp.where(idx == n - 1, seed, bwd_recv[v - 1])
+            else:
+                out_cot = jnp.where(idx == n - 1, bwd_recv[c + 1],
+                                    bwd_recv[c])
+            _, pull_s = jax.vjp(
+                lambda ps, h: stage_fn(ps, h, mb_cl + ls), chunk(c), x_saved)
+            gw, dx = pull_s(out_cot)
+            gs2[c] = [a + jnp.where(b_act, g, 0) for a, g in zip(gs2[c], gw)]
+            dx = jnp.where(b_act, dx, jnp.zeros_like(dx))
+            if c == 0:
+                # chunk 0 on stage 0 owns the embed: pull dx back through it
+                _, pull_e = jax.vjp(
+                    lambda ep: embed_fn(ep, x_stack[mb_cl], mb_cl), eparams)
+                (ge_c,) = pull_e(dx)
+                on_first = jnp.logical_and(b_act, idx == 0)
+                ge2 = [a + jnp.where(on_first, g, 0)
+                       for a, g in zip(ge2, ge_c)]
+            dxs.append(dx)
+        perm_f = [(i, (i + 1) % n) for i in range(n)]
+        perm_b = [(i, (i - 1) % n) for i in range(n)]
+        fwd_next = lax.ppermute(jnp.stack(ys_f), axis_name, perm_f)
+        bwd_next = lax.ppermute(jnp.stack(dxs), axis_name, perm_b)
+        return (fwd_next, bwd_next, new_stash, ge2, gs2, gh2, lsum2), None
+
+    zrecv = jnp.zeros((v,) + zact.shape, zact.dtype)
+    carry0 = (zrecv, zrecv,
+              [jnp.zeros((S,) + zact.shape, zact.dtype) for _ in range(v)],
+              [jnp.zeros_like(w) for w in eparams],
+              [[jnp.zeros_like(w) for w in chunk(c)] for c in range(v)],
+              [jnp.zeros_like(w) for w in hparams],
+              jnp.float32(0.0))
+    (_, _, _, ge, gs, gh, lsum), _ = lax.scan(tick, carry0, jnp.arange(T))
+    gs_cat = [jnp.concatenate([gs[c][i] for c in range(v)])
+              for i in range(len(sparams))]
+    return lsum, ge, gs_cat, gh
+
+
 class PipelineTrainer:
     """Fused pipeline-parallel trainer (optionally composed with data
-    parallelism over a 'dp' mesh axis).
+    parallelism over a 'dp' axis, weight-sharded tensor parallelism over a
+    'tp' axis, and the ZeRO-style sharded update over 'dp').
 
     `net` must expose `pipeline_split() -> (embed, cells, head)` where
     `cells` are structurally identical stateless HybridBlocks (transformer
     encoder layers — models/bert.py grows this method). Cell parameters are
     stacked layerwise into (n_layers, ...) arrays sharded over 'pp'
-    (layers_per_stage = n_layers / pp); embed and head stay replicated, with
-    their gradients psum'd over 'pp' (only stage 0 / the last stage produce
+    (`_stack_order` permutes rows so each device's v interleaved chunks are
+    contiguous); embed and head stay replicated over pp, with their
+    gradients psum'd over 'pp' (only stage 0 / the last stage produce
     nonzero contributions — the psum is the sync that keeps the replicas
     identical).
 
-    One jit computes: embed -> circular GPipe schedule (pipeline_apply) ->
-    head -> loss -> reverse-schedule backward -> optimizer update, with the
-    cross-'dp' gradient pmean inserted explicitly when dp > 1. `loss` must be
-    a mean-reduction callable (pred_raw, label_raw) -> scalar so microbatch
-    splitting leaves the math identical to a full-batch step.
+    `schedule='1f1b'` (default, MXNET_TPU_PP_SCHEDULE) runs the
+    bounded-memory hand-scheduled 1F1B program; `schedule='gpipe'` keeps the
+    grad-of-scan transpose. `virtual_stages=v>1` (1F1B only) interleaves v
+    layer chunks per device to shrink the pipeline bubble. Frozen
+    (grad_req='null') embed/head/cell params skip their update lanes.
+
+    Composition (docs/pipeline_parallel.md):
+      - dp_axis:        grads pmean'd over dp (or reduce-scattered, below)
+      - zero_update:    ZeRO sharded update over dp — stage buckets carry
+                        per-stage (n_stages, padded) state sharded
+                        P(pp, dp); requires dp_axis, excludes tp_axis
+      - comm_dtype:     bf16/int8 wire for the zero reduce-scatter
+      - tp_axis:        leaves with Parameter.sharding specs over 'tp' are
+                        STORED sharded (1/tp weight+state memory),
+                        all-gathered once per step outside the
+                        differentiated region, grads sliced back for the
+                        local update lane. Compute-partitioned (Megatron)
+                        TP stays on DataParallelTrainer's auto-sharding jit.
+
+    One jit computes: embed -> schedule -> head -> loss -> backward ->
+    collectives -> optimizer update. `loss` must be a mean-reduction
+    callable (pred_raw, label_raw) -> scalar so microbatch splitting leaves
+    the math identical to a full-batch step.
     """
 
     def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
                  mesh: Optional[Mesh] = None, num_microbatch: Optional[int] = None,
                  pp_axis: str = "pp", dp_axis: Optional[str] = None,
-                 dtype=None, remat: bool = True):
+                 tp_axis: Optional[str] = None, dtype=None, remat: bool = True,
+                 schedule: Optional[str] = None, virtual_stages: int = 1,
+                 zero_update: Optional[bool] = None,
+                 bucket_bytes: Optional[int] = None, comm_dtype=None):
         from .data_parallel import functional_optimizer, _make_apply_fn
         self.net = net
         self.loss = loss
         self.mesh = mesh if mesh is not None else current_mesh()
-        if pp_axis not in self.mesh.shape:
-            raise MXNetError(f"mesh has no {pp_axis!r} axis: {self.mesh.shape}")
-        if dp_axis is not None and dp_axis not in self.mesh.shape:
-            raise MXNetError(f"mesh has no {dp_axis!r} axis: {self.mesh.shape}")
-        self.pp_axis, self.dp_axis = pp_axis, dp_axis
-        self.n_stages = self.mesh.shape[pp_axis]
-        self.n_dp = self.mesh.shape[dp_axis] if dp_axis else 1
+        self.n_stages = require_axis(self.mesh, pp_axis, "pipeline stages")
+        self.pp_axis, self.dp_axis, self.tp_axis = pp_axis, dp_axis, tp_axis
+        self.n_dp = require_axis(self.mesh, dp_axis, "data parallelism") \
+            if dp_axis else 1
+        self.n_tp = require_axis(self.mesh, tp_axis, "tensor parallelism") \
+            if tp_axis else 1
         self.remat = remat
+
+        if schedule is None:
+            schedule = env.get("MXNET_TPU_PP_SCHEDULE") or "1f1b"
+        if schedule not in ("1f1b", "gpipe"):
+            raise MXNetError(f"unknown pipeline schedule {schedule!r}; "
+                             "use '1f1b' or 'gpipe'")
+        self.schedule = schedule
+        self.virtual_stages = int(virtual_stages)
+        if self.virtual_stages < 1:
+            raise MXNetError("virtual_stages must be >= 1")
+        if self.virtual_stages > 1 and schedule != "1f1b":
+            raise MXNetError("virtual_stages (interleaved schedule) "
+                             "requires schedule='1f1b'")
 
         if not hasattr(net, "pipeline_split"):
             raise MXNetError(
                 f"{type(net).__name__} has no pipeline_split(); implement it "
                 "returning (embed_block, identical_cells, head_block)")
         embed, cells, head = net.pipeline_split()
-        if len(cells) % self.n_stages != 0:
+        nv = self.n_stages * self.virtual_stages
+        if len(cells) % nv != 0:
             raise MXNetError(
                 f"{len(cells)} layers do not divide into {self.n_stages} "
-                "pipeline stages")
+                f"pipeline stages x {self.virtual_stages} virtual chunks")
         self.n_layers = len(cells)
         self.layers_per_stage = self.n_layers // self.n_stages
 
@@ -163,11 +349,48 @@ class PipelineTrainer:
                     for a, b in zip(cp, ref)):
                 raise MXNetError(f"cell {j} is not structurally identical to "
                                  "cell 0; pipeline stages must be homogeneous")
-        all_cell_params = [p for cp in self._cell_plists for p in cp]
-        for p in self._embed_plist + self._head_plist + all_cell_params:
-            if p.grad_req == "null":
-                raise MXNetError("frozen (grad_req='null') parameters are not "
-                                 "supported in PipelineTrainer yet")
+        # frozen (grad_req='null') params skip their update lanes; a stacked
+        # cell leaf must be uniformly frozen across cells (one update lane
+        # serves all layers of the leaf)
+        self._tr_e = [p.grad_req != "null" for p in self._embed_plist]
+        self._tr_h = [p.grad_req != "null" for p in self._head_plist]
+        self._tr_s = [ref[i].grad_req != "null" for i in range(len(ref))]
+        for cp in self._cell_plists[1:]:
+            for i, p in enumerate(cp):
+                if (p.grad_req != "null") != self._tr_s[i]:
+                    raise MXNetError(
+                        f"cell param {ref[i].name!r} is frozen in some "
+                        "layers but not others; freeze a stacked leaf "
+                        "uniformly across cells")
+
+        # manual weight-sharded TP: which dim of each leaf is sharded
+        if tp_axis is not None:
+            self._tp_e = [tp_shard_dim(p.sharding, tp_axis)
+                          for p in self._embed_plist]
+            self._tp_h = [tp_shard_dim(p.sharding, tp_axis)
+                          for p in self._head_plist]
+            self._tp_s = [tp_shard_dim(ref[i].sharding, tp_axis)
+                          for i in range(len(ref))]
+            for cp in self._cell_plists[1:]:
+                for i, p in enumerate(cp):
+                    if tp_shard_dim(p.sharding, tp_axis) != self._tp_s[i]:
+                        raise MXNetError(
+                            f"cell param {ref[i].name!r} carries different "
+                            "tp specs across cells; stacked leaves must "
+                            "shard uniformly")
+            for plist, dims in ((self._embed_plist, self._tp_e),
+                                (self._head_plist, self._tp_h),
+                                (ref, self._tp_s)):
+                for p, d in zip(plist, dims):
+                    if d is not None and \
+                            p._data._data.shape[d] % self.n_tp != 0:
+                        raise MXNetError(
+                            f"{p.name!r} dim {d} ({p._data._data.shape[d]}) "
+                            f"does not divide by tp={self.n_tp}")
+        else:
+            self._tp_e = [None] * len(self._embed_plist)
+            self._tp_h = [None] * len(self._head_plist)
+            self._tp_s = [None] * len(ref)
 
         self._embed_apply = _make_apply_fn(embed, self._embed_plist, train=True)
         self._cell_apply = _make_apply_fn(cells[0], ref, train=True)
@@ -188,24 +411,58 @@ class PipelineTrainer:
             num_microbatch = self.n_stages
         self.num_microbatch = num_microbatch
 
+        if zero_update is None:
+            zero_update = bool(env.get("MXNET_TPU_ZERO"))
+        self._zero = bool(zero_update)
+        self._bucket_bytes = int(bucket_bytes if bucket_bytes is not None
+                                 else env.get("MXNET_TPU_BUCKET_BYTES"))
+        if comm_dtype is None:
+            comm_dtype = env.get("MXNET_TPU_COMM_DTYPE") or None
+        self._comm_dtype = _zero.canonical_comm_dtype(comm_dtype) \
+            if self._zero else None
+        if self._zero:
+            self._validate_zero()
+        if tp_axis is not None:
+            from ..optimizer.optimizer import LAMB, LARS
+            if isinstance(self.optimizer, (LAMB, LARS)):
+                raise MXNetError(
+                    f"weight-sharded tp does not support "
+                    f"{type(self.optimizer).__name__}: per-tensor "
+                    "trust-ratio norms are wrong on tp shards")
+
+        # interleaved stacking: global row s*L_dev + c*Lc + j holds the
+        # params of logical stage c*pp+s, layer j (identity when v == 1)
+        Ld, v = self.layers_per_stage, self.virtual_stages
+        Lc = Ld // v
+        self._stack_order = [(c * self.n_stages + s) * Lc + j
+                             for s in range(self.n_stages)
+                             for c in range(v) for j in range(Lc)]
+
         rep = NamedSharding(self.mesh, P())
-        stk = NamedSharding(self.mesh, P(pp_axis))
-        self._e_raw = [jax.device_put(jnp.array(p._data._data, copy=True), rep)
-                       for p in self._embed_plist]
-        self._h_raw = [jax.device_put(jnp.array(p._data._data, copy=True), rep)
-                       for p in self._head_plist]
-        # layerwise stack: leaf i -> (n_layers, ...) sharded over pp
+
+        def _leaf_sharding(dim, ndim, stacked):
+            spec = [None] * (ndim + (1 if stacked else 0))
+            if stacked:
+                spec[0] = pp_axis
+            if dim is not None:
+                spec[dim + (1 if stacked else 0)] = tp_axis
+            return NamedSharding(self.mesh, P(*spec))
+
+        self._e_sh = [_leaf_sharding(d, p._data._data.ndim, False)
+                      for p, d in zip(self._embed_plist, self._tp_e)]
+        self._h_sh = [_leaf_sharding(d, p._data._data.ndim, False)
+                      for p, d in zip(self._head_plist, self._tp_h)]
+        self._s_sh = [_leaf_sharding(d, ref[i]._data._data.ndim, True)
+                      for i, d in enumerate(self._tp_s)]
+        self._e_raw = [jax.device_put(jnp.array(p._data._data, copy=True), sh)
+                       for p, sh in zip(self._embed_plist, self._e_sh)]
+        self._h_raw = [jax.device_put(jnp.array(p._data._data, copy=True), sh)
+                       for p, sh in zip(self._head_plist, self._h_sh)]
+        # layerwise stack in schedule order: leaf i -> (n_layers, ...)
         self._s_raw = [
-            jax.device_put(jnp.stack([cp[i]._data._data
-                                      for cp in self._cell_plists]), stk)
-            for i in range(len(ref))]
-        self._opt_e = [jax.device_put(self._init_fn(w), rep)
-                       for w in self._e_raw]
-        self._opt_h = [jax.device_put(self._init_fn(w), rep)
-                       for w in self._h_raw]
-        self._opt_s = [jax.tree_util.tree_map(
-            lambda l: jax.device_put(l, stk), self._init_fn(w))
-            for w in self._s_raw]
+            jax.device_put(jnp.stack([self._cell_plists[m][i]._data._data
+                                      for m in self._stack_order]), sh)
+            for i, sh in enumerate(self._s_sh)]
         # weight-decay indices follow the optimizer's param-idx convention:
         # embed params first, then the stacked cell leaves, then head
         nE, nS = len(self._e_raw), len(self._s_raw)
@@ -213,13 +470,122 @@ class PipelineTrainer:
         self._wd_s = [self.optimizer._get_wd(nE + i) for i in range(nS)]
         self._wd_h = [self.optimizer._get_wd(nE + nS + i)
                       for i in range(len(self._h_raw))]
+        if self._zero:
+            self._init_zero_state()
+        else:
+            def _state(w, sh, tr):
+                if not tr:
+                    return ()
+                return jax.tree_util.tree_map(
+                    lambda l: jax.device_put(l, sh), self._init_fn(w))
+            self._opt_e = [_state(w, sh, tr) for w, sh, tr in
+                           zip(self._e_raw, self._e_sh, self._tr_e)]
+            self._opt_h = [_state(w, sh, tr) for w, sh, tr in
+                           zip(self._h_raw, self._h_sh, self._tr_h)]
+            self._opt_s = [_state(w, sh, tr) for w, sh, tr in
+                           zip(self._s_raw, self._s_sh, self._tr_s)]
         self._t = 0
         # bounded in-flight dispatch window (engine/async_feed), same
         # contract as DataParallelTrainer: step() stays non-blocking
         self._window = _feed.DispatchWindow(name="pp")
-        self._step_jit = {}
-        self._step_cost = {}
-        self._region_cache = {}  # sig -> roofline ledger row key
+        self._comm_cache = {}   # sig -> (ppermute bytes, calls)
+        self._rs_bytes = None
+        self._ag_bytes = None
+        self._opt_bytes = None
+        # process-wide engine-cache key base: N trainers over one model
+        # structure and configuration share compiled step artifacts; any
+        # change to schedule/microbatching/parallel axes/zero/precision
+        # compiles apart (docs/compilation.md "fused-step fingerprints")
+        self._step_key_base = (
+            "pp_step",
+            _engine.structural_fingerprint(net),
+            _engine.config_fingerprint(
+                optimizer=type(self.optimizer).__name__,
+                opt_conf=tuple(sorted(
+                    (k, repr(v)) for k, v in vars(self.optimizer).items()
+                    if isinstance(v, (int, float, bool, str, type(None))))),
+                wds=tuple(float(w) for w in
+                          self._wd_e + self._wd_s + self._wd_h),
+                loss=self.loss,
+                mesh=tuple(sorted(dict(self.mesh.shape).items())),
+                axis_order=tuple(self.mesh.axis_names),
+                devices=tuple(int(d.id) for d in self.mesh.devices.flat),
+                pp_axis=pp_axis, dp_axis=dp_axis, tp_axis=tp_axis,
+                schedule=self.schedule,
+                virtual_stages=self.virtual_stages,
+                num_microbatch=self.num_microbatch,
+                remat=self.remat,
+                trainable=(tuple(self._tr_e), tuple(self._tr_s),
+                           tuple(self._tr_h)),
+                tp_dims=(tuple(self._tp_e), tuple(self._tp_s),
+                         tuple(self._tp_h)),
+                compute_dtype=str(self.compute_dtype),
+                zero=self._zero,
+                bucket_bytes=self._bucket_bytes if self._zero else None,
+                comm_dtype=self._comm_dtype))
+        self._program = StepProgram(
+            f"pp.step[{type(self.net).__name__}]", self._step_key_base)
+
+    # -- ZeRO-over-dp composition -------------------------------------------
+    def _validate_zero(self):
+        if self.dp_axis is None:
+            raise MXNetError("zero_update requires a dp_axis: the sharded "
+                             "update distributes over data-parallel replicas")
+        if self.tp_axis is not None:
+            raise MXNetError("zero_update and tp_axis do not compose in "
+                             "PipelineTrainer; pick one memory-sharding axis")
+        from ..optimizer.optimizer import LAMB, LARS
+        if isinstance(self.optimizer, (LAMB, LARS)):
+            raise MXNetError(
+                f"zero_update does not support "
+                f"{type(self.optimizer).__name__}: its per-tensor "
+                "trust-ratio norms do not decompose over flat bucket "
+                "shards; use sgd/adam/adamw/...")
+
+    def _init_zero_state(self):
+        """Fusion-bucket plans + dp-sharded optimizer state for the three
+        parameter groups. Embed/head buckets mirror the dp trainer exactly
+        ((padded,) state sharded P(dp)); stage buckets are planned over the
+        LOCAL stacked shapes (identical plan on every stage) with per-stage
+        state stacked into (n_stages, padded) arrays sharded P(pp, dp) —
+        each (pp, dp) group holds 1/(dp) of its own stage's state."""
+        dp_sh = NamedSharding(self.mesh, P(self.dp_axis))
+        stg_sh = NamedSharding(self.mesh, P(self.pp_axis, self.dp_axis))
+        ndp, Ld = self.n_dp, self.layers_per_stage
+
+        def _plan(params, trainables, shapes=None):
+            entries = [(i, shapes[i] if shapes else w.shape, w.dtype)
+                       for i, (w, tr) in enumerate(zip(params, trainables))
+                       if tr and jnp.issubdtype(w.dtype, jnp.floating)]
+            return _zero.plan_buckets(entries, ndp, self._bucket_bytes)
+
+        def _flat_carry(plan, params, wds):
+            carry = []
+            for b in plan:
+                flat_w = _zero.flatten_bucket(b, params)
+                state = opt_mod.init_functional_state(self._init_fn, flat_w,
+                                                      sharding=dp_sh)
+                wd_dev = jax.device_put(_zero.wd_vector(b, wds), dp_sh)
+                carry.append((wd_dev, state))
+            return tuple(carry)
+
+        self._zplan_e = _plan(self._e_raw, self._tr_e)
+        self._zplan_h = _plan(self._h_raw, self._tr_h)
+        self._opt_e = _flat_carry(self._zplan_e, self._e_raw, self._wd_e)
+        self._opt_h = _flat_carry(self._zplan_h, self._h_raw, self._wd_h)
+        local_shapes = [(Ld,) + w.shape[1:] for w in self._s_raw]
+        self._zplan_s = _plan(self._s_raw, self._tr_s, shapes=local_shapes)
+        carry_s = []
+        for b in self._zplan_s:
+            rows = [_zero.flatten_bucket(
+                        b, [w[s * Ld:(s + 1) * Ld] for w in self._s_raw])
+                    for s in range(self.n_stages)]
+            w_glob = jax.device_put(jnp.stack(rows), stg_sh)
+            state = opt_mod.init_functional_state(self._init_fn, w_glob,
+                                                  sharding=stg_sh)
+            wd_dev = jax.device_put(_zero.wd_vector(b, self._wd_s), dp_sh)
+            carry_s.append((wd_dev, state))
+        self._opt_s = tuple(carry_s)
 
     # ------------------------------------------------------------------
     def _loss_raw(self, pred_raw, label_raw):
@@ -232,10 +598,15 @@ class PipelineTrainer:
         head_apply = self._head_apply
         update_fn = self._update_fn
         loss_raw = self._loss_raw
-        mesh, ppax, dpax = self.mesh, self.pp_axis, self.dp_axis
-        n_stages, L, M = self.n_stages, self.layers_per_stage, self.num_microbatch
+        mesh = self.mesh
+        ppax, dpax, tpax = self.pp_axis, self.dp_axis, self.tp_axis
+        n_stages, M = self.n_stages, self.num_microbatch
+        v = self.virtual_stages
         wd_e, wd_s, wd_h = self._wd_e, self._wd_s, self._wd_h
-        remat = self.remat
+        tr_e, tr_s, tr_h = self._tr_e, self._tr_s, self._tr_h
+        tp_e, tp_s, tp_h = self._tp_e, self._tp_s, self._tp_h
+        sched, remat = self.schedule, self.remat
+        zero, ndp, comm = self._zero, self.n_dp, self._comm_dtype
         cdt = self.compute_dtype
 
         def _low(a):
@@ -261,41 +632,84 @@ class PipelineTrainer:
             kk = jax.random.fold_in(kk, idx)
             if dpax is not None:
                 kk = jax.random.fold_in(kk, lax.axis_index(dpax))
+            # deliberately NOT folded over tp: ranks must draw identical
+            # dropout masks so the replicated compute (and the grads being
+            # sliced back per rank) stays bitwise identical
+
+            # weight-sharded tp leaves: gather to full size ONCE per step,
+            # OUTSIDE the differentiated region — grads w.r.t. the gathered
+            # arrays come out rank-identical, no gradient collective needed
+            if tpax is not None:
+                ep_f = [gather_tp(w, d, tpax) if d is not None else w
+                        for w, d in zip(eparams, tp_e)]
+                hp_f = [gather_tp(w, d, tpax) if d is not None else w
+                        for w, d in zip(hparams, tp_h)]
+                sp_f = [gather_tp(w, d + 1, tpax) if d is not None else w
+                        for w, d in zip(sparams, tp_s)]
+            else:
+                ep_f, sp_f, hp_f = eparams, sparams, hparams
 
             def stage_fn(params_local, h, tick):
                 # fold (tick, layer) so each microbatch draws fresh dropout
                 # masks — tick advances per microbatch in the schedule
                 kt = jax.random.fold_in(kk, tick)
+                low = [_low(q) for q in params_local]
+                nloc = params_local[0].shape[0]
 
                 def cell_body(hc, xs):
                     lp, li = xs
                     klayer = jax.random.key_data(jax.random.fold_in(kt, li))
                     return _no_aux(cell_apply(klayer, lp, hc), "cell"), None
-                out, _ = lax.scan(cell_body, h, (params_local, jnp.arange(L)))
+                out, _ = lax.scan(cell_body, h, (low, jnp.arange(nloc)))
                 return out
 
-            def lossf(ep, sp, hp):
-                k_e = jax.random.key_data(jax.random.fold_in(kk, 10_000))
-                k_h = jax.random.key_data(jax.random.fold_in(kk, 10_001))
-                xf = x.reshape((-1,) + x.shape[2:])
-                h = _no_aux(embed_apply(k_e, [_low(p) for p in ep], xf),
-                            "embed block")
-                h = h.reshape((M, -1) + h.shape[1:])
-                out = pipeline_apply(
-                    lambda p, hx, t_: stage_fn([_low(q) for q in p], hx, t_),
-                    sp, h, axis_name=ppax, remat=remat)
-                of = out.reshape((-1,) + out.shape[2:])
-                logits = _no_aux(head_apply(k_h, [_low(p) for p in hp], of),
-                                 "head block")
-                lossv = loss_raw(logits, y.reshape((-1,) + y.shape[2:]))
-                # only the last stage saw real activations. The mask must be
-                # a plain where — NOT a psum: collectives inside the
-                # differentiated scalar would re-psum the per-device
-                # cotangent seeds and inflate every gradient by n_stages.
-                return jnp.where(idx == n_stages - 1, lossv, 0.0)
+            if sched == "1f1b":
+                def embed_mb(ep, xm, m):
+                    k_e = jax.random.key_data(jax.random.fold_in(
+                        jax.random.fold_in(kk, 10_000), m))
+                    return _no_aux(embed_apply(k_e, [_low(p) for p in ep],
+                                               xm), "embed block")
 
-            lossv, (ge, gs, gh) = jax.value_and_grad(
-                lossf, argnums=(0, 1, 2))(eparams, sparams, hparams)
+                def head_loss_mb(hp, h, ym, m):
+                    k_h = jax.random.key_data(jax.random.fold_in(
+                        jax.random.fold_in(kk, 10_001), m))
+                    logits = _no_aux(head_apply(k_h, [_low(p) for p in hp],
+                                                h), "head block")
+                    return loss_raw(logits, ym)
+
+                lsum, ge, gs, gh = schedule_1f1b(
+                    embed_mb, stage_fn, head_loss_mb, ep_f, sp_f, hp_f,
+                    x, y, axis_name=ppax, n_chunks=v)
+                # microbatch sums -> batch means (equal microbatch sizes)
+                lossv = lsum / M
+                ge = [g / M for g in ge]
+                gs = [g / M for g in gs]
+                gh = [g / M for g in gh]
+            else:
+                def lossf(ep, sp, hp):
+                    k_e = jax.random.key_data(
+                        jax.random.fold_in(kk, 10_000))
+                    k_h = jax.random.key_data(
+                        jax.random.fold_in(kk, 10_001))
+                    xf = x.reshape((-1,) + x.shape[2:])
+                    h = _no_aux(embed_apply(k_e, [_low(p) for p in ep], xf),
+                                "embed block")
+                    h = h.reshape((M, -1) + h.shape[1:])
+                    out = pipeline_apply(stage_fn, sp, h, axis_name=ppax,
+                                         remat=remat)
+                    of = out.reshape((-1,) + out.shape[2:])
+                    logits = _no_aux(head_apply(k_h, [_low(p) for p in hp],
+                                                of), "head block")
+                    lossv = loss_raw(logits, y.reshape((-1,) + y.shape[2:]))
+                    # only the last stage saw real activations. The mask
+                    # must be a plain where — NOT a psum: collectives inside
+                    # the differentiated scalar would re-psum the per-device
+                    # cotangent seeds and inflate every gradient by
+                    # n_stages.
+                    return jnp.where(idx == n_stages - 1, lossv, 0.0)
+
+                lossv, (ge, gs, gh) = jax.value_and_grad(
+                    lossf, argnums=(0, 1, 2))(ep_f, sp_f, hp_f)
             # loss reporting + replica sync happen OUTSIDE the grad: psum
             # selects the last stage's loss and broadcasts it; embed grads
             # live on stage 0 and head grads on the last stage, so psum over
@@ -305,31 +719,94 @@ class PipelineTrainer:
                 lossv = lax.pmean(lossv, dpax)
             ge = [lax.psum(g, ppax) for g in ge]
             gh = [lax.psum(g, ppax) for g in gh]
-            if dpax is not None:
+            if dpax is not None and not zero:
+                # zero mode skips the pmean: the bucket reduce-scatter (+/ndp)
+                # below IS the dp mean
                 ge = [lax.pmean(g, dpax) for g in ge]
                 gs = [lax.pmean(g, dpax) for g in gs]
                 gh = [lax.pmean(g, dpax) for g in gh]
+            if tpax is not None:
+                # grads are rank-identical over tp; each rank updates its
+                # own weight shard from its slice — no collective
+                ge = [slice_tp(g, d, tpax) if d is not None else g
+                      for g, d in zip(ge, tp_e)]
+                gh = [slice_tp(g, d, tpax) if d is not None else g
+                      for g, d in zip(gh, tp_h)]
+                gs = [slice_tp(g, d + 1, tpax) if d is not None else g
+                      for g, d in zip(gs, tp_s)]
 
-            def upd(grads, params, states, wds):
-                new_p, new_s = [], []
-                for g, w, s, wd in zip(grads, params, states, wds):
-                    w2, s2 = update_fn(g, w, s, t, lr, jnp.float32(wd))
-                    new_p.append(w2.astype(w.dtype))
-                    new_s.append(s2)
-                return new_p, new_s
+            if zero:
+                pos = lax.axis_index(dpax)
 
-            eparams, opt_e = upd(ge, eparams, opt_e, wd_e)
-            sparams, opt_s = upd(gs, sparams, opt_s, wd_s)
-            hparams, opt_h = upd(gh, hparams, opt_h, wd_h)
+                def zupd(plan, grads, params, carry, stage_state):
+                    new_p, new_c = list(params), []
+                    for b, (wd_vec, st) in zip(plan, carry):
+                        stl = jax.tree_util.tree_map(
+                            lambda a: a[0], st) if stage_state else st
+                        flat_g = _zero.flatten_bucket(b, grads)
+                        g_sh = _zero.reduce_scatter_bucket(
+                            flat_g, dpax, ndp, comm) / ndp
+                        w_sh = _zero.shard_slice(
+                            b, _zero.flatten_bucket(b, params), pos)
+                        w2, s2 = update_fn(g_sh.astype(w_sh.dtype), w_sh,
+                                           stl, t, lr, wd_vec)
+                        full = _zero.all_gather_bucket(
+                            w2.astype(w_sh.dtype), dpax)
+                        for i, arr in _zero.unflatten_bucket(b, full):
+                            new_p[i] = arr.astype(params[i].dtype)
+                        if stage_state:
+                            s2 = jax.tree_util.tree_map(
+                                lambda a: a[None], s2)
+                        new_c.append((wd_vec, s2))
+                    return new_p, tuple(new_c)
+
+                eparams, opt_e = zupd(self._zplan_e, ge, eparams, opt_e,
+                                      False)
+                hparams, opt_h = zupd(self._zplan_h, gh, hparams, opt_h,
+                                      False)
+                sparams, opt_s = zupd(self._zplan_s, gs, sparams, opt_s,
+                                      True)
+            else:
+                def upd(grads, params, states, wds, trainables):
+                    new_p, new_s = [], []
+                    for g, w, s, wd, tr in zip(grads, params, states, wds,
+                                               trainables):
+                        if not tr:
+                            new_p.append(w)
+                            new_s.append(s)
+                            continue
+                        w2, s2 = update_fn(g, w, s, t, lr, jnp.float32(wd))
+                        new_p.append(w2.astype(w.dtype))
+                        new_s.append(s2)
+                    return new_p, new_s
+
+                eparams, opt_e = upd(ge, eparams, opt_e, wd_e, tr_e)
+                sparams, opt_s = upd(gs, sparams, opt_s, wd_s, tr_s)
+                hparams, opt_h = upd(gh, hparams, opt_h, wd_h, tr_h)
             return eparams, sparams, hparams, opt_e, opt_s, opt_h, lossv
 
-        rep, stk = P(), P(ppax)
+        e_in = [sh.spec for sh in self._e_sh]
+        s_in = [sh.spec for sh in self._s_sh]
+        h_in = [sh.spec for sh in self._h_sh]
+        if zero:
+            opt_e_in = tuple(
+                (P(dpax), jax.tree_util.tree_map(lambda _: P(dpax), st))
+                for (_, st) in self._opt_e)
+            opt_h_in = tuple(
+                (P(dpax), jax.tree_util.tree_map(lambda _: P(dpax), st))
+                for (_, st) in self._opt_h)
+            opt_s_in = tuple(
+                (P(dpax), jax.tree_util.tree_map(lambda _: P(ppax, dpax), st))
+                for (_, st) in self._opt_s)
+        else:
+            opt_e_in, opt_s_in, opt_h_in = e_in, s_in, h_in
         data = P(None, dpax) if dpax is not None else P(None)
-        from .zero import shard_map_compat
-        return shard_map_compat(
+        rep = P()
+        return _zero.shard_map_compat(
             body, mesh=mesh,
-            in_specs=(rep, stk, rep, rep, stk, rep, rep, data, data, rep, rep),
-            out_specs=(rep, stk, rep, rep, stk, rep, rep))
+            in_specs=(e_in, s_in, h_in, opt_e_in, opt_s_in, opt_h_in,
+                      rep, data, data, rep, rep),
+            out_specs=(e_in, s_in, h_in, opt_e_in, opt_s_in, opt_h_in, rep))
 
     def step(self, x, y):
         """One fused pipeline-parallel training step on a global batch."""
@@ -346,11 +823,12 @@ class PipelineTrainer:
         xr = xr.reshape((M, B // M) + xr.shape[1:])
         yr = yr.reshape((M, B // M) + yr.shape[1:])
         sig = (xr.shape, str(xr.dtype), yr.shape, str(yr.dtype))
-        fn = self._step_jit.get(sig)
-        if fn is None:
-            fn = jax.jit(self._build_step(),
-                         donate_argnums=(0, 1, 2, 3, 4, 5))
-            self._step_jit[sig] = fn
+        # engine cache owns the executable: same-config trainers share one
+        # compile (engine.cache_stats()["compiles"] stays flat on the 2nd)
+        fn = self._program.get(
+            (sig,),
+            lambda: jax.jit(self._build_step(),
+                            donate_argnums=(0, 1, 2, 3, 4, 5)))
         self._t += 1
         self.optimizer.num_update = self._t
         lr = _np.float32(self.optimizer.learning_rate)
@@ -367,9 +845,7 @@ class PipelineTrainer:
             NamedSharding(self.mesh, P()))
         call_args = (self._e_raw, self._s_raw, self._h_raw, self._opt_e,
                      self._opt_s, self._opt_h, key, xr, yr, lr, t_in)
-        if _telem._ENABLED and sig not in self._step_cost:
-            self._step_cost[sig] = _engine.estimate_cost(fn, *call_args,
-                                                         kind="pp_step")
+        self._program.capture_cost(sig, fn, *call_args, kind="pp_step")
         with _telem.annotate("mx.pp.step"), _sanitize.guard():
             (self._e_raw, self._s_raw, self._h_raw, self._opt_e, self._opt_s,
              self._opt_h, lossv) = fn(*call_args)
@@ -377,32 +853,93 @@ class PipelineTrainer:
         # telemetry after admission (completion-paced, sync-free)
         self._window.admit(lossv)
         if _telem._ENABLED:
-            # per-step collective volume: the embed/head grad psum over 'pp'
-            # (the stage-hop ppermute traffic is activation-shaped and
-            # schedule-dependent; the psum'd replicated params dominate)
-            if self.n_stages > 1:
-                rep_bytes = sum(int(w.nbytes) for w in
-                                self._e_raw + self._h_raw)
-                _telem.record_comm("pipeline_grad_psum", rep_bytes,
-                                   store="mesh")
-            cost = self._step_cost.get(sig, {})
-            flops = cost.get("flops")
-            region = self._region_cache.get(sig)
-            if region is None:
-                import hashlib
-                digest = hashlib.sha1(repr(("pp_step", self.n_stages,
-                                            self.num_microbatch,
-                                            sig)).encode()).hexdigest()
-                region = self._region_cache[sig] = f"pp.step#{digest[:6]}"
-            # roofline ledger + aggregate flops/bytes through the one
-            # engine funnel (after window admission: completion-paced)
-            _engine.record_execution(
-                "step", flops or 0.0,
-                bytes_accessed=cost.get("bytes_accessed", 0.0),
-                region=region, cost=cost)
-            _telem.record_step(B, source="pipeline", flops_per_step=flops,
-                               lr=float(self.optimizer.learning_rate))
+            self._record_telemetry(sig, B)
         return _feed.PendingScalar(lossv)
+
+    # -- telemetry -----------------------------------------------------------
+    def _ppermute_stats(self, sig):
+        """Per-step activation-hop volume of the schedule's ppermute rings
+        (per-replica wire bytes, both directions). One activation hops
+        M + pp·v − 1 ticks per direction under GPipe's scan (+ transpose)
+        and M + 2(pp·v − 1) under 1F1B; the interleaved variant moves a
+        v-stack per hop. Shapes come from an abstract eval of the embed —
+        no device work, cached per signature."""
+        st = self._comm_cache.get(sig)
+        if st is None:
+            x_shape, x_dtype = sig[0], sig[1]
+            out, _ = jax.eval_shape(
+                self._embed_apply,
+                jax.ShapeDtypeStruct((2,), _np.uint32),
+                [jax.ShapeDtypeStruct(w.shape, w.dtype)
+                 for w in self._e_raw],
+                jax.ShapeDtypeStruct(x_shape[1:], x_dtype))
+            h = out if not isinstance(out, tuple) else out[0]
+            itemsize = self.compute_dtype.itemsize \
+                if self.compute_dtype is not None else h.dtype.itemsize
+            act_local = int(_np.prod(h.shape)) // self.n_dp * itemsize
+            nv = self.n_stages * self.virtual_stages
+            M = self.num_microbatch
+            hops = M + 2 * (nv - 1) if self.schedule == "1f1b" \
+                else M + nv - 1
+            st = (act_local * self.virtual_stages * 2 * hops, 2 * hops)
+            self._comm_cache[sig] = st
+        return st
+
+    def _record_zero_telemetry(self):
+        if self._rs_bytes is None:
+            plans = self._zplan_e + self._zplan_s + self._zplan_h
+            self._rs_bytes = _zero.reduce_scatter_wire_bytes(
+                plans, self.n_dp, self._comm_dtype)
+            self._ag_bytes = _zero.all_gather_wire_bytes(plans, self.n_dp)
+        nb = len(self._zplan_e) + len(self._zplan_s) + len(self._zplan_h)
+        _telem.record_comm("reduce_scatter", self._rs_bytes, store="mesh",
+                           calls=nb)
+        _telem.record_comm("all_gather", self._ag_bytes, store="mesh",
+                           calls=nb)
+
+    def _opt_state_replica_bytes(self) -> int:
+        if self._opt_bytes is None:
+            tree = (self._opt_e, self._opt_s, self._opt_h)
+            if self._zero:
+                # wd vectors riding the bucket carries are hyperparameter
+                # constants, not optimizer state
+                tree = tuple([st for _, st in grp] for grp in tree)
+            self._opt_bytes = _zero.per_replica_state_bytes(tree)
+        return self._opt_bytes
+
+    def _record_telemetry(self, sig, examples):
+        cost = self._program.cost(sig)
+        flops = cost.get("flops")
+        if self.n_stages > 1:
+            # per-step collective volume: the schedule's activation-hop
+            # ppermute rings + the embed/head grad psum over 'pp'
+            pp_bytes, pp_calls = self._ppermute_stats(sig)
+            _telem.record_comm("ppermute", pp_bytes, store="mesh",
+                               calls=pp_calls)
+            rep_bytes = sum(int(w.nbytes) for w in
+                            self._e_raw + self._h_raw)
+            _telem.record_comm("pipeline_grad_psum", rep_bytes, store="mesh")
+        if self._zero:
+            self._record_zero_telemetry()
+        if self.tp_axis is not None and self.n_tp > 1:
+            # per-step weight all-gather of the tp-sharded leaves
+            # (ring estimate: (tp-1)/tp of the full footprint)
+            ag = sum(int(w.nbytes) * (self.n_tp - 1) // self.n_tp
+                     for w, d in zip(self._e_raw + self._s_raw + self._h_raw,
+                                     self._tp_e + self._tp_s + self._tp_h)
+                     if d is not None)
+            _telem.record_comm("tp_weight_all_gather", ag, store="mesh")
+        _telem.record_optimizer_state(self._opt_state_replica_bytes(),
+                                      source="pipeline")
+        # roofline ledger + aggregate flops/bytes through the one engine
+        # funnel (after window admission: completion-paced); the region is
+        # the fingerprint-derived StepProgram row, like DP
+        _engine.record_execution(
+            "step", flops or 0.0,
+            bytes_accessed=cost.get("bytes_accessed", 0.0),
+            region=self._program.region(sig), cost=cost)
+        _telem.record_step(examples, source="pipeline", flops_per_step=flops,
+                           lr=float(self.optimizer.learning_rate))
 
     def drain(self):
         """Block until every dispatched step completed (epoch/eval
@@ -411,16 +948,17 @@ class PipelineTrainer:
 
     def sync(self):
         """Write device params back into the gluon Parameters (unstacking
-        the layerwise cell stacks)."""
+        the layerwise cell stacks through `_stack_order`). Row slices are
+        device-side views — one (lazy) transfer per leaf at most, never a
+        host round-trip per layer."""
         self.drain()
         for p, w in zip(self._embed_plist, self._e_raw):
             p._data._set_data(w)
         for p, w in zip(self._head_plist, self._h_raw):
             p._data._set_data(w)
         for i, w in enumerate(self._s_raw):
-            host = _np.asarray(w)
-            for j, cp in enumerate(self._cell_plists):
-                cp[i]._data._set_data(jnp.asarray(host[j]))
+            for k, m in enumerate(self._stack_order):
+                self._cell_plists[m][i]._data._set_data(w[k])
 
     @property
     def num_update(self):

@@ -15,11 +15,14 @@ from __future__ import annotations
 import re
 from typing import Dict, Optional
 
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..base import MXNetError
 from ..gluon.block import Block
 from ..gluon.parameter import Parameter
 from .. import telemetry as _telem
+from .mesh import axis_size as _axis_size
 
 
 def column_parallel_spec(axis: str = "tp") -> P:
@@ -28,6 +31,52 @@ def column_parallel_spec(axis: str = "tp") -> P:
 
 def row_parallel_spec(axis: str = "tp") -> P:
     return P(None, axis)
+
+
+def tp_shard_dim(spec: Optional[P], axis: str = "tp") -> Optional[int]:
+    """Index of the dimension a Parameter's PartitionSpec shards over `axis`,
+    or None when the spec is absent/fully replicated.
+
+    Used by the manual (shard_map) weight-sharded TP path in
+    parallel/pipeline.py, which gathers exactly one sharded dim per leaf —
+    specs naming any OTHER mesh axis (compute-partitioned layouts for the
+    auto-sharding jit path) are rejected so the two TP styles can't be
+    mixed inside one manual program."""
+    if spec is None:
+        return None
+    dim = None
+    for d, ax in enumerate(spec):
+        if ax is None:
+            continue
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        if tuple(axes) != (axis,):
+            raise MXNetError(
+                f"partition spec {spec} names mesh axis {ax!r}; the manual "
+                f"weight-sharded pipeline TP path only supports specs over "
+                f"{axis!r}")
+        if dim is not None:
+            raise MXNetError(
+                f"partition spec {spec} shards {axis!r} over two dims; "
+                "one sharded dim per leaf")
+        dim = d
+    return dim
+
+
+def gather_tp(w, dim: int, axis: str = "tp"):
+    """All-gather a weight-sharded leaf's `dim` back to full logical size
+    (call INSIDE shard_map, OUTSIDE the differentiated region — the grads
+    w.r.t. the gathered array are then bitwise identical on every rank, so
+    `slice_tp` recovers this rank's exact update shard with no collective)."""
+    return lax.all_gather(w, axis, axis=dim, tiled=True)
+
+
+def slice_tp(g, dim: int, axis: str = "tp"):
+    """This rank's shard of a replicated-identical full gradient along
+    `dim` — the inverse of `gather_tp` for the update lane."""
+    n = _axis_size(axis)
+    shard = g.shape[dim] // n
+    return lax.dynamic_slice_in_dim(g, lax.axis_index(axis) * shard, shard,
+                                    axis=dim)
 
 
 def shard_params_megatron(block: Block, rules: Optional[Dict[str, P]] = None,

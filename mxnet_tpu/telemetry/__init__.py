@@ -659,7 +659,14 @@ def payload_bytes(x) -> int:
 
 def record_comm(op: str, nbytes: int, store: str = "",
                 seconds: Optional[float] = None, calls: int = 1):
-    """Account one collective/comm operation (bytes moved, calls, time)."""
+    """Account one collective/comm operation (bytes moved, calls, time).
+
+    `op` labels the collective kind — "allreduce", "reduce_scatter",
+    "all_gather", the pipeline schedule's "ppermute" activation hops and
+    "pipeline_grad_psum", "tp_weight_all_gather", kvstore "push"/"pull" —
+    so per-kind wire accounting survives aggregation (the
+    check_instrumentation gate pins the trainer paths that must book
+    here)."""
     counter("mx_comm_bytes_total", "Bytes moved by comm/collective ops",
             ("op", "store")).labels(op, store).inc(max(int(nbytes), 0))
     counter("mx_comm_calls_total", "Comm/collective operations",

@@ -63,6 +63,13 @@ METHOD_CHECKS = [
      "_record_telemetry", {"record_optimizer_state"}, "call"),
     ("parallel/pipeline.py", "PipelineTrainer", "step",
      {"record_step", "_record_telemetry"}, "call"),
+    # pipeline schedule comm accounting: the per-step ppermute
+    # activation-hop volume and the embed/head grad psum must both be
+    # booked, plus the per-replica optimizer-state gauge
+    ("parallel/pipeline.py", "PipelineTrainer", "_record_telemetry",
+     {"record_comm"}, "call"),
+    ("parallel/pipeline.py", "PipelineTrainer", "_record_telemetry",
+     {"record_optimizer_state"}, "call"),
     ("parallel/tensor_parallel.py", None, "shard_params_megatron",
      {"record_comm", "counter", "gauge"}, "call"),
     ("module/base_module.py", "BaseModule", "fit", {"record_step"}, "call"),
@@ -91,7 +98,7 @@ METHOD_CHECKS = [
     # aggregate flops_executed account
     ("parallel/data_parallel.py", "DataParallelTrainer",
      "_record_telemetry", {"record_execution"}, "call"),
-    ("parallel/pipeline.py", "PipelineTrainer", "step",
+    ("parallel/pipeline.py", "PipelineTrainer", "_record_telemetry",
      {"record_execution"}, "call"),
     ("predict.py", "ForwardArtifact", "__call__",
      {"record_execution"}, "call"),
@@ -107,6 +114,10 @@ TEXT_CHECKS = [
      "the fused HybridBlock path must account executions with the engine"),
     ("symbol/executor.py", "record_execution",
      "the symbol Executor path must account executions with the engine"),
+    ("parallel/pipeline.py", '"ppermute"',
+     "the pipeline trainer must book the schedule's activation-hop "
+     "ppermute volume under its own comm kind (bubble/ICI accounting — "
+     "the grad psum alone undercounts pipeline wire traffic)"),
     ("telemetry/__init__.py", "def record_optimizer_state",
      "the registry must expose the per-replica optimizer-state gauge "
      "(the zero-update memory acceptance signal)"),

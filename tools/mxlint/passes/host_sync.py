@@ -28,7 +28,10 @@ HOT_FUNCTIONS = [
      r"_loss_raw|_put_batch|_grad_allreduce_bytes)\b"),
     ("mxnet_tpu/parallel/data_parallel.py", r"\b_make_apply_fn\b"),
     ("mxnet_tpu/parallel/pipeline.py",
-     r"(PipelineTrainer\.(step|_build_step|_loss_raw)\b|\bpipeline_apply\b)"),
+     r"(PipelineTrainer\.(step|_build_step|_loss_raw|_record_telemetry)\b"
+     r"|\bpipeline_apply\b|\bschedule_1f1b\b)"),
+    ("mxnet_tpu/parallel/step_program.py",
+     r"StepProgram\.(get|region|capture_cost|cost)\b"),
     ("mxnet_tpu/kvstore/kvstore.py",
      r"KVStore(Dist)?\.(push|pull|pushpull|row_sparse_pull|broadcast)\b"),
     ("mxnet_tpu/optimizer/optimizer.py",

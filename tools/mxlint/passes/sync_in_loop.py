@@ -28,7 +28,10 @@ LOOP_FUNCTIONS = [
      r"Estimator\.(fit|fit_epoch|_train_loop)\b"),
     ("mxnet_tpu/parallel/data_parallel.py",
      r"DataParallelTrainer\.(run_steps|step)\b"),
-    ("mxnet_tpu/parallel/pipeline.py", r"PipelineTrainer\.step\b"),
+    ("mxnet_tpu/parallel/pipeline.py",
+     r"PipelineTrainer\.(step|_record_telemetry)\b|\bschedule_1f1b\b"),
+    ("mxnet_tpu/parallel/step_program.py",
+     r"StepProgram\.(get|region|capture_cost)\b"),
     ("mxnet_tpu/gluon/trainer.py", r"Trainer\.step\b"),
     # serving dispatch loop (ISSUE 6): forming/dispatching batch i+1 must
     # never sync on batch i's outputs — the completion thread owns the one
