@@ -156,14 +156,26 @@ class Trainer:
                     if p.grad_req != "null"]
             if len(live) > 1 and (self._kvstore.type.startswith("dist")
                                   or self._kvstore.type in ("tpu", "nccl")):
-                # one batched pushpull: grads ride the kvstore's bucketed
-                # reduce path (parallel/zero.py fusion buckets — one
-                # collective per bucket instead of one per key) and come
-                # back globally reduced, so the local updater then applies
-                # the same update on every worker
-                grads = [p.grad() for _, p in live]
-                self._kvstore.pushpull([i for i, _ in live], grads,
-                                       out=grads)
+                # grads ride the kvstore's bucketed reduce path
+                # (parallel/zero.py fusion buckets — one collective per
+                # bucket instead of one per key), but one pushpull over ALL
+                # keys can only be issued after the whole backward. Plan the
+                # same buckets here and issue one pushpull per bucket in
+                # reverse declaration order — the order backward finalizes
+                # gradients — so each bucket's collective dispatches while
+                # earlier-declared grads are still being produced. The
+                # reduced values land in the same grad buffers either way.
+                from ..parallel import zero as _zero
+                from ..base import env as _env
+                grad_of = {i: p.grad() for i, p in live}
+                entries = [(i, grad_of[i].shape, grad_of[i].dtype)
+                           for i, _ in live]
+                buckets = _zero.plan_buckets(
+                    entries, 1, int(_env.get("MXNET_TPU_BUCKET_BYTES")))
+                for b in sorted(buckets, key=lambda b: -max(b.indices)):
+                    grads = [grad_of[i] for i in b.indices]
+                    self._kvstore.pushpull(list(b.indices), grads,
+                                           out=grads)
                 return
             for i, p in live:
                 self._kvstore.push(i, p.grad())

@@ -1,0 +1,294 @@
+"""Backward-overlapped gradient collectives: chunked-vjp segment planning.
+
+The fused data-parallel step runs the whole backward, then reduces every
+gradient bucket at the tail — all collective time is exposed. The standard
+production-trainer fix is to chunk the backward and issue each fusion
+bucket's collective as soon as the last gradient contributing to it
+finalizes, so the scheduler can hoist the DMA under the remaining backward
+dots. This module holds the pieces `DataParallelTrainer(overlap_grads=True)`
+composes with `parallel/zero.py`:
+
+  - a **chain extractor**: a linear list of child blocks whose sequential
+    application equals the net's forward (pipeline_split() stages, a
+    HybridSequential's children, or the model-zoo features+output shape —
+    the same recipes the roofline bench walks);
+  - a **segment planner**: the chain grouped into K segments of ~equal
+    trainable-parameter bytes; each segment becomes one `jax.vjp` call in
+    the step, and the segment's first parameter slots become the
+    ``boundaries=`` hint to ``zero.plan_buckets`` so no bucket spans a
+    segment;
+  - the **per-bucket all-reduce** used when zero_update is off (native
+    psum, or a compressed-wire reduce-scatter + all-gather composition),
+    plus its wire-byte estimator for telemetry;
+  - the ``@_segment_vjp_kernel`` donation decorator for eager segment-grad
+    accumulation (mxlint's donation-safety pass knows it: reading a donated
+    accumulator after the call is flagged).
+
+The big win is on-chip (async collectives + the latency-hiding scheduler,
+engine/xla_flags.py); the CPU host still verifies the *structure* — K
+interleaved per-bucket collectives in the optimized HLO instead of one
+fused tail block — and exact trajectory parity.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax import lax
+import jax.numpy as jnp
+
+from ..base import MXNetError, env
+from .. import engine as _engine
+from . import zero as _zero
+
+__all__ = ["Segment", "SegmentPlan", "chain_blocks", "plan_segments",
+           "allreduce_bucket", "allreduce_wire_bytes",
+           "accumulate_segment_grads"]
+
+env.declare("MXNET_TPU_OVERLAP_GRADS", False, bool,
+            "Default DataParallelTrainer(overlap_grads=...) to the "
+            "backward-overlapped collective schedule (chunked-vjp backward, "
+            "per-bucket collectives issued as segments finalize). Nets "
+            "without a linear block chain fall back to the plain step with "
+            "a warning when enabled this way.")
+env.declare("MXNET_TPU_OVERLAP_SEGMENTS", 4, int,
+            "Target number of backward vjp segments for the overlapped "
+            "step (clamped to the net's chain length; >= 2 required)")
+
+
+@dataclass(frozen=True)
+class Segment:
+    """One chunk of the backward: a run of chain blocks applied in order.
+
+    ``uses`` are the plist slots the segment's forward consumes (first-use
+    order — the vjp differentiates w.r.t. exactly these). ``owned`` are the
+    slots whose gradient FINALIZES when this segment's pullback runs: a
+    parameter shared across segments is owned by its earliest user, since
+    the backward visits segments in reverse and the earliest user
+    contributes last."""
+    index: int
+    names: Tuple[str, ...]
+    blocks: Tuple[Any, ...] = field(compare=False)
+    block_uses: Tuple[Tuple[int, ...], ...]
+    uses: Tuple[int, ...]
+    owned: Tuple[int, ...]
+
+
+class SegmentPlan:
+    """Segments plus the bucket-alignment view the trainer needs."""
+
+    def __init__(self, segments: Sequence[Segment]):
+        self.segments: Tuple[Segment, ...] = tuple(segments)
+        self.segment_of_slot: Dict[int, int] = {
+            i: s.index for s in self.segments for i in s.owned}
+        # plan_buckets boundary hint: cut before each segment's first owned
+        # slot. Owned slots are contiguous runs in declaration order
+        # (plan_segments enforces it), so interval cuts align exactly.
+        self.boundaries: Tuple[int, ...] = tuple(
+            min(s.owned) for s in self.segments[1:] if s.owned)
+
+    def __len__(self):
+        return len(self.segments)
+
+    @property
+    def fingerprint(self):
+        """Deterministic token for engine.config_fingerprint: two nets that
+        segment differently must compile (and roofline-ledger) apart."""
+        return tuple((s.index, s.names, s.uses, s.owned)
+                     for s in self.segments)
+
+
+def chain_blocks(net) -> Optional[List[Tuple[str, Any]]]:
+    """A linear ``[(name, block), ...]`` chain whose sequential application
+    reproduces ``net``'s forward, or None when the net has no such shape.
+
+    Recognized shapes (the same recipes bench.py's roofline scenario walks):
+    a ``pipeline_split()`` model (embed + cells + head), a HybridSequential,
+    and the model-zoo ``features`` (HybridSequential) + ``output`` pair."""
+    from ..gluon import nn as _nn
+    from ..gluon.block import HybridBlock
+    split = getattr(net, "pipeline_split", None)
+    if callable(split):
+        embed, cells, head = split()
+        return ([("embed", embed)]
+                + [(f"cell{i}", c) for i, c in enumerate(cells)]
+                + [("head", head)])
+    if isinstance(net, _nn.HybridSequential):
+        return [(f"[{i}]", b) for i, b in enumerate(net._children.values())]
+    feats = getattr(net, "features", None)
+    out = getattr(net, "output", None)
+    if isinstance(feats, _nn.HybridSequential) and isinstance(out, HybridBlock):
+        return ([(f"features[{i}]", b)
+                 for i, b in enumerate(feats._children.values())]
+                + [("output", out)])
+    return None
+
+
+def plan_segments(net, plist: Sequence[Any], n_segments: int) -> SegmentPlan:
+    """Group ``net``'s block chain into ``n_segments`` backward segments of
+    ~equal owned-parameter bytes. Raises MXNetError when the net has no
+    linear chain, when the chain covers parameters `plist` doesn't (or vice
+    versa), or when segment ownership is not contiguous in declaration
+    order (bucket boundaries are slot intervals)."""
+    chain = chain_blocks(net)
+    if not chain:
+        raise MXNetError(
+            f"net {type(net).__name__} has no linear block chain "
+            "(pipeline_split() / HybridSequential / features+output); "
+            "overlap_grads needs one to segment the backward")
+    slot_of = {id(p): i for i, p in enumerate(plist)}
+    per_block_uses: List[Tuple[int, ...]] = []
+    for name, blk in chain:
+        uses = []
+        for p in blk.collect_params().values():
+            i = slot_of.get(id(p))
+            if i is None:
+                raise MXNetError(
+                    f"chain block {name} holds parameter {p.name!r} that "
+                    "the trainer's parameter list doesn't (initialize the "
+                    "net before constructing the trainer)")
+            if i not in uses:
+                uses.append(i)
+        per_block_uses.append(tuple(uses))
+    covered = {i for uses in per_block_uses for i in uses}
+    missing = [i for i in range(len(plist)) if i not in covered]
+    if missing:
+        raise MXNetError(
+            "net parameters outside the block chain (slots "
+            f"{missing[:4]}…): their gradients would never finalize in a "
+            "segmented backward; overlap_grads requires the chain to cover "
+            "every parameter")
+    # owner = earliest chain block using the slot (shared parameters get
+    # their last backward contribution there)
+    owner_block = {}
+    for j, uses in enumerate(per_block_uses):
+        for i in uses:
+            owner_block.setdefault(i, j)
+
+    k = max(2, int(n_segments))
+    k = min(k, len(chain))
+    sizes = [sum(int(getattr(plist[i]._data, "size", 0))
+                 * jnp.dtype(plist[i].dtype or "float32").itemsize
+                 for i in uses if owner_block[i] == j)
+             for j, uses in enumerate(per_block_uses)]
+    total = sum(sizes) or 1
+    # cut at cumulative-bytes thresholds i*total/k: groups of ~equal owned
+    # bytes; a block heavier than total/k simply swallows later thresholds
+    # (fewer, fatter segments — never an infeasible plan)
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    acc = 0
+    for j in range(len(chain)):
+        cur.append(j)
+        acc += sizes[j]
+        if len(groups) < k - 1 and acc >= total * (len(groups) + 1) / k:
+            groups.append(cur)
+            cur = []
+    if cur:
+        groups.append(cur)
+
+    segments = []
+    for s, grp in enumerate(groups):
+        uses: List[int] = []
+        for j in grp:
+            for i in per_block_uses[j]:
+                if i not in uses:
+                    uses.append(i)
+        owned = tuple(sorted(i for i in uses
+                             if owner_block[i] in grp))
+        segments.append(Segment(
+            index=s,
+            names=tuple(chain[j][0] for j in grp),
+            blocks=tuple(chain[j][1] for j in grp),
+            block_uses=tuple(per_block_uses[j] for j in grp),
+            uses=tuple(uses),
+            owned=owned))
+    # interval boundaries need ownership contiguous in declaration order
+    prev_max = -1
+    for seg in segments:
+        if not seg.owned:
+            continue
+        if seg.owned[0] <= prev_max:
+            raise MXNetError(
+                "segment ownership is not contiguous in parameter "
+                f"declaration order (segment {seg.index} owns slot "
+                f"{seg.owned[0]} after slot {prev_max}); declare "
+                "parameters in chain order to use overlap_grads")
+        prev_max = seg.owned[-1]
+    return SegmentPlan(segments)
+
+
+# ---------------------------------------------------------------------------
+# Per-bucket all-reduce (the non-zero overlap collective; traced under
+# shard_map over dp, like zero's reduce_scatter_bucket)
+# ---------------------------------------------------------------------------
+
+def allreduce_bucket(flat, axis_name: str, ndp: int,
+                     comm_dtype: Optional[str] = None):
+    """Cross-replica SUM all-reduce of one flat gradient bucket, fp32 out.
+
+    comm_dtype None: native ``lax.psum`` (XLA schedules the ring — one
+    all-reduce instruction per bucket, the unit the latency-hiding
+    scheduler hoists). 'bfloat16'/'int8': the reduce phase rides
+    zero.reduce_scatter_bucket's compressed wire (fp32 accumulation), and
+    the fp32 partial sums all-gather back."""
+    if ndp <= 1:
+        return flat.astype(jnp.float32)
+    if comm_dtype is None:
+        return lax.psum(flat, axis_name).astype(jnp.float32)
+    shard = _zero.reduce_scatter_bucket(flat, axis_name, ndp, comm_dtype)
+    return _zero.all_gather_bucket(shard, axis_name)
+
+
+def allreduce_wire_bytes(buckets, ndp: int,
+                         comm_dtype: Optional[str] = None) -> int:
+    """Per-step wire bytes of the per-bucket all-reduces (ring estimate,
+    like DataParallelTrainer._grad_allreduce_bytes; the compressed form is
+    the reduce-scatter wire plus the fp32 gather-back)."""
+    if ndp <= 1:
+        return 0
+    if comm_dtype is None:
+        return sum(b.nbytes * 2 * (ndp - 1) // ndp for b in buckets)
+    return (_zero.reduce_scatter_wire_bytes(buckets, ndp, comm_dtype)
+            + _zero.all_gather_wire_bytes(buckets, ndp))
+
+
+# ---------------------------------------------------------------------------
+# Eager segment-grad accumulation (host-driven microbatch loops)
+# ---------------------------------------------------------------------------
+
+def _segment_vjp_kernel(*donate):
+    """``zero._sharded_update_kernel``'s analog for segment-grad carries:
+    jit the kernel donating the given argnums, so the running flat
+    accumulator a host-driven microbatch loop threads through segment
+    backwards aliases its output in place. mxlint's donation-safety pass
+    knows this decorator — reading a donated carry (or any view sliced out
+    of it) after the call is flagged."""
+    def wrap(fn):
+        cache = {"jit": None}
+
+        @functools.wraps(fn)
+        def call(*args):
+            if cache["jit"] is None:
+                donating = bool(donate) and _engine.donation_enabled()
+                cache["jit"] = jax.jit(
+                    fn, donate_argnums=donate if donating else ())
+            return cache["jit"](*args)
+        call.__wrapped__ = fn
+        return call
+    return wrap
+
+
+@_segment_vjp_kernel(0)
+def _k_segment_grad_accum(acc, seg_flat):
+    """Fold one segment's flat gradient into the fp32 running accumulator;
+    the old accumulator buffer is dead afterwards and is donated."""
+    return acc + seg_flat.astype(acc.dtype)
+
+
+def accumulate_segment_grads(acc, seg_flat):
+    """Eager helper: ``acc += seg_flat`` with the accumulator donated.
+    The returned array REPLACES ``acc`` — keep no other reference to it."""
+    return _k_segment_grad_accum(acc, seg_flat)

@@ -259,7 +259,17 @@ class MetricFamily:
         self._default().observe(v)
 
     def get(self, *values) -> float:
-        s = self._series.get(tuple(str(v) for v in values))
+        """Exact series value — or, with FEWER label values than the family
+        has labelnames, the sum over every series matching that label
+        prefix (Prometheus-style aggregation over the remaining labels, so
+        readers written before a family grew a label keep working)."""
+        values = tuple(str(v) for v in values)
+        if len(values) < len(self.labelnames):
+            with _LOCK:
+                series = list(self._series.items())
+            return sum(getattr(s, "value", getattr(s, "sum", 0.0))
+                       for lv, s in series if lv[:len(values)] == values)
+        s = self._series.get(values)
         if s is None:
             return 0.0
         return getattr(s, "value", getattr(s, "sum", 0.0))
@@ -658,7 +668,8 @@ def payload_bytes(x) -> int:
 
 
 def record_comm(op: str, nbytes: int, store: str = "",
-                seconds: Optional[float] = None, calls: int = 1):
+                seconds: Optional[float] = None, calls: int = 1,
+                overlapped: bool = False):
     """Account one collective/comm operation (bytes moved, calls, time).
 
     `op` labels the collective kind — "allreduce", "reduce_scatter",
@@ -666,14 +677,50 @@ def record_comm(op: str, nbytes: int, store: str = "",
     "pipeline_grad_psum", "tp_weight_all_gather", kvstore "push"/"pull" —
     so per-kind wire accounting survives aggregation (the
     check_instrumentation gate pins the trainer paths that must book
-    here)."""
+    here). `overlapped` marks traffic issued while backward compute was
+    still pending (the chunked-vjp schedule, parallel/overlap.py); it
+    becomes the "overlap" label and feeds the mx_comm_overlap_ratio gauge.
+    Family.get(op, store) aggregates over the label, so two-label readers
+    see totals unchanged."""
+    ov = "1" if overlapped else "0"
     counter("mx_comm_bytes_total", "Bytes moved by comm/collective ops",
-            ("op", "store")).labels(op, store).inc(max(int(nbytes), 0))
+            ("op", "store", "overlap")).labels(op, store, ov) \
+        .inc(max(int(nbytes), 0))
     counter("mx_comm_calls_total", "Comm/collective operations",
-            ("op", "store")).labels(op, store).inc(calls)
+            ("op", "store", "overlap")).labels(op, store, ov).inc(calls)
     if seconds is not None:
         counter("mx_comm_seconds_total", "Wall seconds inside comm ops",
-                ("op", "store")).labels(op, store).inc(seconds)
+                ("op", "store", "overlap")).labels(op, store, ov) \
+            .inc(seconds)
+
+
+# gradient-collective kinds eligible for backward overlap — the ratio
+# denominator (kvstore push/pull and the pipeline's ppermute hops have no
+# "issue during backward" notion and would only dilute the signal)
+_OVERLAP_OPS = frozenset({"allreduce", "reduce_scatter", "all_gather"})
+
+
+def comm_overlap_ratio() -> float:
+    """Fraction of gradient-collective wire traffic issued overlapped with
+    backward compute. Byte-weighted over mx_comm_bytes_total's allreduce /
+    reduce_scatter / all_gather series; since estimated collective seconds
+    are bytes / peak_bytes_per_second() (the roofline interval accounting's
+    conversion), the same number reads as the estimated-collective-time
+    overlap fraction. 0.0 when nothing has been recorded."""
+    fam = get_metric("mx_comm_bytes_total")
+    if fam is None:
+        return 0.0
+    with _LOCK:
+        series = list(fam._series.items())
+    total = overlapped = 0.0
+    for lv, s in series:
+        if not lv or lv[0] not in _OVERLAP_OPS:
+            continue
+        v = getattr(s, "value", 0.0)
+        total += v
+        if len(lv) > 2 and lv[2] == "1":
+            overlapped += v
+    return overlapped / total if total else 0.0
 
 
 def record_optimizer_state(nbytes: int, source: str = "trainer"):
@@ -883,6 +930,12 @@ def _sync_engine_stats():
     per-region roofline ledger refreshes its gauges here too."""
     from . import roofline as _roofline
     _roofline.export_metrics()
+    if get_metric("mx_comm_bytes_total") is not None:
+        gauge("mx_comm_overlap_ratio",
+              "Fraction of gradient-collective wire bytes (equivalently, "
+              "estimated collective seconds at the roofline bandwidth "
+              "peak) issued overlapped with backward compute") \
+            .set(comm_overlap_ratio())
     try:
         from .. import engine as _engine
         st = _engine.cache_stats()

@@ -59,6 +59,11 @@ METHOD_CHECKS = [
     # booked for every step that runs the sharded update
     ("parallel/data_parallel.py", "DataParallelTrainer",
      "_record_zero_telemetry", {"record_comm"}, "call"),
+    # backward-overlapped collectives (ISSUE 10): every overlapped step
+    # must book its per-bucket collective volume under the overlap label
+    # (the mx_comm_overlap_ratio gauge derives from exactly these series)
+    ("parallel/data_parallel.py", "DataParallelTrainer",
+     "_record_overlap_telemetry", {"record_comm"}, "call"),
     ("parallel/data_parallel.py", "DataParallelTrainer",
      "_record_telemetry", {"record_optimizer_state"}, "call"),
     ("parallel/pipeline.py", "PipelineTrainer", "step",
@@ -121,6 +126,14 @@ TEXT_CHECKS = [
     ("telemetry/__init__.py", "def record_optimizer_state",
      "the registry must expose the per-replica optimizer-state gauge "
      "(the zero-update memory acceptance signal)"),
+    ("telemetry/__init__.py", "mx_comm_overlap_ratio",
+     "the registry must export the comm-overlap ratio gauge (fraction of "
+     "collective bytes issued inside the backward — the overlapped step's "
+     "structural acceptance signal)"),
+    ("engine/xla_flags.py", "def ensure_overlap_flags",
+     "the engine must expose the async-collective XLA flag helper "
+     "(latency-hiding scheduler flags are frozen at backend init; the "
+     "overlapped step depends on them landing early)"),
     ("telemetry/__init__.py", "mx_feed_queue_depth",
      "the registry must export the async-feed queue-depth gauge"),
     ("telemetry/__init__.py", "mx_feed_stall_seconds_total",
