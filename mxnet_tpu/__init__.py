@@ -114,6 +114,7 @@ _LAZY_SUBMODULES = {
     "rtc": ".rtc",
     "library": ".library",
     "checkpoint": ".checkpoint",   # orbax costs ~2.6 s to import
+    "elastic": ".elastic",
     "predict": ".predict",
     "serving": ".serving",
     "sanitize": ".sanitize",

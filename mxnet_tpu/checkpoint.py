@@ -1,22 +1,26 @@
-"""Elastic sharded checkpoint / resume.
+"""Orbax-backed checkpoint shim (legacy path).
 
-Capability UPLIFT over the reference (SURVEY.md §5-c): the reference's
-recovery story is "checkpoint + relaunch" with no in-framework resume —
-ps-lite only exposes dead-node counts. Here:
+The first-class fault-tolerance subsystem is ``mxnet_tpu.elastic``
+(docs/checkpointing.md): async sharded snapshots with no gather and no
+host sync on the step path, trainer-aware resharding restore, resumable
+input feeds, and preemption handling. This module remains as the
+orbax-format compatibility surface — generic pytree checkpoints, plus
+the original trainer save/restore hooks — for checkpoints that must
+interoperate with other orbax consumers.
 
-  - CheckpointManager saves the FULL training state (sharded parameters,
-    optimizer state, step counter, RNG) via orbax — per-shard parallel IO,
-    resharding on restore (save on N chips, resume on M), atomic step
-    directories, retention policy;
-  - resume_or_init() implements the elastic pattern: on boot every worker
-    restores the latest complete step if one exists, else starts fresh —
-    a preempted/rescheduled job self-heals without operator action;
-  - DataParallelTrainer gains save/restore hooks carrying its donated
-    device buffers directly (no host round-trip through gluon Parameters).
+No-target restore is manifest-driven: ``save`` writes a
+``mx-leaves-<step>.json`` sidecar describing the tree (container
+structure + per-leaf shape/dtype), and ``restore`` rebuilds the orbax
+target from it — no devices from the saving run needed, the elastic
+case. Checkpoints written before the sidecar existed fall back to
+sniffing orbax's per-version metadata object (the old
+``getattr(meta, "tree", ...)`` chain) with a DeprecationWarning.
 """
 from __future__ import annotations
 
+import json
 import os
+import warnings
 from typing import Any, Dict, Optional
 
 import numpy as _np
@@ -29,6 +33,33 @@ try:
     _HAS_ORBAX = True
 except ImportError:  # pragma: no cover
     _HAS_ORBAX = False
+
+
+def _leaf_spec_of(tree):
+    """JSON-able mirror of a state tree: containers kept, array leaves
+    reduced to shape+dtype (the sidecar ``restore`` rebuilds from)."""
+    if isinstance(tree, dict):
+        return {str(k): _leaf_spec_of(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [_leaf_spec_of(v) for v in tree]
+    shape = getattr(tree, "shape", None)
+    dtype = getattr(tree, "dtype", None)
+    if shape is not None and dtype is not None:
+        return {"__leaf__": True, "shape": [int(d) for d in shape],
+                "dtype": str(_np.dtype(dtype))}
+    return {"__opaque__": True}
+
+
+def _target_from_spec(spec, sharding):
+    if isinstance(spec, list):
+        return [_target_from_spec(v, sharding) for v in spec]
+    if spec.get("__leaf__"):
+        return jax.ShapeDtypeStruct(tuple(spec["shape"]),
+                                    _np.dtype(spec["dtype"]),
+                                    sharding=sharding)
+    if spec.get("__opaque__"):
+        return None
+    return {k: _target_from_spec(v, sharding) for k, v in spec.items()}
 
 
 class CheckpointManager:
@@ -47,11 +78,26 @@ class CheckpointManager:
             create=True)
         self._mgr = _ocp.CheckpointManager(self.directory, options=opts)
 
+    def _sidecar(self, step: int) -> str:
+        return os.path.join(self.directory, f"mx-leaves-{int(step)}.json")
+
     def save(self, step: int, state: Dict[str, Any], force: bool = False,
              wait: bool = False):
         """state: pytree of jax arrays / numpy / scalars."""
+        # numpy scalar leaves (np.int64(step) etc.) are not in orbax's
+        # STANDARD_ARRAY_TYPES — normalize them to 0-d ndarrays
+        state = jax.tree_util.tree_map(
+            lambda x: _np.asarray(x) if isinstance(x, _np.generic) else x,
+            state)
         saved = self._mgr.save(step, args=_ocp.args.StandardSave(state),
                                force=force)
+        if saved:
+            # leaf-spec sidecar: what no-target restore rebuilds its orbax
+            # target from (atomic, like the checkpoint dirs themselves)
+            tmp = self._sidecar(step) + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(_leaf_spec_of(state), f)
+            os.replace(tmp, self._sidecar(step))
         if wait:
             self._mgr.wait_until_finished()
         return saved
@@ -72,15 +118,29 @@ class CheckpointManager:
                 if hasattr(x, "shape") else x, like)
             return self._mgr.restore(step,
                                      args=_ocp.args.StandardRestore(tgt))
-        # no target: rebuild one from saved metadata WITHOUT shardings —
-        # orbax would otherwise try to resolve the devices the checkpoint
-        # was written on, which may no longer exist (the elastic case)
+        # no target: rebuild one WITHOUT the saving run's shardings —
+        # orbax would otherwise try to resolve devices that may no longer
+        # exist (the elastic case). The leaf-spec sidecar written at save
+        # time is authoritative; pre-sidecar checkpoints fall back to
+        # sniffing orbax's (version-dependent) metadata object.
+        dev = jax.config.jax_default_device or jax.devices()[0]
+        sh = jax.sharding.SingleDeviceSharding(dev)
+        side = self._sidecar(step)
+        if os.path.exists(side):
+            with open(side) as f:
+                tgt = _target_from_spec(json.load(f), sh)
+            return self._mgr.restore(step,
+                                     args=_ocp.args.StandardRestore(tgt))
+        warnings.warn(
+            "restoring a checkpoint without its mx-leaves sidecar: falling "
+            "back to orbax metadata sniffing, which depends on the orbax "
+            "version the checkpoint was written with. Re-save with this "
+            "build (or use mxnet_tpu.elastic snapshots) to get the "
+            "manifest-driven restore path.", DeprecationWarning,
+            stacklevel=2)
         meta = self._mgr.item_metadata(step)
         tree = getattr(meta, "tree", None) or getattr(meta, "item_metadata",
                                                       None) or meta
-
-        dev = jax.config.jax_default_device or jax.devices()[0]
-        sh = jax.sharding.SingleDeviceSharding(dev)
 
         def _as_sds(m):
             shape = getattr(m, "shape", None)
@@ -127,13 +187,22 @@ def trainer_state(trainer) -> Dict[str, Any]:
     """Snapshot a DataParallelTrainer's full training state (device buffers
     go straight to orbax — no host copy). Keys are POSITIONAL ("p3"):
     gluon parameter names embed process-global counters (dense0 vs dense1
-    for the same layer rebuilt after restart) and would never match."""
+    for the same layer rebuilt after restart) and would never match.
+
+    Legacy orbax-format hook; ``trainer.state_dict()`` +
+    ``mxnet_tpu.elastic`` is the first-class path (sharded no-gather
+    writes, ZeRO support, resharding restore). ``sched`` carries the
+    schedule counters a resumed run needs for lr parity at step K+1
+    (optimizer num_update / per-index counts / mutable lr-scheduler
+    fields) — dropping them was the historical resume bug."""
     from . import random as _rng
+    from .elastic import state as _estate
     state = {
         "params": {f"p{i}": w for i, w in enumerate(trainer._params_raw)},
         "opt_state": {f"p{i}": s for i, s in enumerate(trainer._opt_state)},
         "step": _np.int64(trainer._t),
         "rng": _np.asarray(_rng.get_state_raw()),
+        "sched": _estate.sched_state(trainer.optimizer),
     }
     if trainer._scaler is not None:  # fp16 dynamic loss scaling
         state["loss_scale"] = _np.float64(trainer._scaler.loss_scale)
@@ -155,7 +224,11 @@ def load_trainer_state(trainer, state: Dict[str, Any]):
         tuple(v) if isinstance(v := opt[f"p{i}"], (list, tuple)) else v
         for i in range(n)]
     trainer._t = int(state["step"])
-    trainer.optimizer.num_update = trainer._t
+    if state.get("sched"):
+        from .elastic import state as _estate
+        _estate.install_sched(trainer.optimizer, state["sched"])
+    else:  # pre-sched checkpoints: at least realign the update counter
+        trainer.optimizer.num_update = trainer._t
     if "rng" in state:
         from . import random as _rng
         _rng.set_state_raw(state["rng"])

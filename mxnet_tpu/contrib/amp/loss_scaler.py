@@ -49,6 +49,17 @@ class LossScaler:
         keeps the step functions themselves transfer-free."""
         return self.update_scale(not bool(finite))
 
+    def state_dict(self):
+        """Resumable state: the current scale and the overflow-free step
+        count toward the next doubling. A resumed fp16 run that dropped
+        these would restart at init_scale and skip/rescale differently
+        from the uninterrupted trajectory."""
+        return {"loss_scale": self.loss_scale, "unskipped": self._unskipped}
+
+    def load_state_dict(self, d):
+        self.loss_scale = float(d["loss_scale"])
+        self._unskipped = int(d.get("unskipped", 0))
+
     def update_scale(self, overflow: bool):
         if overflow:
             self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)

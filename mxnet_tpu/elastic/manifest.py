@@ -1,0 +1,255 @@
+"""On-disk snapshot layout + atomic manifest commit (mxnet_tpu.elastic).
+
+A snapshot of training step K is a directory
+
+    <root>/step-<K>/shard-<p>.npz     per-process chunk payloads
+    <root>/step-<K>/shard-<p>.json    per-process chunk index
+    <root>/step-<K>/manifest.json     commit marker (merged index + meta)
+
+Every process writes ONLY the array chunks it is the designated owner of
+(its addressable shards with ``replica_id == 0`` — the same no-gather
+ownership rule the ZeRO sharded update establishes, arXiv:2004.13336), so
+a snapshot never materializes a gathered copy of the model on any host.
+``manifest.json`` is the atomicity token: it is written to a temp file and
+``os.replace``d into place only after every expected shard file landed, so
+a snapshot directory without it is by definition incomplete (a preempted
+writer) and is ignored by restore and pruned by retention.
+
+The manifest records everything restore needs WITHOUT the saving process:
+
+  - ``leaves``: global shape + dtype per named leaf;
+  - ``chunks``: for each leaf, the ``[[start, stop], ...]`` index region
+    each npz entry covers — chunks tile the global array exactly, so a
+    restore onto a *different* mesh assembles the full host array and
+    re-places it under the new sharding (elastic re-scale);
+  - ``meta``: the trainer-level host state (step, schedule counters, loss
+    scale, RNG is a leaf, ZeRO bucket plans, mesh shape, program
+    fingerprint) — see elastic/state.py for the exact schema.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["step_dirname", "step_path", "parse_step", "all_complete_steps",
+           "latest_complete_step", "write_shard", "commit", "load", "prune",
+           "SnapshotReader"]
+
+FORMAT = 1
+_STEP_PREFIX = "step-"
+MANIFEST = "manifest.json"
+
+
+def step_dirname(step: int) -> str:
+    return f"{_STEP_PREFIX}{int(step):08d}"
+
+
+def step_path(root: str, step: int) -> str:
+    return os.path.join(root, step_dirname(step))
+
+
+def parse_step(name: str) -> Optional[int]:
+    if not name.startswith(_STEP_PREFIX):
+        return None
+    try:
+        return int(name[len(_STEP_PREFIX):])
+    except ValueError:
+        return None
+
+
+def all_complete_steps(root: str) -> List[int]:
+    """Steps whose manifest committed (incomplete dirs are invisible)."""
+    if not os.path.isdir(root):
+        return []
+    steps = []
+    for name in os.listdir(root):
+        step = parse_step(name)
+        if step is not None and \
+                os.path.exists(os.path.join(root, name, MANIFEST)):
+            steps.append(step)
+    return sorted(steps)
+
+
+def latest_complete_step(root: str) -> Optional[int]:
+    steps = all_complete_steps(root)
+    return steps[-1] if steps else None
+
+
+# ---------------------------------------------------------------------------
+# Writer side (runs on the background snapshot thread, never the step path)
+# ---------------------------------------------------------------------------
+
+def write_shard(sdir: str, process_index: int, entries) -> int:
+    """Write one process's chunk payloads + index.
+
+    ``entries``: iterable of ``(leaf_name, index, array, global_shape,
+    dtype)`` where ``index`` is ``[[start, stop], ...]`` per dim of the
+    global leaf and ``array`` is the host chunk covering exactly that
+    region. Returns the payload byte count."""
+    os.makedirs(sdir, exist_ok=True)
+    payload: Dict[str, _np.ndarray] = {}
+    chunks, leaves = [], {}
+    nbytes = 0
+    for i, (name, index, arr, gshape, dtype) in enumerate(entries):
+        key = f"c{i}"
+        arr = _np.asarray(arr)
+        payload[key] = arr
+        nbytes += arr.nbytes
+        chunks.append({"name": name, "key": key,
+                       "index": [[int(a), int(b)] for a, b in index]})
+        leaves[name] = {"shape": [int(d) for d in gshape],
+                        "dtype": str(dtype)}
+    base = os.path.join(sdir, f"shard-{int(process_index):05d}")
+    tmp = base + ".npz.tmp"
+    with open(tmp, "wb") as f:
+        _np.savez(f, **payload)
+    os.replace(tmp, base + ".npz")
+    tmp = base + ".json.tmp"
+    with open(tmp, "w") as f:
+        json.dump({"process": int(process_index), "chunks": chunks,
+                   "leaves": leaves, "nbytes": int(nbytes)}, f)
+    os.replace(tmp, base + ".json")
+    return nbytes
+
+
+def commit(sdir: str, step: int, meta: Dict[str, Any],
+           expected_processes: int = 1, timeout: float = 120.0
+           ) -> Dict[str, Any]:
+    """Merge the per-process chunk indexes and atomically write
+    ``manifest.json`` — the snapshot exists only once this returns.
+
+    Single-controller runs commit immediately; in multi-controller SPMD
+    process 0 calls this after writing its own shard and polls (bounded by
+    ``timeout``) for the other processes' index files."""
+    deadline = time.monotonic() + timeout
+    while True:
+        shard_jsons = sorted(n for n in os.listdir(sdir)
+                             if n.startswith("shard-") and n.endswith(".json"))
+        if len(shard_jsons) >= expected_processes:
+            break
+        if time.monotonic() >= deadline:
+            raise MXNetError(
+                f"snapshot commit timed out: {len(shard_jsons)}/"
+                f"{expected_processes} shard indexes present in {sdir}")
+        time.sleep(0.05)
+    leaves: Dict[str, Any] = {}
+    chunks: Dict[str, List[Dict[str, Any]]] = {}
+    nbytes = 0
+    for name in shard_jsons:
+        with open(os.path.join(sdir, name)) as f:
+            shard = json.load(f)
+        npz = name[:-len(".json")] + ".npz"
+        nbytes += int(shard.get("nbytes", 0))
+        leaves.update(shard["leaves"])
+        for c in shard["chunks"]:
+            chunks.setdefault(c["name"], []).append(
+                {"file": npz, "key": c["key"], "index": c["index"]})
+    man = {"format": FORMAT, "step": int(step), "meta": meta,
+           "leaves": leaves, "chunks": chunks, "nbytes": int(nbytes)}
+    tmp = os.path.join(sdir, MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(man, f)
+    os.replace(tmp, os.path.join(sdir, MANIFEST))
+    return man
+
+
+def load(root: str, step: int) -> Dict[str, Any]:
+    path = os.path.join(step_path(root, step), MANIFEST)
+    if not os.path.exists(path):
+        raise MXNetError(f"no complete snapshot for step {step} in {root}")
+    with open(path) as f:
+        man = json.load(f)
+    if man.get("format") != FORMAT:
+        raise MXNetError(
+            f"snapshot format {man.get('format')!r} unsupported "
+            f"(this build reads format {FORMAT})")
+    return man
+
+
+def prune(root: str, max_to_keep: int) -> List[int]:
+    """Retention: drop the oldest COMPLETE snapshots beyond ``max_to_keep``
+    and any incomplete directory older than the newest complete one (a
+    preempted writer's leftovers). Never touches the newest snapshot."""
+    complete = all_complete_steps(root)
+    removed = []
+    if max_to_keep > 0:
+        for step in complete[:-max_to_keep] if len(complete) > max_to_keep \
+                else []:
+            shutil.rmtree(step_path(root, step), ignore_errors=True)
+            removed.append(step)
+    if complete:
+        for name in os.listdir(root):
+            step = parse_step(name)
+            if step is not None and step < complete[-1] and \
+                    not os.path.exists(os.path.join(root, name, MANIFEST)):
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+    return removed
+
+
+# ---------------------------------------------------------------------------
+# Reader side (restore)
+# ---------------------------------------------------------------------------
+
+class SnapshotReader:
+    """Assemble full host arrays for named leaves of one snapshot.
+
+    The fetch interface elastic/state.py's ``install`` consumes:
+    ``reader(name)`` returns the GLOBAL numpy array for that leaf,
+    stitched from however many per-process chunks the saving mesh
+    produced — the resharding pivot for save-on-N / resume-on-M."""
+
+    def __init__(self, root: str, step: int,
+                 manifest: Optional[Dict[str, Any]] = None):
+        self._dir = step_path(root, step)
+        self.manifest = manifest if manifest is not None else load(root, step)
+        self._npz: Dict[str, Any] = {}
+
+    @property
+    def names(self):
+        return set(self.manifest["leaves"])
+
+    def _file(self, npz_name: str):
+        f = self._npz.get(npz_name)
+        if f is None:
+            f = self._npz[npz_name] = _np.load(
+                os.path.join(self._dir, npz_name))
+        return f
+
+    def __call__(self, name: str) -> _np.ndarray:
+        spec = self.manifest["leaves"].get(name)
+        if spec is None:
+            raise KeyError(name)
+        shape = tuple(spec["shape"])
+        out = _np.empty(shape, dtype=_np.dtype(spec["dtype"]))
+        covered = 0
+        for c in self.manifest["chunks"].get(name, ()):
+            chunk = self._file(c["file"])[c["key"]]
+            idx = tuple(slice(a, b) for a, b in c["index"])
+            out[idx] = chunk
+            covered += int(chunk.size)
+        if covered != out.size:
+            raise MXNetError(
+                f"snapshot leaf {name!r}: chunks cover {covered} of "
+                f"{out.size} elements — corrupt or partial snapshot")
+        return out
+
+    def close(self):
+        for f in self._npz.values():
+            try:
+                f.close()
+            except Exception:
+                pass
+        self._npz.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,201 @@
+"""Supervised elastic training: boot-from-latest, preemption, run loop.
+
+The contract a preemptible job wants is small:
+
+    mgr, trainer, start, outcome = elastic.resume_or_init(dir, make_trainer,
+                                                          feed=feed)
+    elastic.run(trainer, feed, num_steps, manager=mgr)
+
+Every worker calls ``resume_or_init`` at boot: it finds the latest
+COMPLETE snapshot (manifest presence is the commit token), rebuilds the
+trainer's full state onto whatever mesh the new job got — the same shape
+("resumed") or a different one ("resharded", classified by comparing the
+saved mesh + ``StepProgram`` fingerprint) — and rewinds the input feed to
+the exact batch cursor. ``run`` then drives the training loop with an
+interval snapshot policy and a SIGTERM/SIGINT ``PreemptionGuard``: on
+preemption it finishes the in-flight step, drains the dispatch window,
+forces a final synchronous snapshot, and returns cleanly — the relaunched
+job loses zero completed steps and replays the trajectory exactly
+(tests/test_elastic.py asserts K+1..K+10 loss/param parity).
+
+The loop body keeps losses as ``PendingScalar`` handles (mxlint's
+sync-in-loop pass hot-lists ``run`` — a ``float()`` on a step output in
+here would re-serialize the device pipeline and fail CI).
+"""
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from ..base import MXNetError
+from .. import telemetry as _telem
+from . import manifest as _manifest
+from . import state as _state
+from .snapshot import SnapshotManager
+
+__all__ = ["capture_trainer", "save_trainer", "resume_or_init",
+           "PreemptionGuard", "run"]
+
+
+def capture_trainer(trainer, feed=None) -> Dict[str, Any]:
+    """Trainer snapshot (elastic/state.py schema), with the input feed's
+    cursor folded into meta so restore rewinds the data stream too."""
+    snap = _state.capture(trainer)
+    if feed is not None and hasattr(feed, "state_dict"):
+        snap["meta"]["feed"] = feed.state_dict()
+    return snap
+
+
+def save_trainer(manager: SnapshotManager, trainer, feed=None,
+                 wait: bool = False):
+    """Capture + async save at the trainer's current step."""
+    manager.save(trainer._t, capture_trainer(trainer, feed), wait=wait)
+
+
+def resume_or_init(directory: str, make_trainer: Callable[[], Any],
+                   feed=None, max_to_keep: int = 3,
+                   save_interval_steps: Optional[int] = None):
+    """Boot a worker: restore the latest complete snapshot, or start fresh.
+
+    ``make_trainer`` constructs the trainer for THIS job's mesh/config;
+    restore reshards the saved state onto it. Returns ``(manager, trainer,
+    start_step, outcome)`` with outcome one of ``"fresh"`` (no snapshot),
+    ``"resumed"`` (same mesh + step program), ``"resharded"`` (state was
+    re-laid-out for a different mesh or program). Booked on the
+    ``mx_resume_total{outcome}`` counter."""
+    mgr = SnapshotManager(directory, max_to_keep=max_to_keep,
+                          save_interval_steps=save_interval_steps)
+    step = mgr.latest_step()
+    trainer = make_trainer()
+    if step is None:
+        _record_resume("fresh")
+        return mgr, trainer, 0, "fresh"
+    man = _manifest.load(mgr.directory, step)
+    meta = man["meta"]
+    with _manifest.SnapshotReader(mgr.directory, step, manifest=man) as rd:
+        _state.install(trainer, meta, rd, rd.names)
+    if feed is not None and meta.get("feed") is not None \
+            and hasattr(feed, "load_state_dict"):
+        feed.load_state_dict(meta["feed"])
+    mesh_now = {str(a): int(s) for a, s in dict(trainer.mesh.shape).items()}
+    outcome = "resumed" if (mesh_now == meta.get("mesh")
+                            and trainer._program.fingerprint
+                            == meta.get("program")) else "resharded"
+    _record_resume(outcome)
+    return mgr, trainer, int(meta["step"]), outcome
+
+
+def _record_resume(outcome: str):
+    if _telem._ENABLED:
+        _telem.record_resume(outcome, source="elastic")
+
+
+class PreemptionGuard:
+    """Convert SIGTERM/SIGINT into a cooperative flag the train loop polls.
+
+    The handler only sets an event — no I/O, no raising into arbitrary
+    frames — so the in-flight step completes and the loop exits at a step
+    boundary where a consistent snapshot is possible. Restores the prior
+    handlers on ``__exit__``. Outside the main thread (where Python
+    forbids signal handlers) it degrades to an inert flag that can still
+    be set programmatically via ``request_stop``."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = tuple(signals)
+        self._flag = threading.Event()
+        self._prev: Dict[int, Any] = {}
+
+    def _handle(self, signum, frame):
+        self._flag.set()
+
+    def request_stop(self):
+        self._flag.set()
+
+    @property
+    def triggered(self) -> bool:
+        return self._flag.is_set()
+
+    def __enter__(self):
+        for s in self.signals:
+            try:
+                self._prev[s] = signal.signal(s, self._handle)
+            except ValueError:  # not the main thread
+                pass
+        return self
+
+    def __exit__(self, *exc):
+        for s, h in self._prev.items():
+            signal.signal(s, h)
+        self._prev.clear()
+        return False
+
+
+def _xy(batch):
+    data = getattr(batch, "data", None)
+    if data is not None:  # io.DataBatch
+        label = getattr(batch, "label", None)
+        return data[0], (label[0] if label else None)
+    x, y = batch
+    return x, y
+
+
+def run(trainer, feed, num_steps: int, directory: Optional[str] = None,
+        manager: Optional[SnapshotManager] = None,
+        save_every: Optional[int] = None, guard: Optional[PreemptionGuard]
+        = None, on_step=None) -> Dict[str, Any]:
+    """Drive ``trainer.step`` over ``feed`` until ``num_steps`` TOTAL steps
+    (the trainer's step counter, so a resumed trainer does only the
+    remainder), snapshotting every ``save_every`` steps and on exit.
+
+    ``feed`` yields ``(x, y)`` tuples or ``DataBatch`` items; epoch ends
+    trigger ``feed.reset()``. On SIGTERM/SIGINT the loop finishes the
+    current step, drains the dispatch window, writes a final synchronous
+    snapshot, and returns ``{"preempted": True}`` — relaunching the job
+    through ``resume_or_init`` continues the exact trajectory. Losses are
+    returned as unsynced ``PendingScalar`` handles.
+    """
+    if manager is None:
+        if directory is None:
+            raise MXNetError("elastic.run needs directory= or manager=")
+        manager = SnapshotManager(directory,
+                                  save_interval_steps=save_every)
+    elif save_every is not None:
+        manager.save_interval_steps = int(save_every)
+    losses = []
+    preempted = False
+    own_guard = guard is None
+    g = PreemptionGuard() if own_guard else guard
+    if own_guard:
+        g.__enter__()
+    try:
+        it = iter(feed)
+        while trainer._t < num_steps:
+            if g.triggered:
+                preempted = True
+                break
+            try:
+                batch = next(it)
+            except StopIteration:
+                if not hasattr(feed, "reset"):
+                    break
+                feed.reset()
+                it = iter(feed)
+                continue
+            x, y = _xy(batch)
+            losses.append(trainer.step(x, y))
+            if manager.should_save(trainer._t):
+                save_trainer(manager, trainer, feed)
+            if on_step is not None:
+                on_step(trainer._t, losses[-1])
+        # exit (normal or preempted): drain in-flight steps, then one
+        # final synchronous snapshot so the relaunch loses nothing
+        trainer.drain()
+        if trainer._t != manager._last_saved:
+            save_trainer(manager, trainer, feed, wait=True)
+        else:
+            manager.wait_until_finished()
+    finally:
+        if own_guard:
+            g.__exit__(None, None, None)
+    return {"step": trainer._t, "losses": losses, "preempted": preempted}

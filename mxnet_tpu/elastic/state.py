@@ -1,0 +1,525 @@
+"""Trainer state capture/install for elastic snapshots.
+
+``capture(trainer)`` produces ``{"leaves": {name: array}, "meta": {...}}``
+— the schema ``SnapshotManager`` persists. Leaves stay DEVICE arrays with
+their live shardings (the snapshot writer copies and chunks them off the
+step path); meta is host-side JSON: step counter, optimizer schedule
+(``num_update`` / ``begin_num_update`` / per-index update counts /
+lr-scheduler fields), fp16 loss-scaler state, the ZeRO bucket plans, mesh
+shape, and the ``StepProgram`` fingerprint (restore uses it to classify
+the boot as "resumed" vs "resharded").
+
+``install(trainer, meta, fetch, names)`` is the inverse: ``fetch(name)``
+returns the GLOBAL host array for a leaf (a ``manifest.SnapshotReader``,
+or a plain dict lookup for in-memory ``load_state_dict``). Placement goes
+through ``jax.make_array_from_callback`` against the NEW trainer's
+template shardings, so the same path restores onto the saving mesh or a
+different one.
+
+Resharding rules (docs/checkpointing.md):
+
+  - parameters and replicated optimizer state are mesh-independent
+    (global shapes) — they restore onto any mesh;
+  - ZeRO bucket state is layout-dependent (``padded_size`` is a multiple
+    of the dp degree): cross-dp restore re-canonicalizes — each saved
+    bucket's flat lanes are split back into per-parameter segments using
+    the SAVED ``BucketSpec`` (recorded in the manifest) and re-packed
+    under the NEW trainer's plan, zero-padded to its shard multiple;
+  - pipeline stage stacks reorder rows when the (pp, virtual_stages)
+    schedule changes (``_stack_order`` permutation); ZeRO-over-pp state
+    cannot cross pp degrees (per-stage shards have no global layout) and
+    restore raises an informative error instead of mis-assembling.
+
+Leaf naming (flat, positional within each structural slot — gluon
+parameter NAMES embed process-global counters and never match across
+restarts, the same reason checkpoint.py keys positionally):
+
+    dp:  param.{i}            opt.p{i}.{k}   (replicated update)
+         opt.b{j}.{k} opt.x{i}.{k}           (zero buckets / extras)
+    pp:  param.e.{i} param.s.{i} param.h.{i}
+         opt.e.{i}.{k} opt.s.{i}.{k} opt.h.{i}.{k}
+         opt.ze.{j}.{k} opt.zs.{j}.{k} opt.zh.{j}.{k}
+    both: rng                 (raw uint32 key data, a device leaf)
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["capture", "install", "sched_state", "install_sched"]
+
+
+# ---------------------------------------------------------------------------
+# Optimizer schedule state (satellite: lr schedule / step-counter parity)
+# ---------------------------------------------------------------------------
+
+def sched_state(opt) -> Dict[str, Any]:
+    """Host-side schedule counters a resumed run needs for lr parity at
+    step K+1: ``num_update``/``begin_num_update``, the per-index update
+    counts, and the lr-scheduler's mutable fields (FactorScheduler.count,
+    MultiFactorScheduler.cur_step_ind, decayed base_lr)."""
+    d = {"num_update": int(opt.num_update),
+         "begin_num_update": int(opt.begin_num_update),
+         "index_update_count": {str(k): int(v)
+                                for k, v in opt._index_update_count.items()},
+         "scheduler": None}
+    sched = getattr(opt, "lr_scheduler", None)
+    if sched is not None:
+        d["scheduler"] = sched.state_dict()
+    return d
+
+
+def install_sched(opt, d: Dict[str, Any]):
+    opt.num_update = int(d["num_update"])
+    opt.begin_num_update = int(d.get("begin_num_update", 0))
+    counts = {}
+    for k, v in (d.get("index_update_count") or {}).items():
+        try:
+            k = int(k)
+        except (TypeError, ValueError):
+            pass
+        counts[k] = int(v)
+    opt._index_update_count = counts
+    sched = getattr(opt, "lr_scheduler", None)
+    if sched is not None and d.get("scheduler") is not None:
+        sched.load_state_dict(d["scheduler"])
+
+
+# ---------------------------------------------------------------------------
+# Shared helpers
+# ---------------------------------------------------------------------------
+
+def _bucket_dict(b) -> Dict[str, Any]:
+    return {"dtype": b.dtype, "indices": list(b.indices),
+            "offsets": list(b.offsets), "sizes": list(b.sizes),
+            "shapes": [list(s) for s in b.shapes],
+            "padded_size": b.padded_size, "ndp": b.ndp}
+
+
+def _bucket_from(d) -> "Any":
+    from ..parallel.zero import BucketSpec
+    return BucketSpec(dtype=d["dtype"], indices=tuple(d["indices"]),
+                      offsets=tuple(d["offsets"]), sizes=tuple(d["sizes"]),
+                      shapes=tuple(tuple(s) for s in d["shapes"]),
+                      padded_size=int(d["padded_size"]), ndp=int(d["ndp"]))
+
+
+def _tree_leaves(tree):
+    import jax
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _tree_rebuild(template, leaves):
+    import jax
+    _, treedef = jax.tree_util.tree_flatten(template)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _place_like(host, like, what: str):
+    """Place an assembled global host array under a template leaf's
+    sharding (works on any mesh, single- or multi-process — the callback
+    serves arbitrary index regions from the full host value)."""
+    import jax
+    host = _np.asarray(host)
+    if not isinstance(like, jax.Array):
+        return host
+    if tuple(host.shape) != tuple(like.shape):
+        raise MXNetError(
+            f"snapshot leaf {what!r}: saved shape {tuple(host.shape)} != "
+            f"trainer shape {tuple(like.shape)} — architecture mismatch")
+    if _np.dtype(host.dtype) != _np.dtype(like.dtype):
+        host = host.astype(like.dtype)
+    return jax.make_array_from_callback(
+        host.shape, like.sharding, lambda idx: host[idx])
+
+
+def _fetch_np(fetch, name):
+    try:
+        return _np.asarray(fetch(name))
+    except KeyError:
+        raise MXNetError(
+            f"snapshot is missing leaf {name!r} — saved with a different "
+            "trainer configuration (optimizer/zero/precision)") from None
+
+
+def _revector(old_specs, old_flats, new_spec) -> _np.ndarray:
+    """Re-pack ONE flat state lane from the saved bucket layout onto a new
+    bucket's layout: split each old flat vector back into per-parameter
+    segments (saved offsets/sizes), then concatenate the new bucket's
+    members in ITS order and zero-pad to its ``padded_size``."""
+    pieces: Dict[int, _np.ndarray] = {}
+    for spec, flat in zip(old_specs, old_flats):
+        flat = _np.asarray(flat).reshape(-1)
+        for i, o, s in zip(spec.indices, spec.offsets, spec.sizes):
+            pieces[i] = flat[o:o + s]
+    try:
+        parts = [pieces[i] for i in new_spec.indices]
+    except KeyError as e:
+        raise MXNetError(
+            f"zero-state reshard: parameter slot {e} absent from the saved "
+            "bucket plan — trainable set changed between save and resume")
+    out = _np.zeros((new_spec.padded_size,), parts[0].dtype)
+    off = 0
+    for p in parts:
+        out[off:off + p.size] = p
+        off += p.size
+    return out
+
+
+def _bucket_lane_count(names: Set[str], prefix: str) -> int:
+    """How many ``{prefix}.{k}`` leaves the snapshot holds."""
+    n = 0
+    while f"{prefix}.{n}" in names:
+        n += 1
+    return n
+
+
+def _restore_zero_carry(prefix_fmt, old_specs, new_specs, template_carry,
+                        fetch, names, row_dim: Optional[int] = None):
+    """Rebuild a tuple of per-bucket ``(wd, state...)`` carries.
+
+    ``prefix_fmt`` formats the saved leaf prefix for old bucket ``j``
+    (e.g. ``"opt.b{j}"``). When old and new specs agree the lanes restore
+    verbatim; otherwise every flat lane is re-packed via ``_revector``.
+    ``row_dim`` handles the pipeline stage buckets whose state leaves are
+    (n_stages, padded) stacks — each row re-packs independently."""
+    same = len(old_specs) == len(new_specs) and all(
+        o.padded_size == n.padded_size and o.indices == n.indices
+        and o.ndp == n.ndp for o, n in zip(old_specs, new_specs))
+    # every old bucket's flat lanes, fetched host-side once
+    old_lanes: List[List[_np.ndarray]] = []
+    for j in range(len(old_specs)):
+        prefix = prefix_fmt.format(j=j)
+        k = _bucket_lane_count(names, prefix)
+        old_lanes.append([_fetch_np(fetch, f"{prefix}.{k_}")
+                          for k_ in range(k)])
+    carry = []
+    for j2, (new_spec, tmpl) in enumerate(zip(new_specs, template_carry)):
+        tmpl_leaves = _tree_leaves(tmpl)
+        if same:
+            lanes = old_lanes[j2]
+            if len(lanes) != len(tmpl_leaves):
+                raise MXNetError(
+                    "zero-state restore: saved bucket has "
+                    f"{len(lanes)} state lanes, trainer expects "
+                    f"{len(tmpl_leaves)} — optimizer mismatch")
+            new_leaves = [_place_like(h, t, f"zero bucket {j2} lane {k}")
+                          for k, (h, t) in enumerate(zip(lanes, tmpl_leaves))]
+            carry.append(_tree_rebuild(tmpl, new_leaves))
+            continue
+        # cross-layout: scalar lanes come from the old bucket holding this
+        # bucket's first parameter; flat lanes re-pack per parameter
+        first = new_spec.indices[0]
+        j_scalar = next((jo for jo, s in enumerate(old_specs)
+                         if first in s.indices), 0)
+        new_leaves = []
+        for k, t in enumerate(tmpl_leaves):
+            shape = tuple(t.shape)
+            if shape == ():
+                new_leaves.append(_place_like(
+                    old_lanes[j_scalar][k], t, f"zero scalar lane {k}"))
+            elif row_dim is not None and len(shape) == 2:
+                rows = [_revector(old_specs,
+                                  [lane[k][r] for lane in (old_lanes[jo]
+                                   for jo in range(len(old_specs)))]
+                                  if False else
+                                  [old_lanes[jo][k][r]
+                                   for jo in range(len(old_specs))],
+                                  new_spec)
+                        for r in range(shape[0])]
+                new_leaves.append(_place_like(
+                    _np.stack(rows), t, f"zero stage lane {k}"))
+            else:
+                new_leaves.append(_place_like(
+                    _revector(old_specs,
+                              [old_lanes[jo][k]
+                               for jo in range(len(old_specs))],
+                              new_spec),
+                    t, f"zero flat lane {k}"))
+        carry.append(_tree_rebuild(tmpl, new_leaves))
+    return tuple(carry)
+
+
+# ---------------------------------------------------------------------------
+# Capture
+# ---------------------------------------------------------------------------
+
+def capture(trainer) -> Dict[str, Any]:
+    """Snapshot-schema view of a trainer's full training state. Pure
+    bookkeeping on the caller's thread: leaves reference the live device
+    arrays (SnapshotManager copies them), meta reads host counters only —
+    no device transfer, no sync (mxlint host-sync hot list)."""
+    if hasattr(trainer, "_params_raw"):
+        return _capture_dp(trainer)
+    if hasattr(trainer, "_s_raw"):
+        return _capture_pp(trainer)
+    raise MXNetError(f"cannot snapshot {type(trainer).__name__}; expected "
+                     "DataParallelTrainer or PipelineTrainer")
+
+
+def _common_meta(trainer) -> Dict[str, Any]:
+    from .. import random as _rng
+    meta = {
+        "format": 1,
+        "step": trainer._t,
+        "optimizer": type(trainer.optimizer).__name__,
+        "mesh": {str(a): s for a, s in dict(trainer.mesh.shape).items()},
+        "program": trainer._program.fingerprint,
+        "sched": sched_state(trainer.optimizer),
+        "scaler": None,
+    }
+    scaler = getattr(trainer, "_scaler", None)
+    if scaler is not None:
+        meta["scaler"] = scaler.state_dict()
+    return meta
+
+
+def _capture_dp(trainer) -> Dict[str, Any]:
+    from .. import random as _rng
+    leaves: Dict[str, Any] = {}
+    for i, w in enumerate(trainer._params_raw):
+        leaves[f"param.{i}"] = w
+    if trainer._zero:
+        carry, extra = trainer._opt_state
+        for j, c in enumerate(carry):
+            for k, leaf in enumerate(_tree_leaves(c)):
+                leaves[f"opt.b{j}.{k}"] = leaf
+        for i, st in enumerate(extra):
+            for k, leaf in enumerate(_tree_leaves(st)):
+                leaves[f"opt.x{i}.{k}"] = leaf
+    else:
+        for i, st in enumerate(trainer._opt_state):
+            for k, leaf in enumerate(_tree_leaves(st)):
+                leaves[f"opt.p{i}.{k}"] = leaf
+    leaves["rng"] = _rng.get_state_raw()
+    meta = _common_meta(trainer)
+    meta.update({
+        "kind": "dp",
+        "n_params": len(trainer._params_raw),
+        "zero": trainer._zero,
+        "dp_degree": trainer._dp_degree,
+        "zero_plan": [_bucket_dict(b) for b in trainer._zero_plan],
+    })
+    return {"leaves": leaves, "meta": meta}
+
+
+def _capture_pp(trainer) -> Dict[str, Any]:
+    from .. import random as _rng
+    leaves: Dict[str, Any] = {}
+    for tag, group in (("e", trainer._e_raw), ("s", trainer._s_raw),
+                       ("h", trainer._h_raw)):
+        for i, w in enumerate(group):
+            leaves[f"param.{tag}.{i}"] = w
+    if trainer._zero:
+        for tag, carry in (("ze", trainer._opt_e), ("zs", trainer._opt_s),
+                           ("zh", trainer._opt_h)):
+            for j, c in enumerate(carry):
+                for k, leaf in enumerate(_tree_leaves(c)):
+                    leaves[f"opt.{tag}.{j}.{k}"] = leaf
+    else:
+        for tag, grp in (("e", trainer._opt_e), ("s", trainer._opt_s),
+                         ("h", trainer._opt_h)):
+            for i, st in enumerate(grp):
+                for k, leaf in enumerate(_tree_leaves(st)):
+                    leaves[f"opt.{tag}.{i}.{k}"] = leaf
+    leaves["rng"] = _rng.get_state_raw()
+    meta = _common_meta(trainer)
+    meta.update({
+        "kind": "pp",
+        "n_e": len(trainer._e_raw), "n_s": len(trainer._s_raw),
+        "n_h": len(trainer._h_raw),
+        "n_layers": trainer.n_layers,
+        "n_stages": trainer.n_stages,
+        "virtual_stages": trainer.virtual_stages,
+        "stack_order": list(trainer._stack_order),
+        "zero": trainer._zero,
+        "dp_degree": trainer.n_dp,
+    })
+    if trainer._zero:
+        meta["zero_plan_e"] = [_bucket_dict(b) for b in trainer._zplan_e]
+        meta["zero_plan_s"] = [_bucket_dict(b) for b in trainer._zplan_s]
+        meta["zero_plan_h"] = [_bucket_dict(b) for b in trainer._zplan_h]
+    return {"leaves": leaves, "meta": meta}
+
+
+# ---------------------------------------------------------------------------
+# Install
+# ---------------------------------------------------------------------------
+
+def install(trainer, meta: Dict[str, Any], fetch: Callable[[str], Any],
+            names: Set[str]):
+    """Install a snapshot into a freshly-constructed trainer. ``fetch``
+    returns the global host (or device) value for a leaf name; ``names``
+    is the set of leaf names the snapshot holds."""
+    kind = meta.get("kind")
+    if kind == "dp":
+        if not hasattr(trainer, "_params_raw"):
+            raise MXNetError("snapshot holds DataParallelTrainer state but "
+                             f"the target is {type(trainer).__name__}")
+        _install_dp(trainer, meta, fetch, names)
+    elif kind == "pp":
+        if not hasattr(trainer, "_s_raw"):
+            raise MXNetError("snapshot holds PipelineTrainer state but "
+                             f"the target is {type(trainer).__name__}")
+        _install_pp(trainer, meta, fetch, names)
+    else:
+        raise MXNetError(f"unknown snapshot kind {kind!r}")
+    _install_host_state(trainer, meta, fetch, names)
+    trainer.sync()
+    return trainer
+
+
+def _check(cond, msg):
+    if not cond:
+        raise MXNetError(msg)
+
+
+def _install_host_state(trainer, meta, fetch, names):
+    from .. import random as _rng
+    trainer._t = int(meta["step"])
+    if meta.get("sched"):
+        install_sched(trainer.optimizer, meta["sched"])
+    else:
+        trainer.optimizer.num_update = trainer._t
+    scaler = getattr(trainer, "_scaler", None)
+    if scaler is not None and meta.get("scaler"):
+        scaler.load_state_dict(meta["scaler"])
+    if "rng" in names:
+        _rng.set_state_raw(_fetch_np(fetch, "rng"))
+    # drop the device-resident per-call caches run_steps keeps (stale lr /
+    # step-counter / RNG uploads would otherwise survive the restore)
+    for attr in ("_t_dev_val", "_lr_cache_sig", "_scale_cache_val",
+                 "_key_dev"):
+        if hasattr(trainer, attr):
+            setattr(trainer, attr, None)
+
+
+def _install_dp(trainer, meta, fetch, names):
+    _check(meta.get("optimizer") == type(trainer.optimizer).__name__,
+           f"snapshot optimizer {meta.get('optimizer')!r} != trainer "
+           f"{type(trainer.optimizer).__name__!r}")
+    n = len(trainer._params_raw)
+    _check(int(meta.get("n_params", -1)) == n,
+           f"snapshot has {meta.get('n_params')} parameters, trainer has "
+           f"{n} — architecture mismatch")
+    _check(bool(meta.get("zero")) == bool(trainer._zero),
+           "snapshot and trainer disagree on zero_update; construct the "
+           "resuming trainer with the same zero_update setting")
+    # parameters: global shapes are mesh-independent — any-mesh restore
+    trainer._params_raw = [
+        _place_like(_fetch_np(fetch, f"param.{i}"), w, f"param.{i}")
+        for i, w in enumerate(trainer._params_raw)]
+    if trainer._zero:
+        carry, extra = trainer._opt_state
+        old_specs = [_bucket_from(d) for d in meta.get("zero_plan", [])]
+        new_carry = _restore_zero_carry(
+            "opt.b{j}", old_specs, list(trainer._zero_plan), list(carry),
+            fetch, names)
+        new_extra = []
+        for i, st in enumerate(extra):
+            tmpl_leaves = _tree_leaves(st)
+            new_extra.append(_tree_rebuild(st, [
+                _place_like(_fetch_np(fetch, f"opt.x{i}.{k}"), t,
+                            f"opt.x{i}.{k}")
+                for k, t in enumerate(tmpl_leaves)]))
+        trainer._opt_state = (new_carry, tuple(new_extra))
+    else:
+        new_state = []
+        for i, st in enumerate(trainer._opt_state):
+            tmpl_leaves = _tree_leaves(st)
+            new_state.append(_tree_rebuild(st, [
+                _place_like(_fetch_np(fetch, f"opt.p{i}.{k}"), t,
+                            f"opt.p{i}.{k}")
+                for k, t in enumerate(tmpl_leaves)]))
+        trainer._opt_state = new_state
+
+
+def _stack_perm(old_order: Sequence[int], new_order: Sequence[int]):
+    """Row permutation mapping a stacked cell leaf saved under
+    ``old_order`` onto ``new_order``: new row k' holds global layer
+    ``new_order[k']``, which the save put at row
+    ``old_order.index(new_order[k'])``."""
+    if list(old_order) == list(new_order):
+        return None
+    pos = {m: r for r, m in enumerate(old_order)}
+    try:
+        return [pos[m] for m in new_order]
+    except KeyError:
+        raise MXNetError(
+            "snapshot and trainer stack orders cover different layer sets "
+            f"({sorted(pos)} vs {sorted(new_order)})")
+
+
+def _install_pp(trainer, meta, fetch, names):
+    _check(meta.get("optimizer") == type(trainer.optimizer).__name__,
+           f"snapshot optimizer {meta.get('optimizer')!r} != trainer "
+           f"{type(trainer.optimizer).__name__!r}")
+    for key, have in (("n_e", len(trainer._e_raw)),
+                      ("n_s", len(trainer._s_raw)),
+                      ("n_h", len(trainer._h_raw)),
+                      ("n_layers", trainer.n_layers)):
+        _check(int(meta.get(key, -1)) == int(have),
+               f"snapshot {key}={meta.get(key)} != trainer {have} — "
+               "architecture mismatch")
+    _check(bool(meta.get("zero")) == bool(trainer._zero),
+           "snapshot and trainer disagree on zero_update; construct the "
+           "resuming trainer with the same zero_update setting")
+    old_order = meta.get("stack_order") or list(range(trainer.n_layers))
+    perm = _stack_perm(old_order, trainer._stack_order)
+    same_pp = (int(meta.get("n_stages", -1)) == trainer.n_stages
+               and int(meta.get("virtual_stages", 1)) ==
+               trainer.virtual_stages)
+
+    def _rows(host, tmpl):
+        if perm is not None and getattr(host, "ndim", 0) >= 1 \
+                and host.shape[0] == trainer.n_layers:
+            host = host[perm]
+        return host
+
+    trainer._e_raw = [
+        _place_like(_fetch_np(fetch, f"param.e.{i}"), w, f"param.e.{i}")
+        for i, w in enumerate(trainer._e_raw)]
+    trainer._h_raw = [
+        _place_like(_fetch_np(fetch, f"param.h.{i}"), w, f"param.h.{i}")
+        for i, w in enumerate(trainer._h_raw)]
+    trainer._s_raw = [
+        _place_like(_rows(_fetch_np(fetch, f"param.s.{i}"), w), w,
+                    f"param.s.{i}")
+        for i, w in enumerate(trainer._s_raw)]
+    if trainer._zero:
+        _check(same_pp and perm is None,
+               "ZeRO-over-pp optimizer state cannot reshard across pipeline "
+               f"degrees (saved pp={meta.get('n_stages')}x"
+               f"v{meta.get('virtual_stages')}, trainer pp="
+               f"{trainer.n_stages}xv{trainer.virtual_stages}); resume on "
+               "the saved pipeline layout, or save without zero_update")
+        olds = {t: [_bucket_from(d) for d in meta.get(f"zero_plan_{t}", [])]
+                for t in ("e", "s", "h")}
+        trainer._opt_e = _restore_zero_carry(
+            "opt.ze.{j}", olds["e"], list(trainer._zplan_e),
+            list(trainer._opt_e), fetch, names)
+        trainer._opt_h = _restore_zero_carry(
+            "opt.zh.{j}", olds["h"], list(trainer._zplan_h),
+            list(trainer._opt_h), fetch, names)
+        trainer._opt_s = _restore_zero_carry(
+            "opt.zs.{j}", olds["s"], list(trainer._zplan_s),
+            list(trainer._opt_s), fetch, names, row_dim=0)
+    else:
+        def _grp(tag, group, permute):
+            out = []
+            for i, st in enumerate(group):
+                tmpl_leaves = _tree_leaves(st)
+                leaves = []
+                for k, t in enumerate(tmpl_leaves):
+                    host = _fetch_np(fetch, f"opt.{tag}.{i}.{k}")
+                    if permute:
+                        host = _rows(host, t)
+                    leaves.append(_place_like(host, t, f"opt.{tag}.{i}.{k}"))
+                out.append(_tree_rebuild(st, leaves))
+            return out
+        trainer._opt_e = _grp("e", trainer._opt_e, False)
+        trainer._opt_h = _grp("h", trainer._opt_h, False)
+        trainer._opt_s = _grp("s", trainer._opt_s, True)

@@ -281,6 +281,14 @@ class DeviceFeed:
         self._peek = None
         self.stall_seconds = 0.0
         self.batches_delivered = 0
+        # resumable-input cursor (elastic fault tolerance): epoch counts
+        # reset() calls on the wrapped source, _epoch_delivered counts
+        # batches handed out THIS epoch, _skip is a pending fast-forward
+        # the producer consumes (host-only, no device placement) when it
+        # starts after load_state_dict
+        self._epoch = 0
+        self._epoch_delivered = 0
+        self._skip = 0
 
     @classmethod
     def for_trainer(cls, source, trainer, depth: Optional[int] = None,
@@ -365,6 +373,16 @@ class DeviceFeed:
     def _produce(self, stop: threading.Event, q: "queue.Queue"):
         try:
             it = iter(self._source)
+            # resume fast-forward: replay the source up to the restored
+            # cursor on this thread, host-side only — skipped batches are
+            # never placed on device, so rewind costs no transfers
+            skip, self._skip = self._skip, 0
+            for _ in range(skip):
+                try:
+                    next(it)
+                except StopIteration:
+                    _bounded_put(q, _END, stop)
+                    return
             while not stop.is_set():
                 try:
                     item = next(it)
@@ -452,6 +470,7 @@ class DeviceFeed:
             self._stop_producer()
             raise item
         self.batches_delivered += 1
+        self._epoch_delivered += 1
         return item
 
     def __next__(self):
@@ -485,8 +504,48 @@ class DeviceFeed:
         self._stop_producer()
         self._peek = None
         self._eof = False
+        self._epoch += 1
+        self._epoch_delivered = 0
+        self._skip = 0
         if hasattr(self._source, "reset"):
             self._source.reset()
+
+    # -- resumable input (elastic fault tolerance) ---------------------------
+    def state_dict(self):
+        """Durable cursor: which epoch the wrapped source is on and how
+        many batches this epoch were consumed (a peeked-but-unused batch
+        doesn't count). With a seeded source, ``load_state_dict`` on a
+        fresh process replays the exact remaining batch sequence."""
+        d = {"epoch": self._epoch,
+             "cursor": self._epoch_delivered
+             - (1 if self._peek is not None else 0),
+             "delivered": self.batches_delivered}
+        if hasattr(self._source, "state_dict"):
+            d["source"] = self._source.state_dict()
+        return d
+
+    def load_state_dict(self, d):
+        """Rewind to a saved cursor. A source snapshot (epoch-level state:
+        shuffle order, shard assignment — anything ``reset()`` advances)
+        is authoritative over the reset-replay; either way the producer
+        still fast-forwards ``cursor`` batches host-side when it starts —
+        the intra-epoch position is the FEED's knowledge, because the
+        producer prefetches ahead of what the consumer was ever handed."""
+        self._stop_producer()
+        self._peek = None
+        self._eof = False
+        self._epoch = int(d.get("epoch", 0))
+        src = d.get("source")
+        if src is not None and hasattr(self._source, "load_state_dict"):
+            self._source.load_state_dict(src)
+        else:
+            for _ in range(self._epoch):
+                if hasattr(self._source, "reset"):
+                    self._source.reset()
+        self._skip = int(d.get("cursor", 0))
+        self._epoch_delivered = int(d.get("cursor", 0))
+        self.batches_delivered = int(d.get("delivered",
+                                           self._epoch_delivered))
 
     def close(self):
         self._stop_producer()

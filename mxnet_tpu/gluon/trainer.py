@@ -211,6 +211,22 @@ class Trainer:
         self._window.drain()
 
     # -- states ----------------------------------------------------------------
+    def state_dict(self):
+        """Schedule counters the legacy save_states path drops: optimizer
+        num_update / per-index update counts / mutable lr-scheduler fields
+        and the grad rescale. Elastic snapshots carry this so a resumed
+        eager loop sees the same lr at step K+1 (elastic/state.py)."""
+        from ..elastic import state as _estate
+        return {"sched": _estate.sched_state(self._optimizer),
+                "scale": self._scale}
+
+    def load_state_dict(self, d):
+        from ..elastic import state as _estate
+        if d.get("sched"):
+            _estate.install_sched(self._optimizer, d["sched"])
+        if "scale" in d:
+            self._scale = float(d["scale"])
+
     def save_states(self, fname):
         if not self._kv_initialized:
             self._init_kvstore()

@@ -24,6 +24,21 @@ class LRScheduler:
     def __call__(self, num_update):
         raise NotImplementedError
 
+    # Factor/MultiFactor schedulers MUTATE on __call__ (decayed base_lr,
+    # count / cur_step_ind) — a resumed run that drops these re-decays
+    # from scratch and sees a different lr at step K+1. Elastic snapshots
+    # persist them (mxnet_tpu/elastic/state.py sched_state).
+    _STATE_ATTRS = ("base_lr", "count", "cur_step_ind")
+
+    def state_dict(self):
+        return {k: getattr(self, k) for k in self._STATE_ATTRS
+                if hasattr(self, k)}
+
+    def load_state_dict(self, d):
+        for k in self._STATE_ATTRS:
+            if k in d and hasattr(self, k):
+                setattr(self, k, d[k])
+
 
 class FactorScheduler(LRScheduler):
     def __init__(self, step, factor=1.0, stop_factor_lr=1e-8, base_lr=0.01,

@@ -1525,6 +1525,27 @@ class DataParallelTrainer:
         self.sync()
         self.net.save_parameters(prefix + ".params")
 
+    # -- elastic fault tolerance ---------------------------------------------
+    def state_dict(self):
+        """Full training state in the elastic snapshot schema
+        ``{"leaves": {name: device array}, "meta": {...}}`` — params,
+        optimizer state (incl. per-replica ZeRO shards), RNG, step/schedule
+        counters, loss-scaler state. Feed it to
+        ``elastic.SnapshotManager.save`` (async, no gather) or to another
+        trainer's ``load_state_dict``."""
+        from ..elastic import state as _estate
+        return _estate.capture(self)
+
+    def load_state_dict(self, snapshot):
+        """Install a ``state_dict()``/manifest snapshot into this trainer,
+        resharding onto this trainer's mesh if it differs from the saving
+        run's (see docs/checkpointing.md for the resharding rules)."""
+        from ..elastic import state as _estate
+        self.drain()
+        leaves, meta = snapshot["leaves"], snapshot["meta"]
+        _estate.install(self, meta, leaves.__getitem__, set(leaves))
+        return self
+
     @property
     def num_update(self):
         return self._t

@@ -960,6 +960,25 @@ class PipelineTrainer:
             for k, m in enumerate(self._stack_order):
                 self._cell_plists[m][i]._data._set_data(w[k])
 
+    # -- elastic fault tolerance ---------------------------------------------
+    def state_dict(self):
+        """Full training state in the elastic snapshot schema (embed/stage/
+        head params with their stacked layout + stack order, per-replica
+        ZeRO shards, RNG, step/schedule counters) — see
+        mxnet_tpu/elastic/state.py."""
+        from ..elastic import state as _estate
+        return _estate.capture(self)
+
+    def load_state_dict(self, snapshot):
+        """Install a ``state_dict()``/manifest snapshot, permuting stacked
+        stage rows when the (pp, virtual_stages) schedule changed and
+        resharding onto this trainer's mesh (docs/checkpointing.md)."""
+        from ..elastic import state as _estate
+        self.drain()
+        leaves, meta = snapshot["leaves"], snapshot["meta"]
+        _estate.install(self, meta, leaves.__getitem__, set(leaves))
+        return self
+
     @property
     def num_update(self):
         return self._t

@@ -49,6 +49,14 @@ class StepProgram:
         self._costs: Dict[Any, Dict[str, float]] = {}
         self._regions: Dict[Any, str] = {}
 
+    @property
+    def fingerprint(self) -> str:
+        """Stable digest of the trainer configuration (network structure +
+        trainer config, NOT the mesh placement of a particular run).
+        Elastic snapshots record it; ``resume_or_init`` compares it to
+        classify a boot as same-program "resumed" vs "resharded"."""
+        return _engine.region_digest(self.key_base, "program")
+
     # -- executables --------------------------------------------------------
     def get(self, variant: Tuple, build: Callable[[], Callable]):
         """The compiled step for ``key_base + variant``: local memo ->

@@ -50,6 +50,7 @@ __all__ = [
     "peak_bytes_per_second", "ridge_point", "roofline", "trace_steps",
     "trace_active",
     "record_feed_depth", "record_feed_stall", "record_inflight",
+    "record_checkpoint_save", "record_resume",
     "set_epoch", "timed", "annotate", "start_http_server",
     "stop_http_server", "DEFAULT_LATENCY_BUCKETS", "record_serving_enqueue",
     "record_serving_queue_depth", "record_serving_dispatch",
@@ -760,6 +761,36 @@ def record_inflight(n: int, source: str = "step"):
     gauge("mx_inflight_steps",
           "Training steps dispatched but not yet retired by the bounded "
           "in-flight window", ("source",)).labels(source).set(int(n))
+
+
+# ---------------------------------------------------------------------------
+# Elastic fault tolerance (mxnet_tpu/elastic — docs/checkpointing.md)
+# ---------------------------------------------------------------------------
+
+def record_checkpoint_save(seconds: float, nbytes: int,
+                           source: str = "elastic"):
+    """Booked by the snapshot writer ON COMMIT (the background thread,
+    never the step path): wall time from save() dispatch to manifest
+    commit, and payload bytes this process wrote. save_seconds trending
+    toward the snapshot interval means cadence outruns write bandwidth —
+    the tuning signal docs/checkpointing.md's cadence section reads."""
+    gauge("mx_checkpoint_save_seconds",
+          "Wall seconds of the last snapshot, dispatch to manifest commit",
+          ("source",)).labels(source).set(float(seconds))
+    counter("mx_checkpoint_bytes_total",
+            "Cumulative snapshot payload bytes written by this process",
+            ("source",)).labels(source).inc(int(nbytes))
+
+
+def record_resume(outcome: str, source: str = "elastic"):
+    """Boot-path outcome counter: ``fresh`` (no snapshot found),
+    ``resumed`` (same mesh + step program), ``resharded`` (state was
+    re-laid-out onto a different mesh). A fleet restarting after a
+    preemption should show resumed/resharded, never fresh — fresh after
+    a kill means snapshots are not landing."""
+    counter("mx_resume_total",
+            "Worker boots by restore outcome",
+            ("outcome", "source")).labels(outcome, source).inc()
 
 
 # ---------------------------------------------------------------------------

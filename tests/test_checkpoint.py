@@ -89,6 +89,40 @@ def test_resume_or_init_elastic_boot(tmp_path):
     mgr2.close()
 
 
+def test_no_target_restore_is_sidecar_driven(tmp_path):
+    """save() writes a mx-leaves-<step>.json leaf manifest; no-target
+    restore() rebuilds its orbax target from it (no metadata sniffing).
+    Deleting the sidecar exercises the pre-sidecar compat shim, which must
+    warn DeprecationWarning and still restore."""
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    state = {"w": jnp.arange(4.0).reshape(2, 2),
+             "nested": {"m": jnp.ones((3,)), "k": onp.int64(9)}}
+    mgr.save(3, state, wait=True)
+    side = tmp_path / "ck" / "mx-leaves-3.json"
+    assert side.exists()
+    got = mgr.restore()
+    onp.testing.assert_allclose(onp.asarray(got["w"]),
+                                onp.arange(4.0).reshape(2, 2))
+    assert int(onp.asarray(got["nested"]["k"])) == 9
+    os.remove(side)
+    with pytest.warns(DeprecationWarning, match="sidecar"):
+        got2 = mgr.restore()
+    onp.testing.assert_allclose(onp.asarray(got2["nested"]["m"]),
+                                onp.ones(3))
+
+
+def test_orbax_missing_error_message(tmp_path, monkeypatch):
+    """The documented no-orbax failure mode: a clear MXNetError pointing at
+    the single-host alternatives (and mxnet_tpu.elastic has no orbax
+    dependency at all)."""
+    from mxnet_tpu import checkpoint as ckpt
+    from mxnet_tpu.base import MXNetError
+    monkeypatch.setattr(ckpt, "_HAS_ORBAX", False)
+    with pytest.raises(MXNetError, match=r"orbax is unavailable; use "
+                       r"mx\.nd\.save / save_checkpoint"):
+        ckpt.CheckpointManager(str(tmp_path / "ck"))
+
+
 def test_reshard_on_restore(tmp_path):
     """Save replicated on 1 device, restore sharded over 4 — elastic
     re-scale (the reference cannot do this at all)."""

@@ -107,6 +107,14 @@ METHOD_CHECKS = [
      {"record_execution"}, "call"),
     ("predict.py", "ForwardArtifact", "__call__",
      {"record_execution"}, "call"),
+    # elastic fault tolerance (ISSUE 11): the snapshot writer must book
+    # its commit (save seconds + bytes) and every worker boot must book
+    # its restore outcome — a fleet whose snapshots stop landing or whose
+    # relaunches silently boot "fresh" must show on the dashboards
+    ("elastic/snapshot.py", "SnapshotManager", "_commit",
+     {"record_checkpoint_save"}, "call"),
+    ("elastic/run.py", None, "_record_resume",
+     {"record_resume"}, "call"),
 ]
 
 # (relative file, required substring, rationale)
@@ -173,6 +181,16 @@ TEXT_CHECKS = [
      "estimate_cost lowering failures must be counted, not swallowed"),
     ("engine/__init__.py", "cost_capture_failures",
      "engine.cache_stats must carry the cost-capture failure count"),
+    # elastic fault tolerance (ISSUE 11)
+    ("telemetry/__init__.py", "mx_checkpoint_save_seconds",
+     "the registry must export the snapshot save-latency gauge (cadence "
+     "vs write-bandwidth tuning, docs/checkpointing.md)"),
+    ("telemetry/__init__.py", "mx_checkpoint_bytes_total",
+     "the registry must export the cumulative snapshot payload counter"),
+    ("telemetry/__init__.py", "mx_resume_total",
+     "the registry must export the boot-outcome counter "
+     "(fresh/resumed/resharded — fresh after a kill means snapshots are "
+     "not landing)"),
 ]
 
 

@@ -61,6 +61,17 @@ HOT_FUNCTIONS = [
     ("mxnet_tpu/serving/registry.py",
      r"RegisteredModel\.(forward|place_input)\b"),
     ("mxnet_tpu/predict.py", r"ForwardArtifact\.__call__\b"),
+    # elastic snapshot hot path (ISSUE 11): save() runs BETWEEN step
+    # dispatches — capture builds the leaf/meta view and _copy_leaves
+    # dispatches async device copies; any host transfer here would
+    # serialize the pipeline the async writer exists to protect. The
+    # designed syncs (np.asarray of shard data, manifest IO) live on the
+    # background writer thread (_write/_commit), deliberately NOT hot.
+    ("mxnet_tpu/elastic/snapshot.py",
+     r"SnapshotManager\.(save|should_save|_copy_leaves)\b"),
+    ("mxnet_tpu/elastic/state.py",
+     r"\b(capture|_capture_dp|_capture_pp|_common_meta|_bucket_dict)\b"),
+    ("mxnet_tpu/elastic/run.py", r"\b(capture_trainer|save_trainer)\b"),
 ]
 
 # host reads of *python* scalars that merely look like syncs. Matched

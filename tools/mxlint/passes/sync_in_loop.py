@@ -43,6 +43,10 @@ LOOP_FUNCTIONS = [
     ("mxnet_tpu/telemetry/roofline.py", r"\b(record|wrap)\b"),
     ("mxnet_tpu/parallel/data_parallel.py",
      r"DataParallelTrainer\.(_record_telemetry|_region_name)\b"),
+    # elastic supervised loop (ISSUE 11): run() interleaves step dispatch
+    # with async snapshot saves — syncing on the running step's loss would
+    # stall both; losses stay PendingScalar until the caller drains them
+    ("mxnet_tpu/elastic/run.py", r"\brun\b"),
 ]
 
 # calls whose result is a step output: loss/metric/output handles the loop
