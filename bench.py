@@ -1504,6 +1504,215 @@ def bench_roofline(steps, warmup):
     }
 
 
+def _recipe_run(trainer, x, y, steps, warmup):
+    """The recipe-scenario measurement protocol (bench_roofline's A/B):
+    warm + time with telemetry off, then enable, let the one-time cost
+    captures happen, reset to a measured-only ledger, and time again.
+    Returns (dt_off, dt_on, ledger, flops_per_step)."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry import roofline
+
+    def loop(n):
+        last = None
+        for _ in range(n):
+            last = trainer.step(x, y)
+        float(last)                           # device sync
+        trainer.drain()
+
+    telemetry.disable()
+    telemetry.reset()
+    loop(max(warmup, 2))                      # compiles, telemetry off
+    t0 = time.perf_counter()
+    loop(steps)
+    dt_off = time.perf_counter() - t0
+    telemetry.enable()
+    loop(2)                                   # one-time cost captures
+    telemetry.reset()                         # measured ledger only
+    t0 = time.perf_counter()
+    loop(steps)
+    dt_on = time.perf_counter() - t0
+    ledger = roofline.as_dict()
+    flops_per_step = max((c.get("flops", 0.0)
+                          for c in trainer._program._costs.values()),
+                         default=0.0)
+    telemetry.disable()
+    return dt_off, dt_on, ledger, flops_per_step
+
+
+def moe_train_flops_per_step(batch, seq, layers, units, hidden, experts,
+                             top_k, capacity_factor, vocab, shards):
+    """Analytic matmul FLOPs of one MoE train step, matching the einsum
+    formulation the model executes (gating + one-hot dispatch/combine
+    einsums carry real FLOPs): forward terms below, train = 3x."""
+    N = batch * seq
+    nl = N // shards                          # tokens per gating shard
+    cap = max(1, int(capacity_factor * nl * top_k / experts))
+    slots = shards * experts * cap            # global expert slots
+    attn = 2 * N * units * 3 * units + 4 * N * seq * units \
+        + 2 * N * units * units
+    gate = 2 * N * units * experts
+    dispatch = 2 * 2 * N * experts * cap * units      # dispatch + combine
+    expert = 2 * 2 * slots * units * hidden           # w1 + w2
+    per_layer = attn + gate + dispatch + expert
+    return 3 * (layers * per_layer + 2 * N * units * vocab)
+
+
+def bench_moe(steps, warmup):
+    """Expert-parallel MoE recipe (recipes/moe.py) as a benchmarked
+    workload on a dp x ep mesh: fused-step time with telemetry off vs on,
+    MFU from the step artifact's cost_analysis FLOPs, the roofline ledger
+    row the step writes, exact all_to_all wire bytes per step, and the
+    FLOP reconciliation — roofline-ledger sum vs cost_analysis x steps
+    (must agree within 5%), with the analytic einsum count reported as an
+    independent cross-check.
+
+    Env knobs (CPU-sized defaults): BENCH_MOE_DP (2), BENCH_MOE_EP (2),
+    BENCH_MOE_BATCH (16), BENCH_MOE_SEQ (32), BENCH_MOE_EXPERTS (4),
+    BENCH_MOE_TOPK (1), BENCH_MOE_VOCAB (256)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, telemetry
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel import moe as pmoe
+    from mxnet_tpu.recipes import get_recipe
+    from mxnet_tpu.recipes import moe as rmoe
+
+    ndp = int(os.environ.get("BENCH_MOE_DP", 2))
+    nep = int(os.environ.get("BENCH_MOE_EP", 2))
+    batch = int(os.environ.get("BENCH_MOE_BATCH", 16))
+    seq = int(os.environ.get("BENCH_MOE_SEQ", 32))
+    experts = int(os.environ.get("BENCH_MOE_EXPERTS", 4))
+    top_k = int(os.environ.get("BENCH_MOE_TOPK", 1))
+    vocab = int(os.environ.get("BENCH_MOE_VOCAB", 256))
+    devs = jax.devices()
+    if len(devs) < ndp * nep:
+        devs = jax.devices("cpu")
+    assert len(devs) >= ndp * nep, f"need {ndp * nep} devices for dp x ep"
+    mesh = make_mesh({"dp": ndp, "ep": nep}, devices=devs[:ndp * nep])
+
+    r = get_recipe("moe")
+    mx.random.seed(0)
+    net = r.build_model(vocab_size=vocab, num_experts=experts, top_k=top_k)
+    tr = r.build_trainer(net, mesh)
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randint(0, vocab, (batch, seq)), dtype="int32")
+    y = nd.array(rs.randint(0, vocab, (batch, seq)), dtype="int32")
+
+    dt_off, dt_on, ledger, flops_step = _recipe_run(tr, x, y, steps, warmup)
+    a2a_bytes, a2a_calls = tr._a2a_step_bytes((batch, seq))
+    # cost_analysis counts the per-device SPMD program; the analytic
+    # count is global — divide by the mesh size to compare
+    analytic = moe_train_flops_per_step(
+        batch, seq, 2, 64, 128, experts, top_k, 2.0, vocab,
+        ndp * nep) / (ndp * nep)
+    recon = ledger["total_flops"] / max(flops_step * steps, 1.0)
+    tok_s = batch * seq * steps / dt_on
+    return {
+        "metric": "moe_recipe_flops_reconciliation",
+        "value": round(recon, 4),
+        "unit": "ledger/cost_analysis (pass: within 5% of 1.0)",
+        "vs_baseline": round(dt_on / max(dt_off, 1e-9), 3),
+        "extra": {
+            "mesh": {"dp": ndp, "ep": nep},
+            "batch": batch, "seq": seq, "experts": experts, "top_k": top_k,
+            "step_ms_disabled": round(dt_off / steps * 1e3, 2),
+            "step_ms_enabled": round(dt_on / steps * 1e3, 2),
+            "tokens_per_s": round(tok_s, 1),
+            "gflops_per_step_cost": round(flops_step / 1e9, 3),
+            "gflops_per_step_analytic": round(analytic / 1e9, 3),
+            "analytic_vs_cost": round(analytic / max(flops_step, 1.0), 4),
+            "mfu": round(flops_step * steps / dt_on / PEAK_BF16, 6),
+            "all_to_all_bytes_per_step": a2a_bytes,
+            "all_to_all_calls_per_step": a2a_calls,
+            "dropped_tokens": telemetry.counter(
+                "mx_moe_dropped_tokens_total").get("moe"),
+            "roofline_regions": [
+                {k: rr[k] for k in ("region", "kind", "executions",
+                                    "bound")}
+                for rr in ledger["regions"]],
+            "roofline_total_gflops": round(ledger["total_flops"] / 1e9, 3),
+        },
+    }
+
+
+def long_context_train_flops_per_step(batch, seq, layers, units, hidden,
+                                      vocab):
+    """Analytic matmul FLOPs of one long-context train step: fused qkv +
+    scores/values + out proj + FFN per layer, vocab head; train = 3x.
+    Ring attention moves kv around but computes the same score FLOPs."""
+    N = batch * seq
+    per_layer = 2 * N * units * 3 * units + 4 * N * seq * units \
+        + 2 * N * units * units + 4 * N * units * hidden
+    return 3 * (layers * per_layer + 2 * N * units * vocab)
+
+
+def bench_long_context(steps, warmup):
+    """Long-context recipe (recipes/long_context.py) as a benchmarked
+    workload on a dp x sp mesh: ring attention over sequence shards,
+    fused-step time, MFU, roofline row, per-step ppermute ring bytes, and
+    the same ledger-vs-cost FLOP reconciliation gate as bench_moe.
+
+    Env knobs (CPU-sized defaults): BENCH_LC_DP (2), BENCH_LC_SP (2),
+    BENCH_LC_BATCH (4), BENCH_LC_SEQ (512), BENCH_LC_VOCAB (256)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, telemetry
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.recipes import get_recipe
+
+    ndp = int(os.environ.get("BENCH_LC_DP", 2))
+    nsp = int(os.environ.get("BENCH_LC_SP", 2))
+    batch = int(os.environ.get("BENCH_LC_BATCH", 4))
+    seq = int(os.environ.get("BENCH_LC_SEQ", 512))
+    vocab = int(os.environ.get("BENCH_LC_VOCAB", 256))
+    devs = jax.devices()
+    if len(devs) < ndp * nsp:
+        devs = jax.devices("cpu")
+    assert len(devs) >= ndp * nsp, f"need {ndp * nsp} devices for dp x sp"
+    mesh = make_mesh({"dp": ndp, "sp": nsp}, devices=devs[:ndp * nsp])
+
+    r = get_recipe("long_context")
+    mx.random.seed(0)
+    net = r.build_model(vocab_size=vocab, seq_len=seq)
+    tr = r.build_trainer(net, mesh)
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randint(0, vocab, (batch, seq)), dtype="int32")
+    y = nd.array(rs.randint(0, vocab, (batch, seq)), dtype="int32")
+
+    dt_off, dt_on, ledger, flops_step = _recipe_run(tr, x, y, steps, warmup)
+    ring_bytes, ring_calls = tr._ring_step_bytes((batch, seq))
+    # cost_analysis counts the per-device SPMD program; the analytic
+    # count is global — divide by the mesh size to compare
+    analytic = long_context_train_flops_per_step(
+        batch, seq, 2, 64, 128, vocab) / (ndp * nsp)
+    recon = ledger["total_flops"] / max(flops_step * steps, 1.0)
+    tok_s = batch * seq * steps / dt_on
+    return {
+        "metric": "long_context_recipe_flops_reconciliation",
+        "value": round(recon, 4),
+        "unit": "ledger/cost_analysis (pass: within 5% of 1.0)",
+        "vs_baseline": round(dt_on / max(dt_off, 1e-9), 3),
+        "extra": {
+            "mesh": {"dp": ndp, "sp": nsp},
+            "batch": batch, "seq": seq,
+            "step_ms_disabled": round(dt_off / steps * 1e3, 2),
+            "step_ms_enabled": round(dt_on / steps * 1e3, 2),
+            "tokens_per_s": round(tok_s, 1),
+            "gflops_per_step_cost": round(flops_step / 1e9, 3),
+            "gflops_per_step_analytic": round(analytic / 1e9, 3),
+            "analytic_vs_cost": round(analytic / max(flops_step, 1.0), 4),
+            "mfu": round(flops_step * steps / dt_on / PEAK_BF16, 6),
+            "ppermute_bytes_per_step": ring_bytes,
+            "ppermute_calls_per_step": ring_calls,
+            "roofline_regions": [
+                {k: rr[k] for k in ("region", "kind", "executions",
+                                    "bound")}
+                for rr in ledger["regions"]],
+            "roofline_total_gflops": round(ledger["total_flops"] / 1e9, 3),
+        },
+    }
+
+
 def bench_lint_walltime():
     """Static-analyzer cost over the whole package (tier-1 runs mxlint via
     tests/test_lint_clean.py, so it must stay well under the suite budget:
@@ -1602,6 +1811,36 @@ def main():
         print(json.dumps(bench_elastic(
             int(os.environ.get("BENCH_TRAIN_STEPS", 40)),
             int(os.environ.get("BENCH_TRAIN_WARMUP", 8)))))
+        return
+    if os.environ.get("BENCH_SCENARIO") == "moe":
+        # the dp x ep mesh needs dp*ep devices; request virtual host
+        # devices BEFORE the CPU backend initializes
+        need = (int(os.environ.get("BENCH_MOE_DP", 2))
+                * int(os.environ.get("BENCH_MOE_EP", 2)))
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={need}"
+            ).strip()
+        _enable_compile_cache()
+        print(json.dumps(bench_moe(
+            int(os.environ.get("BENCH_TRAIN_STEPS", 8)),
+            int(os.environ.get("BENCH_TRAIN_WARMUP", 2)))))
+        return
+    if os.environ.get("BENCH_SCENARIO") == "long_context":
+        # the dp x sp mesh needs dp*sp devices; request virtual host
+        # devices BEFORE the CPU backend initializes
+        need = (int(os.environ.get("BENCH_LC_DP", 2))
+                * int(os.environ.get("BENCH_LC_SP", 2)))
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={need}"
+            ).strip()
+        _enable_compile_cache()
+        print(json.dumps(bench_long_context(
+            int(os.environ.get("BENCH_TRAIN_STEPS", 8)),
+            int(os.environ.get("BENCH_TRAIN_WARMUP", 2)))))
         return
     if os.environ.get("BENCH_SCENARIO") == "serving":
         _enable_compile_cache()

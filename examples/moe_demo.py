@@ -1,0 +1,102 @@
+"""Expert-parallel MoE training via the recipes subsystem
+(docs/large_models.md).
+
+Builds the sparse-MoE transformer recipe, trains it on a learnable
+next-token task over a {'dp', 'ep'} mesh — expert weights sharded over
+'ep' and exchanged with quantizable all_to_all dispatch/combine, dense
+weights on the ZeRO-over-dp path — and reads back the recipe's
+observability surface: dropped-token counter, exact all_to_all wire
+bytes, and the per-region roofline row of the fused step.
+
+Runs on any mesh; by default builds dp=2 x ep=2 from the available
+devices (forces 4 virtual CPU devices when run standalone).
+
+Run: python examples/moe_demo.py [--steps N]
+Returns (first_loss, last_loss) from main().
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# default to 4 virtual host devices when run standalone on a 1-device box
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4").strip()
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+from mxnet_tpu.parallel import make_mesh  # noqa: E402
+
+VOCAB = 32
+SEQ = 16
+
+
+def batches(rng, n, bs):
+    """Learnable task: next token = (current + 1) mod VOCAB."""
+    for _ in range(n):
+        start = rng.randint(0, VOCAB, (bs, 1))
+        seq = (start + np.arange(SEQ + 1)) % VOCAB
+        yield nd.array(seq[:, :-1], dtype="int32"), \
+            nd.array(seq[:, 1:], dtype="int32")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--dp", type=int, default=2)
+    ap.add_argument("--ep", type=int, default=2)
+    ap.add_argument("--experts", type=int, default=4)
+    ap.add_argument("--capacity-factor", type=float, default=1.25)
+    args = ap.parse_args(argv)
+
+    cpus = jax.devices("cpu")
+    need = args.dp * args.ep
+    assert len(cpus) >= need, f"need {need} devices, have {len(cpus)}"
+    mesh = make_mesh({"dp": args.dp, "ep": args.ep}, devices=cpus[:need])
+
+    mx.random.seed(0)
+    recipe = mx.recipes.get_recipe("moe")
+    net = recipe.build_model(vocab_size=VOCAB, num_experts=args.experts,
+                             capacity_factor=args.capacity_factor)
+    tr = recipe.build_trainer(net, mesh, learning_rate=3e-3)
+
+    mx.telemetry.reset()
+    mx.telemetry.enable()
+    rng = np.random.RandomState(0)
+    # non-blocking dispatch: losses stay pending until drain()
+    pending = [tr.step(x, y)
+               for x, y in batches(rng, args.steps, args.batch_size)]
+    tr.drain()
+    losses = [float(p) for p in pending]
+
+    first = float(np.mean(losses[:5]))
+    last = float(np.mean(losses[-5:]))
+    dropped = mx.telemetry.counter(
+        "mx_moe_dropped_tokens_total").get("moe")
+    a2a = mx.telemetry.counter(
+        "mx_comm_bytes_total").get("all_to_all", "mesh", "0")
+    print(f"dp={args.dp} ep={args.ep} E={args.experts} "
+          f"loss {first:.3f} -> {last:.3f} ({args.steps} steps)")
+    print(f"dropped tokens: {int(dropped)}  "
+          f"all_to_all wire: {a2a / 1e6:.2f} MB")
+    for row in mx.telemetry.roofline.as_dict()["regions"]:
+        if row["region"].startswith("moe.step"):
+            print(f"roofline[{row['region']}]: "
+                  f"{row['flops'] / 1e9:.2f} GFLOP, bound={row['bound']}")
+    mx.telemetry.disable()
+    return first, last
+
+
+if __name__ == "__main__":
+    main()

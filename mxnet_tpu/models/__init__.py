@@ -16,7 +16,10 @@ from .lenet import LeNet, lenet
 from .mlp import MLP, mlp
 from .bert import (BertModel, BertEncoder, TransformerEncoderCell,
                    bert_base, bert_large, bert_tiny)
+from .moe_transformer import (MoEPositionwiseFFN, MoETransformerCell,
+                              MoETransformerLM, moe_transformer_tiny)
 
 __all__ = ["get_model", "LeNet", "lenet", "MLP", "mlp", "BertModel",
            "BertEncoder", "TransformerEncoderCell", "bert_base", "bert_large",
-           "bert_tiny"]
+           "bert_tiny", "MoEPositionwiseFFN", "MoETransformerCell",
+           "MoETransformerLM", "moe_transformer_tiny"]

@@ -1,0 +1,149 @@
+"""Sparse-MoE transformer LM — the expert-parallel recipe's model
+(recipes/moe.py, docs/large_models.md).
+
+A pre-LN decoder-only transformer whose FFN is a capacity-gated
+mixture-of-experts (parallel/moe.py). Design points:
+
+  - the MoE cell computes with raw jax inside ``hybrid_forward``: gating/
+    dispatch are data-dependent einsums with no gluon-op equivalent, and
+    the recipe trainer differentiates the whole step with ``jax.value_and_
+    grad`` through ``_make_apply_fn`` (the fused-step path), where raw jax
+    is fully differentiable. The eager ``.backward()`` tape does NOT see
+    through this block — train it with ``recipes.moe.MoETrainer`` (or any
+    fused-step trainer), not eager autograd.
+  - expert weights are registered at FULL (E, ...) shapes and tagged
+    ``_is_moe_expert`` so the trainer can place them on the 'ep' mesh axis
+    and keep them out of the dp ZeRO buckets. Under ``parallel.moe.
+    expert_axis`` the cell receives the LOCAL (E/ep, ...) shard (shard_map
+    hands each device its slice) and dispatches with the all_to_all
+    exchange; otherwise it runs the single-shard ``moe_ffn``.
+  - ``dense_ffn=True`` builds the PARITY ORACLE: identical params and
+    attention, but the FFN uses expert 0 densely and ignores the gate.
+    With E=1/top_k=1/normalize_gates the gated layer reproduces it
+    exactly (tests/test_recipes.py).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+from ..ndarray import NDArray
+from .bert import SelfAttention, _position_ids
+
+__all__ = ["MoEPositionwiseFFN", "MoETransformerCell", "MoETransformerLM",
+           "moe_transformer_tiny"]
+
+
+class MoEPositionwiseFFN(HybridBlock):
+    """Capacity-gated top-k MoE FFN over (B, T, C) activations."""
+
+    def __init__(self, units, hidden_size, num_experts, top_k=2,
+                 capacity_factor=1.5, dense_ffn=False, **kwargs):
+        super().__init__(**kwargs)
+        from .. import initializer as init_mod
+        self._units = units
+        self._num_experts = num_experts
+        self._top_k = top_k
+        self._capacity_factor = capacity_factor
+        self._dense_ffn = dense_ffn
+        self.gate_w = self.params.get(
+            "gate_w", shape=(units, num_experts),
+            init=init_mod.Normal(0.02))
+        self.w1 = self.params.get(
+            "w1", shape=(num_experts, units, hidden_size),
+            init=init_mod.Xavier())
+        self.w2 = self.params.get(
+            "w2", shape=(num_experts, hidden_size, units),
+            init=init_mod.Xavier())
+        # trainer-visible tag: place on 'ep', exclude from dp ZeRO buckets
+        self.w1._is_moe_expert = True
+        self.w2._is_moe_expert = True
+
+    def hybrid_forward(self, F, x, gate_w, w1, w2):
+        if not isinstance(x, NDArray):
+            raise MXNetError(
+                "MoEPositionwiseFFN has no symbolic form (data-dependent "
+                "dispatch); export is unsupported — serve the dense oracle")
+        import jax
+        from ..parallel import moe as _moe
+
+        xr, gw, w1r, w2r = x._data, gate_w._data, w1._data, w2._data
+        B, T, C = xr.shape
+        flat = xr.reshape(B * T, C)
+        if self._dense_ffn:
+            # parity oracle: expert 0 as a plain FFN, gate ignored
+            y = jax.nn.gelu(flat @ w1r[0]) @ w2r[0]
+            return NDArray(y.reshape(B, T, C))
+        ctx = _moe.current_expert_axis()
+        kw = dict(top_k=self._top_k, capacity_factor=self._capacity_factor,
+                  activation=jax.nn.gelu, normalize_gates=True,
+                  return_aux=True)
+        if ctx is not None:
+            # under shard_map w1r/w2r are the local (E/ep, ...) shards and
+            # flat is this device's token shard
+            y, aux = _moe.expert_parallel_moe(
+                flat, gw, w1r, w2r, axis_name=ctx.axis_name,
+                comm_dtype=ctx.comm_dtype, **kw)
+        else:
+            y, aux = _moe.moe_ffn(flat, gw, w1r, w2r, **kw)
+        _moe.report_metrics(aux)
+        return NDArray(y.reshape(B, T, C))
+
+
+class MoETransformerCell(HybridBlock):
+    """Pre-LN block: attention + MoE FFN (bert.TransformerEncoderCell with
+    the dense FFN swapped for the gated mixture)."""
+
+    def __init__(self, units, hidden_size, num_heads, num_experts, top_k=2,
+                 capacity_factor=1.5, dropout=0.0, dense_ffn=False, **kwargs):
+        super().__init__(**kwargs)
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.attn = SelfAttention(units, num_heads, dropout)
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self.moe = MoEPositionwiseFFN(units, hidden_size, num_experts,
+                                      top_k=top_k,
+                                      capacity_factor=capacity_factor,
+                                      dense_ffn=dense_ffn)
+
+    def hybrid_forward(self, F, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.moe(self.ln2(x))
+        return x
+
+
+class MoETransformerLM(HybridBlock):
+    """Token+position embeddings -> N MoE cells -> LM head."""
+
+    def __init__(self, vocab_size, num_layers=2, units=128, hidden_size=256,
+                 num_heads=2, num_experts=4, top_k=2, capacity_factor=1.5,
+                 max_length=512, dropout=0.0, dense_ffn=False, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self.word_embed = nn.Embedding(vocab_size, units)
+        self.pos_embed = nn.Embedding(max_length, units)
+        self.embed_ln = nn.LayerNorm(in_channels=units)
+        self.cells = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.cells.add(MoETransformerCell(
+                units, hidden_size, num_heads, num_experts, top_k=top_k,
+                capacity_factor=capacity_factor, dropout=dropout,
+                dense_ffn=dense_ffn))
+        self.ln = nn.LayerNorm(in_channels=units)
+        self.decoder = nn.Dense(vocab_size, flatten=False, in_units=units)
+
+    def hybrid_forward(self, F, token_ids):
+        pos = _position_ids(F, token_ids)
+        x = self.word_embed(token_ids) + self.pos_embed(pos).expand_dims(axis=0)
+        x = self.embed_ln(x)
+        x = self.cells(x)
+        return self.decoder(self.ln(x))
+
+
+def moe_transformer_tiny(vocab_size=1024, num_experts=4, top_k=2,
+                         capacity_factor=2.0, dense_ffn=False, **kw):
+    """The recipe/test-sized config: 2 layers, 64 units, E experts."""
+    return MoETransformerLM(vocab_size, num_layers=2, units=64,
+                            hidden_size=128, num_heads=2,
+                            num_experts=num_experts, top_k=top_k,
+                            capacity_factor=capacity_factor,
+                            dense_ffn=dense_ffn, **kw)

@@ -392,6 +392,12 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
         scale = 1.0 / (D ** 0.5)
     on_tpu = _on_tpu(q)
     if not (on_tpu or (_HAS_PALLAS and _use_interpret())):
+        # The fallback is differentiated by jax AS WRITTEN (no custom_vjp):
+        # its gradient contract — matches the dense-softmax VJP at every
+        # shape, including T not a multiple of block_size and causal
+        # masking — holds because the scan masks via jnp.where against
+        # CONSTANT biases (masked lanes contribute zero cotangent), pinned
+        # by tests/test_pallas_kernels.py::test_fallback_grad_*.
         from ..attention import blockwise_attention
         return blockwise_attention(q, k, v, causal=causal, scale=scale,
                                    block_size=block_k)

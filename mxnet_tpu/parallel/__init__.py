@@ -23,7 +23,9 @@ from .pipeline import (pipeline_spec, pipeline_apply, gpipe_schedule,
                        schedule_1f1b, PipelineTrainer)
 from .step_program import StepProgram
 from .moe import (moe_ffn, expert_parallel_moe, topk_gating,
-                  load_balancing_loss)
+                  load_balancing_loss, load_balance_loss, dropped_tokens,
+                  wire_all_to_all, all_to_all_wire_bytes, moe_capacity,
+                  expert_axis, collect_metrics)
 
 __all__ = ["make_mesh", "local_mesh", "replicate", "shard_batch", "P",
            "current_mesh", "set_default_mesh", "require_axis",
@@ -34,4 +36,6 @@ __all__ = ["make_mesh", "local_mesh", "replicate", "shard_batch", "P",
            "pipeline_spec", "pipeline_apply", "gpipe_schedule",
            "schedule_1f1b", "PipelineTrainer", "StepProgram",
            "moe_ffn", "expert_parallel_moe", "topk_gating",
-           "load_balancing_loss"]
+           "load_balancing_loss", "load_balance_loss", "dropped_tokens",
+           "wire_all_to_all", "all_to_all_wire_bytes", "moe_capacity",
+           "expert_axis", "collect_metrics"]

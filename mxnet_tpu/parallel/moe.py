@@ -6,14 +6,22 @@ combine tensors (no dynamic shapes — dropped tokens are the standard
 capacity-overflow semantics), expert FFNs run as one batched einsum, and
 expert parallelism shards the expert dimension over an 'ep' mesh axis with
 two `lax.all_to_all` exchanges (token -> expert shard -> token), riding ICI.
+The exchanges optionally compress onto the same bf16/int8 comm wire the
+ZeRO gradient collectives use (EQuARX, arXiv:2506.17615) — see
+``wire_all_to_all`` / ``MXNET_TPU_COMM_DTYPE``.
+
+End-to-end training of these layers lives in ``mxnet_tpu.recipes.moe``
+(docs/large_models.md); this module stays a pure function library.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 from jax import lax
 
 from .mesh import axis_size as _axis_size
@@ -25,6 +33,21 @@ def topk_gating(logits, top_k: int, capacity: int):
     logits: (N, E). Returns (dispatch (N, E, C) float 0/1, combine (N, E, C)).
     Token n's k-th choice lands in expert e's slot c if fewer than C earlier
     tokens chose e; overflow tokens are dropped (their combine weight is 0).
+
+    Determinism contract (parity tests depend on it):
+
+      - expert ties break toward the LOWER expert index — ``lax.top_k``
+        returns the first maximal index on equal probabilities, on every
+        backend;
+      - capacity slots are claimed in TOKEN order (the running ``cumsum``
+        over axis 0), so for a fixed token ordering the overflow set is a
+        pure function of the logits — two runs (or two devices gating the
+        same shard) always drop the same tokens;
+      - choice ranks fill sequentially: all k=0 assignments claim slots
+        before any k=1 assignment of the same call (the ``counts`` carry).
+
+    Nothing here samples or depends on iteration order of a hash map, so
+    gating is bitwise-reproducible for identical inputs.
     """
     N, E = logits.shape
     probs = jax.nn.softmax(logits, axis=-1)
@@ -51,17 +74,144 @@ def topk_gating(logits, top_k: int, capacity: int):
     return dispatch, combine
 
 
+def load_balance_loss(probs, dispatch):
+    """Switch-style auxiliary load-balancing loss from the gate's outputs.
+
+    probs: (N, E) router probabilities; dispatch: (N, E, C) assignment mask
+    from ``topk_gating``. ``E * sum_e f_e * P_e`` where ``f_e`` is the
+    fraction of realized (post-capacity) assignments that landed on expert
+    e and ``P_e`` the mean router probability — minimized (= 1) at uniform
+    routing, so adding ``aux_weight * load_balance_loss`` to the task loss
+    pushes the router toward balance. Differentiable through ``probs``
+    only (the dispatch mask is a hard assignment; its gradient is zero
+    a.e., matching the Switch Transformer estimator).
+    """
+    E = probs.shape[1]
+    assigned = jnp.sum(dispatch, axis=2)                      # (N, E) 0/1
+    denom = jnp.maximum(jnp.sum(assigned), 1.0)
+    f = lax.stop_gradient(jnp.sum(assigned, axis=0) / denom)  # realized share
+    p = jnp.mean(probs, axis=0)
+    return E * jnp.sum(f * p)
+
+
+def dropped_tokens(dispatch, n_tokens: int, top_k: int):
+    """Capacity-overflow count: (token, choice) assignments that found no
+    free slot. Scalar int32, ``0 <= dropped <= N * top_k``. Surfaced by the
+    MoE recipe trainer on ``mx_moe_dropped_tokens_total``."""
+    made = jnp.sum(dispatch.astype(jnp.float32))
+    return (jnp.int32(n_tokens * top_k) - made.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Comm-wire all_to_all: the dispatch/combine exchanges ride the same
+# bf16/int8 wire as the ZeRO gradient collectives (zero.py, EQuARX
+# arXiv:2506.17615). all_to_all with split_axis=0/concat_axis=0 is a pure
+# block permutation, so it is its own transpose: the custom VJP runs the
+# SAME compressed exchange on the cotangent.
+# ---------------------------------------------------------------------------
+
+def _a2a(x, axis_name):
+    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)
+
+
+def _wire_exchange(x, axis_name, comm_dtype):
+    """One compressed all_to_all. x: (n_dev, ...) local block layout."""
+    if comm_dtype is None:
+        return _a2a(x, axis_name)
+    if comm_dtype == "bfloat16":
+        return _a2a(x.astype(jnp.bfloat16), axis_name).astype(x.dtype)
+    if comm_dtype == "int8":
+        # per-destination-row chunk scaling (one amax per outbound block,
+        # the zero.py reduce_scatter idiom): scale rides the wire as f32
+        n = x.shape[0]
+        flat = x.reshape(n, -1)
+        amax = jnp.max(jnp.abs(flat), axis=1, keepdims=True)
+        scale = jnp.maximum(amax / 127.0, 1e-12)
+        q = jnp.clip(jnp.round(flat / scale), -127, 127).astype(jnp.int8)
+        q = _a2a(q, axis_name)
+        scale = _a2a(scale, axis_name)
+        return (q.astype(x.dtype) * scale).reshape(x.shape)
+    raise ValueError(f"unsupported comm_dtype {comm_dtype!r}")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def wire_all_to_all(x, axis_name: str, comm_dtype: Optional[str] = None):
+    """``lax.all_to_all(split_axis=0, concat_axis=0)`` over `axis_name`,
+    optionally compressed on the wire (``comm_dtype`` None/'bfloat16'/
+    'int8' — the ``MXNET_TPU_COMM_DTYPE`` vocabulary, canonicalized by
+    ``zero.canonical_comm_dtype``). The backward exchange compresses the
+    cotangent identically, so forward and backward wire volume match
+    ``all_to_all_wire_bytes`` exactly."""
+    return _wire_exchange(x, axis_name, comm_dtype)
+
+
+def _wire_a2a_fwd(x, axis_name, comm_dtype):
+    return _wire_exchange(x, axis_name, comm_dtype), None
+
+
+def _wire_a2a_bwd(axis_name, comm_dtype, _res, g):
+    return (_wire_exchange(g, axis_name, comm_dtype),)
+
+
+wire_all_to_all.defvjp(_wire_a2a_fwd, _wire_a2a_bwd)
+
+
+def moe_capacity(n_tokens_local: int, top_k: int, capacity_factor: float,
+                 n_experts: int) -> int:
+    """The per-expert slot count every gating call in this module uses."""
+    return max(1, int(capacity_factor * n_tokens_local * top_k / n_experts))
+
+
+def all_to_all_wire_bytes(n_tokens_local: int, d_model: int, *,
+                          n_experts: int, top_k: int,
+                          capacity_factor: float, ep: int,
+                          comm_dtype: Optional[str] = None,
+                          dtype="float32") -> int:
+    """Exact per-device wire bytes of ONE dispatch/combine exchange.
+
+    The exchanged tensor is (ep, El, C, D) = E*C*D elements per device; an
+    all_to_all keeps 1/ep of it local, so (ep-1)/ep of the payload crosses
+    the wire — the same (n-1)/n convention the ZeRO wire accounting uses
+    (zero.reduce_scatter_wire_bytes). Compression changes the element size
+    (bf16: 2, int8: 1 + one f32 scale per outbound row); ``comm_dtype``
+    None means the payload dtype. Multiply by 4 * n_layers for a full MoE
+    training step (dispatch + combine, forward + backward).
+    """
+    if ep <= 1:
+        return 0
+    cap = moe_capacity(n_tokens_local, top_k, capacity_factor, n_experts)
+    elems = n_experts * cap * d_model
+    if comm_dtype == "bfloat16":
+        item = 2
+        extra = 0
+    elif comm_dtype == "int8":
+        item = 1
+        extra = ep * 4                      # one f32 scale per outbound row
+    else:
+        item = _np.dtype(dtype).itemsize
+        extra = 0
+    return elems * item * (ep - 1) // ep + extra
+
+
+# ---------------------------------------------------------------------------
+# MoE layers
+# ---------------------------------------------------------------------------
+
 def moe_ffn(x, gate_w, w1, w2, *, top_k: int = 2,
             capacity_factor: float = 1.5, activation=jax.nn.relu,
-            normalize_gates: bool = True):
+            normalize_gates: bool = True, return_aux: bool = False):
     """Dense (single-shard) MoE FFN.
 
-    x (N, D); gate_w (D, E); w1 (E, D, H); w2 (E, H, D). Returns (N, D).
+    x (N, D); gate_w (D, E); w1 (E, D, H); w2 (E, H, D). Returns (N, D),
+    or ``(y, {"aux_loss", "dropped"})`` with ``return_aux=True`` — the
+    Switch load-balance loss and the capacity-overflow count for this call.
     """
     N, D = x.shape
     E = gate_w.shape[1]
-    capacity = max(1, int(capacity_factor * N * top_k / E))
+    capacity = moe_capacity(N, top_k, capacity_factor, E)
     logits = x @ gate_w
+    probs = jax.nn.softmax(logits, axis=-1)
     dispatch, combine = topk_gating(logits, top_k, capacity)
     if normalize_gates:
         denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
@@ -69,12 +219,19 @@ def moe_ffn(x, gate_w, w1, w2, *, top_k: int = 2,
     expert_in = jnp.einsum("nd,nec->ecd", x, dispatch)     # (E, C, D)
     h = activation(jnp.einsum("ecd,edh->ech", expert_in, w1))
     expert_out = jnp.einsum("ech,ehd->ecd", h, w2)         # (E, C, D)
-    return jnp.einsum("ecd,nec->nd", expert_out, combine)
+    y = jnp.einsum("ecd,nec->nd", expert_out, combine)
+    if not return_aux:
+        return y
+    aux = {"aux_loss": load_balance_loss(probs, dispatch),
+           "dropped": dropped_tokens(dispatch, N, top_k)}
+    return y, aux
 
 
 def expert_parallel_moe(x, gate_w, w1_local, w2_local, *, axis_name: str,
                         top_k: int = 2, capacity_factor: float = 1.5,
-                        activation=jax.nn.relu, normalize_gates: bool = True):
+                        activation=jax.nn.relu, normalize_gates: bool = True,
+                        comm_dtype: Optional[str] = None,
+                        return_aux: bool = False):
     """Expert-parallel MoE FFN — call inside shard_map over `axis_name`.
 
     Tokens are sharded over the axis (x is the LOCAL (Nl, D) shard); experts
@@ -85,15 +242,19 @@ def expert_parallel_moe(x, gate_w, w1_local, w2_local, *, axis_name: str,
       -> batched expert FFN on local experts
       -> all_to_all back -> combine locally
 
-    Same math as moe_ffn on the gathered arrays (up to capacity rounding).
+    Same math as moe_ffn on the gathered arrays (up to capacity rounding);
+    with ``axis_size == 1`` the exchanges are identities and the result
+    equals ``moe_ffn`` bitwise. ``comm_dtype`` compresses both exchanges on
+    the wire (``wire_all_to_all``).
     """
     n_dev = _axis_size(axis_name)
     Nl, D = x.shape
     El = w1_local.shape[0]
     E = El * n_dev
-    capacity = max(1, int(capacity_factor * Nl * top_k / E))
+    capacity = moe_capacity(Nl, top_k, capacity_factor, E)
 
     logits = x @ gate_w                                     # (Nl, E)
+    probs = jax.nn.softmax(logits, axis=-1)
     dispatch, combine = topk_gating(logits, top_k, capacity)
     if normalize_gates:
         denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
@@ -102,26 +263,103 @@ def expert_parallel_moe(x, gate_w, w1_local, w2_local, *, axis_name: str,
     # regroup experts by owner device and exchange: after all_to_all, axis 0
     # indexes the SOURCE device and axis 1 the local expert
     expert_in = expert_in.reshape(n_dev, El, capacity, D)
-    expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0,
-                               concat_axis=0, tiled=False)
+    expert_in = wire_all_to_all(expert_in, axis_name, comm_dtype)
     # (n_dev_src, El, C, D) -> (El, n_dev_src * C, D)
     gathered = jnp.moveaxis(expert_in, 0, 1).reshape(El, n_dev * capacity, D)
     h = activation(jnp.einsum("ecd,edh->ech", gathered, w1_local))
     out = jnp.einsum("ech,ehd->ecd", h, w2_local)           # (El, n_dev*C, D)
     # reverse exchange: send each source device its slots back
     out = jnp.moveaxis(out.reshape(El, n_dev, capacity, D), 1, 0)
-    out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
-                         tiled=False)                       # (n_dev, El, C, D)
+    out = wire_all_to_all(out, axis_name, comm_dtype)       # (n_dev, El, C, D)
     out = out.reshape(E, capacity, D)
-    return jnp.einsum("ecd,nec->nd", out, combine)
+    y = jnp.einsum("ecd,nec->nd", out, combine)
+    if not return_aux:
+        return y
+    aux = {"aux_loss": load_balance_loss(probs, dispatch),
+           "dropped": dropped_tokens(dispatch, Nl, top_k)}
+    return y, aux
 
 
 def load_balancing_loss(logits, top_k: int = 2):
-    """Auxiliary load-balance loss (Switch Transformer eq. 4): encourages
-    uniform expert utilization. Returns a scalar >= 1/E."""
+    """Auxiliary load-balance loss (Switch Transformer eq. 4) from raw
+    logits, pre-capacity (kept for callers that gate elsewhere; the
+    post-capacity variant is ``load_balance_loss``). Scalar >= 1/E."""
     N, E = logits.shape
     probs = jax.nn.softmax(logits, axis=-1)
     _, idx = lax.top_k(probs, top_k)
     me = jnp.mean(probs, axis=0)                            # mean router prob
     ce = jnp.mean(jax.nn.one_hot(idx[:, 0], E), axis=0)     # token fraction
     return E * jnp.sum(me * ce)
+
+
+# ---------------------------------------------------------------------------
+# Trace-time plumbing for model cells (models/moe_transformer.py): which
+# mesh axis the MoE layers should dispatch over, and where they report
+# their per-call aux loss / dropped count. Both are plain trace-time
+# context stacks — the recipe trainer opens them around the apply-fn call
+# inside its loss function, so the collected values are tracers belonging
+# to that trace and flow into the fused step's outputs.
+# ---------------------------------------------------------------------------
+
+class _ExpertCtx:
+    __slots__ = ("axis_name", "comm_dtype")
+
+    def __init__(self, axis_name, comm_dtype):
+        self.axis_name = axis_name
+        self.comm_dtype = comm_dtype
+
+
+_EXPERT_STACK: List[_ExpertCtx] = []
+_COLLECT_STACK: List["MoEMetrics"] = []
+
+
+class MoEMetrics:
+    """Per-trace accumulator the MoE cells append to."""
+
+    def __init__(self):
+        self.aux_losses = []
+        self.dropped = []
+
+    def add(self, aux):
+        self.aux_losses.append(aux["aux_loss"])
+        self.dropped.append(aux["dropped"])
+
+    def aux_loss(self):
+        return sum(self.aux_losses) if self.aux_losses else jnp.float32(0.0)
+
+    def dropped_total(self):
+        return sum(self.dropped) if self.dropped else jnp.int32(0)
+
+
+@contextlib.contextmanager
+def expert_axis(axis_name: str, comm_dtype: Optional[str] = None):
+    """While active, MoE cells traced under this context dispatch with
+    ``expert_parallel_moe`` over `axis_name` (their expert params are the
+    local ep shards) instead of the single-shard ``moe_ffn``."""
+    _EXPERT_STACK.append(_ExpertCtx(axis_name, comm_dtype))
+    try:
+        yield
+    finally:
+        _EXPERT_STACK.pop()
+
+
+def current_expert_axis() -> Optional[_ExpertCtx]:
+    return _EXPERT_STACK[-1] if _EXPERT_STACK else None
+
+
+@contextlib.contextmanager
+def collect_metrics():
+    """Collect every MoE cell's (aux_loss, dropped) traced inside the
+    ``with`` body. Yields the ``MoEMetrics`` accumulator."""
+    mc = MoEMetrics()
+    _COLLECT_STACK.append(mc)
+    try:
+        yield mc
+    finally:
+        _COLLECT_STACK.pop()
+
+
+def report_metrics(aux):
+    """Called by MoE cells after each gated forward."""
+    if _COLLECT_STACK:
+        _COLLECT_STACK[-1].add(aux)

@@ -1,0 +1,65 @@
+"""Reference large-model training recipes (docs/large_models.md).
+
+Each recipe is a composable (model-builder, trainer-config, parity-oracle)
+triple that turns a large-model primitive into a first-class benchmarked
+workload, the way ResNet/BERT exercise the dense path:
+
+  - ``recipes.moe``:  sparse-MoE transformer with expert parallelism over
+    an 'ep' mesh axis — capacity gating + aux load-balance loss, quantized
+    all_to_all dispatch/combine, ZeRO-over-dp for the dense params, full
+    StepProgram/roofline/elastic integration. Oracle: the same model with
+    ``dense_ffn=True`` (E=1 degenerate gating matches it exactly).
+  - ``recipes.long_context``: >=32k-token BERT variant on the blockwise/
+    flash attention path, sequence chunking through ``DeviceFeed``.
+    Oracle: the dense O(T^2) attention path at moderate T.
+
+The subsystem is lazy — ``mxnet_tpu.recipes.moe`` imports nothing until
+touched (jax-free at package import, like mxnet_tpu.elastic).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+__all__ = ["Recipe", "get_recipe", "list_recipes", "moe", "long_context"]
+
+
+class Recipe(NamedTuple):
+    """The (model-builder, trainer-config, parity-oracle) triple."""
+    name: str
+    build_model: Callable[..., Any]     # -> initialized HybridBlock
+    build_trainer: Callable[..., Any]   # (net, mesh, **kw) -> trainer
+    build_oracle: Callable[..., Any]    # -> the parity-reference model
+
+
+_REGISTRY = {}
+
+
+def _lazy(name):
+    import importlib
+    mod = importlib.import_module(f".{name}", __name__)
+    globals()[name] = mod
+    return mod
+
+
+def __getattr__(name):
+    if name in ("moe", "long_context"):
+        return _lazy(name)
+    raise AttributeError(f"module 'mxnet_tpu.recipes' has no attribute {name!r}")
+
+
+def get_recipe(name: str) -> Recipe:
+    if name not in _REGISTRY:
+        if name in ("moe", "long_context"):
+            _lazy(name)  # registers itself at import
+        else:
+            raise KeyError(f"unknown recipe {name!r}; have {list_recipes()}")
+    return _REGISTRY[name]
+
+
+def list_recipes():
+    return ["moe", "long_context"]
+
+
+def register(recipe: Recipe):
+    _REGISTRY[recipe.name] = recipe
+    return recipe

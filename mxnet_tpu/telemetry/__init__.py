@@ -50,7 +50,7 @@ __all__ = [
     "peak_bytes_per_second", "ridge_point", "roofline", "trace_steps",
     "trace_active",
     "record_feed_depth", "record_feed_stall", "record_inflight",
-    "record_checkpoint_save", "record_resume",
+    "record_checkpoint_save", "record_resume", "record_moe_dropped",
     "set_epoch", "timed", "annotate", "start_http_server",
     "stop_http_server", "DEFAULT_LATENCY_BUCKETS", "record_serving_enqueue",
     "record_serving_queue_depth", "record_serving_dispatch",
@@ -791,6 +791,23 @@ def record_resume(outcome: str, source: str = "elastic"):
     counter("mx_resume_total",
             "Worker boots by restore outcome",
             ("outcome", "source")).labels(outcome, source).inc()
+
+
+# ---------------------------------------------------------------------------
+# MoE recipes (mxnet_tpu/recipes/moe.py — docs/large_models.md)
+# ---------------------------------------------------------------------------
+
+def record_moe_dropped(n: int, source: str = "moe"):
+    """Capacity-overflow (token, choice) assignments dropped by top-k
+    gating, summed over experts and devices. Booked at drain()/sync()
+    from device handles the step path accumulated — never per step, so
+    the counter costs no host sync on the hot path. A sustained rate
+    above a few percent of tokens/step means capacity_factor is too low
+    or the router collapsed (check it against the aux loss — see
+    docs/large_models.md)."""
+    counter("mx_moe_dropped_tokens_total",
+            "Tokens dropped by MoE capacity overflow",
+            ("source",)).labels(source).inc(max(int(n), 0))
 
 
 # ---------------------------------------------------------------------------

@@ -115,6 +115,66 @@ def test_flash_bf16(interpret_mode):
 
 
 # ---------------------------------------------------------------------------
+# non-Pallas fallback gradient path (NO interpret fixture: on CPU
+# flash_attention routes to the blockwise lax.scan — the path every
+# CPU-trained model differentiates through)
+# ---------------------------------------------------------------------------
+
+def _grad_pair(fn_a, fn_b, q, k, v, seed):
+    """Cotangent-contracted grads of both implementations."""
+    rs = np.random.RandomState(seed)
+    co = jnp.asarray(rs.normal(0, 1, q.shape).astype(np.float32))
+    ga = jax.grad(lambda *a: jnp.vdot(fn_a(*a), co), argnums=(0, 1, 2))(q, k, v)
+    gb = jax.grad(lambda *a: jnp.vdot(fn_b(*a), co), argnums=(0, 1, 2))(q, k, v)
+    return ga, gb
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("T,block", [(100, 256), (257, 256), (128, 32)])
+def test_fallback_grad_matches_naive_vjp(causal, T, block):
+    """The CPU fallback's gradient must equal the dense-softmax VJP,
+    including sequence lengths that are NOT a multiple of the block (the
+    padded key rows must contribute exactly zero cotangent)."""
+    q, k, v = _rand_qkv(11 + T, B=1, H=2, T=T, D=16)
+    g_fb, g_ref = _grad_pair(
+        lambda q, k, v: flash_attention(q, k, v, causal=causal,
+                                        block_q=block, block_k=block),
+        lambda q, k, v: naive_attention(q, k, v, causal=causal),
+        q, k, v, seed=T)
+    for name, a, b in zip("qkv", g_fb, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5,
+            err_msg=f"fallback d{name} diverges at T={T} causal={causal}")
+
+
+def test_fallback_grad_cross_attention():
+    # Tk != T and Tk not a block multiple: key-padding mask in the bwd
+    q, k, v = _rand_qkv(21, B=1, H=1, T=96, Tk=200, D=16)
+    g_fb, g_ref = _grad_pair(
+        lambda q, k, v: flash_attention(q, k, v, block_k=128),
+        lambda q, k, v: naive_attention(q, k, v),
+        q, k, v, seed=21)
+    for a, b in zip(g_fb, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_fallback_grad_matches_blockwise_direct():
+    """flash_attention's fallback and blockwise_attention called directly
+    must be the SAME differentiable function (routing adds no wrapper that
+    detaches or rescales gradients)."""
+    q, k, v = _rand_qkv(22, B=1, H=2, T=100, D=16)
+    g_fb, g_bw = _grad_pair(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, block_k=64),
+        lambda q, k, v: blockwise_attention(q, k, v, causal=True,
+                                            block_size=64),
+        q, k, v, seed=22)
+    for a, b in zip(g_fb, g_bw):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
 # fused optimizer
 # ---------------------------------------------------------------------------
 

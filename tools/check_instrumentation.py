@@ -115,6 +115,21 @@ METHOD_CHECKS = [
      {"record_checkpoint_save"}, "call"),
     ("elastic/run.py", None, "_record_resume",
      {"record_resume"}, "call"),
+    # large-model recipes (ISSUE 12): the MoE trainer must book its
+    # all_to_all dispatch/combine wire volume per step and its dropped-
+    # token count at the drain boundary (capacity starvation must show on
+    # mx_moe_dropped_tokens_total, never require a per-step host sync);
+    # the long-context trainer must book the ring ppermute volume
+    ("recipes/moe.py", "MoETrainer", "step",
+     {"record_step", "_record_telemetry"}, "call"),
+    ("recipes/moe.py", "MoETrainer", "_record_telemetry",
+     {"record_comm"}, "call"),
+    ("recipes/moe.py", "MoETrainer", "_flush_dropped",
+     {"record_moe_dropped"}, "call"),
+    # LongContextTrainer inherits step() from DataParallelTrainer (already
+    # checked above); its telemetry override books the ring wire volume
+    ("recipes/long_context.py", "LongContextTrainer", "_record_telemetry",
+     {"record_comm"}, "call"),
 ]
 
 # (relative file, required substring, rationale)
@@ -191,6 +206,17 @@ TEXT_CHECKS = [
      "the registry must export the boot-outcome counter "
      "(fresh/resumed/resharded — fresh after a kill means snapshots are "
      "not landing)"),
+    # large-model recipes (ISSUE 12)
+    ("telemetry/__init__.py", "mx_moe_dropped_tokens_total",
+     "the registry must export the MoE capacity-overflow counter "
+     "(a silently-dropping router looks like a loss plateau without it)"),
+    ("recipes/moe.py", '"all_to_all"',
+     "the MoE trainer must book the expert dispatch/combine exchanges "
+     "under their own comm kind (the a2a wire is the expert-parallel "
+     "scaling limit; folding it into generic comm hides it)"),
+    ("recipes/long_context.py", '"ppermute"',
+     "the long-context trainer must book the ring-attention kv rotation "
+     "volume (sequence-parallel wire accounting, docs/large_models.md)"),
 ]
 
 

@@ -72,6 +72,18 @@ HOT_FUNCTIONS = [
     ("mxnet_tpu/elastic/state.py",
      r"\b(capture|_capture_dp|_capture_pp|_common_meta|_bucket_dict)\b"),
     ("mxnet_tpu/elastic/run.py", r"\b(capture_trainer|save_trainer)\b"),
+    # large-model recipes (ISSUE 12): the fused dp x ep / dp x sp step
+    # dispatch and the per-step comm byte accounting must stay sync-free —
+    # the dropped-token counters ride as device handles until drain. The
+    # designed sync (`_flush_dropped`'s int(handle) at the drain boundary)
+    # is deliberately NOT hot. LongContextTrainer.step is inherited from
+    # DataParallelTrainer and covered by that file's row.
+    ("mxnet_tpu/recipes/moe.py",
+     r"MoETrainer\.(step|_build_step_zero|_record_telemetry|"
+     r"_a2a_step_bytes)\b"),
+    ("mxnet_tpu/recipes/long_context.py",
+     r"LongContextTrainer\.(_build_step_zero|_record_telemetry|"
+     r"_ring_step_bytes)\b"),
 ]
 
 # host reads of *python* scalars that merely look like syncs. Matched

@@ -47,6 +47,14 @@ LOOP_FUNCTIONS = [
     # with async snapshot saves — syncing on the running step's loss would
     # stall both; losses stay PendingScalar until the caller drains them
     ("mxnet_tpu/elastic/run.py", r"\brun\b"),
+    # recipe trainers (ISSUE 12): the traced bodies built by the zero-step
+    # builders loop over params/buckets while losses and dropped counts
+    # stay device values; `drain()` is the designed drain point and is not
+    # listed. LongContextTrainer.step comes from DataParallelTrainer.
+    ("mxnet_tpu/recipes/moe.py",
+     r"MoETrainer\.(step|_build_step_zero)\b"),
+    ("mxnet_tpu/recipes/long_context.py",
+     r"LongContextTrainer\._build_step_zero\b"),
 ]
 
 # calls whose result is a step output: loss/metric/output handles the loop
