@@ -1739,10 +1739,230 @@ def bench_lint_walltime():
     }
 
 
+def bench_chaos():
+    """The fault-injection plane's two promises, measured (ISSUE 13):
+
+    1. **Free when off.** The headline A/B runs the elastic snapshot hot
+       cycle (write_shard -> commit -> load -> SnapshotReader) with the
+       plane disarmed vs armed-but-never-firing (every elastic point on
+       ``every_nth:10^9`` — strictly MORE work than disarmed: the lock,
+       the attempt counters, the schedule call all run). Gate: < 1%.
+       The disarmed guard itself (`if _faults._ACTIVE` at a call site)
+       is also timed directly, in ns/check.
+
+    2. **Bounded recovery.** Per fault class, the wall-clock cost of one
+       injected transient fault absorbed by its recovery path, vs the
+       clean run: shard write / manifest commit / manifest read under
+       ``first_k:1`` (io_retry), a DeviceFeed producer restart
+       (exactly-once redelivery), and the serving admission reject
+       latency (how fast an overloaded queue says 503-equivalent).
+    """
+    import shutil
+    import statistics
+    import tempfile
+    import threading
+
+    from mxnet_tpu import faults
+    from mxnet_tpu.elastic import manifest as _manifest
+    from mxnet_tpu.engine.async_feed import DeviceFeed
+    from mxnet_tpu.serving.batcher import ContinuousBatcher, ServerOverloaded
+
+    os.environ["MXNET_TPU_IO_BACKOFF"] = "0.001"  # recovery lanes: tiny,
+    os.environ["MXNET_TPU_IO_BACKOFF_MAX"] = "0.002"  # bounded jitter
+    cycles = int(os.environ.get("BENCH_CHAOS_CYCLES", 60))
+    reps = int(os.environ.get("BENCH_CHAOS_REPS", 3))
+    rs = np.random.RandomState(0)
+    arr = rs.uniform(-1, 1, (64, 128)).astype(np.float32)
+    entries = [("w", [(0, 64), (0, 128)], arr, arr.shape, arr.dtype)]
+    root = tempfile.mkdtemp(prefix="mx-bench-chaos-")
+    counter = [0]
+
+    def cycle(tag):
+        counter[0] += 1
+        step = counter[0]
+        sub = os.path.join(root, tag)
+        sdir = _manifest.step_path(sub, step)
+        _manifest.write_shard(sdir, 0, entries)
+        _manifest.commit(sdir, step, {"step": step})
+        man = _manifest.load(sub, step)
+        with _manifest.SnapshotReader(sub, step, manifest=man) as rd:
+            rd("w")
+
+    try:
+        faults.clear()
+        for _ in range(5):  # warm the fs path + imports
+            cycle("warm")
+        never = "every_nth:1000000000"
+        dt_off = dt_on = float("inf")
+        for _ in range(reps):  # paired interleaved reps, min aggregation
+            t0 = time.perf_counter()
+            for _ in range(cycles):
+                cycle("off")
+            dt_off = min(dt_off, time.perf_counter() - t0)
+            for p in ("elastic.write_shard", "elastic.commit",
+                      "elastic.read"):
+                faults.inject(p, never)
+            t0 = time.perf_counter()
+            for _ in range(cycles):
+                cycle("on")
+            dt_on = min(dt_on, time.perf_counter() - t0)
+            faults.clear()
+        overhead = dt_on / dt_off - 1.0
+
+        # disarmed call-site guard, ns/check (the TRUE disabled path)
+        n = 2_000_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            if faults._ACTIVE:
+                faults.check("elastic.read")
+        guard_ns = (time.perf_counter() - t0) / n * 1e9
+
+        def _recover(point, fn, trials=15):
+            """Median wall of one clean run vs one run whose FIRST attempt
+            is injected and absorbed (first_k:1 + counter reset)."""
+            clean, faulty = [], []
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                fn()
+                clean.append(time.perf_counter() - t0)
+                faults.inject(point, "first_k:1")
+                try:
+                    t0 = time.perf_counter()
+                    fn()
+                    faulty.append(time.perf_counter() - t0)
+                finally:
+                    faults.clear()  # reset attempts so first_k re-fires
+            return (statistics.median(clean) * 1e3,
+                    statistics.median(faulty) * 1e3)
+
+        wr_clean, wr_fault = _recover(
+            "elastic.write_shard",
+            lambda: _manifest.write_shard(
+                _manifest.step_path(os.path.join(root, "rw"), 1), 0,
+                entries))
+        cm_state = {"n": 1000}
+
+        def _commit_once():
+            cm_state["n"] += 1
+            sdir = _manifest.step_path(os.path.join(root, "rc"),
+                                       cm_state["n"])
+            _manifest.write_shard(sdir, 0, entries)
+            faults.clear("elastic.write_shard")
+            _manifest.commit(sdir, cm_state["n"], {"step": cm_state["n"]})
+
+        cm_clean, cm_fault = _recover("elastic.commit", _commit_once)
+        rd_clean, rd_fault = _recover(
+            "elastic.read",
+            lambda: _manifest.load(os.path.join(root, "rc"),
+                                   cm_state["n"]))
+
+        # DeviceFeed producer restart: exactly-once redelivery cost
+        class _Src:
+            def __iter__(self):
+                return (np.full((4,), float(i), np.float32)
+                        for i in range(16))
+
+        def _drain(restarts=0):
+            feed = DeviceFeed(_Src(), name="bench-chaos",
+                              restarts=restarts)
+            t0 = time.perf_counter()
+            n = sum(1 for _ in feed)
+            dt = time.perf_counter() - t0
+            feed.close()
+            assert n == 16
+            return dt * 1e3
+
+        _drain()  # warm the backend
+        fd_clean = statistics.median(_drain() for _ in range(5))
+        fd_fault = []
+        for _ in range(5):
+            faults.inject("feed.produce", "first_k:1")
+            try:
+                fd_fault.append(_drain(restarts=1))
+            finally:
+                faults.clear()
+        fd_fault = statistics.median(fd_fault)
+
+        # serving admission reject latency (how fast overload says no)
+        class _Stub:
+            name = "bench"
+            input_names = ("data",)
+            output_names = ("out",)
+            buckets = (1, 4)
+            max_bucket = 4
+
+            def input_dtype(self, name):
+                return "float32"
+
+            def row_shape(self, name):
+                return (2,)
+
+            def smallest_bucket(self, rows):
+                return 1 if rows <= 1 else 4
+
+            def place_input(self, name, host):
+                return host
+
+            def forward(self, bucket, feed):
+                return [feed["data"]]
+
+        b = ContinuousBatcher(_Stub(), max_wait_ms=10_000, max_queue=1)
+        try:
+            b.submit(data=np.zeros((2,), np.float32))  # fill the bound
+            lat = []
+            for _ in range(300):
+                t0 = time.perf_counter()
+                try:
+                    b.submit(data=np.zeros((2,), np.float32))
+                except ServerOverloaded:
+                    lat.append(time.perf_counter() - t0)
+            shed_us = statistics.median(lat) * 1e6
+        finally:
+            b.close()
+
+        return {
+            "metric": "chaos_disabled_path_overhead",
+            "value": round(overhead * 100, 2),
+            "unit": "% snapshot-cycle overhead, plane armed-never-fire "
+                    "vs disarmed",
+            "vs_baseline": round(dt_on / dt_off, 4),
+            "extra": {
+                "pass_lt_1pct": overhead < 0.01,
+                "cycles": cycles,
+                "cycle_ms_disarmed": round(dt_off / cycles * 1e3, 3),
+                "cycle_ms_armed_never_fire": round(dt_on / cycles * 1e3, 3),
+                "disarmed_guard_ns_per_check": round(guard_ns, 1),
+                "recovery_ms": {
+                    "elastic.write_shard": {"clean": round(wr_clean, 3),
+                                            "one_fault": round(wr_fault, 3)},
+                    "elastic.commit": {"clean": round(cm_clean, 3),
+                                       "one_fault": round(cm_fault, 3)},
+                    "elastic.read": {"clean": round(rd_clean, 3),
+                                     "one_fault": round(rd_fault, 3)},
+                    "feed.produce_restart_16_batches": {
+                        "clean": round(fd_clean, 3),
+                        "one_fault": round(fd_fault, 3)},
+                },
+                "shed_reject_us_p50": round(shed_us, 1),
+                "io_backoff_s": float(os.environ["MXNET_TPU_IO_BACKOFF"]),
+                "host_cores": os.cpu_count(),
+            },
+        }
+    finally:
+        faults.clear()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main():
     if os.environ.get("BENCH_SCENARIO") == "lint_walltime":
         # no backend init needed (and none wanted: this must run anywhere)
         print(json.dumps(bench_lint_walltime()))
+        return
+    if os.environ.get("BENCH_SCENARIO") == "chaos":
+        # host-only: manifest IO, queue policy, and the DeviceFeed lane's
+        # device_put land on CPU — the plane's costs are host costs
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps(bench_chaos()))
         return
     if os.environ.get("BENCH_SCENARIO") == "async_feed":
         # the dp parity variant needs >1 device: request virtual host

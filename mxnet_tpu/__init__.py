@@ -115,6 +115,7 @@ _LAZY_SUBMODULES = {
     "library": ".library",
     "checkpoint": ".checkpoint",   # orbax costs ~2.6 s to import
     "elastic": ".elastic",
+    "faults": ".faults",
     "recipes": ".recipes",
     "predict": ".predict",
     "serving": ".serving",

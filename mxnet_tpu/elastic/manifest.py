@@ -25,17 +25,31 @@ The manifest records everything restore needs WITHOUT the saving process:
   - ``meta``: the trainer-level host state (step, schedule counters, loss
     scale, RNG is a leaf, ZeRO bucket plans, mesh shape, program
     fingerprint) — see elastic/state.py for the exact schema.
+
+Failure hardening (docs/reliability.md): every write path fsyncs file
+contents before its ``os.replace`` and fsyncs the directory after — the
+rename alone orders the metadata but not the data, so a power cut could
+otherwise commit a manifest pointing at torn shards. All IO runs under
+``faults.io_retry`` (bounded backoff+jitter on ``OSError``/injected
+faults, ``MXNET_TPU_IO_RETRIES``), and ``commit`` serializes concurrent
+committers through a lease file with a fencing token: exactly one writer
+finalizes a step's manifest, a fenced-out writer raises ``MXNetError``
+instead of interleaving, and a crashed committer's stale lease is taken
+over with an incremented token.
 """
 from __future__ import annotations
 
 import json
 import os
 import shutil
+import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 import numpy as _np
 
+from .. import faults as _faults
 from ..base import MXNetError
 
 __all__ = ["step_dirname", "step_path", "parse_step", "all_complete_steps",
@@ -45,6 +59,27 @@ __all__ = ["step_dirname", "step_path", "parse_step", "all_complete_steps",
 FORMAT = 1
 _STEP_PREFIX = "step-"
 MANIFEST = "manifest.json"
+LEASE = "commit.lease"
+
+
+def _fsync_file(f):
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str):
+    """Make a completed rename durable: fsync the containing directory.
+    Best-effort no-op on platforms where directories can't be opened."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def step_dirname(step: int) -> str:
@@ -107,27 +142,121 @@ def write_shard(sdir: str, process_index: int, entries) -> int:
         leaves[name] = {"shape": [int(d) for d in gshape],
                         "dtype": str(dtype)}
     base = os.path.join(sdir, f"shard-{int(process_index):05d}")
-    tmp = base + ".npz.tmp"
-    with open(tmp, "wb") as f:
-        _np.savez(f, **payload)
-    os.replace(tmp, base + ".npz")
-    tmp = base + ".json.tmp"
-    with open(tmp, "w") as f:
-        json.dump({"process": int(process_index), "chunks": chunks,
-                   "leaves": leaves, "nbytes": int(nbytes)}, f)
-    os.replace(tmp, base + ".json")
+
+    def _write_payload():
+        tmp = base + ".npz.tmp"
+        with open(tmp, "wb") as f:
+            _np.savez(f, **payload)
+            _fsync_file(f)
+        os.replace(tmp, base + ".npz")
+
+    def _write_index():
+        tmp = base + ".json.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"process": int(process_index), "chunks": chunks,
+                       "leaves": leaves, "nbytes": int(nbytes)}, f)
+            _fsync_file(f)
+        os.replace(tmp, base + ".json")
+
+    _faults.io_retry("elastic.write_shard", _write_payload)
+    _faults.io_retry("elastic.write_shard", _write_index)
+    _fsync_dir(sdir)
     return nbytes
 
 
+# -- commit lease: exactly one concurrent committer finalizes a step --------
+
+def _lease_path(sdir: str) -> str:
+    return os.path.join(sdir, LEASE)
+
+
+def _read_lease(sdir: str) -> Dict[str, Any]:
+    try:
+        with open(_lease_path(sdir)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _write_lease_to(path: str, owner: str, token: int):
+    with open(path, "w") as f:
+        json.dump({"owner": owner, "token": int(token),
+                   "ts": time.time()}, f)
+        _fsync_file(f)
+
+
+def _acquire_lease(sdir: str, owner: str, stale_after: float) -> int:
+    """Take the step dir's commit lease; returns this holder's fencing
+    token. Exactly one of N concurrent committers wins via O_EXCL create
+    (shared-filesystem atomic); losers raise ``MXNetError``. A lease whose
+    holder died (older than ``stale_after`` seconds) is taken over with an
+    INCREMENTED token, so a crashed committer cannot block commits forever
+    while the fenced-out stale holder can never finalize — ``commit``
+    re-verifies owner+token immediately before the manifest rename."""
+    path = _lease_path(sdir)
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        pass
+    else:
+        with os.fdopen(fd, "w") as f:
+            json.dump({"owner": owner, "token": 1, "ts": time.time()}, f)
+            _fsync_file(f)
+        return 1
+    holder = _read_lease(sdir)
+    age = time.time() - float(holder.get("ts", 0.0))
+    if age <= stale_after and holder:
+        raise MXNetError(
+            f"snapshot commit lease for {sdir} is held by "
+            f"{holder.get('owner')!r} (age {age:.1f}s, token "
+            f"{holder.get('token')}): exactly one committer may finalize "
+            "a step; this writer lost the race")
+    token = int(holder.get("token", 0)) + 1
+    tmp = path + f".{owner}.tmp"
+    _write_lease_to(tmp, owner, token)
+    os.replace(tmp, path)
+    # concurrent takeovers race on the replace; last write wins — re-read
+    # to learn whether WE hold it now
+    if _read_lease(sdir).get("owner") != owner:
+        raise MXNetError(
+            f"lost the stale-lease takeover race for {sdir}")
+    return token
+
+
+def _verify_lease(sdir: str, owner: str, token: int):
+    cur = _read_lease(sdir)
+    if cur.get("owner") != owner or int(cur.get("token", -1)) != int(token):
+        raise MXNetError(
+            f"commit fenced out: lease for {sdir} now held by "
+            f"{cur.get('owner')!r} (token {cur.get('token')}, ours "
+            f"{token}) — a newer committer took over; this manifest "
+            "must not land")
+
+
+def _release_lease(sdir: str, owner: str):
+    if _read_lease(sdir).get("owner") == owner:
+        try:
+            os.unlink(_lease_path(sdir))
+        except OSError:
+            pass
+
+
 def commit(sdir: str, step: int, meta: Dict[str, Any],
-           expected_processes: int = 1, timeout: float = 120.0
-           ) -> Dict[str, Any]:
+           expected_processes: int = 1, timeout: float = 120.0,
+           lease_timeout: float = 30.0) -> Dict[str, Any]:
     """Merge the per-process chunk indexes and atomically write
     ``manifest.json`` — the snapshot exists only once this returns.
 
     Single-controller runs commit immediately; in multi-controller SPMD
     process 0 calls this after writing its own shard and polls (bounded by
-    ``timeout``) for the other processes' index files."""
+    ``timeout``) for the other processes' index files.
+
+    Concurrent committers (a split-brain rank 0 after an elastic restart,
+    or racing supervisors) are serialized by a lease file with a fencing
+    token: the winner's token is recorded in the manifest (``fence``), the
+    loser raises ``MXNetError`` without touching the manifest, and a lease
+    older than ``lease_timeout`` seconds is treated as a crashed holder
+    and taken over."""
     deadline = time.monotonic() + timeout
     while True:
         shard_jsons = sorted(n for n in os.listdir(sdir)
@@ -139,33 +268,58 @@ def commit(sdir: str, step: int, meta: Dict[str, Any],
                 f"snapshot commit timed out: {len(shard_jsons)}/"
                 f"{expected_processes} shard indexes present in {sdir}")
         time.sleep(0.05)
-    leaves: Dict[str, Any] = {}
-    chunks: Dict[str, List[Dict[str, Any]]] = {}
-    nbytes = 0
-    for name in shard_jsons:
-        with open(os.path.join(sdir, name)) as f:
-            shard = json.load(f)
-        npz = name[:-len(".json")] + ".npz"
-        nbytes += int(shard.get("nbytes", 0))
-        leaves.update(shard["leaves"])
-        for c in shard["chunks"]:
-            chunks.setdefault(c["name"], []).append(
-                {"file": npz, "key": c["key"], "index": c["index"]})
-    man = {"format": FORMAT, "step": int(step), "meta": meta,
-           "leaves": leaves, "chunks": chunks, "nbytes": int(nbytes)}
-    tmp = os.path.join(sdir, MANIFEST + ".tmp")
-    with open(tmp, "w") as f:
-        json.dump(man, f)
-    os.replace(tmp, os.path.join(sdir, MANIFEST))
-    return man
+    owner = f"{os.getpid()}.{threading.get_ident()}.{uuid.uuid4().hex[:8]}"
+    token = _acquire_lease(sdir, owner, lease_timeout)
+    try:
+        if os.path.exists(os.path.join(sdir, MANIFEST)):
+            raise MXNetError(
+                f"step {step} is already committed in {sdir}: another "
+                "writer won the fence; exactly one committer finalizes "
+                "a snapshot")
+        leaves: Dict[str, Any] = {}
+        chunks: Dict[str, List[Dict[str, Any]]] = {}
+        nbytes = 0
+        for name in shard_jsons:
+            with open(os.path.join(sdir, name)) as f:
+                shard = json.load(f)
+            npz = name[:-len(".json")] + ".npz"
+            nbytes += int(shard.get("nbytes", 0))
+            leaves.update(shard["leaves"])
+            for c in shard["chunks"]:
+                chunks.setdefault(c["name"], []).append(
+                    {"file": npz, "key": c["key"], "index": c["index"]})
+        man = {"format": FORMAT, "step": int(step), "meta": meta,
+               "leaves": leaves, "chunks": chunks, "nbytes": int(nbytes),
+               "fence": int(token)}
+
+        def _write_manifest():
+            tmp = os.path.join(sdir, MANIFEST + ".tmp")
+            with open(tmp, "w") as f:
+                json.dump(man, f)
+                _fsync_file(f)
+            # the fencing check: a stale holder that slept past its lease
+            # gets caught HERE, after its payload write but before the
+            # commit rename becomes visible
+            _verify_lease(sdir, owner, token)
+            os.replace(tmp, os.path.join(sdir, MANIFEST))
+            _fsync_dir(sdir)
+
+        _faults.io_retry("elastic.commit", _write_manifest)
+        return man
+    finally:
+        _release_lease(sdir, owner)
 
 
 def load(root: str, step: int) -> Dict[str, Any]:
     path = os.path.join(step_path(root, step), MANIFEST)
     if not os.path.exists(path):
         raise MXNetError(f"no complete snapshot for step {step} in {root}")
-    with open(path) as f:
-        man = json.load(f)
+
+    def _read():
+        with open(path) as f:
+            return json.load(f)
+
+    man = _faults.io_retry("elastic.read", _read)
     if man.get("format") != FORMAT:
         raise MXNetError(
             f"snapshot format {man.get('format')!r} unsupported "
@@ -218,8 +372,8 @@ class SnapshotReader:
     def _file(self, npz_name: str):
         f = self._npz.get(npz_name)
         if f is None:
-            f = self._npz[npz_name] = _np.load(
-                os.path.join(self._dir, npz_name))
+            f = self._npz[npz_name] = _faults.io_retry(
+                "elastic.read", _np.load, os.path.join(self._dir, npz_name))
         return f
 
     def __call__(self, name: str) -> _np.ndarray:

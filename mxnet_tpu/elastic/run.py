@@ -185,7 +185,25 @@ def run(trainer, feed, num_steps: int, directory: Optional[str] = None,
             x, y = _xy(batch)
             losses.append(trainer.step(x, y))
             if manager.should_save(trainer._t):
-                save_trainer(manager, trainer, feed)
+                try:
+                    save_trainer(manager, trainer, feed)
+                except MXNetError as e:
+                    # a failed INTERVAL snapshot (exhausted IO retries on a
+                    # flaky filesystem) must not kill a healthy training
+                    # job: resume falls back to the previous complete
+                    # snapshot. The FINAL snapshot below stays strict.
+                    import warnings
+                    warnings.warn(
+                        f"elastic.run: interval snapshot at step "
+                        f"{trainer._t} failed and was skipped ({e}); "
+                        "training continues, resume falls back to the "
+                        "previous snapshot", RuntimeWarning)
+                    if _telem._ENABLED:
+                        _telem.counter(
+                            "mx_snapshot_failures_total",
+                            "Interval snapshots skipped after exhausting "
+                            "IO retries", ("source",)) \
+                            .labels("elastic").inc()
             if on_step is not None:
                 on_step(trainer._t, losses[-1])
         # exit (normal or preempted): drain in-flight steps, then one

@@ -131,7 +131,7 @@ class SnapshotManager:
             nbytes = _manifest.write_shard(sdir, proc, entries)
             if proc == 0:
                 self._commit(sdir, step, meta, nbytes, t0)
-        except BaseException as e:  # surfaced at the next save()/wait
+        except BaseException as e:  # stash-and-reraise thread boundary: surfaced at the next save()/wait  # mxlint: disable=broad-except
             self._error = e
 
     def _commit(self, sdir, step, meta, nbytes, t0):

@@ -66,6 +66,18 @@ env.declare("MXNET_TPU_FEED_GIL_INTERVAL", 0.001, float,
             "switch interval makes the consumer wait up to 5 ms behind a "
             "producer mid-batch on few-core hosts; 0 leaves the "
             "interpreter setting untouched")
+env.declare("MXNET_TPU_FEED_RESTARTS", 0, int,
+            "Opt-in supervised DeviceFeed: bounded producer restarts on "
+            "transient source errors (OSError/ConnectionError/"
+            "TimeoutError + injected faults) — the producer re-opens the "
+            "source iterator, fast-forwards past already-delivered "
+            "batches host-side, and resumes; 0 (default) surfaces the "
+            "first error at next()")
+env.declare("MXNET_TPU_FEED_JOIN_TIMEOUT", 5.0, float,
+            "Seconds to wait (per drain round, two rounds) for a "
+            "DeviceFeed producer thread to exit at stop/reset/close "
+            "before abandoning it (warned loudly + counted in "
+            "mx_feed_producer_leaks_total)")
 
 
 def feed_depth() -> int:
@@ -264,7 +276,8 @@ class DeviceFeed:
     """
 
     def __init__(self, source, sharding=None, mesh=None, data_spec=None,
-                 depth: Optional[int] = None, name: str = "feed"):
+                 depth: Optional[int] = None, name: str = "feed",
+                 restarts: Optional[int] = None):
         self._source = source
         self._sharding = sharding
         self._mesh = mesh
@@ -272,8 +285,12 @@ class DeviceFeed:
         if sharding is not None and mesh is not None:
             raise MXNetError("pass sharding OR mesh+data_spec, not both")
         self._depth = max(feed_depth() if depth is None else int(depth), 1)
+        self._max_restarts = int(env.get("MXNET_TPU_FEED_RESTARTS")
+                                 if restarts is None else restarts)
         self.name = name
         self.batch_size = getattr(source, "batch_size", 0)
+        self.restarts = 0            # producer restarts taken (supervised)
+        self.producer_leaks = 0      # producer threads abandoned at join
         self._q: Optional[queue.Queue] = None
         self._stop: Optional[threading.Event] = None
         self._producer: Optional[threading.Thread] = None
@@ -370,29 +387,52 @@ class DeviceFeed:
         return self._place_leaf(item)
 
     # -- producer ------------------------------------------------------------
+    _TRANSIENT = (OSError, ConnectionError, TimeoutError)
+
     def _produce(self, stop: threading.Event, q: "queue.Queue"):
-        try:
-            it = iter(self._source)
-            # resume fast-forward: replay the source up to the restored
-            # cursor on this thread, host-side only — skipped batches are
-            # never placed on device, so rewind costs no transfers
-            skip, self._skip = self._skip, 0
-            for _ in range(skip):
-                try:
+        """Producer body, optionally supervised: with restarts budgeted
+        (``restarts=``/``MXNET_TPU_FEED_RESTARTS``) a TRANSIENT source
+        error re-opens the iterator and fast-forwards host-side past
+        everything already queued — batches are delivered exactly once,
+        in order, and ``mx_feed_producer_restarts_total`` is booked. A
+        non-transient error (or an exhausted budget) still surfaces at
+        the consumer's next()."""
+        from .. import faults as _faults
+        restarts_left = self._max_restarts
+        produced = 0
+        skip, self._skip = self._skip, 0
+        while True:
+            try:
+                it = iter(self._source)
+                # resume/restart fast-forward: replay the source up to the
+                # restored cursor plus already-produced batches on this
+                # thread, host-side only — skipped batches are never
+                # placed on device, so rewind costs no transfers
+                for _ in range(skip + produced):
                     next(it)
-                except StopIteration:
-                    _bounded_put(q, _END, stop)
-                    return
-            while not stop.is_set():
-                try:
+                while not stop.is_set():
+                    if _faults._ACTIVE:
+                        _faults.check("feed.produce")
                     item = next(it)
-                except StopIteration:
-                    _bounded_put(q, _END, stop)
-                    return
-                if not _bounded_put(q, self._place(item), stop):
-                    return
-        except Exception as e:  # surfaced at the consumer's next()
-            _bounded_put(q, e, stop)
+                    if not _bounded_put(q, self._place(item), stop):
+                        return
+                    produced += 1
+                return
+            except StopIteration:
+                _bounded_put(q, _END, stop)
+                return
+            except Exception as e:
+                if restarts_left > 0 and not stop.is_set() and \
+                        isinstance(e, self._TRANSIENT
+                                   + (_faults.FaultInjected,)):
+                    restarts_left -= 1
+                    self.restarts += 1
+                    from .. import telemetry as _telem
+                    if _telem._ENABLED:
+                        _telem.record_feed_producer_restart(self.name)
+                    continue
+                _bounded_put(q, e, stop)  # surfaced at the consumer's next()
+                return
 
     def _ensure_producer(self):
         if self._producer is not None and self._producer.is_alive():
@@ -418,6 +458,8 @@ class DeviceFeed:
     def _stop_producer(self):
         if self._producer is not None and self._stop is not None:
             self._stop.set()
+            timeout = max(float(env.get("MXNET_TPU_FEED_JOIN_TIMEOUT")),
+                          0.01)
             # unblock a producer stuck in put(), then join; drain again in
             # case it completed one more put before seeing the stop flag
             for _ in range(2):
@@ -426,9 +468,26 @@ class DeviceFeed:
                         self._q.get_nowait()
                 except queue.Empty:
                     pass
-                self._producer.join(timeout=5)
+                self._producer.join(timeout=timeout)
                 if not self._producer.is_alive():
                     break
+            if self._producer.is_alive():
+                # blocked inside the wrapped source (not our put(), which
+                # polls the stop flag) — abandoning it leaks the thread
+                # until the source unblocks; say so LOUDLY and count it
+                import warnings
+                self.producer_leaks += 1
+                warnings.warn(
+                    f"DeviceFeed {self.name!r}: producer thread did not "
+                    f"exit within {2 * timeout:.1f}s and was abandoned "
+                    "(blocked inside the wrapped source?); the thread "
+                    "leaks until the source unblocks — booked in "
+                    "mx_feed_producer_leaks_total "
+                    "(MXNET_TPU_FEED_JOIN_TIMEOUT tunes the wait)",
+                    RuntimeWarning, stacklevel=3)
+                from .. import telemetry as _telem
+                if _telem._ENABLED:
+                    _telem.record_feed_producer_leak(self.name)
         self._producer = None
         self._q = None
         self._stop = None

@@ -1,0 +1,251 @@
+"""Deterministic fault-injection plane (chaos layer) for mxnet_tpu.
+
+PR 11 made training *resumable*; this package makes the failure paths
+*tested*. Named injection points are threaded through the stack (elastic
+snapshot IO, the DeviceFeed producer, the serving dispatcher and HTTP
+front door — ``points()`` is the live catalog) and fire ``FaultInjected``
+according to deterministic schedules, so every recovery path — IO retry,
+commit fencing, load shedding, producer restart — is exercised by exact
+replayable chaos tests instead of hand monkeypatches.
+
+Design rules (telemetry precedent, PR 2):
+
+  - **Off by default, one-flag free path.** Instrumented sites guard with
+    ``if _faults._ACTIVE: _faults.check("point")`` — a module-attribute
+    load and a branch when disarmed, nothing else. ``BENCH_SCENARIO=chaos``
+    holds this under 1% on the snapshot hot path.
+  - **Deterministic.** Schedules are pure functions of the per-point
+    attempt counter (plus a private seeded RNG stream for probability
+    schedules); the same spec replays the same fault sequence.
+  - **Process-wide.** Armed via ``MXNET_TPU_FAULTS=<spec>`` at import or
+    ``faults.inject(point, schedule)`` / the ``faults.injected(...)``
+    context manager in tests.
+
+The plane also hosts :func:`io_retry` — bounded exponential-backoff+jitter
+retry for transient IO (``OSError`` and injected faults), the hardening
+primitive the elastic writer/reader paths are wrapped in. See
+docs/reliability.md for the catalog, grammar, and tuning guidance.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional, Union
+
+from ..base import MXNetError, env
+from .schedule import (Schedule, EveryNth, FirstK, SeededProbability,
+                       parse_schedule, parse_spec)
+
+__all__ = ["FaultInjected", "Schedule", "EveryNth", "FirstK",
+           "SeededProbability", "parse_schedule", "parse_spec",
+           "declare_point", "points", "inject", "injected", "clear",
+           "armed", "check", "attempts", "fired", "install_from_env",
+           "io_retry"]
+
+env.declare("MXNET_TPU_FAULTS", "", str,
+            "Arm the fault-injection plane at import: "
+            "point=schedule[;point=schedule...] where schedule is "
+            "every_nth:N, first_k:K, or p:P[:seedS] "
+            "(docs/reliability.md); empty = disarmed")
+env.declare("MXNET_TPU_IO_RETRIES", 3, int,
+            "Bounded retries for transient elastic/serving IO failures "
+            "(OSError + injected faults) around each io_retry-wrapped "
+            "operation; 0 disables retry (first failure surfaces)")
+env.declare("MXNET_TPU_IO_BACKOFF", 0.05, float,
+            "Base delay (seconds) for io_retry exponential backoff; the "
+            "k-th retry sleeps uniform(0, min(cap, base*2^k)) — full "
+            "jitter, so racing writers decorrelate")
+env.declare("MXNET_TPU_IO_BACKOFF_MAX", 1.0, float,
+            "Backoff delay cap (seconds) for io_retry")
+
+
+class FaultInjected(MXNetError):
+    """Raised by an armed injection point. Deliberately a transient-style
+    error: retry/restart layers treat it exactly like an ``OSError`` from
+    the real world, which is what makes injected chaos prove the same
+    recovery path production faults take."""
+
+    def __init__(self, point: str, attempt: int):
+        super().__init__(
+            f"injected fault at {point!r} (attempt {attempt})")
+        self.point = point
+        self.attempt = attempt
+
+
+# _ACTIVE is THE disabled-path guard: call sites check this module
+# attribute before calling check(), so a disarmed plane costs one
+# attribute load + branch (same idiom as telemetry._ENABLED).
+_ACTIVE = False
+
+_LOCK = threading.Lock()
+_POINTS: Dict[str, str] = {}       # name -> doc (static catalog + ad hoc)
+_SCHEDULES: Dict[str, Schedule] = {}
+_ATTEMPTS: Dict[str, int] = {}     # 1-based check() count per point
+_FIRED: Dict[str, int] = {}
+
+
+def declare_point(name: str, doc: str = ""):
+    """Register an injection point in the catalog (idempotent). Sites may
+    check undeclared points too — they are added on first ``inject`` —
+    but the canonical set below is what docs and tests enumerate."""
+    with _LOCK:
+        _POINTS.setdefault(name, doc)
+
+
+for _name, _doc in (
+    ("elastic.write_shard", "shard .npz/.json payload+index write "
+                            "(elastic/manifest.py write_shard)"),
+    ("elastic.commit", "manifest merge + atomic rename "
+                       "(elastic/manifest.py commit)"),
+    ("elastic.read", "snapshot manifest/chunk reads "
+                     "(elastic/manifest.py load + SnapshotReader)"),
+    ("feed.produce", "DeviceFeed producer next() on the wrapped source "
+                     "(engine/async_feed.py)"),
+    ("serving.load", "model artifact load at registration "
+                     "(serving/registry.py)"),
+    ("serving.dispatch", "continuous-batcher batch assemble/forward "
+                         "(serving/batcher.py)"),
+    ("serving.http", "HTTP front-door request handling "
+                     "(serving/server.py)"),
+):
+    declare_point(_name, _doc)
+
+
+def points() -> Dict[str, str]:
+    """The injection-point catalog: name -> where it is threaded."""
+    with _LOCK:
+        return dict(_POINTS)
+
+
+def check(point: str):
+    """Count one attempt at ``point`` and raise :class:`FaultInjected` if
+    the armed schedule says this attempt fires. Call sites keep this off
+    the free path behind the ``_ACTIVE`` module flag."""
+    with _LOCK:
+        n = _ATTEMPTS.get(point, 0) + 1
+        _ATTEMPTS[point] = n
+        sched = _SCHEDULES.get(point)
+        fire = sched is not None and sched.fires(n)
+        if fire:
+            _FIRED[point] = _FIRED.get(point, 0) + 1
+    if fire:
+        from .. import telemetry as _telem
+        if _telem._ENABLED:
+            _telem.record_fault_injected(point)
+        raise FaultInjected(point, n)
+
+
+def inject(point: str, schedule: Union[Schedule, str]):
+    """Arm ``point`` with a schedule (instance or spec string)."""
+    global _ACTIVE
+    if isinstance(schedule, str):
+        schedule = parse_schedule(schedule)
+    if not isinstance(schedule, Schedule):
+        raise MXNetError(f"inject needs a Schedule or spec string, "
+                         f"got {type(schedule).__name__}")
+    with _LOCK:
+        _POINTS.setdefault(point, "")
+        _SCHEDULES[point] = schedule
+        _ACTIVE = True
+
+
+def clear(point: Optional[str] = None):
+    """Disarm one point, or the whole plane (and reset counters) when
+    called without arguments."""
+    global _ACTIVE
+    with _LOCK:
+        if point is None:
+            _SCHEDULES.clear()
+            _ATTEMPTS.clear()
+            _FIRED.clear()
+        else:
+            _SCHEDULES.pop(point, None)
+        _ACTIVE = bool(_SCHEDULES)
+
+
+@contextmanager
+def injected(point: str, schedule: Union[Schedule, str]):
+    """Test helper: arm ``point`` for the block, disarm on exit."""
+    inject(point, schedule)
+    try:
+        yield
+    finally:
+        clear(point)
+
+
+def armed() -> Dict[str, str]:
+    """Currently armed points -> schedule spec."""
+    with _LOCK:
+        return {p: s.spec() for p, s in _SCHEDULES.items()}
+
+
+def attempts(point: str) -> int:
+    with _LOCK:
+        return _ATTEMPTS.get(point, 0)
+
+
+def fired(point: str) -> int:
+    with _LOCK:
+        return _FIRED.get(point, 0)
+
+
+def install_from_env():
+    """Arm the plane from ``MXNET_TPU_FAULTS`` (called at import; a bad
+    spec fails loudly here rather than silently running chaos-free)."""
+    spec = str(env.get("MXNET_TPU_FAULTS") or "").strip()
+    if not spec:
+        return
+    for point, sched in parse_spec(spec):
+        inject(point, sched)
+
+
+# ---------------------------------------------------------------------------
+# Bounded retry: the hardening primitive the injector targets
+# ---------------------------------------------------------------------------
+
+def io_retry(point: str, fn, *args, retries: Optional[int] = None,
+             backoff: Optional[float] = None, **kwargs):
+    """Run ``fn(*args, **kwargs)`` with the named fault point checked on
+    every attempt and transient failures (``OSError`` and injected
+    faults) retried with exponential backoff + full jitter.
+
+    Retry budget is ``MXNET_TPU_IO_RETRIES`` (or ``retries``); the k-th
+    retry sleeps ``uniform(0, min(cap, base * 2**k))`` with base
+    ``MXNET_TPU_IO_BACKOFF`` and cap ``MXNET_TPU_IO_BACKOFF_MAX`` — full
+    jitter so concurrent writers hitting the same contended filesystem
+    decorrelate. Every retry books ``mx_io_retries_total{point}``.
+    Non-transient errors (``MXNetError`` subclasses other than
+    :class:`FaultInjected` — e.g. a lost commit fence) are NEVER retried:
+    retrying a fenced-out writer is exactly the interleaving the lease
+    exists to prevent."""
+    budget = int(env.get("MXNET_TPU_IO_RETRIES")) if retries is None \
+        else int(retries)
+    base = float(env.get("MXNET_TPU_IO_BACKOFF")) if backoff is None \
+        else float(backoff)
+    cap = float(env.get("MXNET_TPU_IO_BACKOFF_MAX"))
+    attempt = 0
+    while True:
+        try:
+            if _ACTIVE:
+                check(point)
+            return fn(*args, **kwargs)
+        except FaultInjected:
+            if attempt >= budget:
+                raise
+        except MXNetError:
+            raise               # permanent by design (fence, validation)
+        except OSError:
+            if attempt >= budget:
+                raise
+        attempt += 1
+        from .. import telemetry as _telem
+        if _telem._ENABLED:
+            _telem.record_io_retry(point)
+        delay = min(cap, base * (2 ** (attempt - 1)))
+        if delay > 0:
+            time.sleep(random.uniform(0, delay))
+
+
+install_from_env()

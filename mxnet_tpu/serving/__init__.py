@@ -32,8 +32,10 @@ imports only the symbolic core, the engine, and telemetry.
 from __future__ import annotations
 
 from .registry import ModelRegistry, RegisteredModel
-from .batcher import ContinuousBatcher, ServingFuture
+from .batcher import (ContinuousBatcher, DeadlineExceeded, PRIORITIES,
+                      ServerOverloaded, ServingFuture)
 from .server import Server
 
 __all__ = ["ModelRegistry", "RegisteredModel", "ContinuousBatcher",
-           "ServingFuture", "Server"]
+           "ServingFuture", "Server", "ServerOverloaded",
+           "DeadlineExceeded", "PRIORITIES"]

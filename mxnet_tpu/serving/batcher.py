@@ -19,6 +19,25 @@ separate completion thread performs the single designed host sync, slices
 per-request rows out of the padded outputs, resolves futures, and records
 end-to-end latency. mxlint's ``sync-in-loop`` pass gates the dispatch loop
 the same way it gates the trainers' fit loops.
+
+Graceful degradation under overload (docs/reliability.md):
+
+  - **Admission control.** ``max_queue`` bounds the pending queue; an
+    over-bound ``submit`` raises :class:`ServerOverloaded` immediately
+    (the HTTP front door maps it to 503 + ``Retry-After``) and books
+    ``mx_requests_shed_total{reason="queue_full"}`` — shedding at the
+    door beats queueing work the SLO already lost.
+  - **Deadlines.** ``submit(deadline_ms=...)`` bounds how long a request
+    may WAIT; batch formation drops expired requests (resolved with
+    :class:`DeadlineExceeded`, never dispatched) so a backlog drains to
+    live work instead of computing dead answers. ``result(timeout)``
+    additionally CANCELS a still-queued request on timeout, reclaiming
+    the queue slot.
+  - **Priority classes.** Two classes — ``latency`` (default) and
+    ``batch`` — with strict priority at batch formation: latency requests
+    fill the bucket first, so a heavy bulk workload cannot starve the
+    latency-sensitive one (SLO asserted on the per-model
+    ``mx_serving_request_seconds`` histogram).
 """
 from __future__ import annotations
 
@@ -30,30 +49,78 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as _np
 
-from ..base import MXNetError
+from .. import faults as _faults
+from ..base import MXNetError, env
 from ..engine.async_feed import DispatchWindow
 from .registry import RegisteredModel
 
-__all__ = ["ServingFuture", "ContinuousBatcher"]
+__all__ = ["ServingFuture", "ContinuousBatcher", "ServerOverloaded",
+           "DeadlineExceeded", "PRIORITIES"]
+
+env.declare("MXNET_TPU_SERVING_MAX_QUEUE", 0, int,
+            "Default per-model serving admission bound: submit() sheds "
+            "(ServerOverloaded / HTTP 503) when this many requests are "
+            "already queued; 0 = unbounded")
+
+PRIORITIES = ("latency", "batch")
+
+
+class ServerOverloaded(MXNetError):
+    """The request was shed at admission: the pending queue is at its
+    ``max_queue`` bound. Retry after backoff (HTTP callers get 503 with
+    ``Retry-After``)."""
+
+
+class DeadlineExceeded(MXNetError):
+    """The request's deadline passed before a result was ready — it was
+    either dropped while queued (never dispatched) or abandoned by the
+    caller's ``result(timeout)``."""
 
 
 class ServingFuture:
     """Handle for one in-flight request: ``result(timeout)`` blocks until
-    the completion thread resolves it (numpy outputs, per-request rows)."""
+    the completion thread resolves it (numpy outputs, per-request rows).
 
-    __slots__ = ("_event", "_result", "_error")
+    On timeout the future first tries to CANCEL the request; if it was
+    still queued, the slot is reclaimed and :class:`DeadlineExceeded`
+    raises immediately. A request already dispatched to the device cannot
+    be recalled — ``result`` then waits one more ``timeout`` grace period
+    for the in-flight batch before giving up (the completion thread still
+    resolves the future; a later ``result()`` call returns it)."""
 
-    def __init__(self):
+    __slots__ = ("_event", "_result", "_error", "_batcher", "_request")
+
+    def __init__(self, batcher: Optional["ContinuousBatcher"] = None,
+                 request: Optional["_Request"] = None):
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
+        self._batcher = batcher
+        self._request = request
 
     def done(self) -> bool:
         return self._event.is_set()
 
+    def cancel(self) -> bool:
+        """Remove the request from the pending queue if it has not been
+        taken for dispatch yet. True if cancelled (the future resolves
+        with :class:`DeadlineExceeded`); False if already dispatched or
+        resolved."""
+        b, r = self._batcher, self._request
+        if b is None or r is None or self._event.is_set():
+            return False
+        return b._cancel(r)
+
     def result(self, timeout: Optional[float] = None):
         if not self._event.wait(timeout):
-            raise MXNetError("serving request timed out")
+            if self.cancel():
+                raise DeadlineExceeded(
+                    f"serving request timed out after {timeout}s while "
+                    "queued (cancelled, slot reclaimed)")
+            if not self._event.wait(timeout):
+                raise DeadlineExceeded(
+                    f"serving request timed out after {timeout}s grace "
+                    "with its batch still in flight")
         if self._error is not None:
             raise self._error
         return self._result
@@ -68,13 +135,19 @@ class ServingFuture:
 
 
 class _Request:
-    __slots__ = ("inputs", "rows", "future", "t_enqueue")
+    __slots__ = ("inputs", "rows", "future", "t_enqueue", "priority",
+                 "deadline")
 
-    def __init__(self, inputs: Dict[str, _np.ndarray], rows: int):
+    def __init__(self, inputs: Dict[str, _np.ndarray], rows: int,
+                 priority: str = "latency",
+                 deadline_ms: Optional[float] = None):
         self.inputs = inputs
         self.rows = rows
-        self.future = ServingFuture()
+        self.priority = priority
         self.t_enqueue = time.perf_counter()
+        self.deadline = None if deadline_ms is None \
+            else self.t_enqueue + float(deadline_ms) / 1e3
+        self.future = None  # set by the batcher (needs the backref)
 
 
 class ContinuousBatcher:
@@ -83,14 +156,20 @@ class ContinuousBatcher:
 
     ``submit()`` never blocks on the device; ``close()`` drains in-flight
     work (pending requests are still served) and joins both worker threads.
+    ``max_queue`` bounds admission (default ``MXNET_TPU_SERVING_MAX_QUEUE``,
+    0 = unbounded).
     """
 
     def __init__(self, model: RegisteredModel, max_wait_ms: float = 5.0,
-                 max_inflight: int = 2, name: Optional[str] = None):
+                 max_inflight: int = 2, name: Optional[str] = None,
+                 max_queue: Optional[int] = None):
         self._model = model
         self._name = name or model.name
         self._max_wait = max(float(max_wait_ms), 0.0) / 1e3
-        self._pending: "deque[_Request]" = deque()
+        self._max_queue = int(env.get("MXNET_TPU_SERVING_MAX_QUEUE")
+                              if max_queue is None else max_queue)
+        self._pending: Dict[str, "deque[_Request]"] = {
+            p: deque() for p in PRIORITIES}
         self._cond = threading.Condition()
         self._closed = False
         self._window = DispatchWindow(depth=max_inflight,
@@ -150,67 +229,159 @@ class ContinuousBatcher:
                 "request or register a larger bucket")
         return arrays, rows
 
+    def _shed(self, reason: str, n: int = 1):
+        """Book shed requests (admission reject / expired / cancelled)."""
+        from .. import telemetry as _telem
+        if _telem._ENABLED:
+            for _ in range(max(int(n), 0)):
+                _telem.record_request_shed(self._name, reason)
+
     def submit(self, inputs: Optional[Dict[str, Any]] = None,
+               priority: str = "latency",
+               deadline_ms: Optional[float] = None,
                **named) -> ServingFuture:
         """Enqueue one request (dict or kwargs of input name -> array with
-        leading batch dim, or a bare row). Returns immediately."""
+        leading batch dim, or a bare row). Returns immediately.
+
+        ``priority`` is ``"latency"`` (strictly preferred at batch
+        formation) or ``"batch"``; ``deadline_ms`` bounds queue wait —
+        an expired request is dropped, never dispatched, and its future
+        raises :class:`DeadlineExceeded`."""
+        if priority not in PRIORITIES:
+            raise MXNetError(
+                f"submit: unknown priority {priority!r}; classes are "
+                f"{PRIORITIES}")
         merged = dict(inputs or {})
         merged.update(named)
         arrays, rows = self._validate(merged)
-        req = _Request(arrays, rows)
+        req = _Request(arrays, rows, priority=priority,
+                       deadline_ms=deadline_ms)
+        req.future = ServingFuture(self, req)
         with self._cond:
             if self._closed:
                 raise MXNetError(
                     f"serving queue for {self._name!r} is closed")
-            self._pending.append(req)
-            depth = len(self._pending)
-            self._cond.notify_all()
+            depth = self._depth_locked()
+            if self._max_queue > 0 and depth >= self._max_queue:
+                overloaded = ServerOverloaded(
+                    f"serving queue for {self._name!r} is full "
+                    f"({depth}/{self._max_queue} requests queued); shed — "
+                    "retry with backoff")
+            else:
+                overloaded = None
+                self._pending[priority].append(req)
+                depth += 1
+                self._cond.notify_all()
+        if overloaded is not None:
+            self._shed("queue_full")
+            raise overloaded
         from .. import telemetry as _telem
         if _telem._ENABLED:
             _telem.record_serving_enqueue(self._name, rows)
             _telem.record_serving_queue_depth(self._name, depth)
         return req.future
 
+    def _cancel(self, req: _Request) -> bool:
+        """Remove a still-queued request (future.cancel / result timeout).
+        True only if the request had not been taken for dispatch."""
+        with self._cond:
+            try:
+                self._pending[req.priority].remove(req)
+            except ValueError:
+                return False
+        self._shed("cancelled")
+        return True
+
     # -- batch formation -----------------------------------------------------
+    def _depth_locked(self) -> int:
+        return sum(len(q) for q in self._pending.values())
+
+    def _iter_locked(self):
+        """Pending requests in take order: latency class first, FIFO
+        within each class."""
+        for p in PRIORITIES:
+            yield from self._pending[p]
+
+    def _expire_locked(self, now: float) -> List[_Request]:
+        """Drop queued requests whose deadline passed (they are resolved
+        with DeadlineExceeded by the caller, outside dispatch)."""
+        expired: List[_Request] = []
+        for p in PRIORITIES:
+            q = self._pending[p]
+            live = [r for r in q if r.deadline is not None
+                    and r.deadline <= now]
+            for r in live:
+                q.remove(r)
+            expired.extend(live)
+        return expired
+
     def _take_locked(self) -> Tuple[List[_Request], int]:
-        """Pop the longest request prefix fitting the largest bucket.
-        Caller holds the lock."""
+        """Pop the longest latency-first prefix fitting the largest
+        bucket. Caller holds the lock."""
         take: List[_Request] = []
         rows = 0
-        while self._pending and \
-                rows + self._pending[0].rows <= self._model.max_bucket:
-            req = self._pending.popleft()
-            take.append(req)
-            rows += req.rows
+        for p in PRIORITIES:
+            q = self._pending[p]
+            while q and rows + q[0].rows <= self._model.max_bucket:
+                req = q.popleft()
+                take.append(req)
+                rows += req.rows
         return take, rows
 
     def _next_batch(self) -> Optional[Tuple[List[_Request], int, int, int]]:
         """Block until a batch is ready under the dispatch policy; None on
         shutdown with an empty queue."""
-        with self._cond:
-            while True:
-                if self._pending:
-                    head_rows = 0
-                    n_fit = 0
-                    for req in self._pending:
-                        if head_rows + req.rows > self._model.max_bucket:
-                            break
-                        head_rows += req.rows
-                        n_fit += 1
-                    deadline = self._pending[0].t_enqueue + self._max_wait
-                    now = time.perf_counter()
-                    full = head_rows >= self._model.max_bucket or \
-                        n_fit < len(self._pending)
-                    if full or self._closed or now >= deadline:
-                        take, rows = self._take_locked()
-                        depth = len(self._pending)
-                        bucket = self._model.smallest_bucket(rows)
-                        return take, bucket, rows, depth
-                    self._cond.wait(timeout=deadline - now)
-                elif self._closed:
-                    return None
-                else:
-                    self._cond.wait()
+        from .. import telemetry as _telem
+        while True:
+            with self._cond:
+                now = time.perf_counter()
+                expired = self._expire_locked(now)
+                if not expired:
+                    if self._depth_locked():
+                        head_rows = 0
+                        n_fit = 0
+                        for req in self._iter_locked():
+                            if head_rows + req.rows > self._model.max_bucket:
+                                break
+                            head_rows += req.rows
+                            n_fit += 1
+                        oldest = min(q[0].t_enqueue
+                                     for q in self._pending.values() if q)
+                        deadline = oldest + self._max_wait
+                        full = head_rows >= self._model.max_bucket or \
+                            n_fit < self._depth_locked()
+                        if full or self._closed or now >= deadline:
+                            take, rows = self._take_locked()
+                            depth = self._depth_locked()
+                            bucket = self._model.smallest_bucket(rows)
+                            return take, bucket, rows, depth
+                        # wake for the batch deadline OR the nearest
+                        # request deadline, whichever is sooner
+                        wake = deadline
+                        for req in self._iter_locked():
+                            if req.deadline is not None:
+                                wake = min(wake, req.deadline)
+                        self._cond.wait(timeout=max(wake - now, 0.0))
+                        continue
+                    elif self._closed:
+                        return None
+                    else:
+                        self._cond.wait()
+                        continue
+            # resolve expired futures OUTSIDE the lock (telemetry +
+            # event.set need not serialize batch formation)
+            for r in expired:
+                r.future._set_error(DeadlineExceeded(
+                    f"request deadline passed after "
+                    f"{(now - r.t_enqueue) * 1e3:.1f}ms in the "
+                    f"{r.priority!r} queue of {self._name!r}; dropped "
+                    "before dispatch"))
+            self._shed("deadline", n=len(expired))
+            if _telem._ENABLED:
+                for r in expired:
+                    _telem.record_serving_completion(
+                        self._name, now - r.t_enqueue, r.rows,
+                        status="deadline")
 
     def _assemble(self, reqs: List[_Request], bucket: int) -> Dict[str, Any]:
         """Concatenate + zero-pad the requests' host arrays to the bucket
@@ -238,9 +409,13 @@ class ContinuousBatcher:
                 break
             reqs, bucket, rows, depth = batch
             try:
+                if _faults._ACTIVE:
+                    _faults.check("serving.dispatch")
                 feed = self._assemble(reqs, bucket)
                 outs = self._model.forward(bucket, feed)
-            except BaseException as e:  # fail THIS batch, keep serving
+            except Exception as e:  # fail THIS batch, keep serving;
+                # KeyboardInterrupt/SystemExit propagate (mxlint
+                # broad-except)
                 for r in reqs:
                     r.future._set_error(e)
                 if _telem._ENABLED:
@@ -272,7 +447,8 @@ class ContinuousBatcher:
         from .. import telemetry as _telem
         try:
             host = [_np.asarray(o) for o in outs]
-        except BaseException as e:
+        except Exception as e:  # device-side batch failure; the workers
+            # stay up (KeyboardInterrupt/SystemExit propagate)
             for r in reqs:
                 r.future._set_error(e)
                 if _telem._ENABLED:
@@ -293,7 +469,7 @@ class ContinuousBatcher:
     @property
     def queue_depth(self) -> int:
         with self._cond:
-            return len(self._pending)
+            return self._depth_locked()
 
     def close(self, timeout: float = 30.0):
         """Stop accepting requests, serve everything already queued, join

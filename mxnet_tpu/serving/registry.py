@@ -48,9 +48,14 @@ class RegisteredModel:
                  dtype: str = "float32",
                  dtypes: Optional[Dict[str, str]] = None,
                  mesh=None, data_spec=None):
+        from .. import faults as _faults
         from .. import symbol as sym_mod
         self.name = name
-        self._sym = sym_mod.load(symbol_file)
+        # artifact loads ride the same transient-IO retry as elastic
+        # snapshots (a registry boot on a flaky model store should not
+        # need an operator retry loop)
+        self._sym = _faults.io_retry("serving.load", sym_mod.load,
+                                     symbol_file)
         self._dtype = dtype
         self._dtypes = dict(dtypes or {})
         self._mesh = mesh
@@ -60,7 +65,7 @@ class RegisteredModel:
         if not self.buckets or self.buckets[0] < 1:
             raise MXNetError(f"buckets must be positive ints, got {buckets}")
         arg_params, aux_params = ({}, {}) if param_file is None \
-            else load_params(param_file)
+            else _faults.io_retry("serving.load", load_params, param_file)
         self._arg_params = {k: self._place_param(self._raw(v))
                             for k, v in arg_params.items()}
         self._aux_params = {k: self._place_param(self._raw(v))

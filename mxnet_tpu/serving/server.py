@@ -12,10 +12,19 @@ In-process API (futures)::
 HTTP API (stdlib ``http.server``, daemon thread)::
 
     port = srv.start_http(8000)
-    # POST /v1/models/<name>:predict   {"inputs": {"data": [[...], ...]}}
+    # POST /v1/models/<name>:predict   {"inputs": {"data": [[...], ...]},
+    #                                   "priority": "latency"|"batch",
+    #                                   "timeout_ms": 500}
     #   -> {"model": ..., "output_names": [...], "outputs": [[...], ...]}
+    #   -> 503 + Retry-After when the model's queue sheds (ServerOverloaded)
+    #   -> 504 when the request's deadline passed (DeadlineExceeded)
     # GET  /v1/models                  registry listing + memory budget
     # GET  /metrics                    Prometheus text (mx.telemetry.scrape)
+
+Overload never hangs a caller: admission control sheds at the door with
+an explicit retry hint, deadlines cancel queued work, and the two
+priority classes keep the latency-sensitive model responsive under bulk
+traffic (docs/reliability.md).
 
 Every worker thread funnels into the same continuous batcher, so HTTP and
 in-process callers share buckets, artifacts, and SLO metrics.
@@ -28,8 +37,10 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as _np
 
+from .. import faults as _faults
 from ..base import MXNetError
-from .batcher import ContinuousBatcher, ServingFuture
+from .batcher import (ContinuousBatcher, DeadlineExceeded, ServerOverloaded,
+                      ServingFuture)
 from .registry import ModelRegistry, RegisteredModel
 
 __all__ = ["Server"]
@@ -39,11 +50,12 @@ class Server:
     """Multi-model serving front door (registry + per-model batcher)."""
 
     def __init__(self, max_wait_ms: float = 5.0, max_inflight: int = 2,
-                 mesh=None, data_spec=None):
+                 mesh=None, data_spec=None, max_queue: Optional[int] = None):
         self.registry = ModelRegistry()
         self._batchers: Dict[str, ContinuousBatcher] = {}
         self._max_wait_ms = float(max_wait_ms)
         self._max_inflight = int(max_inflight)
+        self._max_queue = max_queue
         self._mesh = mesh
         self._data_spec = data_spec
         self._http = None
@@ -56,10 +68,12 @@ class Server:
                  buckets: Sequence[int] = (1, 8, 64),
                  dtype: str = "float32",
                  dtypes: Optional[Dict[str, str]] = None,
-                 max_wait_ms: Optional[float] = None) -> RegisteredModel:
+                 max_wait_ms: Optional[float] = None,
+                 max_queue: Optional[int] = None) -> RegisteredModel:
         """Load + warm a model (one compiled artifact per bucket, eagerly,
         possibly straight from the persistent XLA cache) and start its
-        batcher. Returns the RegisteredModel."""
+        batcher. ``max_queue`` overrides the server-wide admission bound
+        for this model. Returns the RegisteredModel."""
         model = self.registry.register(
             name, symbol_file, param_file, input_shapes=input_shapes,
             buckets=buckets, dtype=dtype, dtypes=dtypes,
@@ -69,7 +83,9 @@ class Server:
                 model,
                 max_wait_ms=self._max_wait_ms if max_wait_ms is None
                 else max_wait_ms,
-                max_inflight=self._max_inflight)
+                max_inflight=self._max_inflight,
+                max_queue=self._max_queue if max_queue is None
+                else max_queue)
         return model
 
     def unregister(self, name: str):
@@ -101,14 +117,26 @@ class Server:
                     f"{list(self._batchers)}") from None
 
     def submit(self, model: str, inputs: Optional[Dict[str, Any]] = None,
+               priority: str = "latency",
+               deadline_ms: Optional[float] = None,
                **named) -> ServingFuture:
-        """Enqueue a request; returns a future immediately."""
-        return self._batcher(model).submit(inputs, **named)
+        """Enqueue a request; returns a future immediately. May raise
+        ``ServerOverloaded`` (queue at its admission bound — retry with
+        backoff). ``deadline_ms`` bounds queue wait; ``priority`` is
+        ``"latency"`` or ``"batch"``."""
+        return self._batcher(model).submit(
+            inputs, priority=priority, deadline_ms=deadline_ms, **named)
 
     def predict(self, model: str, inputs: Optional[Dict[str, Any]] = None,
-                timeout: float = 60.0, **named):
-        """Blocking submit+result convenience."""
-        return self.submit(model, inputs, **named).result(timeout)
+                timeout: float = 60.0, priority: str = "latency",
+                deadline_ms: Optional[float] = None, **named):
+        """Blocking submit+result convenience. The queue-wait deadline
+        defaults to ``timeout`` so a result timeout also cancels the
+        queued work instead of leaking the slot."""
+        if deadline_ms is None and timeout is not None:
+            deadline_ms = float(timeout) * 1e3
+        return self.submit(model, inputs, priority=priority,
+                           deadline_ms=deadline_ms, **named).result(timeout)
 
     # -- HTTP front door -----------------------------------------------------
     def start_http(self, port: int = 0, addr: str = "127.0.0.1") -> int:
@@ -119,10 +147,13 @@ class Server:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def _send(self, code: int, body: bytes,
-                      ctype: str = "application/json"):
+                      ctype: str = "application/json",
+                      headers: Optional[Dict[str, str]] = None):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -149,10 +180,17 @@ class Server:
                     return
                 name = path[len("/v1/models/"):-len(":predict")]
                 try:
+                    if _faults._ACTIVE:
+                        _faults.check("serving.http")
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n) or b"{}")
                     inputs = payload.get("inputs", payload)
-                    out = server.predict(name, inputs)
+                    priority = payload.get("priority", "latency")
+                    timeout_ms = payload.get("timeout_ms")
+                    timeout = 60.0 if timeout_ms is None \
+                        else float(timeout_ms) / 1e3
+                    out = server.predict(name, inputs, timeout=timeout,
+                                         priority=priority)
                     outs = out if isinstance(out, list) else [out]
                     model = server.registry.get(name)
                     body = json.dumps({
@@ -161,6 +199,15 @@ class Server:
                         "outputs": [_np.asarray(o).tolist() for o in outs],
                     }).encode()
                     self._send(200, body)
+                except (ServerOverloaded, _faults.FaultInjected) as e:
+                    # graceful degradation: shed with an explicit retry
+                    # hint instead of queueing doomed work
+                    self._send(503, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode(),
+                        headers={"Retry-After": "1"})
+                except DeadlineExceeded as e:
+                    self._send(504, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode())
                 except Exception as e:
                     self._send(400, json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}).encode())

@@ -54,7 +54,9 @@ __all__ = [
     "set_epoch", "timed", "annotate", "start_http_server",
     "stop_http_server", "DEFAULT_LATENCY_BUCKETS", "record_serving_enqueue",
     "record_serving_queue_depth", "record_serving_dispatch",
-    "record_serving_completion",
+    "record_serving_completion", "record_fault_injected", "record_io_retry",
+    "record_request_shed", "record_feed_producer_leak",
+    "record_feed_producer_restart",
 ]
 
 env.declare("MXNET_TELEMETRY", False, bool,
@@ -877,6 +879,59 @@ def record_serving_completion(model: str, seconds: float, rows: int = 1,
     counter("mx_serving_response_rows_total",
             "Rows returned across completed requests",
             ("model",)).labels(model).inc(max(int(rows), 0))
+
+
+# ---------------------------------------------------------------------------
+# Reliability plane (mxnet_tpu/faults + hardened paths — docs/reliability.md)
+# ---------------------------------------------------------------------------
+
+def record_fault_injected(point: str):
+    """Account one fault fired by the deterministic injection plane. In a
+    chaos run this is the denominator every recovery metric divides by:
+    mx_io_retries_total/mx_faults_injected_total ≈ 1 means every injected
+    IO fault was absorbed by a retry."""
+    counter("mx_faults_injected_total",
+            "Faults fired by the injection plane (mxnet_tpu.faults)",
+            ("point",)).labels(point).inc()
+
+
+def record_io_retry(point: str):
+    """Account one transient-IO retry (backoff+jitter) at a named fault
+    point. A nonzero steady-state rate without armed chaos means the
+    snapshot filesystem is genuinely flaky — page before it exhausts
+    MXNET_TPU_IO_RETRIES and surfaces as failed snapshots."""
+    counter("mx_io_retries_total",
+            "Transient IO failures retried with exponential backoff",
+            ("point",)).labels(point).inc()
+
+
+def record_request_shed(model: str, reason: str = "queue_full"):
+    """Account one serving request rejected or abandoned by admission
+    control: ``queue_full`` (max_queue bound, HTTP 503), ``deadline``
+    (expired while queued, HTTP 504), ``cancelled`` (caller timed out and
+    reclaimed the queue slot). Shed rate vs mx_serving_requests_total is
+    the overload signal the autoscaler should act on."""
+    counter("mx_requests_shed_total",
+            "Serving requests shed by admission control or deadlines",
+            ("model", "reason")).labels(model, reason).inc()
+
+
+def record_feed_producer_leak(source: str = "feed"):
+    """Account one DeviceFeed producer thread abandoned after the join
+    timeout (blocked inside the wrapped source). Each leak pins a thread
+    until the source unblocks — a growing counter means the source needs
+    an interruptible read or a larger MXNET_TPU_FEED_JOIN_TIMEOUT."""
+    counter("mx_feed_producer_leaks_total",
+            "DeviceFeed producer threads abandoned after join timeout",
+            ("source",)).labels(source).inc()
+
+
+def record_feed_producer_restart(source: str = "feed"):
+    """Account one bounded DeviceFeed producer restart after a transient
+    source error (supervised feed, MXNET_TPU_FEED_RESTARTS)."""
+    counter("mx_feed_producer_restarts_total",
+            "Bounded DeviceFeed producer restarts on transient errors",
+            ("source",)).labels(source).inc()
 
 
 @contextmanager

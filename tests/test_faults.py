@@ -1,0 +1,733 @@
+"""Chaos suite: the deterministic fault-injection plane + every recovery
+path it targets (ISSUE 13, docs/reliability.md).
+
+The contract under test: a fault armed by spec fires on exact, replayable
+attempts (never a flake), and each hardened layer survives it the way it
+would survive the real failure the point models —
+
+  - elastic IO retries transient shard/manifest/read failures with a
+    bounded backoff budget, fsyncs before every atomic rename, and fences
+    concurrent committers through the lease file (exactly one manifest);
+  - a kill-and-resume run under injected shard-write failure still
+    replays the EXACT uninterrupted loss trajectory;
+  - serving sheds at the admission bound (ServerOverloaded / HTTP 503 +
+    Retry-After), drops deadline-expired queued work (DeadlineExceeded /
+    HTTP 504), serves the latency class before the batch class, and a
+    failed batch never kills the dispatch loop;
+  - the DeviceFeed producer restarts across transient source errors with
+    exactly-once, in-order delivery, and a producer that cannot be joined
+    is abandoned LOUDLY (RuntimeWarning + counter), never silently.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import elastic, faults, gluon, nd, serving, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.elastic import manifest as _manifest
+from mxnet_tpu.engine.async_feed import DeviceFeed
+from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+from mxnet_tpu.serving.batcher import (ContinuousBatcher, DeadlineExceeded,
+                                       ServerOverloaded)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test starts disarmed with fresh attempt counters and leaves
+    telemetry off."""
+    faults.clear()
+    yield
+    faults.clear()
+    telemetry.disable()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# schedules: deterministic fire patterns + spec grammar
+# ---------------------------------------------------------------------------
+
+def test_schedule_fire_patterns():
+    nth = faults.EveryNth(3)
+    assert [nth.fires(i) for i in range(1, 7)] == \
+        [False, False, True, False, False, True]
+    fk = faults.FirstK(2)
+    assert [fk.fires(i) for i in range(1, 5)] == [True, True, False, False]
+    assert not faults.FirstK(0).fires(1)
+
+
+def test_seeded_probability_replays_exactly():
+    a = faults.SeededProbability(0.4, seed=11)
+    b = faults.SeededProbability(0.4, seed=11)
+    seq_a = [a.fires(i) for i in range(1, 101)]
+    seq_b = [b.fires(i) for i in range(1, 101)]
+    assert seq_a == seq_b          # same seed -> identical chaos, always
+    assert any(seq_a) and not all(seq_a)
+    c = faults.SeededProbability(0.4, seed=12)
+    assert [c.fires(i) for i in range(1, 101)] != seq_a
+
+
+def test_parse_schedule_roundtrip_and_errors():
+    assert faults.parse_schedule("every_nth:4").spec() == "every_nth:4"
+    assert faults.parse_schedule("first_k:2").spec() == "first_k:2"
+    assert faults.parse_schedule("p:0.25:seed7").spec() == "p:0.25:seed7"
+    for bad in ("nope", "every_nth", "every_nth:x", "first_k:-1",
+                "p:1.5", ""):
+        with pytest.raises(MXNetError):
+            faults.parse_schedule(bad)
+
+
+def test_parse_spec_multi_point_and_duplicates():
+    pairs = faults.parse_spec(
+        "elastic.write_shard=first_k:1; serving.dispatch=every_nth:3")
+    assert [(p, s.spec()) for p, s in pairs] == [
+        ("elastic.write_shard", "first_k:1"),
+        ("serving.dispatch", "every_nth:3")]
+    with pytest.raises(MXNetError):
+        faults.parse_spec("a=first_k:1;a=first_k:2")
+    with pytest.raises(MXNetError):
+        faults.parse_spec("just-a-point")
+
+
+def test_env_spec_arms_the_plane(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FAULTS",
+                       "elastic.read=first_k:2;feed.produce=every_nth:5")
+    faults.install_from_env()
+    assert faults.armed() == {"elastic.read": "first_k:2",
+                              "feed.produce": "every_nth:5"}
+
+
+# ---------------------------------------------------------------------------
+# plane mechanics
+# ---------------------------------------------------------------------------
+
+def test_catalog_covers_every_threaded_point():
+    cat = faults.points()
+    for point in ("elastic.write_shard", "elastic.commit", "elastic.read",
+                  "feed.produce", "serving.load", "serving.dispatch",
+                  "serving.http"):
+        assert point in cat and cat[point], point
+
+
+def test_off_by_default_and_counting():
+    assert faults._ACTIVE is False and faults.armed() == {}
+    faults.check("elastic.read")   # unarmed: counts, never raises
+    telemetry.enable()
+    with faults.injected("elastic.read", faults.EveryNth(2)):
+        assert faults._ACTIVE is True
+        fired = 0
+        for _ in range(4):
+            try:
+                faults.check("elastic.read")
+            except faults.FaultInjected as e:
+                assert e.point == "elastic.read"
+                fired += 1
+        assert fired == 2
+        assert faults.fired("elastic.read") == 2
+        assert faults.attempts("elastic.read") == 5
+    assert faults._ACTIVE is False       # context manager disarms
+    assert telemetry.get_metric(
+        "mx_faults_injected_total").get("elastic.read") == 2
+
+
+# ---------------------------------------------------------------------------
+# io_retry: transient vs permanent
+# ---------------------------------------------------------------------------
+
+def test_io_retry_absorbs_transient_oserror():
+    telemetry.enable()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("disk hiccup")
+        return "ok"
+
+    assert faults.io_retry("elastic.read", flaky,
+                           retries=3, backoff=0.0) == "ok"
+    assert len(calls) == 3
+    assert telemetry.get_metric(
+        "mx_io_retries_total").get("elastic.read") == 2
+
+
+def test_io_retry_exhausts_budget():
+    def always(): raise OSError("dead disk")
+    with pytest.raises(OSError):
+        faults.io_retry("elastic.read", always, retries=1, backoff=0.0)
+
+
+def test_io_retry_never_retries_permanent_mxnet_error():
+    calls = []
+
+    def fenced():
+        calls.append(1)
+        raise MXNetError("commit fenced out")
+
+    with pytest.raises(MXNetError, match="fenced"):
+        faults.io_retry("elastic.commit", fenced, retries=5, backoff=0.0)
+    assert len(calls) == 1     # a fenced-out writer must NOT retry
+
+
+def test_io_retry_absorbs_injected_faults():
+    calls = []
+    with faults.injected("elastic.read", faults.FirstK(2)):
+        out = faults.io_retry("elastic.read", lambda: calls.append(1) or 7,
+                              retries=3, backoff=0.0)
+    assert out == 7 and len(calls) == 1    # attempts 1,2 fired pre-call
+    assert faults.fired("elastic.read") == 2
+
+
+# ---------------------------------------------------------------------------
+# elastic manifest: fencing, retries, crash simulation
+# ---------------------------------------------------------------------------
+
+def _entries(seed=0):
+    rs = onp.random.RandomState(seed)
+    arr = rs.uniform(-1, 1, (4, 3)).astype(onp.float32)
+    return arr, [("w", [(0, 4), (0, 3)], arr, arr.shape, arr.dtype)]
+
+
+def test_clean_cycle_fence_token_and_lease_release(tmp_path):
+    sdir = _manifest.step_path(str(tmp_path), 3)
+    arr, entries = _entries()
+    _manifest.write_shard(sdir, 0, entries)
+    man = _manifest.commit(sdir, 3, {"step": 3})
+    assert man["fence"] == 1
+    assert not (tmp_path / "step-00000003" / _manifest.LEASE).exists()
+    with _manifest.SnapshotReader(str(tmp_path), 3) as rd:
+        onp.testing.assert_array_equal(rd("w"), arr)
+
+
+def test_write_shard_recovers_from_injected_fault(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_IO_BACKOFF", "0.001")
+    sdir = _manifest.step_path(str(tmp_path), 1)
+    arr, entries = _entries(1)
+    with faults.injected("elastic.write_shard", faults.FirstK(1)):
+        _manifest.write_shard(sdir, 0, entries)
+        _manifest.commit(sdir, 1, {"step": 1})
+    assert faults.fired("elastic.write_shard") == 1
+    assert _manifest.latest_complete_step(str(tmp_path)) == 1
+    with _manifest.SnapshotReader(str(tmp_path), 1) as rd:
+        onp.testing.assert_array_equal(rd("w"), arr)
+
+
+def test_commit_fault_exhausts_and_releases_lease(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_IO_RETRIES", "1")
+    monkeypatch.setenv("MXNET_TPU_IO_BACKOFF", "0.001")
+    sdir = _manifest.step_path(str(tmp_path), 2)
+    _, entries = _entries(2)
+    _manifest.write_shard(sdir, 0, entries)
+    with faults.injected("elastic.commit", faults.EveryNth(1)):
+        with pytest.raises(faults.FaultInjected):
+            _manifest.commit(sdir, 2, {"step": 2})
+    # no torn manifest, and the lease was released on the failure path:
+    # a later (healthy) committer finishes the step
+    assert _manifest.latest_complete_step(str(tmp_path)) is None
+    assert not (tmp_path / "step-00000002" / _manifest.LEASE).exists()
+    assert _manifest.commit(sdir, 2, {"step": 2})["fence"] == 1
+
+
+def test_truncated_shard_crash_sim(tmp_path):
+    # step 1 committed; step 2's writer "crashed": shard truncated, no
+    # manifest. Restore must see step 1; prune removes the debris.
+    for step in (1, 2):
+        sdir = _manifest.step_path(str(tmp_path), step)
+        _, entries = _entries(step)
+        _manifest.write_shard(sdir, 0, entries)
+        if step == 1:
+            _manifest.commit(sdir, 1, {"step": 1})
+    shard = tmp_path / "step-00000002" / "shard-00000.npz"
+    shard.write_bytes(shard.read_bytes()[:16])     # torn write
+    assert _manifest.all_complete_steps(str(tmp_path)) == [1]
+    assert _manifest.latest_complete_step(str(tmp_path)) == 1
+    # the incomplete dir is older than... no: step 2 > 1, so prune keeps it
+    # (an in-flight writer); but once a NEWER step commits it is debris
+    sdir3 = _manifest.step_path(str(tmp_path), 3)
+    _, entries = _entries(3)
+    _manifest.write_shard(sdir3, 0, entries)
+    _manifest.commit(sdir3, 3, {"step": 3})
+    _manifest.prune(str(tmp_path), max_to_keep=3)
+    assert not (tmp_path / "step-00000002").exists()
+    assert _manifest.all_complete_steps(str(tmp_path)) == [1, 3]
+
+
+def test_two_writer_commit_race_exactly_one_wins(tmp_path):
+    sdir = _manifest.step_path(str(tmp_path), 7)
+    _, entries = _entries(7)
+    _manifest.write_shard(sdir, 0, entries)
+    barrier = threading.Barrier(2)
+    outcomes = {}
+
+    def committer(tag):
+        barrier.wait()
+        try:
+            outcomes[tag] = ("won", _manifest.commit(sdir, 7, {"step": 7}))
+        except MXNetError as e:
+            outcomes[tag] = ("lost", str(e))
+
+    threads = [threading.Thread(target=committer, args=(t,))
+               for t in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    results = sorted(v[0] for v in outcomes.values())
+    assert results == ["lost", "won"], outcomes
+    loser_msg = next(v[1] for v in outcomes.values() if v[0] == "lost")
+    assert "race" in loser_msg or "fence" in loser_msg
+    # the surviving manifest is complete and valid
+    man = _manifest.load(str(tmp_path), 7)
+    assert man["step"] == 7 and man["fence"] >= 1
+    with _manifest.SnapshotReader(str(tmp_path), 7, manifest=man) as rd:
+        assert rd("w").shape == (4, 3)
+
+
+def test_stale_lease_takeover_increments_fence(tmp_path):
+    sdir = _manifest.step_path(str(tmp_path), 4)
+    _, entries = _entries(4)
+    _manifest.write_shard(sdir, 0, entries)
+    # a crashed committer left a lease 1000s ago with token 5
+    with open(_manifest._lease_path(sdir), "w") as f:
+        json.dump({"owner": "dead-proc", "token": 5,
+                   "ts": time.time() - 1000.0}, f)
+    man = _manifest.commit(sdir, 4, {"step": 4}, lease_timeout=1.0)
+    assert man["fence"] == 6     # takeover token fences out the dead holder
+
+
+def test_fresh_lease_holder_fences_out_second_writer(tmp_path):
+    sdir = _manifest.step_path(str(tmp_path), 5)
+    _, entries = _entries(5)
+    _manifest.write_shard(sdir, 0, entries)
+    with open(_manifest._lease_path(sdir), "w") as f:
+        json.dump({"owner": "live-proc", "token": 1, "ts": time.time()}, f)
+    with pytest.raises(MXNetError, match="lost the race"):
+        _manifest.commit(sdir, 5, {"step": 5}, lease_timeout=30.0)
+    assert not (tmp_path / "step-00000005" / _manifest.MANIFEST).exists()
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume trajectory parity UNDER INJECTED IO FAILURE (acceptance)
+# ---------------------------------------------------------------------------
+
+def _loss_fn(logits, labels):
+    import jax.numpy as jnp
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def _mlp():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32), gluon.nn.Activation("relu"),
+            gluon.nn.Dense(4))
+    net.initialize()
+    net(nd.zeros((2, 16)))
+    return net
+
+
+def _batch(seed=0, n=16):
+    rs = onp.random.RandomState(seed)
+    return (nd.array(rs.uniform(-1, 1, (n, 16)).astype(onp.float32)),
+            nd.array(rs.randint(0, 4, (n,)), dtype="int32"))
+
+
+def _trainer(mesh):
+    mx.random.seed(7)
+    return DataParallelTrainer(_mlp(), _loss_fn, optimizer="adam",
+                               optimizer_params={"learning_rate": 0.01},
+                               mesh=mesh)
+
+
+def _mesh4():
+    return make_mesh({"dp": 4}, devices=jax.devices("cpu")[:4])
+
+
+def test_kill_resume_parity_under_injected_write_faults(tmp_path,
+                                                        monkeypatch):
+    """The snapshot that the resume depends on is written THROUGH injected
+    shard-write faults: io_retry absorbs them and the relaunched job still
+    replays the exact uninterrupted trajectory."""
+    monkeypatch.setenv("MXNET_TPU_IO_BACKOFF", "0.001")
+    mesh = _mesh4()
+    x, y = _batch()
+    ref = _trainer(mesh)
+    ref_losses = [float(ref.step(x, y)) for _ in range(10)]
+
+    tr = _trainer(mesh)
+    for _ in range(5):
+        tr.step(x, y)
+    mgr = elastic.SnapshotManager(str(tmp_path))
+    with faults.injected("elastic.write_shard", faults.FirstK(1)):
+        elastic.save_trainer(mgr, tr, wait=True)
+    assert faults.fired("elastic.write_shard") == 1   # the fault DID fire
+    assert mgr.latest_step() == 5
+
+    with faults.injected("elastic.read", faults.FirstK(1)):
+        mgr2, tr2, start, outcome = elastic.resume_or_init(
+            str(tmp_path), lambda: _trainer(mesh))
+    assert (start, outcome) == (5, "resumed")
+    got = [float(tr2.step(x, y)) for _ in range(5)]
+    onp.testing.assert_allclose(got, ref_losses[5:], rtol=1e-6, atol=1e-7)
+
+
+def test_run_interval_snapshot_failure_warns_and_continues(tmp_path,
+                                                           monkeypatch):
+    """A failed INTERVAL snapshot (retries exhausted) must not kill the
+    job: elastic.run warns, books mx_snapshot_failures_total, keeps
+    training, and the final strict snapshot still lands."""
+    monkeypatch.setenv("MXNET_TPU_IO_RETRIES", "0")
+    telemetry.enable()
+    mesh = _mesh4()
+    tr = _trainer(mesh)
+    feed = [_batch(seed=i) for i in range(10)]
+    with faults.injected("elastic.write_shard", faults.FirstK(1)):
+        with pytest.warns(RuntimeWarning, match="interval snapshot"):
+            out = elastic.run(tr, feed, num_steps=6,
+                              directory=str(tmp_path), save_every=2)
+    assert out["step"] == 6 and not out["preempted"]
+    assert _manifest.latest_complete_step(str(tmp_path)) == 6
+    assert telemetry.get_metric(
+        "mx_snapshot_failures_total").get("elastic") == 1
+
+
+# ---------------------------------------------------------------------------
+# serving: shedding, deadlines, priorities, dispatch-fault containment
+# ---------------------------------------------------------------------------
+
+class _StubModel:
+    """Host-only RegisteredModel stand-in: the batcher tests exercise
+    queue policy, not XLA."""
+    name = "stub"
+    input_names = ("data",)
+    output_names = ("out",)
+    buckets = (1, 2, 4)
+    max_bucket = 4
+
+    def __init__(self, gate=None):
+        self.gate = gate          # forward blocks until set (when given)
+        self.calls = []           # (bucket, first column of each row)
+
+    def input_dtype(self, name):
+        return "float32"
+
+    def row_shape(self, name):
+        return (2,)
+
+    def smallest_bucket(self, rows):
+        for b in self.buckets:
+            if b >= rows:
+                return b
+        return self.buckets[-1]
+
+    def place_input(self, name, host):
+        return host
+
+    def forward(self, bucket, feed):
+        if self.gate is not None:
+            self.gate.wait()
+        x = feed["data"]
+        self.calls.append((bucket, [float(r[0]) for r in x]))
+        return [x.sum(axis=1, keepdims=True)]
+
+
+def _row(v):
+    return onp.array([v, 0.0], dtype=onp.float32)
+
+
+def test_submit_sheds_at_max_queue():
+    telemetry.enable()
+    stub = _StubModel()
+    b = ContinuousBatcher(stub, max_wait_ms=10_000, max_queue=2)
+    try:
+        f1 = b.submit(data=_row(1.0))
+        f2 = b.submit(data=_row(2.0))
+        with pytest.raises(ServerOverloaded, match="full"):
+            b.submit(data=_row(3.0))
+        assert telemetry.get_metric(
+            "mx_requests_shed_total").get("stub", "queue_full") == 1
+    finally:
+        b.close()
+    # admitted work still served through the close() drain
+    assert float(f1.result(timeout=5)[0][0]) == 1.0
+    assert float(f2.result(timeout=5)[0][0]) == 2.0
+
+
+def test_result_timeout_cancels_queued_request():
+    telemetry.enable()
+    stub = _StubModel()
+    b = ContinuousBatcher(stub, max_wait_ms=10_000, max_queue=0)
+    try:
+        f1 = b.submit(data=_row(1.0))
+        f2 = b.submit(data=_row(2.0))
+        t0 = time.perf_counter()
+        with pytest.raises(DeadlineExceeded, match="cancelled"):
+            f2.result(timeout=0.05)
+        assert time.perf_counter() - t0 < 5.0   # no 10s formation wait
+        assert b.queue_depth == 1               # slot reclaimed
+        assert telemetry.get_metric(
+            "mx_requests_shed_total").get("stub", "cancelled") == 1
+    finally:
+        b.close()
+    assert float(f1.result(timeout=5)[0][0]) == 1.0
+
+
+def test_latency_class_dispatches_before_batch_class():
+    gate = threading.Event()
+    stub = _StubModel(gate=gate)
+    b = ContinuousBatcher(stub, max_wait_ms=0.0, max_queue=0)
+    try:
+        futs = [b.submit(data=_row(0.0), priority="batch")]  # occupies the
+        deadline = time.time() + 10                          # dispatcher
+        while b.queue_depth and time.time() < deadline:
+            time.sleep(0.001)
+        assert b.queue_depth == 0
+        for v in (1.0, 2.0, 3.0):
+            futs.append(b.submit(data=_row(v), priority="batch"))
+        futs.append(b.submit(data=_row(9.0), priority="latency"))
+        gate.set()
+        for f in futs:
+            f.result(timeout=10)
+    finally:
+        gate.set()
+        b.close()
+    # second dispatched batch: the latency row leads the bulk rows
+    assert stub.calls[1][0] == 4
+    assert stub.calls[1][1][0] == 9.0
+
+
+def test_deadline_drops_queued_request_before_dispatch():
+    telemetry.enable()
+    gate = threading.Event()
+    stub = _StubModel(gate=gate)
+    b = ContinuousBatcher(stub, max_wait_ms=0.0, max_queue=0)
+    try:
+        blocker = b.submit(data=_row(0.0))
+        deadline = time.time() + 10
+        while b.queue_depth and time.time() < deadline:
+            time.sleep(0.001)
+        doomed = b.submit(data=_row(5.0), deadline_ms=30)
+        time.sleep(0.1)                      # deadline passes while queued
+        gate.set()
+        blocker.result(timeout=10)
+        with pytest.raises(DeadlineExceeded, match="dropped"):
+            doomed.result(timeout=10)
+        assert telemetry.get_metric(
+            "mx_requests_shed_total").get("stub", "deadline") == 1
+    finally:
+        gate.set()
+        b.close()
+    assert len(stub.calls) == 1              # the doomed row never ran
+
+
+def test_dispatch_fault_fails_batch_not_server():
+    stub = _StubModel()
+    b = ContinuousBatcher(stub, max_wait_ms=0.0, max_queue=0)
+    try:
+        with faults.injected("serving.dispatch", faults.FirstK(1)):
+            f1 = b.submit(data=_row(1.0))
+            with pytest.raises(faults.FaultInjected):
+                f1.result(timeout=10)
+        f2 = b.submit(data=_row(2.0))        # the loop survived the fault
+        assert float(f2.result(timeout=10)[0][0]) == 2.0
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# serving HTTP front door + artifact-load retry (real model)
+# ---------------------------------------------------------------------------
+
+class _SoftmaxMLP(gluon.HybridBlock):
+    def __init__(self, classes=5, **kw):
+        super().__init__(**kw)
+        self.body = gluon.nn.HybridSequential()
+        self.body.add(gluon.nn.Dense(16, activation="relu"),
+                      gluon.nn.Dense(classes))
+
+    def hybrid_forward(self, F, x):
+        return self.body(x).softmax()
+
+
+ROW_MLP = (6,)
+
+
+@pytest.fixture
+def mlp_prefix(tmp_path):
+    mx.random.seed(4)
+    net = _SoftmaxMLP()
+    net.initialize()
+    net.hybridize()
+    net(nd.zeros((1,) + ROW_MLP))
+    prefix = str(tmp_path / "mlp")
+    net.export(prefix)
+    return prefix
+
+
+def _post(port, model, payload, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/{model}:predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+def test_registry_load_retries_injected_fault(mlp_prefix, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_IO_BACKOFF", "0.001")
+    srv = serving.Server(max_wait_ms=1.0)
+    try:
+        with faults.injected("serving.load", faults.FirstK(1)):
+            srv.register("mlp", mlp_prefix + "-symbol.json",
+                         mlp_prefix + "-0000.params",
+                         input_shapes={"data": ROW_MLP}, buckets=(1,))
+        assert faults.fired("serving.load") == 1
+        out = srv.predict("mlp", data=onp.zeros((1,) + ROW_MLP,
+                                                dtype=onp.float32))
+        assert onp.asarray(out).shape == (1, 5)
+    finally:
+        srv.close()
+
+
+def test_http_degradation_503_504_and_fault_injection(mlp_prefix):
+    """One server, three failure surfaces: an injected front-door fault
+    and a real queue-full shed both answer 503 + Retry-After; a request
+    whose deadline passes while queued answers 504; a healthy request
+    still answers 200."""
+    srv = serving.Server(max_wait_ms=1.0)
+    srv.register("mlp", mlp_prefix + "-symbol.json",
+                 mlp_prefix + "-0000.params",
+                 input_shapes={"data": ROW_MLP}, buckets=(1,))
+    # same artifacts behind a deliberately stuck queue: an 8-bucket that
+    # single-row requests never fill + a 10s formation wait + max_queue=1
+    srv.register("slow", mlp_prefix + "-symbol.json",
+                 mlp_prefix + "-0000.params",
+                 input_shapes={"data": ROW_MLP}, buckets=(8,),
+                 max_wait_ms=10_000, max_queue=1)
+    port = srv.start_http(0)
+    row = [[0.1] * 6]
+    try:
+        # healthy path, with priority + timeout_ms in the payload
+        status, _, body = _post(port, "mlp", {
+            "inputs": {"data": row}, "priority": "latency",
+            "timeout_ms": 30_000})
+        assert status == 200 and len(body["outputs"][0][0]) == 5
+
+        # injected front-door fault -> 503 + Retry-After, next request OK
+        with faults.injected("serving.http", faults.FirstK(1)):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(port, "mlp", {"inputs": {"data": row}})
+            assert exc.value.code == 503
+            assert exc.value.headers.get("Retry-After") == "1"
+            status, _, _ = _post(port, "mlp", {"inputs": {"data": row}})
+            assert status == 200
+
+        # request A sits in the stuck queue until its deadline -> 504
+        results = {}
+
+        def stuck():
+            try:
+                results["a"] = _post(port, "slow", {
+                    "inputs": {"data": row}, "timeout_ms": 700})
+            except urllib.error.HTTPError as e:
+                results["a"] = (e.code, dict(e.headers), None)
+
+        t = threading.Thread(target=stuck)
+        t.start()
+        deadline = time.time() + 10
+        while srv._batcher("slow").queue_depth < 1 and \
+                time.time() < deadline:
+            time.sleep(0.005)
+        assert srv._batcher("slow").queue_depth == 1
+
+        # request B hits the admission bound -> 503 + Retry-After
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(port, "slow", {"inputs": {"data": row}})
+        assert exc.value.code == 503
+        assert exc.value.headers.get("Retry-After") == "1"
+
+        t.join(timeout=30)
+        assert results["a"][0] == 504
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# DeviceFeed: supervised producer restart + loud leak accounting
+# ---------------------------------------------------------------------------
+
+class _RangeSource:
+    """Restartable source: each iter() yields the same n batches, so the
+    producer's fast-forward replay is observable as exactly-once output."""
+
+    def __init__(self, n=8):
+        self.n = n
+
+    def __iter__(self):
+        return (onp.full((2,), float(i), dtype=onp.float32)
+                for i in range(self.n))
+
+
+def test_feed_restart_delivers_exactly_once_in_order():
+    telemetry.enable()
+    feed = DeviceFeed(_RangeSource(8), name="chaos", restarts=2)
+    try:
+        with faults.injected("feed.produce", faults.FirstK(2)):
+            got = [float(onp.asarray(b)[0]) for b in feed]
+    finally:
+        feed.close()
+    assert got == [float(i) for i in range(8)]
+    assert feed.restarts == 2
+    assert telemetry.get_metric(
+        "mx_feed_producer_restarts_total").get("chaos") == 2
+
+
+def test_feed_fault_surfaces_without_restart_budget():
+    feed = DeviceFeed(_RangeSource(4), name="chaos-hard")
+    try:
+        with faults.injected("feed.produce", faults.EveryNth(1)):
+            with pytest.raises(faults.FaultInjected):
+                feed.next()
+    finally:
+        feed.close()
+    assert feed.restarts == 0
+
+
+class _BlockingSource:
+    """Second next() blocks on an Event the test controls — models a
+    wrapped source stuck in a remote read that join() cannot interrupt."""
+
+    def __init__(self, release):
+        self._release = release
+
+    def __iter__(self):
+        def gen():
+            yield onp.zeros((2,), dtype=onp.float32)
+            self._release.wait()
+            yield onp.ones((2,), dtype=onp.float32)
+        return gen()
+
+
+def test_feed_producer_leak_warns_and_is_counted(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FEED_JOIN_TIMEOUT", "0.1")
+    telemetry.enable()
+    release = threading.Event()
+    feed = DeviceFeed(_BlockingSource(release), name="stuck")
+    try:
+        feed.next()                       # producer now blocked in source
+        with pytest.warns(RuntimeWarning, match="abandoned"):
+            feed.close()
+        assert feed.producer_leaks == 1
+        assert telemetry.get_metric(
+            "mx_feed_producer_leaks_total").get("stuck") == 1
+    finally:
+        release.set()                     # let the abandoned thread exit

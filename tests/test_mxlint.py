@@ -479,6 +479,62 @@ class Helper:
 # baseline + driver mechanics
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# broad-except
+# ---------------------------------------------------------------------------
+
+def test_broad_except_flags_bare_and_base_exception(tmp_path):
+    src = '''
+def worker(q):
+    try:
+        q.get()
+    except BaseException as e:      # swallows KeyboardInterrupt
+        log(e)
+    try:
+        q.get()
+    except (ValueError, BaseException):
+        pass
+
+try:
+    boot()
+except:                             # bare, at module scope
+    pass
+'''
+    out = _lint(tmp_path, "mxnet_tpu/serving/batcher.py", src,
+                ["broad-except"])
+    assert len(out) == 3, out
+    assert _rules_of(out) == {"broad-except"}
+    assert {f.symbol for f in out} == {"worker", "<module>"}
+
+
+def test_broad_except_allows_shutdown_waivers_and_exception(tmp_path):
+    src = '''
+class Feed:
+    def close(self):
+        try:
+            self._join()
+        except Exception:            # narrow containment: fine
+            pass
+    def __del__(self):
+        try:
+            self.close()
+        except BaseException:        # interpreter teardown: exempt
+            pass
+    def __exit__(self, *exc):
+        try:
+            self.close()
+        except:                      # teardown scope: exempt
+            pass
+    def _write(self):
+        try:
+            self._flush()
+        except BaseException as e:  # mxlint: disable=broad-except
+            self._error = e
+'''
+    assert _lint(tmp_path, "mxnet_tpu/engine/async_feed.py", src,
+                 ["broad-except"]) == []
+
+
 def test_baseline_roundtrip_and_diff(tmp_path):
     f1 = Finding("host-sync", "mxnet_tpu/a.py", 10, "A.step", "float() bad")
     f2 = Finding("jit-purity", "mxnet_tpu/b.py", 20, "body", "time.time()")
@@ -505,7 +561,7 @@ def test_all_passes_registered():
     names = set(all_passes())
     assert {"host-sync", "retrace-hazard", "donation-safety", "jit-purity",
             "lock-discipline", "mutable-default", "sync-in-loop",
-            "instrumentation"} <= names
+            "instrumentation", "broad-except"} <= names
 
 
 def test_cli_json_format_and_exit_codes(tmp_path):

@@ -130,6 +130,21 @@ METHOD_CHECKS = [
     # checked above); its telemetry override books the ring wire volume
     ("recipes/long_context.py", "LongContextTrainer", "_record_telemetry",
      {"record_comm"}, "call"),
+    # reliability plane (ISSUE 13): every fired fault and every transient
+    # retry must be booked — chaos runs divide recovery metrics by
+    # mx_faults_injected_total, and a nonzero retry rate WITHOUT armed
+    # chaos is the flaky-filesystem page; load shedding and producer
+    # leaks/restarts are the overload + input-supervision signals
+    ("faults/__init__.py", None, "check",
+     {"record_fault_injected"}, "call"),
+    ("faults/__init__.py", None, "io_retry",
+     {"record_io_retry"}, "call"),
+    ("serving/batcher.py", "ContinuousBatcher", "_shed",
+     {"record_request_shed"}, "call"),
+    ("engine/async_feed.py", "DeviceFeed", "_stop_producer",
+     {"record_feed_producer_leak"}, "call"),
+    ("engine/async_feed.py", "DeviceFeed", "_produce",
+     {"record_feed_producer_restart"}, "call"),
 ]
 
 # (relative file, required substring, rationale)
@@ -175,6 +190,20 @@ TEXT_CHECKS = [
     ("telemetry/__init__.py", "mx_serving_batch_occupancy",
      "the registry must export the batch-occupancy (real vs padded rows) "
      "gauge — the bucket-set tuning signal"),
+    # reliability plane (ISSUE 13)
+    ("telemetry/__init__.py", "mx_faults_injected_total",
+     "the registry must export the injected-fault counter (the chaos "
+     "denominator every recovery metric divides by)"),
+    ("telemetry/__init__.py", "mx_io_retries_total",
+     "the registry must export the transient-IO retry counter (nonzero "
+     "without armed chaos = flaky snapshot filesystem, page before "
+     "retries exhaust)"),
+    ("telemetry/__init__.py", "mx_requests_shed_total",
+     "the registry must export the serving shed counter (admission "
+     "control / deadline drops — the overload signal)"),
+    ("telemetry/__init__.py", "mx_feed_producer_leaks_total",
+     "the registry must export the producer-leak counter (abandoned "
+     "DeviceFeed producer threads must never be silent)"),
     # roofline ledger + trace capture (ISSUE 7)
     ("telemetry/__init__.py", "def peak_bytes_per_second",
      "the registry must expose the roofline bandwidth peak (env override "

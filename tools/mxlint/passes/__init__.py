@@ -1,3 +1,4 @@
 """Importing this package registers every built-in mxlint pass."""
-from . import (donation, host_sync, instrumentation,  # noqa: F401
-               locks, mutable_defaults, purity, retrace, sync_in_loop)
+from . import (broad_except, donation, host_sync,  # noqa: F401
+               instrumentation, locks, mutable_defaults, purity, retrace,
+               sync_in_loop)

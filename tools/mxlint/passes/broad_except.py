@@ -1,0 +1,63 @@
+"""broad-except: ``except BaseException`` / bare ``except:`` swallowing
+KeyboardInterrupt and SystemExit.
+
+The bug class behind ISSUE 13's serving fix: the dispatcher/completer
+threads caught ``BaseException`` "to keep serving", which also swallowed
+Ctrl-C and interpreter shutdown — a server that cannot be stopped. The
+rule: worker-loop error containment catches ``Exception``; only a
+documented stash-and-reraise thread boundary (an error stored and
+re-raised on the consuming thread, e.g. SnapshotManager._write) may see
+``BaseException``, and it says so with a line waiver.
+
+Flagged:
+  - bare ``except:`` anywhere;
+  - ``except BaseException`` (alone or inside a tuple).
+
+Not flagged:
+  - interpreter-teardown scopes (``__del__`` / ``__exit__`` /
+    ``__aexit__``), where best-effort cleanup legitimately must not
+    raise through;
+  - lines waived with ``# mxlint: disable=broad-except`` (the waiver
+    comment doubles as the required justification).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, ModuleInfo, register_pass
+
+_SHUTDOWN_FNS = {"__del__", "__exit__", "__aexit__"}
+
+
+def _mentions_base_exception(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id == "BaseException":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "BaseException":
+            return True
+    return False
+
+
+@register_pass("broad-except",
+               "except BaseException / bare except swallows "
+               "KeyboardInterrupt and SystemExit")
+def check(mod: ModuleInfo):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        fn = mod.enclosing_function(node)
+        if fn is not None and fn.name in _SHUTDOWN_FNS:
+            continue
+        where = mod.qualname(fn) if fn is not None else "<module>"
+        if node.type is None:
+            yield Finding(
+                "broad-except", mod.relpath, node.lineno, where,
+                "bare `except:` catches KeyboardInterrupt/SystemExit; "
+                "catch Exception (or the specific errors) instead")
+        elif _mentions_base_exception(node.type):
+            yield Finding(
+                "broad-except", mod.relpath, node.lineno, where,
+                "`except BaseException` swallows KeyboardInterrupt/"
+                "SystemExit; narrow to Exception, or waive a documented "
+                "stash-and-reraise boundary with "
+                "`# mxlint: disable=broad-except`")
