@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, Optional
 
 from ..base import MXNetError
 from .. import telemetry as _telem
+from ..telemetry import tracing as _tracing
 from . import manifest as _manifest
 from . import state as _state
 from .snapshot import SnapshotManager
@@ -173,6 +174,12 @@ def run(trainer, feed, num_steps: int, directory: Optional[str] = None,
         while trainer._t < num_steps:
             if g.triggered:
                 preempted = True
+                if _tracing._ENABLED:
+                    # black-box dump at the preemption boundary: the final
+                    # steps' spans survive even if the relaunch clobbers
+                    # everything else
+                    _tracing.event("mx.preemption", step=trainer._t)
+                    _tracing.dump_flight_recorder(reason="preemption")
                 break
             try:
                 batch = next(it)
@@ -183,7 +190,15 @@ def run(trainer, feed, num_steps: int, directory: Optional[str] = None,
                 it = iter(feed)
                 continue
             x, y = _xy(batch)
-            losses.append(trainer.step(x, y))
+            try:
+                losses.append(trainer.step(x, y))
+            except BaseException:  # dump-and-reraise: nothing is swallowed  # mxlint: disable=broad-except
+                # unhandled-step-exception hook: dump the recorder before
+                # the error unwinds past the loop (callers often catch and
+                # relaunch, so sys.excepthook would never see it)
+                if _tracing._ENABLED:
+                    _tracing.dump_flight_recorder(reason="step_exception")
+                raise
             if manager.should_save(trainer._t):
                 try:
                     save_trainer(manager, trainer, feed)

@@ -35,6 +35,7 @@ from typing import Any, Dict, Optional
 
 from ..base import MXNetError, env
 from .. import telemetry as _telem
+from ..telemetry import tracing as _tracing
 from . import manifest as _manifest
 
 __all__ = ["SnapshotManager"]
@@ -86,8 +87,13 @@ class SnapshotManager:
         copies = self._copy_leaves(leaves)
         self._last_saved = step
         t0 = time.perf_counter()
+        # snapshot spans parent to the caller's trace (the training loop's
+        # step span when armed there), carried explicitly across the
+        # writer-thread boundary
+        ctx = (_tracing.current() or _tracing.new_root("snapshot")) \
+            if _tracing._ENABLED else None
         self._writer = threading.Thread(
-            target=self._write, args=(step, copies, meta, t0),
+            target=self._write, args=(step, copies, meta, t0, ctx),
             daemon=True, name=f"mx-snapshot-{step}")
         self._writer.start()
         if wait:
@@ -107,40 +113,53 @@ class SnapshotManager:
         return out
 
     # -- background writer ---------------------------------------------------
-    def _write(self, step, copies, meta, t0):
+    def _write(self, step, copies, meta, t0, ctx=None):
         try:
-            import jax
-            sdir = _manifest.step_path(self.directory, step)
-            os.makedirs(sdir, exist_ok=True)
-            import numpy as _np
-            proc = jax.process_index()
-            entries = []
-            for name, v in copies.items():
-                if isinstance(v, jax.Array):
-                    for shard in v.addressable_shards:
-                        if shard.replica_id != 0:
-                            continue
-                        index = [sl.indices(dim)[:2]
-                                 for sl, dim in zip(shard.index, v.shape)]
-                        entries.append((name, index, _np.asarray(shard.data),
-                                        v.shape, v.dtype))
-                elif proc == 0:
-                    arr = _np.asarray(v)
-                    index = [(0, d) for d in arr.shape]
-                    entries.append((name, index, arr, arr.shape, arr.dtype))
-            nbytes = _manifest.write_shard(sdir, proc, entries)
+            if ctx is not None and _tracing._ENABLED:
+                with _tracing.attach(ctx), \
+                        _tracing.span("mx.elastic.snapshot_write", step=step):
+                    nbytes, sdir, proc = self._write_entries(step, copies)
+            else:
+                nbytes, sdir, proc = self._write_entries(step, copies)
             if proc == 0:
-                self._commit(sdir, step, meta, nbytes, t0)
+                self._commit(sdir, step, meta, nbytes, t0, ctx)
         except BaseException as e:  # stash-and-reraise thread boundary: surfaced at the next save()/wait  # mxlint: disable=broad-except
             self._error = e
 
-    def _commit(self, sdir, step, meta, nbytes, t0):
+    def _write_entries(self, step, copies):
+        import jax
+        sdir = _manifest.step_path(self.directory, step)
+        os.makedirs(sdir, exist_ok=True)
+        import numpy as _np
+        proc = jax.process_index()
+        entries = []
+        for name, v in copies.items():
+            if isinstance(v, jax.Array):
+                for shard in v.addressable_shards:
+                    if shard.replica_id != 0:
+                        continue
+                    index = [sl.indices(dim)[:2]
+                             for sl, dim in zip(shard.index, v.shape)]
+                    entries.append((name, index, _np.asarray(shard.data),
+                                    v.shape, v.dtype))
+            elif proc == 0:
+                arr = _np.asarray(v)
+                index = [(0, d) for d in arr.shape]
+                entries.append((name, index, arr, arr.shape, arr.dtype))
+        nbytes = _manifest.write_shard(sdir, proc, entries)
+        return nbytes, sdir, proc
+
+    def _commit(self, sdir, step, meta, nbytes, t0, ctx=None):
         """Atomic manifest commit + retention + save telemetry."""
         import jax
+        t_c0 = time.perf_counter() if _tracing._ENABLED else 0.0
         _manifest.commit(sdir, step, meta,
                          expected_processes=jax.process_count())
         _manifest.prune(self.directory, self.max_to_keep)
         seconds = time.perf_counter() - t0
+        if _tracing._ENABLED:
+            _tracing.record_span("mx.elastic.commit", t_c0, t0 + seconds,
+                                 parent=ctx, step=step, bytes=int(nbytes))
         self.save_seconds = seconds
         self.bytes_written += int(nbytes)
         if _telem._ENABLED:

@@ -49,6 +49,7 @@ from typing import Any, Callable, Optional
 import numpy as _np
 
 from ..base import MXNetError, env
+from ..telemetry import tracing as _tracing
 
 __all__ = ["DeviceFeed", "DispatchWindow", "PendingScalar", "drain",
            "feed_depth", "inflight_steps", "maybe_wrap"]
@@ -129,10 +130,14 @@ class PendingScalar:
         return self
 
     def __float__(self):
-        return float(self._raw)
+        v = float(self._raw)
+        if _tracing._ENABLED:
+            # nonfinite-loss watchdog rides the sync the caller asked for
+            _tracing.check_loss(v, source="pending_scalar")
+        return v
 
     def item(self):
-        return float(self._raw)
+        return self.__float__()
 
     def asnumpy(self):
         return _np.asarray(self._raw)
@@ -165,7 +170,10 @@ def drain(values):
     if hasattr(raw, "block_until_ready"):
         raw.block_until_ready()
     if getattr(raw, "ndim", None) == 0 or isinstance(values, PendingScalar):
-        return float(raw)
+        v = float(raw)
+        if _tracing._ENABLED:
+            _tracing.check_loss(v, source="drain")
+        return v
     return raw
 
 
@@ -207,25 +215,45 @@ class DispatchWindow:
         """Register one dispatched step; blocks on the oldest in-flight step
         when the window exceeds its depth (never on the current one)."""
         self._pending.append(handles)
+        wait0 = self.wait_seconds
+        retired = 0
+        t_first = 0.0
         while len(self._pending) > max(self.depth, 0):
             old = self._pending.popleft()
             t0 = time.perf_counter()
+            if retired == 0:
+                t_first = t0
             self._block(old)
             self.wait_seconds += time.perf_counter() - t0
             self.retired += 1
+            retired += 1
         self.max_inflight = max(self.max_inflight, len(self._pending))
+        if _tracing._ENABLED and retired:
+            # the backpressure wait, rebuilt from the stamps the window
+            # already took — no clock reads beyond the existing ones
+            _tracing.record_span("mx.window.admit", t_first,
+                                 t_first + (self.wait_seconds - wait0),
+                                 source=self.name, retired=retired,
+                                 inflight=len(self._pending))
         from .. import telemetry as _telem
         if _telem._ENABLED:
             _telem.record_inflight(len(self._pending), source=self.name)
 
     def drain(self):
         """Block until every admitted step completed (epoch/eval boundary)."""
+        t_d0 = time.perf_counter() if _tracing._ENABLED else 0.0
+        drained = 0
         while self._pending:
             old = self._pending.popleft()
             t0 = time.perf_counter()
             self._block(old)
             self.wait_seconds += time.perf_counter() - t0
             self.retired += 1
+            drained += 1
+        if _tracing._ENABLED:
+            _tracing.record_span("mx.window.drain", t_d0,
+                                 time.perf_counter(), source=self.name,
+                                 drained=drained)
         from .. import telemetry as _telem
         if _telem._ENABLED:
             _telem.record_inflight(0, source=self.name)
@@ -401,6 +429,9 @@ class DeviceFeed:
         restarts_left = self._max_restarts
         produced = 0
         skip, self._skip = self._skip, 0
+        # all of this producer's spans group under one root context so a
+        # trace viewer shows the feed as a single causal track
+        root = _tracing.new_root(self.name) if _tracing._ENABLED else None
         while True:
             try:
                 it = iter(self._source)
@@ -413,8 +444,22 @@ class DeviceFeed:
                 while not stop.is_set():
                     if _faults._ACTIVE:
                         _faults.check("feed.produce")
-                    item = next(it)
-                    if not _bounded_put(q, self._place(item), stop):
+                    if _tracing._ENABLED:
+                        t0 = time.perf_counter()
+                        item = next(it)
+                        t1 = time.perf_counter()
+                        placed = self._place(item)
+                        t2 = time.perf_counter()
+                        _tracing.record_span("mx.feed.produce", t0, t1,
+                                             parent=root, source=self.name,
+                                             batch=produced)
+                        _tracing.record_span("mx.feed.put", t1, t2,
+                                             parent=root, source=self.name,
+                                             batch=produced)
+                    else:
+                        item = next(it)
+                        placed = self._place(item)
+                    if not _bounded_put(q, placed, stop):
                         return
                     produced += 1
                 return

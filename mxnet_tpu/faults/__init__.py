@@ -134,6 +134,11 @@ def check(point: str):
         from .. import telemetry as _telem
         if _telem._ENABLED:
             _telem.record_fault_injected(point)
+        from ..telemetry import tracing as _tracing
+        if _tracing._ENABLED:
+            # every injected fault is a flight-recorder event: the crash
+            # dump shows exactly which chaos fired before the failure
+            _tracing.event("mx.fault", point=point, attempt=n)
         raise FaultInjected(point, n)
 
 
@@ -225,24 +230,42 @@ def io_retry(point: str, fn, *args, retries: Optional[int] = None,
     base = float(env.get("MXNET_TPU_IO_BACKOFF")) if backoff is None \
         else float(backoff)
     cap = float(env.get("MXNET_TPU_IO_BACKOFF_MAX"))
+    from ..telemetry import tracing as _tracing
     attempt = 0
     while True:
+        t0 = time.perf_counter() if _tracing._ENABLED else 0.0
         try:
             if _ACTIVE:
                 check(point)
-            return fn(*args, **kwargs)
+            out = fn(*args, **kwargs)
+            if _tracing._ENABLED:
+                _tracing.record_span("mx.io." + point, t0,
+                                     time.perf_counter(),
+                                     attempt=attempt, status="ok")
+            return out
         except FaultInjected:
+            if _tracing._ENABLED:
+                _tracing.record_span("mx.io." + point, t0,
+                                     time.perf_counter(),
+                                     attempt=attempt, status="fault")
             if attempt >= budget:
                 raise
         except MXNetError:
             raise               # permanent by design (fence, validation)
-        except OSError:
+        except OSError as e:
+            if _tracing._ENABLED:
+                _tracing.record_span("mx.io." + point, t0,
+                                     time.perf_counter(),
+                                     attempt=attempt, status="error",
+                                     error=type(e).__name__)
             if attempt >= budget:
                 raise
         attempt += 1
         from .. import telemetry as _telem
         if _telem._ENABLED:
             _telem.record_io_retry(point)
+        if _tracing._ENABLED:
+            _tracing.event("mx.io_retry", point=point, attempt=attempt)
         delay = min(cap, base * (2 ** (attempt - 1)))
         if delay > 0:
             time.sleep(random.uniform(0, delay))

@@ -15,6 +15,7 @@ jit so XLA can overlap the gather with the next forward.
 from __future__ import annotations
 
 import functools
+import time
 import warnings
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -33,6 +34,7 @@ from ..engine import xla_flags as _xla_flags
 from .. import random as _rng
 from .. import sanitize as _sanitize
 from .. import telemetry as _telem
+from ..telemetry import tracing as _tracing
 from ..gluon.block import HybridBlock, _AUX_STACK
 from ..gluon.parameter import Parameter
 from .. import optimizer as opt_mod
@@ -1435,11 +1437,18 @@ class DataParallelTrainer:
             cost_key, fn, self._params_raw, self._opt_state,
             self._comp_resid, key_in, xr, yr, lr_in, t_in, scale_in,
             kind="dp_multi")
+        t_sp = time.perf_counter() if _tracing._ENABLED else 0.0
         with _telem.annotate("mx.dp.run_steps"), _sanitize.guard():
             (self._params_raw, self._opt_state, self._comp_resid, losses,
              finite, key_out, t_out) = fn(
                 self._params_raw, self._opt_state, self._comp_resid,
                 key_in, xr, yr, lr_in, t_in, scale_in)
+        if _tracing._ENABLED:
+            # dispatch-only span; the same name as the TraceAnnotation
+            # region so host and device timelines line up in Perfetto
+            _tracing.record_span("mx.dp.run_steps", t_sp,
+                                 time.perf_counter(), steps=n,
+                                 step=self._t, source="data_parallel")
         # one run_steps call = one in-flight entry (n fused steps inside a
         # single executable); telemetry after admission, as in step()
         self._window.admit(losses)
@@ -1487,6 +1496,7 @@ class DataParallelTrainer:
         # cost_analysis FLOPs of the fused step, captured once per
         # signature at artifact-build time (AOT lower shares XLA caches)
         self._program.capture_cost(sig, fn, *call_args, kind="dp_step")
+        t_sp = time.perf_counter() if _tracing._ENABLED else 0.0
         with _telem.annotate("mx.dp.step"), _sanitize.guard():
             if self._compression:
                 (self._params_raw, self._opt_state, self._comp_resid, lossv,
@@ -1494,6 +1504,11 @@ class DataParallelTrainer:
             else:
                 self._params_raw, self._opt_state, lossv, finite, aux = fn(
                     *call_args)
+        if _tracing._ENABLED:
+            # the step-dispatch span, same name as the TraceAnnotation
+            # region; admit/drain pacing is the window's own span
+            _tracing.record_span("mx.dp.step", t_sp, time.perf_counter(),
+                                 step=self._t, source="data_parallel")
         if self._scaler is not None:
             # fp16 dynamic loss scaling reads the finite flag per step —
             # the one sync the overlap window cannot remove (documented in

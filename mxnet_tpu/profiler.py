@@ -181,8 +181,34 @@ class Marker:
                             "s": "p"})
 
 
+def _tracing_rows():
+    """Aggregate completed spans from the telemetry tracing ring into
+    (category, name, count, total, min, max) rows. One event journal, two
+    views: the span ring is owned by telemetry.tracing (bounded by
+    MXNET_TPU_TRACING_MAX_SPANS, the same ring-buffer convention as
+    MXNET_PROFILER_MAX_EVENTS above) and this table is a read-only
+    aggregation over it — dumps(reset=True) does not clear it."""
+    from .telemetry import tracing as _tracing
+    if not _tracing._ENABLED:
+        return []
+    agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+    for e in _tracing.spans():
+        if e.get("kind") != "span":
+            continue
+        dur_us = e["dur"] * 1e6
+        st = agg[e["name"]]
+        st[0] += 1
+        st[1] += dur_us
+        st[2] = min(st[2], dur_us)
+        st[3] = max(st[3], dur_us)
+    return [("tracing", name, c, tot, mn, mx)
+            for name, (c, tot, mn, mx) in sorted(agg.items())]
+
+
 def dumps(reset=False, format="table", reset_events=None) -> str:
-    """Aggregate stats table (reference aggregate_stats.cc).
+    """Aggregate stats table (reference aggregate_stats.cc), including a
+    'tracing' category aggregated from telemetry.tracing's span ring when
+    span tracing is armed (docs/observability.md).
 
     reset=True clears the aggregate table; reset_events (default: follows
     `reset`) also clears the chrome-trace event buffer, so a periodic
@@ -196,6 +222,8 @@ def dumps(reset=False, format="table", reset_events=None) -> str:
             _agg.clear()
         if reset_events:
             _events.clear()
+    rows += [(cat, name, c, tot, tot / max(c, 1), mn, mx)
+             for cat, name, c, tot, mn, mx in _tracing_rows()]
     if format == "json":
         return json.dumps([dict(zip(("category", "name", "count", "total_us",
                                      "avg_us", "min_us", "max_us"), r)) for r in rows])

@@ -52,6 +52,7 @@ import numpy as _np
 from .. import faults as _faults
 from ..base import MXNetError, env
 from ..engine.async_feed import DispatchWindow
+from ..telemetry import tracing as _tracing
 from .registry import RegisteredModel
 
 __all__ = ["ServingFuture", "ContinuousBatcher", "ServerOverloaded",
@@ -133,10 +134,19 @@ class ServingFuture:
         self._error = err
         self._event.set()
 
+    @property
+    def trace_id(self) -> Optional[str]:
+        """The request's tracing trace id (None when tracing is disarmed):
+        the same id on every lifecycle span and in the HTTP response's
+        ``X-MX-Trace-Id`` header."""
+        r = self._request
+        t = None if r is None else r.trace
+        return None if t is None else t[0]
+
 
 class _Request:
     __slots__ = ("inputs", "rows", "future", "t_enqueue", "priority",
-                 "deadline")
+                 "deadline", "trace")
 
     def __init__(self, inputs: Dict[str, _np.ndarray], rows: int,
                  priority: str = "latency",
@@ -148,6 +158,7 @@ class _Request:
         self.deadline = None if deadline_ms is None \
             else self.t_enqueue + float(deadline_ms) / 1e3
         self.future = None  # set by the batcher (needs the backref)
+        self.trace = None   # (trace_id, root span_id) when tracing is armed
 
 
 class ContinuousBatcher:
@@ -257,6 +268,12 @@ class ContinuousBatcher:
         req = _Request(arrays, rows, priority=priority,
                        deadline_ms=deadline_ms)
         req.future = ServingFuture(self, req)
+        if _tracing._ENABLED:
+            # the request's root context: the same trace id rides every
+            # lifecycle span, the future, and the HTTP response header.
+            # Allocated BEFORE the enqueue — the dispatcher may take the
+            # request the moment the queue lock drops.
+            req.trace = _tracing.new_root(self._name)
         with self._cond:
             if self._closed:
                 raise MXNetError(
@@ -275,6 +292,10 @@ class ContinuousBatcher:
         if overloaded is not None:
             self._shed("queue_full")
             raise overloaded
+        if _tracing._ENABLED:
+            _tracing.event("mx.serving.enqueue", parent=req.trace,
+                           model=self._name, rows=rows, priority=priority,
+                           depth=depth)
         from .. import telemetry as _telem
         if _telem._ENABLED:
             _telem.record_serving_enqueue(self._name, rows)
@@ -328,9 +349,12 @@ class ContinuousBatcher:
                 rows += req.rows
         return take, rows
 
-    def _next_batch(self) -> Optional[Tuple[List[_Request], int, int, int]]:
+    def _next_batch(self) -> Optional[Tuple[List[_Request], int, int, int,
+                                            float]]:
         """Block until a batch is ready under the dispatch policy; None on
-        shutdown with an empty queue."""
+        shutdown with an empty queue. The last element is the take-time
+        perf_counter stamp — queue-wait accounting and spans reuse it, so
+        the breakdown adds no clock reads."""
         from .. import telemetry as _telem
         while True:
             with self._cond:
@@ -354,7 +378,7 @@ class ContinuousBatcher:
                             take, rows = self._take_locked()
                             depth = self._depth_locked()
                             bucket = self._model.smallest_bucket(rows)
-                            return take, bucket, rows, depth
+                            return take, bucket, rows, depth, now
                         # wake for the batch deadline OR the nearest
                         # request deadline, whichever is sooner
                         wake = deadline
@@ -382,6 +406,12 @@ class ContinuousBatcher:
                     _telem.record_serving_completion(
                         self._name, now - r.t_enqueue, r.rows,
                         status="deadline")
+            if _tracing._ENABLED:
+                for r in expired:
+                    if r.trace is not None:
+                        _tracing.record_span(
+                            "mx.serving.request", r.t_enqueue, now,
+                            ctx=r.trace, model=self._name, status="deadline")
 
     def _assemble(self, reqs: List[_Request], bucket: int) -> Dict[str, Any]:
         """Concatenate + zero-pad the requests' host arrays to the bucket
@@ -407,23 +437,29 @@ class ContinuousBatcher:
             batch = self._next_batch()
             if batch is None:
                 break
-            reqs, bucket, rows, depth = batch
+            reqs, bucket, rows, depth, t_take = batch
             try:
                 if _faults._ACTIVE:
                     _faults.check("serving.dispatch")
+                t_form0 = time.perf_counter() if _tracing._ENABLED else 0.0
                 feed = self._assemble(reqs, bucket)
+                t_formed = time.perf_counter() if _tracing._ENABLED else 0.0
                 outs = self._model.forward(bucket, feed)
             except Exception as e:  # fail THIS batch, keep serving;
                 # KeyboardInterrupt/SystemExit propagate (mxlint
                 # broad-except)
+                now = time.perf_counter()
                 for r in reqs:
                     r.future._set_error(e)
-                if _telem._ENABLED:
-                    for r in reqs:
+                    if _telem._ENABLED:
                         _telem.record_serving_completion(
-                            self._name,
-                            time.perf_counter() - r.t_enqueue,
+                            self._name, now - r.t_enqueue,
                             r.rows, status="error")
+                    if _tracing._ENABLED and r.trace is not None:
+                        _tracing.record_span(
+                            "mx.serving.request", r.t_enqueue, now,
+                            ctx=r.trace, model=self._name, status="error",
+                            error=type(e).__name__)
                 continue
             # bounded in-flight: blocks on the OLDEST batch when > K are
             # outstanding — backpressure, never a sync on `outs`
@@ -431,6 +467,26 @@ class ContinuousBatcher:
             if _telem._ENABLED:
                 _telem.record_serving_dispatch(self._name, bucket, rows)
                 _telem.record_serving_queue_depth(self._name, depth)
+                for r in reqs:
+                    _telem.record_serving_queue_wait(
+                        self._name, t_take - r.t_enqueue)
+            if _tracing._ENABLED:
+                t_admit = time.perf_counter()
+                batch_ref = next((r.trace for r in reqs
+                                  if r.trace is not None), None)
+                if batch_ref is not None:
+                    _tracing.record_span(
+                        "mx.serving.batch_form", t_form0, t_formed,
+                        parent=batch_ref, model=self._name, bucket=bucket,
+                        rows=rows, n_requests=len(reqs))
+                for r in reqs:
+                    if r.trace is not None:
+                        _tracing.record_span(
+                            "mx.serving.queue_wait", r.t_enqueue, t_take,
+                            parent=r.trace, model=self._name)
+                        _tracing.record_span(
+                            "mx.serving.dispatch", t_take, t_admit,
+                            parent=r.trace, model=self._name, bucket=bucket)
             self._done_q.put((reqs, outs))
         self._done_q.put(None)
 
@@ -445,25 +501,42 @@ class ContinuousBatcher:
         """The designed host sync: read the padded outputs back, slice each
         request's real rows, resolve futures, record end-to-end latency."""
         from .. import telemetry as _telem
+        t_c0 = time.perf_counter() if _tracing._ENABLED else 0.0
         try:
             host = [_np.asarray(o) for o in outs]
         except Exception as e:  # device-side batch failure; the workers
             # stay up (KeyboardInterrupt/SystemExit propagate)
+            now = time.perf_counter()
             for r in reqs:
                 r.future._set_error(e)
                 if _telem._ENABLED:
                     _telem.record_serving_completion(
-                        self._name, time.perf_counter() - r.t_enqueue,
+                        self._name, now - r.t_enqueue,
                         r.rows, status="error")
+                if _tracing._ENABLED and r.trace is not None:
+                    _tracing.record_span(
+                        "mx.serving.request", r.t_enqueue, now,
+                        ctx=r.trace, model=self._name, status="error",
+                        error=type(e).__name__)
             return
         off = 0
+        now = time.perf_counter()
         for r in reqs:
             sl = [h[off:off + r.rows] for h in host]
             off += r.rows
+            if _tracing._ENABLED and r.trace is not None:
+                # completion = the sync + row slicing; recorded BEFORE the
+                # future resolves so a caller that immediately dumps the
+                # ring sees its own request's spans
+                _tracing.record_span("mx.serving.complete", t_c0, now,
+                                     parent=r.trace, model=self._name)
+                _tracing.record_span("mx.serving.request", r.t_enqueue, now,
+                                     ctx=r.trace, model=self._name,
+                                     rows=r.rows, status="ok")
             r.future._set_result(sl[0] if len(sl) == 1 else sl)
             if _telem._ENABLED:
                 _telem.record_serving_completion(
-                    self._name, time.perf_counter() - r.t_enqueue, r.rows)
+                    self._name, now - r.t_enqueue, r.rows)
 
     # -- lifecycle -----------------------------------------------------------
     @property

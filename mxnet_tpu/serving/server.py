@@ -169,6 +169,21 @@ class Server:
                             server.registry.total_param_bytes(),
                     }).encode()
                     self._send(200, body)
+                elif self.path.startswith("/statusz"):
+                    from .. import telemetry as _telem
+                    with server._lock:
+                        queues = {n: b.queue_depth
+                                  for n, b in server._batchers.items()}
+                    body = json.dumps(_telem.statusz(extra={
+                        "serving": {
+                            "models": server.models(),
+                            "total_param_bytes":
+                                server.registry.total_param_bytes(),
+                            "queue_depth": queues,
+                        }}), default=str).encode()
+                    self._send(200, body)
+                elif self.path.startswith("/healthz"):
+                    self._send(200, b'{"status": "ok"}')
                 else:
                     self._send(404, b'{"error": "not found"}')
 
@@ -179,6 +194,7 @@ class Server:
                     self._send(404, b'{"error": "not found"}')
                     return
                 name = path[len("/v1/models/"):-len(":predict")]
+                trace_hdr: Optional[Dict[str, str]] = None
                 try:
                     if _faults._ACTIVE:
                         _faults.check("serving.http")
@@ -189,8 +205,13 @@ class Server:
                     timeout_ms = payload.get("timeout_ms")
                     timeout = 60.0 if timeout_ms is None \
                         else float(timeout_ms) / 1e3
-                    out = server.predict(name, inputs, timeout=timeout,
-                                         priority=priority)
+                    # submit + result (not predict) so the request's trace
+                    # id is in hand for the X-MX-Trace-Id response header
+                    fut = server.submit(name, inputs, priority=priority,
+                                        deadline_ms=float(timeout) * 1e3)
+                    if fut.trace_id is not None:
+                        trace_hdr = {"X-MX-Trace-Id": fut.trace_id}
+                    out = fut.result(timeout)
                     outs = out if isinstance(out, list) else [out]
                     model = server.registry.get(name)
                     body = json.dumps({
@@ -198,19 +219,21 @@ class Server:
                         "output_names": model.output_names,
                         "outputs": [_np.asarray(o).tolist() for o in outs],
                     }).encode()
-                    self._send(200, body)
+                    self._send(200, body, headers=trace_hdr)
                 except (ServerOverloaded, _faults.FaultInjected) as e:
                     # graceful degradation: shed with an explicit retry
                     # hint instead of queueing doomed work
                     self._send(503, json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}).encode(),
-                        headers={"Retry-After": "1"})
+                        headers=dict(trace_hdr or {}, **{"Retry-After": "1"}))
                 except DeadlineExceeded as e:
                     self._send(504, json.dumps(
-                        {"error": f"{type(e).__name__}: {e}"}).encode())
+                        {"error": f"{type(e).__name__}: {e}"}).encode(),
+                        headers=trace_hdr)
                 except Exception as e:
                     self._send(400, json.dumps(
-                        {"error": f"{type(e).__name__}: {e}"}).encode())
+                        {"error": f"{type(e).__name__}: {e}"}).encode(),
+                        headers=trace_hdr)
 
             def log_message(self, *a):
                 pass

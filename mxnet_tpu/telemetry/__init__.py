@@ -56,7 +56,8 @@ __all__ = [
     "record_serving_queue_depth", "record_serving_dispatch",
     "record_serving_completion", "record_fault_injected", "record_io_retry",
     "record_request_shed", "record_feed_producer_leak",
-    "record_feed_producer_restart",
+    "record_feed_producer_restart", "record_serving_queue_wait",
+    "statusz", "tracing",
 ]
 
 env.declare("MXNET_TELEMETRY", False, bool,
@@ -405,6 +406,8 @@ def reset():
         _mem_peak = 0.0
     from . import roofline as _roofline
     _roofline.reset()
+    from . import tracing as _tracing
+    _tracing.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -605,6 +608,10 @@ def record_step(examples: int, source: str = "trainer", steps: int = 1,
               ("source",), buckets=DEFAULT_LATENCY_BUCKETS) \
         .labels(source).observe(seconds / max(steps, 1))
     _trace_tick(steps)
+    if tracing._ENABLED:
+        # feed the anomaly watchdog the per-step seconds this function just
+        # computed — host-side values only, no extra sync or clock read
+        tracing.watch_step_time(seconds / max(steps, 1), source=source)
     if seconds > 0:
         gauge("mx_train_examples_per_second",
               "Training throughput over the last recorded window",
@@ -841,6 +848,19 @@ def record_serving_queue_depth(model: str, depth: int):
     gauge("mx_serving_queue_depth",
           "Requests waiting in the serving queue",
           ("model",)).labels(model).set(int(depth))
+
+
+def record_serving_queue_wait(model: str, seconds: float):
+    """Account one request's queue wait (enqueue -> batch take), the
+    queueing share of mx_serving_request_seconds. Same SLO ladder, and
+    derived from the two timestamps the batcher already stamps (t_enqueue,
+    the take-time perf_counter read) — no new clock reads on the hot path.
+    request_seconds p99 high while queue_wait p99 is low means the device,
+    not admission, is the bottleneck; both high means queueing."""
+    histogram("mx_serving_queue_wait_seconds",
+              "Request queue wait (enqueue to batch take)",
+              ("model",), buckets=DEFAULT_LATENCY_BUCKETS) \
+        .labels(model).observe(float(seconds))
 
 
 def record_serving_dispatch(model: str, bucket: int, rows: int):
@@ -1103,6 +1123,58 @@ def report(reset_profiler: bool = False) -> str:
     return "\n".join(lines)
 
 
+def _family_snapshot(name: str) -> Dict[str, float]:
+    """{joined-label-values: value} for one family (statusz rendering)."""
+    fam = get_metric(name)
+    if fam is None:
+        return {}
+    with _LOCK:
+        series = list(fam._series.items())
+    return {",".join(lv) or "_": getattr(s, "value", getattr(s, "sum", 0.0))
+            for lv, s in series}
+
+
+def statusz(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """The /statusz debug snapshot: config fingerprints (every declared
+    MXNET_* knob whose live value differs from its default), compilation-
+    cache stats, fault-plane arming, queue depth / in-flight gauges,
+    anomaly counts, and the trailing flight-recorder entries. Served by
+    both start_http_server() and serving.Server.start_http(); ``extra``
+    merges caller-side sections (the serving server adds its model list)."""
+    config = {}
+    for name, (default, _typ, _doc) in sorted(env.items().items()):
+        live = env.get(name)
+        if live != default:
+            config[name] = live
+    try:
+        from .. import engine as _engine
+        compilation = {k: v for k, v in _engine.cache_stats().items()
+                       if isinstance(v, (int, float, str))}
+    except Exception:
+        compilation = {}
+    try:
+        from .. import faults as _faults
+        fault_plane = {"active": bool(_faults._ACTIVE),
+                       "armed": _faults.armed()}
+    except Exception:
+        fault_plane = {}
+    d: Dict[str, Any] = {
+        "telemetry_enabled": _ENABLED,
+        "tracing_enabled": tracing._ENABLED,
+        "device_trace_active": trace_active(),
+        "config": config,
+        "compilation": compilation,
+        "faults": fault_plane,
+        "serving_queue_depth": _family_snapshot("mx_serving_queue_depth"),
+        "inflight_steps": _family_snapshot("mx_inflight_steps"),
+        "anomalies": _family_snapshot("mx_anomalies_total"),
+        "recorder_events": tracing.recent(),
+    }
+    if extra:
+        d.update(extra)
+    return d
+
+
 # ---------------------------------------------------------------------------
 # HTTP /metrics endpoint (Prometheus scrape target)
 # ---------------------------------------------------------------------------
@@ -1111,8 +1183,9 @@ _http_server = [None]
 
 
 def start_http_server(port: int = 0, addr: str = "127.0.0.1") -> int:
-    """Serve GET /metrics (Prometheus text) and /metrics.json on a daemon
-    thread; returns the bound port (port=0 picks a free one)."""
+    """Serve GET /metrics (Prometheus text), /metrics.json, /statusz, and
+    /healthz on a daemon thread; returns the bound port (port=0 picks a
+    free one)."""
     import http.server
 
     class Handler(http.server.BaseHTTPRequestHandler):
@@ -1123,6 +1196,12 @@ def start_http_server(port: int = 0, addr: str = "127.0.0.1") -> int:
             elif self.path.startswith("/metrics"):
                 body = scrape().encode()
                 ctype = "text/plain; version=0.0.4"
+            elif self.path.startswith("/statusz"):
+                body = json.dumps(statusz(), default=str).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/healthz"):
+                body = b'{"status": "ok"}'
+                ctype = "application/json"
             else:
                 self.send_response(404)
                 self.end_headers()
@@ -1156,3 +1235,6 @@ def stop_http_server():
 # the per-region roofline ledger (mx.telemetry.roofline.report() / rows();
 # imported last — it only pulls stdlib at module scope)
 from . import roofline  # noqa: E402
+# the span-tracing plane + flight recorder (same stdlib-only constraint;
+# record_step and statusz() above reference it at call time)
+from . import tracing  # noqa: E402

@@ -50,14 +50,18 @@ def test_reductions_and_axis():
     rs = onp.random.RandomState(2)
     x = rs.uniform(-1, 1, (4, 5, 6)).astype(onp.float32)
     a = np.array(x)
+    # atol floors the near-cancellation elements: XLA's f32 reduction
+    # accumulation order differs from numpy's pairwise summation, so a sum
+    # landing near zero can miss a pure-relative 1e-5 while agreeing to
+    # ~1 ulp absolutely.
     onp.testing.assert_allclose(np.sum(a, axis=1).asnumpy(), x.sum(axis=1),
-                                rtol=1e-5)
+                                rtol=1e-5, atol=1e-6)
     onp.testing.assert_allclose(a.mean(axis=(0, 2)).asnumpy(),
-                                x.mean(axis=(0, 2)), rtol=1e-5)
+                                x.mean(axis=(0, 2)), rtol=1e-5, atol=1e-6)
     onp.testing.assert_allclose(np.var(a).asnumpy(), x.var(), rtol=1e-4)
     assert int(np.argmax(a).asnumpy()) == int(x.argmax())
     onp.testing.assert_allclose(np.cumsum(a, axis=0).asnumpy(),
-                                x.cumsum(axis=0), rtol=1e-5)
+                                x.cumsum(axis=0), rtol=1e-5, atol=1e-6)
 
 
 def test_manipulation():

@@ -145,6 +145,33 @@ METHOD_CHECKS = [
      {"record_feed_producer_leak"}, "call"),
     ("engine/async_feed.py", "DeviceFeed", "_produce",
      {"record_feed_producer_restart"}, "call"),
+    # span tracing (ISSUE 14): the cross-layer funnels — serving request
+    # lifecycle, fused-step dispatch, feed produce/put, window admit,
+    # snapshot write, fault firings — must each record into the tracing
+    # ring when armed; a layer that silently drops its spans breaks the
+    # end-to-end trace the flight recorder and Perfetto dump promise
+    ("serving/batcher.py", "ContinuousBatcher", "submit",
+     {"new_root", "event"}, "call"),
+    ("serving/batcher.py", "ContinuousBatcher", "_dispatch_loop",
+     {"record_span"}, "call"),
+    ("serving/batcher.py", "ContinuousBatcher", "_complete",
+     {"record_span"}, "call"),
+    ("parallel/data_parallel.py", "DataParallelTrainer", "step",
+     {"record_span"}, "call"),
+    ("parallel/data_parallel.py", "DataParallelTrainer", "run_steps",
+     {"record_span"}, "call"),
+    ("engine/async_feed.py", "DeviceFeed", "_produce",
+     {"record_span"}, "call"),
+    ("engine/async_feed.py", "DispatchWindow", "admit",
+     {"record_span"}, "call"),
+    ("elastic/snapshot.py", "SnapshotManager", "_write",
+     {"span", "attach"}, "call"),
+    ("faults/__init__.py", None, "check",
+     {"event"}, "call"),
+    ("faults/__init__.py", None, "io_retry",
+     {"record_span"}, "call"),
+    ("telemetry/__init__.py", None, "record_step",
+     {"watch_step_time"}, "call"),
 ]
 
 # (relative file, required substring, rationale)
@@ -246,6 +273,23 @@ TEXT_CHECKS = [
     ("recipes/long_context.py", '"ppermute"',
      "the long-context trainer must book the ring-attention kv rotation "
      "volume (sequence-parallel wire accounting, docs/large_models.md)"),
+    # span tracing + flight recorder + statusz (ISSUE 14)
+    ("telemetry/tracing.py", "mx_anomalies_total",
+     "the anomaly watchdog must book detections on the anomaly counter "
+     "(EWMA step-time regression / nonfinite loss — the page signal)"),
+    ("telemetry/__init__.py", "mx_serving_queue_wait_seconds",
+     "the registry must export the serving queue-wait histogram on the "
+     "shared latency ladder (queue wait vs total separates admission "
+     "pressure from compute)"),
+    ("serving/server.py", "X-MX-Trace-Id",
+     "the HTTP front door must echo the request's trace id so a client "
+     "can join its request to the server-side span timeline"),
+    ("elastic/run.py", "dump_flight_recorder",
+     "the elastic loop must dump the flight recorder on preemption and "
+     "unhandled step exceptions (the black-box postmortem)"),
+    ("telemetry/__init__.py", "def statusz",
+     "the registry must expose the statusz snapshot the debug endpoints "
+     "serve (config fingerprint, cache stats, queue depth, recorder tail)"),
 ]
 
 

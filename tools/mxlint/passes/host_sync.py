@@ -84,6 +84,15 @@ HOT_FUNCTIONS = [
     ("mxnet_tpu/recipes/long_context.py",
      r"LongContextTrainer\.(_build_step_zero|_record_telemetry|"
      r"_ring_step_bytes)\b"),
+    # span tracing record paths (ISSUE 14): spans ride timestamps the
+    # instrumented layers already take — a float()/asarray on a device
+    # value inside the tracer would turn the observer into a serializer.
+    # The watchdog (watch_step_time/check_loss) consumes host floats its
+    # callers already materialized; a sync sneaking in here would charge
+    # every armed step for it.
+    ("mxnet_tpu/telemetry/tracing.py",
+     r"(\b(span|record_span|event|attach|new_root|watch_step_time|"
+     r"check_loss|_append|_anomaly|_resolve_parent)\b|_Span\.__(enter|exit)__)"),
 ]
 
 # host reads of *python* scalars that merely look like syncs. Matched

@@ -55,6 +55,12 @@ LOOP_FUNCTIONS = [
      r"MoETrainer\.(step|_build_step_zero)\b"),
     ("mxnet_tpu/recipes/long_context.py",
      r"LongContextTrainer\._build_step_zero\b"),
+    # span tracing (ISSUE 14): the tracer's record/export paths iterate the
+    # ring inside loops — syncing on a step output in here would serialize
+    # every armed training loop that feeds the watchdog
+    ("mxnet_tpu/telemetry/tracing.py",
+     r"\b(record_span|event|watch_step_time|check_loss|dump_chrome_trace|"
+     r"dump_flight_recorder)\b"),
 ]
 
 # calls whose result is a step output: loss/metric/output handles the loop
