@@ -2105,10 +2105,213 @@ def bench_chaos():
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_multihost():
+    """The multi-host control plane's costs, measured (ISSUE 15):
+
+    1. **Free when idle (the gate).** elastic.run's step path with a
+       coordinator ATTACHED but quiet (heartbeats throttled to a
+       realistic interval, no stop posted) vs coordinator=None, paired
+       interleaved reps, min aggregation (chaos protocol). The hook is
+       one clock read + two flag checks per step; gate: < 1%.
+    2. **Heartbeat cost**: µs per forced membership-lease write (the
+       throttle ceiling — at interval h seconds, a host pays this once
+       per h, not per step).
+    3. **Commit-barrier latency vs N**: N coordinators over one shared
+       directory (threads as hosts — same filesystem protocol, zero
+       process-boot noise), marker write -> global manifest visible.
+    4. **Kill-and-resume wall-clock**: the real multi-process drill —
+       3 spawned hosts, one killed mid-run, survivors coordinate a stop
+       and commit; then a 2-host relaunch resumes the trajectory.
+
+    CPU-container caveats: spawned drill hosts each pay a ~0.5 s
+    mxnet_tpu import on boot and share one core with the survivors, so
+    kill_resume_s is dominated by process boot + lease expiry, not by
+    protocol IO; commit-barrier numbers are tmpfs-backed local fs, a
+    network filesystem multiplies them by its metadata RTT.
+    """
+    import shutil
+    import statistics
+    import tempfile
+    import threading
+
+    from mxnet_tpu import elastic
+    from mxnet_tpu.elastic import drill
+    from mxnet_tpu.elastic import manifest as _manifest
+    from mxnet_tpu.elastic.coordinator import Coordinator
+
+    steps = int(os.environ.get("BENCH_MULTIHOST_STEPS", 300))
+    reps = int(os.environ.get("BENCH_MULTIHOST_REPS", 5))
+    dim, hidden, batch = 96, 192, 64
+    rs = np.random.RandomState(0)
+    batches = [(rs.uniform(-1, 1, (batch, dim)),
+                rs.uniform(-1, 1, (batch, 1))) for _ in range(8)]
+
+    class _Step:
+        """Numpy MLP step sized so one step is ~1 ms of real work — the
+        scale at which a per-step µs hook is honestly gated at 1%."""
+
+        def __init__(self):
+            r = np.random.RandomState(1)
+            self.w1 = r.randn(dim, hidden) * 0.3
+            self.b1 = np.zeros(hidden)
+            self.w2 = r.randn(hidden, 1) * 0.3
+            self.b2 = np.zeros(1)
+            self._t = 0
+
+        def step(self, x, y):
+            h = np.tanh(x @ self.w1 + self.b1)
+            p = h @ self.w2 + self.b2
+            e = p - y
+            g = 2.0 * e / e.size
+            gw2 = h.T @ g
+            gh = (g @ self.w2.T) * (1.0 - h * h)
+            self.w2 -= 0.05 * gw2
+            self.b2 -= 0.05 * g.sum(0)
+            self.w1 -= 0.05 * (x.T @ gh)
+            self.b1 -= 0.05 * gh.sum(0)
+            self._t += 1
+            return float((e * e).mean())
+
+        def drain(self):
+            pass
+
+    class _Feed:
+        def __iter__(self):
+            return iter(batches)
+
+        def reset(self):
+            pass
+
+    root = tempfile.mkdtemp(prefix="mx-bench-multihost-")
+    try:
+        def run_once(coord, tag):
+            tr = _Step()
+            mgr = elastic.SnapshotManager(os.path.join(root, tag),
+                                          coordinator=coord)
+            mgr._last_saved = steps       # step-path A/B: no snapshot IO
+            out = elastic.run(tr, _Feed(), steps, manager=mgr,
+                              coordinator=coord)
+            assert out["step"] == steps and not out["preempted"]
+
+        coord = Coordinator(os.path.join(root, "ab"), 0,
+                            lease_timeout=30.0, heartbeat_interval=5.0)
+        coord.join()
+        run_once(None, "warm-off")        # warm numpy + fs paths
+        run_once(coord, "warm-on")
+        dt_off = dt_on = float("inf")
+        for _ in range(reps):             # paired interleaved, min-of-reps
+            t0 = time.perf_counter()
+            run_once(None, "off")
+            dt_off = min(dt_off, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            run_once(coord, "on")
+            dt_on = min(dt_on, time.perf_counter() - t0)
+        overhead = dt_on / dt_off - 1.0
+
+        # heartbeat: µs per FORCED lease write (the throttle ceiling)
+        n = 300
+        t0 = time.perf_counter()
+        for i in range(n):
+            coord.heartbeat(i, force=True)
+        hb_us = (time.perf_counter() - t0) / n * 1e6
+        coord.leave()
+        coord.close()
+
+        # commit-barrier latency vs N (threads as hosts, shared dir)
+        def barrier_once(world, tag):
+            broot = os.path.join(root, tag)
+            coords = [Coordinator(broot, r, lease_timeout=30.0,
+                                  straggler_timeout=30.0,
+                                  poll_interval=0.002)
+                      for r in range(world)]
+            for c in coords:
+                c.join()
+            for c in coords:
+                c.view()
+            sdir = _manifest.step_path(broot, 1)
+            arr = rs.uniform(-1, 1, (32, 32)).astype(np.float32)
+            for r in range(world):
+                _manifest.write_shard(
+                    sdir, r, [(f"w{r}", [(0, 32), (0, 32)], arr,
+                               arr.shape, arr.dtype)])
+            t0 = time.perf_counter()
+
+            def host(c):
+                c.write_marker(sdir, 1, nbytes=arr.nbytes)
+                c.commit_snapshot(sdir, 1, {"step": 1})
+
+            ts = [threading.Thread(target=host, args=(c,)) for c in coords]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            dt = time.perf_counter() - t0
+            for c in coords:
+                c.leave()
+                c.close()
+            return dt * 1e3
+
+        barrier_ms = {}
+        for world in (2, 3, 4):
+            barrier_ms[str(world)] = round(statistics.median(
+                barrier_once(world, f"bar{world}-{i}")
+                for i in range(3)), 2)
+
+        # kill-and-resume wall-clock: the REAL multi-process drill
+        droot = os.path.join(root, "drill")
+        t0 = time.perf_counter()
+        res = drill.run_drill(droot, world=3, num_steps=120,
+                              save_every=20, report_tag="bench",
+                              scenario={2: {"die_at_step": 5}},
+                              lease_timeout=1.0, straggler_timeout=8.0,
+                              step_sleep=0.02, timeout=90.0)
+        drill_s = time.perf_counter() - t0
+        assert res["exitcodes"][0] == 0 and res["exitcodes"][1] == 0, \
+            res["exitcodes"]
+        s = res["reports"][0]["final_step"]
+        t0 = time.perf_counter()
+        res2 = drill.run_drill(droot, world=2, num_steps=s + 10,
+                               save_every=1000, report_tag="bench2",
+                               lease_timeout=2.0, straggler_timeout=10.0,
+                               timeout=60.0)
+        resume_s = time.perf_counter() - t0
+        assert res2["exitcodes"] == [0, 0], res2["exitcodes"]
+
+        return {
+            "metric": "multihost_step_path_overhead",
+            "value": round(overhead * 100, 2),
+            "unit": "% elastic.run step path, coordinator attached-quiet "
+                    "vs none",
+            "vs_baseline": round(dt_on / dt_off, 4),
+            "extra": {
+                "pass_lt_1pct": overhead < 0.01,
+                "steps": steps,
+                "reps": reps,
+                "step_ms_baseline": round(dt_off / steps * 1e3, 4),
+                "heartbeat_us_per_forced_beat": round(hb_us, 1),
+                "commit_barrier_ms_vs_world": barrier_ms,
+                "kill_and_resume_s": {
+                    "drill_3hosts_kill1": round(drill_s, 2),
+                    "resume_2hosts": round(resume_s, 2),
+                    "survivor_final_step": s,
+                },
+                "host_cores": os.cpu_count(),
+            },
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main():
     if os.environ.get("BENCH_SCENARIO") == "lint_walltime":
         # no backend init needed (and none wanted: this must run anywhere)
         print(json.dumps(bench_lint_walltime()))
+        return
+    if os.environ.get("BENCH_SCENARIO") == "multihost":
+        # host-only: coordinator IO, the numpy toy step, and the spawned
+        # drill hosts (which never import jax) all land on CPU
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps(bench_multihost()))
         return
     if os.environ.get("BENCH_SCENARIO") == "chaos":
         # host-only: manifest IO, queue policy, and the DeviceFeed lane's

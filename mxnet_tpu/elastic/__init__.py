@@ -1,26 +1,33 @@
 """mxnet_tpu.elastic — fault-tolerant training (ROADMAP item 4).
 
 Async sharded snapshots (no gather, no host sync on the step path),
-resharding restore onto a different mesh, resumable input feeds, and
-SIGTERM-clean preemption — the TPU-native answer to the reference
-framework's ps-lite "checkpoint + relaunch" fault model.
+resharding restore onto a different mesh, resumable input feeds,
+SIGTERM-clean preemption, and a shared-filesystem multi-host control
+plane — the TPU-native answer to the reference framework's ps-lite
+"checkpoint + relaunch" fault model.
 
-    manifest.py   on-disk layout + atomic manifest commit + chunk reader
-    snapshot.py   SnapshotManager: async copy-then-write off the step path
-    state.py      trainer capture/install incl. ZeRO re-canonicalization
-    run.py        resume_or_init / PreemptionGuard / supervised run loop
+    manifest.py     on-disk layout + atomic manifest commit + chunk reader
+    snapshot.py     SnapshotManager: async copy-then-write off the step path
+    state.py        trainer capture/install incl. ZeRO re-canonicalization
+    run.py          resume_or_init / PreemptionGuard / supervised run loop
+    coordinator.py  heartbeat membership, coordinated stop, two-phase
+                    cross-host commit, hang watchdog
+    drill.py        real multi-process kill/race/straggler drill harness
 
 See docs/checkpointing.md for anatomy, cadence tuning, resharding rules,
-and the preemption runbook.
+the preemption runbook, and the multi-host snapshot protocol.
 """
 from .manifest import SnapshotReader, all_complete_steps, latest_complete_step
 from .snapshot import SnapshotManager
 from .state import capture, install
 from .run import (PreemptionGuard, capture_trainer, resume_or_init, run,
                   save_trainer)
+from .coordinator import (Coordinator, GroupView, HangWatchdog,
+                          StragglerTimeout)
 
 __all__ = [
     "SnapshotManager", "SnapshotReader", "all_complete_steps",
     "latest_complete_step", "capture", "install", "capture_trainer",
     "save_trainer", "resume_or_init", "PreemptionGuard", "run",
+    "Coordinator", "GroupView", "HangWatchdog", "StragglerTimeout",
 ]

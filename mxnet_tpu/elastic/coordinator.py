@@ -1,0 +1,829 @@
+"""Shared-filesystem multi-host control plane (mxnet_tpu.elastic).
+
+The reference framework's cross-node story is a ps-lite scheduler plus
+worker/server processes; the TPU-native cluster has no scheduler — every
+host is an equal jax.distributed process. What replaces the scheduler is
+this coordinator: a small amount of fenced state on the snapshot
+filesystem (the one piece of infrastructure an elastic fleet always
+shares) that gives N hosts membership, leader election, a coordinated
+stop, and a two-phase cross-host snapshot commit — all of it pure
+host-side file IO, so the control plane runs identically on a pod and on
+a CPU-only CI container (tests/test_multihost_drill.py drives it with
+real OS processes).
+
+Layout, under ``<root>/coord/``:
+
+    members/host-<rank>.json   heartbeat: {rank, pid, generation, fence,
+                               step, ts} — rewritten atomically every
+                               ``heartbeat_interval``; a record whose
+                               ``ts`` is older than ``lease_timeout`` is
+                               a DEAD host (lease expiry, the PR 13 rule)
+    generation.json            the group epoch: {generation, live}. Any
+                               observed membership change (join, leave,
+                               lease expiry) bumps ``generation`` under
+                               the ``generation.lock`` fencing lease, so
+                               the number is monotonic and every host at
+                               the same generation agrees on ``live``
+    stop.json                  coordinated-stop intent (O_EXCL create:
+                               the first poster wins)
+    stop-ack-<rank>.json       phase-1 quiesce acks; the final stop step
+                               S = max over live members' ack steps
+
+and per snapshot step dir (next to the shard files):
+
+    ready-<rank>.json          two-phase commit marker: {rank, step,
+                               generation, chunk_index, fence, live}
+
+Two-phase commit: every host writes ONLY its owned chunks plus its ready
+marker; the elected leader (lowest live rank, fenced by manifest.py's
+commit lease) assembles the global manifest only once every member of
+the marker-stamped live set has posted a marker for the same (step,
+generation). A straggler deadline aborts the snapshot cleanly — booked
+on ``mx_snapshot_failures_total{source="straggler"}`` — rather than
+committing a hole; the step dir stays manifest-less (invisible to
+restore) and retention sweeps it once its markers go stale.
+
+All coordinator IO threads through ``faults.io_retry`` with three
+injection points (``elastic.heartbeat`` / ``elastic.barrier`` /
+``elastic.marker``), so the chaos suite can replay dead-peer detection,
+rejoin, commit races and straggler aborts deterministically
+(docs/reliability.md).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import weakref
+import zlib
+from typing import Any, Callable, Dict, List, Optional
+
+from ..base import MXNetError, env
+from .. import faults as _faults
+from .. import telemetry as _telem
+from ..telemetry import tracing as _tracing
+from . import manifest as _manifest
+
+__all__ = ["Coordinator", "GroupView", "StragglerTimeout", "HangWatchdog",
+           "statusz_view"]
+
+COORD_DIR = "coord"
+MEMBERS_DIR = "members"
+GENERATION = "generation.json"
+GEN_LOCK = "generation.lock"
+STOP = "stop.json"
+READY_PREFIX = "ready-"
+
+env.declare("MXNET_TPU_COORD_LEASE", 10.0, float,
+            "Coordinator membership lease in seconds: a host whose "
+            "heartbeat record is older than this is declared dead (its "
+            "departure bumps the group generation)")
+env.declare("MXNET_TPU_COORD_STRAGGLER", 60.0, float,
+            "Cross-host snapshot commit deadline in seconds: a live "
+            "member whose ready marker does not land within this aborts "
+            "the snapshot cleanly (mx_snapshot_failures_total"
+            "{source=straggler}) instead of committing a hole")
+
+
+class StragglerTimeout(MXNetError):
+    """A cross-host snapshot commit was aborted: a member of the
+    generation's live set never posted its ready marker (or posted one
+    from a different generation) within the straggler deadline. The step
+    directory has no manifest — restore never sees a hole."""
+
+
+def _host_name(rank: int) -> str:
+    return f"host-{int(rank):05d}.json"
+
+
+def _ready_name(rank: int) -> str:
+    return f"{READY_PREFIX}{int(rank):05d}.json"
+
+
+def _ack_name(rank: int) -> str:
+    return f"stop-ack-{int(rank):05d}.json"
+
+
+def _write_json_atomic(path: str, payload: Dict[str, Any]):
+    # tmp name is per-thread: the run loop and the background snapshot
+    # writer both heartbeat; a shared tmp path would let one truncate
+    # the other's half-written record before its rename
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Dict[str, Any]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+class GroupView:
+    """One generation-stamped observation of the group: who is live (a
+    fresh heartbeat lease), who is dead (lease expired), and the leader
+    (lowest live rank). Plain data — safe to ship to /statusz."""
+
+    def __init__(self, generation: int, members: Dict[int, Dict[str, Any]],
+                 live: List[int], dead: List[int]):
+        self.generation = int(generation)
+        self.members = members
+        self.live = sorted(int(r) for r in live)
+        self.dead = sorted(int(r) for r in dead)
+
+    @property
+    def leader(self) -> Optional[int]:
+        return self.live[0] if self.live else None
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"generation": self.generation, "live": self.live,
+                "dead": self.dead, "leader": self.leader,
+                "steps": {str(r): m.get("step")
+                          for r, m in sorted(self.members.items())}}
+
+
+class HangWatchdog:
+    """Wall-clock deadline on a blocking section (DispatchWindow drain,
+    a commit barrier, heartbeat IO that stopped completing). Rides the
+    anomaly plane: on expiry it books ``mx_hang_watchdog_fires_total``,
+    dumps the flight recorder (when tracing is armed) and — in its
+    default ``action="exit"`` mode — ends the process with a one-line
+    diagnosis instead of hanging the fleet forever. ``action="flag"``
+    (tests, advisory use) only sets ``fired``."""
+
+    def __init__(self, timeout: float, what: str = "drain",
+                 action: str = "exit", on_fire: Optional[Callable] = None):
+        self.timeout = float(timeout)
+        self.what = str(what)
+        self.action = action
+        self.on_fire = on_fire
+        self.fired = False
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _watch(self):
+        if not self._done.wait(self.timeout):
+            self._fire()
+
+    def _fire(self):
+        self.fired = True
+        diagnosis = (f"mx_hang_watchdog: {self.what!r} exceeded its "
+                     f"{self.timeout:.1f}s wall-clock deadline — dumping "
+                     "the flight recorder and exiting rather than hanging "
+                     "the fleet")
+        if _telem._ENABLED:
+            _telem.record_hang_watchdog(self.what)
+        if _tracing._ENABLED:
+            _tracing.event("mx.hang_watchdog", what=self.what,
+                           timeout=self.timeout)
+            try:
+                _tracing.dump_flight_recorder(reason=f"hang:{self.what}")
+            except Exception:  # the dump must never mask the diagnosis  # mxlint: disable=broad-except
+                pass
+        print(diagnosis, file=sys.stderr, flush=True)
+        if self.on_fire is not None:
+            self.on_fire(self.what)
+        if self.action == "exit":
+            os._exit(86)
+
+    def __enter__(self):
+        self._done.clear()
+        self.fired = False
+        self._thread = threading.Thread(
+            target=self._watch, daemon=True,
+            name=f"mx-hang-watchdog-{self.what}")
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._done.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+            self._thread = None
+        return False
+
+
+class _NullWatchdog:
+    fired = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# live coordinators for /statusz (weakrefs: the debug plane must never
+# keep a finished job's coordinator alive)
+_REGISTRY: "weakref.WeakValueDictionary[int, Coordinator]" = \
+    weakref.WeakValueDictionary()
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY_SEQ = [0]
+
+
+def statusz_view() -> Dict[str, Any]:
+    """Group view of the most recently constructed live coordinator
+    (telemetry.statusz() merges this under ``"coordinator"``). Read-only:
+    never bumps the generation."""
+    with _REGISTRY_LOCK:
+        items = sorted(_REGISTRY.items())
+    if not items:
+        return {}
+    coord = items[-1][1]
+    view = coord.view(bump=False)
+    d = view.as_dict()
+    d["rank"] = coord.rank
+    d["fence"] = coord.fence
+    return d
+
+
+class Coordinator:
+    """One host's handle on the shared-filesystem control plane.
+
+    ``rank`` is this host's stable worker index (tools/launch.py's
+    MXNET_TPU_RANK). ``lease_timeout`` is the membership lease;
+    ``heartbeat_interval`` throttles heartbeat/stop-poll IO on the step
+    path (0 = every call). ``partition_ownership=True`` makes this host
+    write only the snapshot leaves it owns under the generation's live
+    set (the drill's replicated-model mode; SPMD meshes already shard
+    ownership by ``replica_id == 0`` and keep it False).
+    """
+
+    def __init__(self, root: str, rank: int, *,
+                 lease_timeout: Optional[float] = None,
+                 heartbeat_interval: float = 0.0,
+                 straggler_timeout: Optional[float] = None,
+                 watchdog_timeout: Optional[float] = None,
+                 partition_ownership: bool = False,
+                 poll_interval: float = 0.02,
+                 clock: Callable[[], float] = time.time):
+        self.root = os.path.abspath(root)
+        self.rank = int(rank)
+        self.lease_timeout = float(env.get("MXNET_TPU_COORD_LEASE")
+                                   if lease_timeout is None else lease_timeout)
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.straggler_timeout = float(
+            env.get("MXNET_TPU_COORD_STRAGGLER")
+            if straggler_timeout is None else straggler_timeout)
+        self.watchdog_timeout = watchdog_timeout
+        self.partition_ownership = bool(partition_ownership)
+        self.poll_interval = float(poll_interval)
+        self._clock = clock
+        self.generation = 0
+        self.fence = 0           # generation at (re)join: monotonic per root
+        self._joined = False
+        self._last_beat = float("-inf")   # throttle (clock domain)
+        self._last_beat_ok: Optional[float] = None  # staleness (monotonic)
+        self._stop_seen: Optional[Dict[str, Any]] = None
+        self._dead_seen: set = set()
+        self._live_seen: set = set()
+        # test/drill hooks (documented in drill.py): crash simulation
+        self.debug_exit_after_marker: Optional[int] = None
+        self.debug_marker_delay: Optional[tuple] = None  # (step, seconds)
+        self.debug_force_leader = False
+        self._cdir = os.path.join(self.root, COORD_DIR)
+        self._mdir = os.path.join(self._cdir, MEMBERS_DIR)
+        os.makedirs(self._mdir, exist_ok=True)
+        with _REGISTRY_LOCK:
+            _REGISTRY_SEQ[0] += 1
+            _REGISTRY[_REGISTRY_SEQ[0]] = self
+
+    # -- generation epoch (fenced read-modify-write) -------------------------
+
+    def _gen_record(self) -> Dict[str, Any]:
+        rec = _read_json(os.path.join(self._cdir, GENERATION))
+        return {"generation": int(rec.get("generation", 0)),
+                "live": [int(r) for r in rec.get("live", [])]}
+
+    def _update_generation(self, mutate) -> Dict[str, Any]:
+        """Fenced generation.json update: ``mutate(cur)`` returns the new
+        record (or None to leave it unchanged). Serialized through the
+        GEN_LOCK lease (the PR 13 fence machinery) so concurrent bumps
+        from racing observers coalesce instead of interleaving."""
+        owner = f"{self.rank}.{os.getpid()}.{threading.get_ident()}"
+
+        def _locked_update():
+            token = _manifest._acquire_lease(
+                self._cdir, owner, self.lease_timeout,
+                lease_name=GEN_LOCK)
+            try:
+                cur = self._gen_record()
+                new = mutate(cur)
+                if new is None:
+                    return cur
+                new["generation"] = max(int(new["generation"]),
+                                        cur["generation"])
+                new["ts"] = self._clock()
+                new["fence"] = int(token)
+                _write_json_atomic(os.path.join(self._cdir, GENERATION), new)
+                return new
+            finally:
+                _manifest._release_lease(self._cdir, owner,
+                                         lease_name=GEN_LOCK)
+
+        deadline = time.monotonic() + max(2.0, 2 * self.lease_timeout)
+        while True:
+            try:
+                return _faults.io_retry("elastic.barrier", _locked_update)
+            except MXNetError:
+                # lost the lock race (a fresh lease held by a peer): the
+                # peer's update is as good as ours — re-read and retry the
+                # mutation against the newer record until the deadline
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(self.poll_interval)
+
+    # -- membership ----------------------------------------------------------
+
+    def join(self) -> int:
+        """Register this host: bump the group generation (fenced), record
+        the bumped value as this incarnation's fence token, and write the
+        first heartbeat. Rejoining after being declared dead bumps the
+        generation again — a monotonically higher fence every time."""
+        def _mutate(cur):
+            live = sorted(set(cur["live"]) | {self.rank})
+            return {"generation": cur["generation"] + 1, "live": live}
+
+        rec = self._update_generation(_mutate)
+        self.generation = rec["generation"]
+        self.fence = rec["generation"]
+        self._joined = True
+        self._sweep_expired_members()
+        self.heartbeat(step=None, force=True)
+        return self.generation
+
+    def _sweep_expired_members(self):
+        """Garbage-collect heartbeat files whose lease already expired —
+        debris from a previous incarnation of the job. Safe to race with
+        a merely-slow host: its next heartbeat rewrites the file (and
+        rejoins if peers evicted it in the meantime)."""
+        now = self._clock()
+        try:
+            names = os.listdir(self._mdir)
+        except OSError:
+            return
+        for name in names:
+            if not name.startswith("host-") or not name.endswith(".json"):
+                continue
+            path = os.path.join(self._mdir, name)
+            rec = _read_json(path)
+            if rec and now - float(rec.get("ts", 0.0)) > self.lease_timeout:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    def leave(self):
+        """Clean shutdown: drop the heartbeat record and this rank from
+        the live set (peers otherwise wait a full lease for the expiry)."""
+        if not self._joined:
+            return
+        self._joined = False
+        try:
+            os.unlink(os.path.join(self._mdir, _host_name(self.rank)))
+        except OSError:
+            pass
+
+        def _mutate(cur):
+            if self.rank not in cur["live"]:
+                return None
+            live = [r for r in cur["live"] if r != self.rank]
+            return {"generation": cur["generation"] + 1, "live": live}
+
+        try:
+            self._update_generation(_mutate)
+        except MXNetError:
+            pass            # best effort: lease expiry covers a lost leave
+
+    def heartbeat(self, step: Optional[int] = None,
+                  force: bool = False) -> bool:
+        """Refresh this host's membership lease (throttled to
+        ``heartbeat_interval``). A failed write after retries does NOT
+        raise — the host keeps training while peers see a stale lease —
+        but it is returned as False and ages ``heartbeat_staleness()``.
+        Detects being declared dead (this rank missing from the epoch's
+        live set) and rejoins with a bumped generation."""
+        now = self._clock()
+        if not force and now - self._last_beat < self.heartbeat_interval:
+            return True
+        self._last_beat = now
+        payload = {"rank": self.rank, "pid": os.getpid(),
+                   "generation": self.generation, "fence": self.fence,
+                   "step": None if step is None else int(step), "ts": now}
+        path = os.path.join(self._mdir, _host_name(self.rank))
+        try:
+            _faults.io_retry("elastic.heartbeat", _write_json_atomic,
+                             path, payload)
+        except (OSError, MXNetError):
+            return False
+        self._last_beat_ok = time.monotonic()
+        rec = self._gen_record()
+        if self._joined and rec["generation"] > 0 \
+                and self.rank not in rec["live"]:
+            # peers expired our lease while heartbeats were failing:
+            # rejoin under a NEW (higher) generation + fence
+            self.join()
+        return True
+
+    def heartbeat_staleness(self) -> float:
+        """Seconds since this host's last SUCCESSFUL heartbeat write
+        (the self-side hang signal the watchdog reads)."""
+        if self._last_beat_ok is None:
+            return float("inf")
+        return time.monotonic() - self._last_beat_ok
+
+    def view(self, bump: bool = True) -> GroupView:
+        """Read every member record and classify live/dead by lease
+        expiry. When the observed live set differs from the epoch record
+        and ``bump`` is True, the generation is bumped (fenced) — dead-
+        peer detection and late joins both advance the epoch exactly
+        once no matter how many hosts observe them."""
+        now = self._clock()
+        members: Dict[int, Dict[str, Any]] = {}
+        try:
+            names = os.listdir(self._mdir)
+        except OSError:
+            names = []
+        for name in names:
+            if not name.startswith("host-") or not name.endswith(".json"):
+                continue
+            rec = _read_json(os.path.join(self._mdir, name))
+            if "rank" in rec:
+                members[int(rec["rank"])] = rec
+        live = [r for r, m in members.items()
+                if now - float(m.get("ts", 0.0)) <= self.lease_timeout]
+        dead = [r for r in members if r not in live]
+        rec = self._gen_record()
+        generation = rec["generation"]
+        if bump and sorted(live) != sorted(rec["live"]):
+            def _mutate(cur):
+                if sorted(cur["live"]) == sorted(live):
+                    return None          # a peer already recorded it
+                return {"generation": cur["generation"] + 1,
+                        "live": sorted(live)}
+
+            generation = self._update_generation(_mutate)["generation"]
+        if self._joined and self.rank in live:
+            self.generation = generation
+        self._live_seen.update(live)
+        v = GroupView(generation, members, live, dead)
+        if _telem._ENABLED:
+            _telem.record_hosts_live(len(v.live), generation)
+        return v
+
+    def is_leader(self) -> bool:
+        return self.view(bump=False).leader == self.rank
+
+    # -- leaf ownership (drill / replicated-model partition) -----------------
+
+    def owns(self, name: str) -> bool:
+        """Deterministic leaf-ownership partition over the CURRENT
+        epoch's live set: every host at the same generation computes the
+        same owner for every leaf, so chunks never overlap and never
+        leave a hole. Mesh-sharded leaves don't need this (replica_id 0
+        already partitions them); it exists for replicated/host leaves
+        when ``partition_ownership`` is on."""
+        rec = self._gen_record()
+        live = sorted(rec["live"]) or [self.rank]
+        owner = live[zlib.crc32(name.encode()) % len(live)]
+        return owner == self.rank
+
+    # -- coordinated stop ----------------------------------------------------
+
+    def _stop_stale(self, rec: Dict[str, Any]) -> bool:
+        """A stop intent from a PREVIOUS incarnation of the job (its
+        generation predates this host's join fence) is history, not an
+        instruction — every restart bumps the generation at join, so a
+        leftover stop.json can never re-stop the relaunched fleet."""
+        return int(rec.get("generation", 0)) < self.fence
+
+    def post_stop(self, step: int, reason: str = "preempted") \
+            -> Dict[str, Any]:
+        """Post the stop intent (first poster wins; every later post
+        returns the existing intent). Peers observe it at their next step
+        boundary and everyone converges on one final step S. The intent
+        carries a generation-scoped ``id`` that acks reference, so a
+        resolved stop from an earlier incarnation can never be confused
+        with the current one."""
+        path = os.path.join(self._cdir, STOP)
+        payload = {"step": int(step), "rank": self.rank,
+                   "generation": self.generation, "reason": str(reason),
+                   "id": f"g{self.generation}.r{self.rank}",
+                   "ts": self._clock()}
+
+        def _post():
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                cur = _read_json(path)
+                if cur and not self._stop_stale(cur):
+                    return cur
+                # a stale intent from a finished incarnation: replace it
+                _write_json_atomic(path, payload)
+                return payload
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            return payload
+
+        out = _faults.io_retry("elastic.barrier", _post)
+        self._stop_seen = out
+        if _tracing._ENABLED:
+            _tracing.event("mx.coord.stop", step=int(out.get("step", step)),
+                           rank=int(out.get("rank", self.rank)),
+                           reason=str(out.get("reason", reason)))
+        return out
+
+    def stop_posted(self) -> Optional[Dict[str, Any]]:
+        if self._stop_seen is not None:
+            return self._stop_seen
+        rec = _read_json(os.path.join(self._cdir, STOP))
+        if rec and not self._stop_stale(rec):
+            self._stop_seen = rec
+        return self._stop_seen
+
+    def step_poll(self, step: int) -> Optional[Dict[str, Any]]:
+        """The per-step-boundary coordinator hook ``elastic.run`` calls:
+        refresh the heartbeat, observe a posted stop intent, and detect
+        dead peers (a newly expired lease posts a ``peer_dead`` stop so
+        the survivors converge on a final snapshot). All IO is throttled
+        by ``heartbeat_interval``; returns the stop intent or None."""
+        throttled = (self._clock() - self._last_beat
+                     < self.heartbeat_interval)
+        self.heartbeat(step)
+        stop = self._stop_seen
+        if stop is None and not throttled:
+            stop = self.stop_posted()
+        if stop is None and not throttled:
+            v = self.view()
+            # only a peer THIS incarnation saw live can die on it: a
+            # stale heartbeat file left behind by a previous (finished)
+            # job must not stop the relaunched fleet at its first step
+            newly_dead = [r for r in v.dead if r in self._live_seen
+                          and r not in self._dead_seen]
+            if newly_dead:
+                self._dead_seen.update(newly_dead)
+                stop = self.post_stop(step, reason="peer_dead")
+        return stop
+
+    def resolve_stop(self, step: int, timeout: Optional[float] = None) -> int:
+        """Phase-1 quiesce: post this host's ack at its current step,
+        then wait until every LIVE member has acked (dead peers are
+        excluded as their leases expire). Returns the agreed final step
+        ``S = max(live acks, stop intent step)`` — callers with
+        ``step < S`` run exactly ``S - step`` more steps before the
+        final snapshot, so every survivor snapshots the same S."""
+        deadline = time.monotonic() + (self.straggler_timeout
+                                       if timeout is None else float(timeout))
+        stop = self.stop_posted() or {}
+        stop_id = stop.get("id")
+        ack_path = os.path.join(self._cdir, _ack_name(self.rank))
+        _faults.io_retry(
+            "elastic.barrier", _write_json_atomic, ack_path,
+            {"rank": self.rank, "step": int(step), "stop_id": stop_id,
+             "generation": self.generation, "ts": self._clock()})
+        while True:
+            self.heartbeat(step)
+            v = self.view()
+            acks = {}
+            for r in v.live:
+                rec = _read_json(os.path.join(self._cdir, _ack_name(r)))
+                # acks reference the stop intent they answer: a leftover
+                # ack from a PREVIOUS incarnation's stop must not satisfy
+                # this barrier
+                if rec and rec.get("stop_id") == stop_id:
+                    acks[r] = int(rec.get("step", 0))
+            if v.live and all(r in acks for r in v.live):
+                s = max(list(acks.values()) + [int(stop.get("step", 0))])
+                if _tracing._ENABLED:
+                    _tracing.event("mx.coord.stop_resolved", step=s,
+                                   generation=v.generation)
+                return s
+            if time.monotonic() >= deadline:
+                missing = [r for r in v.live if r not in acks]
+                raise MXNetError(
+                    f"coordinated stop did not resolve: live members "
+                    f"{missing} never acked within the deadline")
+            self._check_self_stale()
+            time.sleep(self.poll_interval)
+
+    # -- two-phase cross-host snapshot commit --------------------------------
+
+    def write_marker(self, sdir: str, step: int, nbytes: int) -> int:
+        """Phase 1 of the commit: after writing its owned chunks, every
+        host posts ``ready-<rank>.json`` stamped with the (step,
+        generation) it wrote under, its fence, and the live set the
+        ownership partition was computed from. Returns the generation.
+
+        A step that already HAS a manifest is history: re-entering the
+        commit path for it (e.g. a relaunched job whose final step
+        coincides with the committed one) must not clobber the markers
+        the manifest was validated against."""
+        if os.path.exists(os.path.join(sdir, _manifest.MANIFEST)):
+            return self.generation
+        v = self.view()
+        if self.debug_marker_delay is not None \
+                and int(self.debug_marker_delay[0]) == int(step):
+            time.sleep(float(self.debug_marker_delay[1]))
+        payload = {"rank": self.rank, "step": int(step),
+                   "generation": v.generation, "fence": self.fence,
+                   "chunk_index": int(self.rank),
+                   "file": f"shard-{self.rank:05d}.npz",
+                   "nbytes": int(nbytes), "live": v.live,
+                   "ts": self._clock()}
+        _faults.io_retry("elastic.marker", _write_json_atomic,
+                         os.path.join(sdir, _ready_name(self.rank)), payload)
+        if self.debug_exit_after_marker is not None \
+                and int(self.debug_exit_after_marker) == int(step):
+            # crash simulation (kill-leader-mid-commit drill): leave a
+            # fresh commit lease behind, exactly like a holder that died
+            # between taking the lease and the manifest rename
+            _manifest._write_lease_to(
+                os.path.join(sdir, _manifest.LEASE + ".crash.tmp"),
+                f"crashed-{self.rank}", 1)
+            os.replace(os.path.join(sdir, _manifest.LEASE + ".crash.tmp"),
+                       os.path.join(sdir, _manifest.LEASE))
+            os._exit(40 + self.rank)
+        return v.generation
+
+    def _markers(self, sdir: str) -> Dict[int, Dict[str, Any]]:
+        out = {}
+        try:
+            names = os.listdir(sdir)
+        except OSError:
+            return out
+        for name in names:
+            if name.startswith(READY_PREFIX) and name.endswith(".json"):
+                rec = _read_json(os.path.join(sdir, name))
+                if "rank" in rec:
+                    out[int(rec["rank"])] = rec
+        return out
+
+    def commit_snapshot(self, sdir: str, step: int, meta: Dict[str, Any],
+                        timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Phase 2: converge on exactly one generation-stamped global
+        manifest. Every host calls this after ``write_marker``; whoever
+        the CURRENT view says is leader assembles the manifest once all
+        required markers for (step, generation) exist — so if the leader
+        dies mid-commit the next-lowest live rank takes over, fenced by
+        the manifest commit lease (a stale lease is taken over with an
+        incremented token; the dead leader's manifest can never land).
+        Aborts via :class:`StragglerTimeout` when a required marker is
+        still missing (or stamped with a foreign generation) at the
+        deadline."""
+        t0 = time.perf_counter()
+        deadline = t0 + (self.straggler_timeout if timeout is None
+                         else float(timeout))
+        my_gen = None
+        while True:
+            self.heartbeat(step)
+            if os.path.exists(os.path.join(sdir, _manifest.MANIFEST)):
+                man = _manifest.load(self.root, int(step))
+                self.validate_manifest(man, int(step))
+                seconds = time.perf_counter() - t0
+                if _telem._ENABLED:
+                    _telem.record_commit_barrier(seconds)
+                if _tracing._ENABLED:
+                    _tracing.record_span("mx.coord.commit_barrier", t0,
+                                         time.perf_counter(), step=int(step),
+                                         generation=man["meta"].get(
+                                             "generation"))
+                return man
+            markers = self._markers(sdir)
+            mine = markers.get(self.rank)
+            if my_gen is None and mine is not None:
+                my_gen = int(mine.get("generation", self.generation))
+            v = self.view()
+            if mine is not None and (v.leader == self.rank
+                                     or self.debug_force_leader):
+                required = [int(r) for r in mine.get("live", v.live)]
+                have = {r: m for r, m in markers.items() if r in required}
+                gens = {int(m.get("generation", -1)) for m in have.values()}
+                if len(have) == len(required) and gens == {my_gen}:
+                    meta2 = dict(meta)
+                    meta2["generation"] = my_gen
+                    meta2["members"] = sorted(required)
+                    try:
+                        man = _manifest.commit(
+                            sdir, int(step), meta2,
+                            expected_processes=len(required),
+                            lease_timeout=self.lease_timeout,
+                            ranks=required)
+                    except MXNetError:
+                        # lost the commit race (another fenced committer —
+                        # a second leader, or a stale-lease holder not yet
+                        # expired): the manifest check at the top of the
+                        # loop picks up the winner's commit
+                        time.sleep(self.poll_interval)
+                        continue
+                    continue        # return via the manifest-exists path
+                if gens - {my_gen} and len(have) == len(required):
+                    self._abort_straggler(
+                        sdir, step,
+                        f"markers span generations {sorted(gens)} "
+                        f"(ours {my_gen})")
+            if time.perf_counter() >= deadline:
+                missing = []
+                if mine is not None:
+                    required = [int(r) for r in mine.get("live", [])]
+                    missing = [r for r in required if r not in markers]
+                self._abort_straggler(
+                    sdir, step,
+                    f"missing ready markers from ranks {missing}"
+                    if missing else "no manifest within the deadline")
+            self._check_self_stale()
+            time.sleep(self.poll_interval)
+
+    def _abort_straggler(self, sdir: str, step: int, why: str):
+        """Clean abort: book the straggler, leave NO manifest (the dir
+        stays invisible to restore and is swept by retention once its
+        markers go stale)."""
+        if _telem._ENABLED:
+            _telem.counter(
+                "mx_snapshot_failures_total",
+                "Interval snapshots skipped after exhausting IO retries",
+                ("source",)).labels("straggler").inc()
+        if _tracing._ENABLED:
+            _tracing.event("mx.coord.straggler_abort", step=int(step),
+                           why=why)
+        raise StragglerTimeout(
+            f"cross-host snapshot commit aborted at step {step}: {why} "
+            f"(straggler deadline {self.straggler_timeout:.1f}s; dir "
+            f"{sdir} stays manifest-less)")
+
+    # -- restore-side validation --------------------------------------------
+
+    def validate_manifest(self, man: Dict[str, Any], step: int):
+        """Generation + fence validation for restore paths: the manifest
+        must carry a fencing token, its generation may not be from the
+        future of this root's epoch, and any ready markers still on disk
+        for the step must agree with the manifest's (step, generation) —
+        a manifest assembled from mixed-generation markers never
+        validates."""
+        fence = man.get("fence")
+        if not isinstance(fence, int) or fence < 1:
+            raise MXNetError(
+                f"snapshot step {step}: manifest carries no commit fence "
+                "token — refused (written by a pre-coordinator writer or "
+                "tampered)")
+        gen = man.get("meta", {}).get("generation")
+        if gen is not None:
+            cur = self._gen_record()["generation"]
+            if cur and int(gen) > cur:
+                raise MXNetError(
+                    f"snapshot step {step}: manifest generation {gen} is "
+                    f"ahead of this root's epoch {cur} — mixed snapshot "
+                    "roots or a wiped coord dir; refusing to restore")
+            members = man.get("meta", {}).get("members") or []
+            for rank, rec in self._markers(
+                    _manifest.step_path(self.root, int(step))).items():
+                if rank in members and (int(rec.get("step", -1)) != int(step)
+                                        or int(rec.get("generation", -1))
+                                        != int(gen)):
+                    raise MXNetError(
+                        f"snapshot step {step}: ready marker of rank "
+                        f"{rank} is stamped (step {rec.get('step')}, "
+                        f"generation {rec.get('generation')}) but the "
+                        f"manifest says (step {step}, generation {gen}) "
+                        "— inconsistent commit; refusing to restore")
+
+    # -- hang watchdog -------------------------------------------------------
+
+    def watchdog(self, what: str = "drain"):
+        """Armed :class:`HangWatchdog` over a blocking section when
+        ``watchdog_timeout`` is configured, else a no-op context."""
+        if self.watchdog_timeout is None:
+            return _NullWatchdog()
+        return HangWatchdog(self.watchdog_timeout, what=what)
+
+    def _check_self_stale(self):
+        """Inside wait loops: our OWN heartbeat not landing for a full
+        watchdog deadline means the shared filesystem (or this process)
+        is wedged — fire the watchdog rather than silently stalling the
+        group."""
+        if self.watchdog_timeout is None:
+            return
+        if self.heartbeat_staleness() > float(self.watchdog_timeout):
+            HangWatchdog(0.0, what="heartbeat")._fire()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self):
+        self.leave()
+
+    def __enter__(self):
+        if not self._joined:
+            self.join()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

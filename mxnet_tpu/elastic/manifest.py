@@ -45,12 +45,12 @@ import shutil
 import threading
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as _np
 
 from .. import faults as _faults
-from ..base import MXNetError
+from ..base import MXNetError, env
 
 __all__ = ["step_dirname", "step_path", "parse_step", "all_complete_steps",
            "latest_complete_step", "write_shard", "commit", "load", "prune",
@@ -60,6 +60,13 @@ FORMAT = 1
 _STEP_PREFIX = "step-"
 MANIFEST = "manifest.json"
 LEASE = "commit.lease"
+READY_PREFIX = "ready-"
+
+env.declare("MXNET_TPU_PRUNE_GRACE", 30.0, float,
+            "Retention liveness grace in seconds: prune skips an "
+            "incomplete snapshot directory whose commit lease or ready "
+            "markers were written within this window — another live host "
+            "may still be mid-write (0 disables the check)")
 
 
 def _fsync_file(f):
@@ -166,13 +173,13 @@ def write_shard(sdir: str, process_index: int, entries) -> int:
 
 # -- commit lease: exactly one concurrent committer finalizes a step --------
 
-def _lease_path(sdir: str) -> str:
-    return os.path.join(sdir, LEASE)
+def _lease_path(sdir: str, lease_name: str = LEASE) -> str:
+    return os.path.join(sdir, lease_name)
 
 
-def _read_lease(sdir: str) -> Dict[str, Any]:
+def _read_lease(sdir: str, lease_name: str = LEASE) -> Dict[str, Any]:
     try:
-        with open(_lease_path(sdir)) as f:
+        with open(_lease_path(sdir, lease_name)) as f:
             return json.load(f)
     except (OSError, ValueError):
         return {}
@@ -185,15 +192,20 @@ def _write_lease_to(path: str, owner: str, token: int):
         _fsync_file(f)
 
 
-def _acquire_lease(sdir: str, owner: str, stale_after: float) -> int:
+def _acquire_lease(sdir: str, owner: str, stale_after: float,
+                   lease_name: str = LEASE) -> int:
     """Take the step dir's commit lease; returns this holder's fencing
     token. Exactly one of N concurrent committers wins via O_EXCL create
     (shared-filesystem atomic); losers raise ``MXNetError``. A lease whose
     holder died (older than ``stale_after`` seconds) is taken over with an
     INCREMENTED token, so a crashed committer cannot block commits forever
     while the fenced-out stale holder can never finalize — ``commit``
-    re-verifies owner+token immediately before the manifest rename."""
-    path = _lease_path(sdir)
+    re-verifies owner+token immediately before the manifest rename.
+
+    ``lease_name`` lets other control-plane state reuse the same fenced
+    mutual exclusion (elastic/coordinator.py serializes generation-epoch
+    updates through ``generation.lock`` with exactly this protocol)."""
+    path = _lease_path(sdir, lease_name)
     try:
         fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
     except FileExistsError:
@@ -203,7 +215,7 @@ def _acquire_lease(sdir: str, owner: str, stale_after: float) -> int:
             json.dump({"owner": owner, "token": 1, "ts": time.time()}, f)
             _fsync_file(f)
         return 1
-    holder = _read_lease(sdir)
+    holder = _read_lease(sdir, lease_name)
     age = time.time() - float(holder.get("ts", 0.0))
     if age <= stale_after and holder:
         raise MXNetError(
@@ -217,14 +229,15 @@ def _acquire_lease(sdir: str, owner: str, stale_after: float) -> int:
     os.replace(tmp, path)
     # concurrent takeovers race on the replace; last write wins — re-read
     # to learn whether WE hold it now
-    if _read_lease(sdir).get("owner") != owner:
+    if _read_lease(sdir, lease_name).get("owner") != owner:
         raise MXNetError(
             f"lost the stale-lease takeover race for {sdir}")
     return token
 
 
-def _verify_lease(sdir: str, owner: str, token: int):
-    cur = _read_lease(sdir)
+def _verify_lease(sdir: str, owner: str, token: int,
+                  lease_name: str = LEASE):
+    cur = _read_lease(sdir, lease_name)
     if cur.get("owner") != owner or int(cur.get("token", -1)) != int(token):
         raise MXNetError(
             f"commit fenced out: lease for {sdir} now held by "
@@ -233,23 +246,29 @@ def _verify_lease(sdir: str, owner: str, token: int):
             "must not land")
 
 
-def _release_lease(sdir: str, owner: str):
-    if _read_lease(sdir).get("owner") == owner:
+def _release_lease(sdir: str, owner: str, lease_name: str = LEASE):
+    if _read_lease(sdir, lease_name).get("owner") == owner:
         try:
-            os.unlink(_lease_path(sdir))
+            os.unlink(_lease_path(sdir, lease_name))
         except OSError:
             pass
 
 
 def commit(sdir: str, step: int, meta: Dict[str, Any],
            expected_processes: int = 1, timeout: float = 120.0,
-           lease_timeout: float = 30.0) -> Dict[str, Any]:
+           lease_timeout: float = 30.0,
+           ranks: Optional[Sequence[int]] = None) -> Dict[str, Any]:
     """Merge the per-process chunk indexes and atomically write
     ``manifest.json`` — the snapshot exists only once this returns.
 
     Single-controller runs commit immediately; in multi-controller SPMD
     process 0 calls this after writing its own shard and polls (bounded by
-    ``timeout``) for the other processes' index files.
+    ``timeout``) for the other processes' index files. When the caller
+    knows the exact membership (the elastic coordinator's two-phase
+    commit), ``ranks`` pins the merge to precisely those shard indexes —
+    a stale shard left by a host fenced out at an older generation is
+    neither waited for nor merged (it would overlap the live set's
+    re-partitioned chunks).
 
     Concurrent committers (a split-brain rank 0 after an elastic restart,
     or racing supervisors) are serialized by a lease file with a fencing
@@ -257,16 +276,25 @@ def commit(sdir: str, step: int, meta: Dict[str, Any],
     loser raises ``MXNetError`` without touching the manifest, and a lease
     older than ``lease_timeout`` seconds is treated as a crashed holder
     and taken over."""
+    required = None if ranks is None else sorted(
+        f"shard-{int(r):05d}.json" for r in ranks)
     deadline = time.monotonic() + timeout
     while True:
-        shard_jsons = sorted(n for n in os.listdir(sdir)
-                             if n.startswith("shard-") and n.endswith(".json"))
-        if len(shard_jsons) >= expected_processes:
-            break
+        present = {n for n in os.listdir(sdir)
+                   if n.startswith("shard-") and n.endswith(".json")}
+        if required is not None:
+            shard_jsons = [n for n in required if n in present]
+            if len(shard_jsons) == len(required):
+                break
+        else:
+            shard_jsons = sorted(present)
+            if len(shard_jsons) >= expected_processes:
+                break
         if time.monotonic() >= deadline:
             raise MXNetError(
                 f"snapshot commit timed out: {len(shard_jsons)}/"
-                f"{expected_processes} shard indexes present in {sdir}")
+                f"{expected_processes if required is None else len(required)}"
+                f" shard indexes present in {sdir}")
         time.sleep(0.05)
     owner = f"{os.getpid()}.{threading.get_ident()}.{uuid.uuid4().hex[:8]}"
     token = _acquire_lease(sdir, owner, lease_timeout)
@@ -327,10 +355,47 @@ def load(root: str, step: int) -> Dict[str, Any]:
     return man
 
 
-def prune(root: str, max_to_keep: int) -> List[int]:
+def _writer_active(sdir: str, grace: float) -> bool:
+    """Liveness check behind prune safety: a manifest-less directory is
+    only debris if nobody is mid-write in it. A commit lease or a
+    coordinator ready marker stamped within ``grace`` seconds means
+    another live host is still producing this snapshot — pruning it out
+    from under that writer turns a slow snapshot into a corrupt one. The
+    recorded wall-clock ``ts`` fields are used (not file mtimes), so
+    stale debris from a crashed writer ages out and is swept normally."""
+    if grace <= 0.0:
+        return False
+    now = time.time()
+    holder = _read_lease(sdir)
+    if holder and now - float(holder.get("ts", 0.0)) <= grace:
+        return True
+    try:
+        names = os.listdir(sdir)
+    except OSError:
+        return False
+    for name in names:
+        if not (name.startswith(READY_PREFIX) and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(sdir, name)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if now - float(rec.get("ts", 0.0)) <= grace:
+            return True
+    return False
+
+
+def prune(root: str, max_to_keep: int,
+          active_grace: Optional[float] = None) -> List[int]:
     """Retention: drop the oldest COMPLETE snapshots beyond ``max_to_keep``
     and any incomplete directory older than the newest complete one (a
-    preempted writer's leftovers). Never touches the newest snapshot."""
+    preempted writer's leftovers). Never touches the newest snapshot, and
+    never an incomplete directory another live host is still writing
+    (fresh lease/ready-marker within ``active_grace`` seconds — default
+    ``MXNET_TPU_PRUNE_GRACE``; see :func:`_writer_active`)."""
+    grace = float(env.get("MXNET_TPU_PRUNE_GRACE")
+                  if active_grace is None else active_grace)
     complete = all_complete_steps(root)
     removed = []
     if max_to_keep > 0:
@@ -342,7 +407,8 @@ def prune(root: str, max_to_keep: int) -> List[int]:
         for name in os.listdir(root):
             step = parse_step(name)
             if step is not None and step < complete[-1] and \
-                    not os.path.exists(os.path.join(root, name, MANIFEST)):
+                    not os.path.exists(os.path.join(root, name, MANIFEST)) \
+                    and not _writer_active(os.path.join(root, name), grace):
                 shutil.rmtree(os.path.join(root, name), ignore_errors=True)
     return removed
 
@@ -357,13 +423,38 @@ class SnapshotReader:
     The fetch interface elastic/state.py's ``install`` consumes:
     ``reader(name)`` returns the GLOBAL numpy array for that leaf,
     stitched from however many per-process chunks the saving mesh
-    produced — the resharding pivot for save-on-N / resume-on-M."""
+    produced — the resharding pivot for save-on-N / resume-on-M.
+
+    Multi-host restore validation: pass ``expected_generation`` /
+    ``expected_fence`` to refuse a manifest committed under a different
+    group epoch or without a fencing token (elastic/coordinator.py's
+    restore path supplies both). ``read_region`` assembles just one
+    index region, opening ONLY the chunk files that intersect it — each
+    host reads its owned chunks, never the whole snapshot; ``files_read``
+    records which payload files were actually opened."""
 
     def __init__(self, root: str, step: int,
-                 manifest: Optional[Dict[str, Any]] = None):
+                 manifest: Optional[Dict[str, Any]] = None,
+                 expected_generation: Optional[int] = None,
+                 expected_fence: Optional[int] = None):
         self._dir = step_path(root, step)
         self.manifest = manifest if manifest is not None else load(root, step)
         self._npz: Dict[str, Any] = {}
+        self.files_read: set = set()
+        if expected_fence is not None and \
+                int(self.manifest.get("fence", -1)) != int(expected_fence):
+            raise MXNetError(
+                f"snapshot step {step}: manifest fence "
+                f"{self.manifest.get('fence')!r} != expected "
+                f"{expected_fence} — written by a different (possibly "
+                "fenced-out) committer; refusing to restore")
+        if expected_generation is not None:
+            got = self.manifest.get("meta", {}).get("generation")
+            if got is None or int(got) != int(expected_generation):
+                raise MXNetError(
+                    f"snapshot step {step}: manifest generation {got!r} "
+                    f"!= expected {expected_generation} — committed under "
+                    "a different group epoch; refusing to restore")
 
     @property
     def names(self):
@@ -374,7 +465,38 @@ class SnapshotReader:
         if f is None:
             f = self._npz[npz_name] = _faults.io_retry(
                 "elastic.read", _np.load, os.path.join(self._dir, npz_name))
+            self.files_read.add(npz_name)
         return f
+
+    def read_region(self, name: str, region) -> _np.ndarray:
+        """Assemble only ``region`` (``[[start, stop], ...]`` per dim) of
+        leaf ``name``, touching only the chunk files that intersect it —
+        the owned-chunk restore path for multi-host resume."""
+        spec = self.manifest["leaves"].get(name)
+        if spec is None:
+            raise KeyError(name)
+        region = [(int(a), int(b)) for a, b in region]
+        shape = tuple(b - a for a, b in region)
+        out = _np.empty(shape, dtype=_np.dtype(spec["dtype"]))
+        covered = 0
+        for c in self.manifest["chunks"].get(name, ()):
+            lo = [max(a, ca) for (a, _), (ca, _) in zip(region, c["index"])]
+            hi = [min(b, cb) for (_, b), (_, cb) in zip(region, c["index"])]
+            if any(l >= h for l, h in zip(lo, hi)):
+                continue
+            chunk = self._file(c["file"])[c["key"]]
+            src = tuple(slice(l - ca, h - ca) for l, h, (ca, _)
+                        in zip(lo, hi, c["index"]))
+            dst = tuple(slice(l - a, h - a) for l, h, (a, _)
+                        in zip(lo, hi, region))
+            out[dst] = chunk[src]
+            covered += int(_np.prod([h - l for l, h in zip(lo, hi)]))
+        if covered != out.size:
+            raise MXNetError(
+                f"snapshot leaf {name!r} region {region}: chunks cover "
+                f"{covered} of {out.size} elements — corrupt or partial "
+                "snapshot")
+        return out
 
     def __call__(self, name: str) -> _np.ndarray:
         spec = self.manifest["leaves"].get(name)

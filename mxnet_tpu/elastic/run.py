@@ -56,7 +56,8 @@ def save_trainer(manager: SnapshotManager, trainer, feed=None,
 
 def resume_or_init(directory: str, make_trainer: Callable[[], Any],
                    feed=None, max_to_keep: int = 3,
-                   save_interval_steps: Optional[int] = None):
+                   save_interval_steps: Optional[int] = None,
+                   coordinator=None):
     """Boot a worker: restore the latest complete snapshot, or start fresh.
 
     ``make_trainer`` constructs the trainer for THIS job's mesh/config;
@@ -64,25 +65,46 @@ def resume_or_init(directory: str, make_trainer: Callable[[], Any],
     start_step, outcome)`` with outcome one of ``"fresh"`` (no snapshot),
     ``"resumed"`` (same mesh + step program), ``"resharded"`` (state was
     re-laid-out for a different mesh or program). Booked on the
-    ``mx_resume_total{outcome}`` counter."""
+    ``mx_resume_total{outcome}`` counter.
+
+    With a ``coordinator`` (elastic/coordinator.py) the manifest is
+    additionally validated against the group epoch — fence token present,
+    generation not from the future, on-disk ready markers consistent —
+    and a snapshot written by a different world size classifies as
+    ``"resharded"``."""
     mgr = SnapshotManager(directory, max_to_keep=max_to_keep,
-                          save_interval_steps=save_interval_steps)
+                          save_interval_steps=save_interval_steps,
+                          coordinator=coordinator)
     step = mgr.latest_step()
     trainer = make_trainer()
     if step is None:
         _record_resume("fresh")
         return mgr, trainer, 0, "fresh"
     man = _manifest.load(mgr.directory, step)
+    if coordinator is not None:
+        coordinator.validate_manifest(man, step)
     meta = man["meta"]
     with _manifest.SnapshotReader(mgr.directory, step, manifest=man) as rd:
         _state.install(trainer, meta, rd, rd.names)
     if feed is not None and meta.get("feed") is not None \
             and hasattr(feed, "load_state_dict"):
         feed.load_state_dict(meta["feed"])
-    mesh_now = {str(a): int(s) for a, s in dict(trainer.mesh.shape).items()}
-    outcome = "resumed" if (mesh_now == meta.get("mesh")
-                            and trainer._program.fingerprint
-                            == meta.get("program")) else "resharded"
+    if hasattr(trainer, "mesh") and hasattr(trainer, "_program"):
+        mesh_now = {str(a): int(s)
+                    for a, s in dict(trainer.mesh.shape).items()}
+        outcome = "resumed" if (mesh_now == meta.get("mesh")
+                                and trainer._program.fingerprint
+                                == meta.get("program")) else "resharded"
+    elif coordinator is not None and meta.get("members"):
+        # a coordinator-committed snapshot records the membership it was
+        # partitioned over: restoring onto a different live set is a
+        # re-layout even when the trainer has no mesh (the drill's toy
+        # trainer)
+        live = coordinator.view(bump=False).live
+        outcome = "resumed" if sorted(meta["members"]) == live \
+            else "resharded"
+    else:
+        outcome = "resumed"
     _record_resume(outcome)
     return mgr, trainer, int(meta["step"]), outcome
 
@@ -144,7 +166,7 @@ def _xy(batch):
 def run(trainer, feed, num_steps: int, directory: Optional[str] = None,
         manager: Optional[SnapshotManager] = None,
         save_every: Optional[int] = None, guard: Optional[PreemptionGuard]
-        = None, on_step=None) -> Dict[str, Any]:
+        = None, on_step=None, coordinator=None) -> Dict[str, Any]:
     """Drive ``trainer.step`` over ``feed`` until ``num_steps`` TOTAL steps
     (the trainer's step counter, so a resumed trainer does only the
     remainder), snapshotting every ``save_every`` steps and on exit.
@@ -155,16 +177,32 @@ def run(trainer, feed, num_steps: int, directory: Optional[str] = None,
     snapshot, and returns ``{"preempted": True}`` — relaunching the job
     through ``resume_or_init`` continues the exact trajectory. Losses are
     returned as unsynced ``PendingScalar`` handles.
+
+    With a ``coordinator`` the loop participates in the COORDINATED stop
+    protocol (docs/reliability.md): every step boundary refreshes the
+    membership heartbeat and polls for a stop intent (this host's own
+    preemption posts one); once a stop is posted, every live host acks
+    its current step, the stop resolves to ``S = max(acked steps)``,
+    hosts behind S run exactly up to S, and ALL survivors write their
+    final snapshot at the same step. The drain is guarded by the hang
+    watchdog, and the final cross-host snapshot retries under a
+    refreshed membership view when a straggler abort or a dead peer
+    interrupts the two-phase commit.
     """
     if manager is None:
         if directory is None:
             raise MXNetError("elastic.run needs directory= or manager=")
         manager = SnapshotManager(directory,
-                                  save_interval_steps=save_every)
-    elif save_every is not None:
-        manager.save_interval_steps = int(save_every)
+                                  save_interval_steps=save_every,
+                                  coordinator=coordinator)
+    else:
+        if save_every is not None:
+            manager.save_interval_steps = int(save_every)
+        if coordinator is not None and manager.coordinator is None:
+            manager.coordinator = coordinator
     losses = []
     preempted = False
+    stop_info = None
     own_guard = guard is None
     g = PreemptionGuard() if own_guard else guard
     if own_guard:
@@ -174,6 +212,10 @@ def run(trainer, feed, num_steps: int, directory: Optional[str] = None,
         while trainer._t < num_steps:
             if g.triggered:
                 preempted = True
+                if coordinator is not None and stop_info is None:
+                    # tell the peers: everyone converges on one final S
+                    stop_info = coordinator.post_stop(trainer._t,
+                                                      reason="preempted")
                 if _tracing._ENABLED:
                     # black-box dump at the preemption boundary: the final
                     # steps' spans survive even if the relaunch clobbers
@@ -181,6 +223,11 @@ def run(trainer, feed, num_steps: int, directory: Optional[str] = None,
                     _tracing.event("mx.preemption", step=trainer._t)
                     _tracing.dump_flight_recorder(reason="preemption")
                 break
+            if coordinator is not None:
+                stop_info = coordinator.step_poll(trainer._t)
+                if stop_info is not None:
+                    preempted = True
+                    break
             try:
                 batch = next(it)
             except StopIteration:
@@ -221,14 +268,55 @@ def run(trainer, feed, num_steps: int, directory: Optional[str] = None,
                             .labels("elastic").inc()
             if on_step is not None:
                 on_step(trainer._t, losses[-1])
+        if coordinator is not None and preempted:
+            # phase-1 quiesce: every live host acks its step; the stop
+            # resolves to S = max over acks, and a host behind S levels
+            # up — every survivor's final snapshot is at the SAME step
+            target = min(coordinator.resolve_stop(trainer._t), num_steps)
+            while trainer._t < target:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    if not hasattr(feed, "reset"):
+                        break
+                    feed.reset()
+                    it = iter(feed)
+                    continue
+                x, y = _xy(batch)
+                losses.append(trainer.step(x, y))
         # exit (normal or preempted): drain in-flight steps, then one
         # final synchronous snapshot so the relaunch loses nothing
-        trainer.drain()
+        if coordinator is not None:
+            with coordinator.watchdog("drain"):
+                trainer.drain()
+        else:
+            trainer.drain()
         if trainer._t != manager._last_saved:
-            save_trainer(manager, trainer, feed, wait=True)
+            _final_save(manager, trainer, feed, coordinator)
         else:
             manager.wait_until_finished()
     finally:
         if own_guard:
             g.__exit__(None, None, None)
-    return {"step": trainer._t, "losses": losses, "preempted": preempted}
+    return {"step": trainer._t, "losses": losses, "preempted": preempted,
+            "stop": stop_info}
+
+
+def _final_save(manager, trainer, feed, coordinator, attempts: int = 3):
+    """The strict final snapshot. Single-host: one shot, failures
+    surface. Coordinated: a straggler abort or a peer dying mid-commit
+    fails the whole two-phase barrier for every survivor — each retries
+    under the REFRESHED membership view (new generation, re-partitioned
+    ownership, fresh markers), bounded by ``attempts``."""
+    if coordinator is None:
+        save_trainer(manager, trainer, feed, wait=True)
+        return
+    for attempt in range(int(attempts)):
+        try:
+            save_trainer(manager, trainer, feed, wait=True)
+            return
+        except MXNetError:
+            if attempt == int(attempts) - 1:
+                raise
+            coordinator.heartbeat(trainer._t, force=True)
+            coordinator.view()      # refresh epoch before re-partitioning

@@ -29,6 +29,7 @@ never silently start blocking the jitted step.
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -39,6 +40,14 @@ from ..telemetry import tracing as _tracing
 from . import manifest as _manifest
 
 __all__ = ["SnapshotManager"]
+
+
+def _is_jax_array(v) -> bool:
+    """jax.Array check that never IMPORTS jax: a pure-host coordinator
+    participant (the drill's toy trainer) must not pay backend init just
+    to snapshot numpy leaves."""
+    jax = sys.modules.get("jax")
+    return jax is not None and isinstance(v, jax.Array)
 
 env.declare("MXNET_TPU_SNAPSHOT_EVERY", 0, int,
             "Default SnapshotManager save interval in steps (0 = only "
@@ -52,16 +61,25 @@ class SnapshotManager:
     (elastic/state.py ``capture``) produces: ``{"leaves": {name: array},
     "meta": {...}}``. Leaves may be jax arrays (device, any sharding) or
     host values; meta must be JSON-serializable.
+
+    With a ``coordinator`` (elastic/coordinator.py) the manager becomes
+    one participant in the TWO-PHASE cross-host commit: this host writes
+    only its owned chunks plus a ready marker, and whoever the group
+    view elects leader assembles the generation-stamped global manifest
+    once every live member's marker landed (docs/checkpointing.md,
+    "Multi-host snapshots").
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 save_interval_steps: Optional[int] = None):
+                 save_interval_steps: Optional[int] = None,
+                 coordinator=None):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_to_keep = int(max_to_keep)
         self.save_interval_steps = int(
             env.get("MXNET_TPU_SNAPSHOT_EVERY")
             if save_interval_steps is None else save_interval_steps)
+        self.coordinator = coordinator
         self._writer: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._last_saved: Optional[int] = None
@@ -104,12 +122,20 @@ class SnapshotManager:
         """Per-leaf eager device copies. One jit over all leaves would
         reject mixed committed placements (mesh-sharded state + the
         default-device RNG leaf); per-leaf ``jnp.copy`` dispatches each
-        copy on its own devices, async, sharding-preserving."""
-        import jax
-        import jax.numpy as jnp
+        copy on its own devices, async, sharding-preserving. Host ndarray
+        leaves are copied too — an in-place optimizer (the drill's toy
+        trainer, host-side scheduler state) keeps mutating the live
+        buffer while the background writer serializes the copy."""
+        import numpy as _np
         out = {}
         for name, v in leaves.items():
-            out[name] = jnp.copy(v) if isinstance(v, jax.Array) else v
+            if _is_jax_array(v):
+                import jax.numpy as jnp
+                out[name] = jnp.copy(v)
+            elif isinstance(v, _np.ndarray):
+                out[name] = _np.array(v)
+            else:
+                out[name] = v
         return out
 
     # -- background writer ---------------------------------------------------
@@ -121,20 +147,30 @@ class SnapshotManager:
                     nbytes, sdir, proc = self._write_entries(step, copies)
             else:
                 nbytes, sdir, proc = self._write_entries(step, copies)
-            if proc == 0:
+            if self.coordinator is not None:
+                self._commit_coordinated(sdir, step, meta, nbytes, t0, ctx)
+            elif proc == 0:
                 self._commit(sdir, step, meta, nbytes, t0, ctx)
         except BaseException as e:  # stash-and-reraise thread boundary: surfaced at the next save()/wait  # mxlint: disable=broad-except
             self._error = e
 
     def _write_entries(self, step, copies):
-        import jax
         sdir = _manifest.step_path(self.directory, step)
         os.makedirs(sdir, exist_ok=True)
         import numpy as _np
-        proc = jax.process_index()
+        coord = self.coordinator
+        partition = coord is not None and coord.partition_ownership
+        if coord is not None:
+            # the control plane is the authority on this host's identity
+            # — a pure-host (drill) participant never touches the jax
+            # distributed runtime
+            proc = coord.rank
+        else:
+            import jax
+            proc = jax.process_index()
         entries = []
         for name, v in copies.items():
-            if isinstance(v, jax.Array):
+            if _is_jax_array(v) and not partition:
                 for shard in v.addressable_shards:
                     if shard.replica_id != 0:
                         continue
@@ -142,6 +178,14 @@ class SnapshotManager:
                              for sl, dim in zip(shard.index, v.shape)]
                     entries.append((name, index, _np.asarray(shard.data),
                                     v.shape, v.dtype))
+            elif partition:
+                # replicated/host leaves partitioned over the live set:
+                # every host at this generation computes the same owner
+                # per leaf, so the chunks tile exactly once
+                if coord.owns(name):
+                    arr = _np.asarray(v)
+                    index = [(0, d) for d in arr.shape]
+                    entries.append((name, index, arr, arr.shape, arr.dtype))
             elif proc == 0:
                 arr = _np.asarray(v)
                 index = [(0, d) for d in arr.shape]
@@ -156,6 +200,30 @@ class SnapshotManager:
         _manifest.commit(sdir, step, meta,
                          expected_processes=jax.process_count())
         _manifest.prune(self.directory, self.max_to_keep)
+        seconds = time.perf_counter() - t0
+        if _tracing._ENABLED:
+            _tracing.record_span("mx.elastic.commit", t_c0, t0 + seconds,
+                                 parent=ctx, step=step, bytes=int(nbytes))
+        self.save_seconds = seconds
+        self.bytes_written += int(nbytes)
+        if _telem._ENABLED:
+            _telem.record_checkpoint_save(seconds, nbytes, source="elastic")
+
+    def _commit_coordinated(self, sdir, step, meta, nbytes, t0, ctx=None):
+        """Two-phase cross-host commit: post this host's ready marker,
+        then converge on the leader-assembled, generation-stamped global
+        manifest (elastic/coordinator.py ``commit_snapshot``). Every
+        participant calls this — leadership is decided by the live view
+        inside the barrier, so a leader that dies mid-commit is replaced
+        without any host taking a different code path. Retention runs on
+        the leader only (prune itself skips dirs a live peer is still
+        writing)."""
+        coord = self.coordinator
+        t_c0 = time.perf_counter() if _tracing._ENABLED else 0.0
+        coord.write_marker(sdir, step, nbytes)
+        coord.commit_snapshot(sdir, step, meta)
+        if coord.view(bump=False).leader == coord.rank:
+            _manifest.prune(self.directory, self.max_to_keep)
         seconds = time.perf_counter() - t0
         if _tracing._ENABLED:
             _tracing.record_span("mx.elastic.commit", t_c0, t0 + seconds,

@@ -252,12 +252,18 @@ def capture(trainer) -> Dict[str, Any]:
     bookkeeping on the caller's thread: leaves reference the live device
     arrays (SnapshotManager copies them), meta reads host counters only —
     no device transfer, no sync (mxlint host-sync hot list)."""
+    if hasattr(trainer, "elastic_state"):
+        # duck-typed extension point: a trainer that is neither of the
+        # fused pair (the multi-host drill's toy trainer, user trainers)
+        # supplies its own snapshot-schema dict + elastic_install()
+        return trainer.elastic_state()
     if hasattr(trainer, "_params_raw"):
         return _capture_dp(trainer)
     if hasattr(trainer, "_s_raw"):
         return _capture_pp(trainer)
     raise MXNetError(f"cannot snapshot {type(trainer).__name__}; expected "
-                     "DataParallelTrainer or PipelineTrainer")
+                     "DataParallelTrainer, PipelineTrainer, or an "
+                     "elastic_state()/elastic_install() provider")
 
 
 def _common_meta(trainer) -> Dict[str, Any]:
@@ -355,6 +361,13 @@ def install(trainer, meta: Dict[str, Any], fetch: Callable[[str], Any],
     returns the global host (or device) value for a leaf name; ``names``
     is the set of leaf names the snapshot holds."""
     kind = meta.get("kind")
+    if kind not in ("dp", "pp") and hasattr(trainer, "elastic_install"):
+        # the duck-typed counterpart of capture()'s elastic_state() hook:
+        # the trainer owns its own leaf layout and host-state restore
+        # (including its step counter), so the fused-pair install below
+        # — and its trainer.sync() — does not apply
+        trainer.elastic_install(meta, fetch, names)
+        return trainer
     if kind == "dp":
         if not hasattr(trainer, "_params_raw"):
             raise MXNetError("snapshot holds DataParallelTrainer state but "

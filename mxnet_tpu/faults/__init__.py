@@ -109,6 +109,14 @@ for _name, _doc in (
                          "(serving/batcher.py)"),
     ("serving.http", "HTTP front-door request handling "
                      "(serving/server.py)"),
+    ("elastic.heartbeat", "coordinator membership heartbeat write "
+                          "(elastic/coordinator.py heartbeat)"),
+    ("elastic.barrier", "coordinator generation/stop barrier IO "
+                        "(elastic/coordinator.py generation epoch, "
+                        "stop intent + acks)"),
+    ("elastic.marker", "per-host ready-marker write in the two-phase "
+                       "cross-host commit (elastic/coordinator.py "
+                       "write_marker)"),
 ):
     declare_point(_name, _doc)
 

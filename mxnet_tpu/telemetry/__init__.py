@@ -33,6 +33,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import json
+import sys
 import threading
 import time
 from bisect import bisect_left
@@ -57,6 +58,7 @@ __all__ = [
     "record_serving_completion", "record_fault_injected", "record_io_retry",
     "record_request_shed", "record_feed_producer_leak",
     "record_feed_producer_restart", "record_serving_queue_wait",
+    "record_hosts_live", "record_commit_barrier", "record_hang_watchdog",
     "statusz", "tracing",
 ]
 
@@ -954,6 +956,43 @@ def record_feed_producer_restart(source: str = "feed"):
             ("source",)).labels(source).inc()
 
 
+def record_hosts_live(n: int, generation: int, source: str = "elastic"):
+    """Multi-host control-plane group view (elastic/coordinator.py):
+    hosts with a fresh membership lease, and the monotonic generation
+    epoch. mx_hosts_live below the fleet size pages a dead host;
+    mx_coordinator_generation climbing without deploys means hosts are
+    flapping (lease expiry + rejoin) — check heartbeat IO latency."""
+    gauge("mx_hosts_live",
+          "Hosts with a fresh coordinator membership lease",
+          ("source",)).labels(source).set(int(n))
+    gauge("mx_coordinator_generation",
+          "Monotonic group-membership generation epoch",
+          ("source",)).labels(source).set(int(generation))
+
+
+def record_commit_barrier(seconds: float, source: str = "elastic"):
+    """Account one host's wait in the two-phase cross-host snapshot
+    commit barrier (its own ready marker posted -> global manifest
+    visible). p99 approaching the straggler deadline means one host's
+    shard writes are outliers — the next incident is a straggler abort
+    (mx_snapshot_failures_total{source="straggler"})."""
+    histogram("mx_commit_barrier_seconds",
+              "Cross-host snapshot commit barrier wait per host",
+              ("source",), buckets=DEFAULT_LATENCY_BUCKETS) \
+        .labels(source).observe(float(seconds))
+
+
+def record_hang_watchdog(what: str):
+    """Account one hang-watchdog firing (elastic/coordinator.py
+    HangWatchdog): a wall-clock deadline expired on a blocking section
+    (``drain``, ``commit``, ``heartbeat`` staleness). The process dumps
+    the flight recorder and exits with a diagnosis — any increment is an
+    incident; the NDJSON dump next to the job is the evidence."""
+    counter("mx_hang_watchdog_fires_total",
+            "Hang-watchdog firings (flight recorder dumped, process exited)",
+            ("what",)).labels(what).inc()
+
+
 @contextmanager
 def comm_scope(op: str, nbytes: int, store: str = ""):
     """Time + count a comm region and annotate it into the device trace
@@ -1158,6 +1197,15 @@ def statusz(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
                        "armed": _faults.armed()}
     except Exception:
         fault_plane = {}
+    # group view only when the control plane is actually in use — the
+    # import must not drag the coordinator in on single-host jobs
+    coordinator: Dict[str, Any] = {}
+    _coord_mod = sys.modules.get("mxnet_tpu.elastic.coordinator")
+    if _coord_mod is not None:
+        try:
+            coordinator = _coord_mod.statusz_view()
+        except Exception:
+            coordinator = {}
     d: Dict[str, Any] = {
         "telemetry_enabled": _ENABLED,
         "tracing_enabled": tracing._ENABLED,
@@ -1169,6 +1217,7 @@ def statusz(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         "inflight_steps": _family_snapshot("mx_inflight_steps"),
         "anomalies": _family_snapshot("mx_anomalies_total"),
         "recorder_events": tracing.recent(),
+        "coordinator": coordinator,
     }
     if extra:
         d.update(extra)

@@ -19,6 +19,7 @@ would survive the real failure the point models —
     is abandoned LOUDLY (RuntimeWarning + counter), never silently.
 """
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -111,6 +112,7 @@ def test_env_spec_arms_the_plane(monkeypatch):
 def test_catalog_covers_every_threaded_point():
     cat = faults.points()
     for point in ("elastic.write_shard", "elastic.commit", "elastic.read",
+                  "elastic.heartbeat", "elastic.barrier", "elastic.marker",
                   "feed.produce", "serving.load", "serving.dispatch",
                   "serving.http"):
         assert point in cat and cat[point], point
@@ -311,6 +313,141 @@ def test_fresh_lease_holder_fences_out_second_writer(tmp_path):
     with pytest.raises(MXNetError, match="lost the race"):
         _manifest.commit(sdir, 5, {"step": 5}, lease_timeout=30.0)
     assert not (tmp_path / "step-00000005" / _manifest.MANIFEST).exists()
+
+
+# ---------------------------------------------------------------------------
+# multi-host coordinator: heartbeat loss, straggler abort, prune safety
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_fault_dead_peer_then_rejoin_bumps_generation(
+        tmp_path, monkeypatch):
+    """The heartbeat chaos lane end to end: injected heartbeat-write
+    faults exhaust the (zeroed) retry budget WITHOUT raising into the
+    training loop; the peer's lease expires on the shared clock, the
+    observer bumps the generation and classifies it dead; and the first
+    heartbeat that lands after the eviction auto-rejoins under a
+    strictly higher generation + fence."""
+    monkeypatch.setenv("MXNET_TPU_IO_RETRIES", "0")
+    monkeypatch.setenv("MXNET_TPU_IO_BACKOFF", "0.001")
+    telemetry.enable()
+    now = [1000.0]
+    a = elastic.Coordinator(str(tmp_path), 0, lease_timeout=5.0,
+                            clock=lambda: now[0])
+    b = elastic.Coordinator(str(tmp_path), 1, lease_timeout=5.0,
+                            clock=lambda: now[0])
+    a.join()
+    b.join()
+    v0 = a.view()
+    assert v0.live == [0, 1] and v0.leader == 0
+    g0 = v0.generation
+
+    # b's heartbeat IO starts failing: swallowed (returns False), never
+    # raised — the host keeps training while its lease goes stale
+    with faults.injected("elastic.heartbeat", faults.EveryNth(1)):
+        assert b.heartbeat(step=1, force=True) is False
+    assert faults.fired("elastic.heartbeat") == 1
+
+    now[0] += 6.0                       # b's lease expires
+    assert a.heartbeat(step=2, force=True) is True
+    v1 = a.view()
+    assert v1.live == [0] and v1.dead == [1]
+    assert v1.generation > g0           # dead-peer detection bumped it
+    assert telemetry.get_metric("mx_hosts_live").get("elastic") == 1
+
+    # the plane is disarmed: b's next heartbeat lands, detects the
+    # eviction, and rejoins with a bumped fence
+    fence_before = b.fence
+    assert b.heartbeat(step=3, force=True) is True
+    assert b.fence > fence_before
+    v2 = a.view()
+    assert v2.live == [0, 1]
+    assert v2.generation >= b.fence > v1.generation
+    a.close()
+    b.close()
+
+
+def test_marker_fault_aborts_commit_as_straggler(tmp_path, monkeypatch):
+    """The marker chaos lane: a host whose ready-marker write dies past
+    the retry budget never posts phase 1, so the leader's commit barrier
+    aborts at the straggler deadline — StragglerTimeout, the failure
+    booked under mx_snapshot_failures_total{source="straggler"}, and NO
+    manifest (restore never sees a hole)."""
+    monkeypatch.setenv("MXNET_TPU_IO_RETRIES", "0")
+    monkeypatch.setenv("MXNET_TPU_IO_BACKOFF", "0.001")
+    telemetry.enable()
+    a = elastic.Coordinator(str(tmp_path), 0, lease_timeout=10.0,
+                            straggler_timeout=0.4, poll_interval=0.01)
+    b = elastic.Coordinator(str(tmp_path), 1, lease_timeout=10.0,
+                            straggler_timeout=0.4, poll_interval=0.01)
+    a.join()
+    b.join()
+    a.view()
+    sdir = _manifest.step_path(str(tmp_path), 9)
+    _, entries = _entries(9)
+    _manifest.write_shard(sdir, 0, entries)
+    rs = onp.random.RandomState(10)
+    arr2 = rs.uniform(-1, 1, (2, 3)).astype(onp.float32)
+    _manifest.write_shard(
+        sdir, 1, [("v", [(0, 2), (0, 3)], arr2, arr2.shape, arr2.dtype)])
+    a.write_marker(sdir, 9, nbytes=64)
+    with faults.injected("elastic.marker", faults.EveryNth(1)):
+        with pytest.raises(faults.FaultInjected):
+            b.write_marker(sdir, 9, nbytes=64)
+    with pytest.raises(elastic.StragglerTimeout, match="straggler|marker"):
+        a.commit_snapshot(sdir, 9, {"step": 9})
+    assert not (tmp_path / "step-00000009" / _manifest.MANIFEST).exists()
+    assert telemetry.get_metric(
+        "mx_snapshot_failures_total").get("straggler") == 1
+
+    # the straggler finally posts (plane disarmed): the retried barrier
+    # commits — the abort cost one attempt, not the snapshot
+    b.write_marker(sdir, 9, nbytes=64)
+    man = a.commit_snapshot(sdir, 9, {"step": 9})
+    assert man["meta"]["members"] == [0, 1]
+    a.close()
+    b.close()
+
+
+def test_prune_skips_dirs_a_live_host_is_writing(tmp_path):
+    """Two-writer prune safety: an uncommitted step directory whose
+    ready marker (or commit lease) is FRESH belongs to a live peer
+    mid-write — prune must skip it even when it is older than the
+    newest commit. Once the recorded ts goes stale it is debris and is
+    swept."""
+    root = str(tmp_path)
+    for step in (1, 5):
+        sdir = _manifest.step_path(root, step)
+        _, entries = _entries(step)
+        _manifest.write_shard(sdir, 0, entries)
+        _manifest.commit(sdir, step, {"step": step})
+    # step 3: incomplete, but another live host just posted its marker
+    sdir3 = _manifest.step_path(root, 3)
+    _, entries = _entries(3)
+    _manifest.write_shard(sdir3, 0, entries)
+    marker = tmp_path / "step-00000003" / "ready-00001.json"
+    marker.write_text(json.dumps(
+        {"rank": 1, "step": 3, "generation": 2, "ts": time.time()}))
+    _manifest.prune(root, max_to_keep=1)
+    assert (tmp_path / "step-00000003").exists()       # live writer: kept
+    assert not (tmp_path / "step-00000001").exists()   # old commit: pruned
+    assert _manifest.all_complete_steps(root) == [5]
+
+    # the writer died long ago: its marker ts is stale -> debris
+    marker.write_text(json.dumps(
+        {"rank": 1, "step": 3, "generation": 2,
+         "ts": time.time() - 3600.0}))
+    _manifest.prune(root, max_to_keep=1)
+    assert not (tmp_path / "step-00000003").exists()
+
+    # a fresh commit LEASE protects the same way (a committer mid-fence,
+    # in a dir OLDER than the newest commit — kept only by the lease)
+    sdir4 = _manifest.step_path(root, 4)
+    _, entries = _entries(4)
+    _manifest.write_shard(sdir4, 0, entries)
+    _manifest._write_lease_to(
+        os.path.join(sdir4, _manifest.LEASE), "live-committer", 1)
+    _manifest.prune(root, max_to_keep=1)
+    assert (tmp_path / "step-00000004").exists()
 
 
 # ---------------------------------------------------------------------------

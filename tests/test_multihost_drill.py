@@ -1,0 +1,193 @@
+"""Multi-host control-plane drills: real OS processes, real kills.
+
+The contract under test (docs/checkpointing.md "Multi-host snapshots",
+docs/reliability.md "Coordinated stop"): N spawned worker processes
+share a snapshot directory through ``elastic.Coordinator`` — heartbeat
+membership with a fenced, monotonically increasing generation; a
+coordinated stop that converges every survivor on ONE final step; and a
+two-phase cross-host commit (per-host ready markers, then a single
+fenced leader assembles the global manifest). Killing a non-leader
+mid-run, killing the leader mid-commit (between its ready marker and
+the manifest rename, leaving a fresh commit lease behind), and racing
+two self-declared leaders must all end with exactly one valid
+generation-stamped manifest — and resuming onto a DIFFERENT world size
+must replay the exact single-process loss trajectory for K+1..K+10.
+
+These drills use the pure-numpy toy trainer from elastic/drill.py: the
+children never import jax, so each costs one mxnet_tpu import (~0.5 s)
+and the whole file stays inside the tier-1 budget.
+"""
+import os
+
+import pytest
+
+from mxnet_tpu.elastic import drill
+from mxnet_tpu.elastic import manifest as _manifest
+from mxnet_tpu.elastic.coordinator import HangWatchdog
+
+
+def _parity(reports, start, count=10):
+    """Every report's losses for steps start+1..start+count must equal
+    the uninterrupted single-process reference trajectory exactly."""
+    ref = drill.reference_losses(start + count)
+    for rank, rep in sorted(reports.items()):
+        got = [rep["losses"][str(t)] for t in range(start + 1,
+                                                    start + count + 1)]
+        assert got == ref[start:start + count], \
+            (rank, got[:3], ref[start:start + 3])
+
+
+def test_clean_run_then_resume_resharded(tmp_path):
+    root = str(tmp_path)
+    res = drill.run_drill(root, world=3, num_steps=8, save_every=4,
+                          report_tag="clean", lease_timeout=2.0,
+                          straggler_timeout=10.0, timeout=60.0)
+    assert res["exitcodes"] == [0, 0, 0], res["exitcodes"]
+    assert _manifest.all_complete_steps(root) == [4, 8]
+    for rep in res["reports"].values():
+        assert rep["outcome"] == "fresh"
+        assert rep["final_step"] == 8
+        assert not rep["preempted"]
+    man = _manifest.load(root, 8)
+    assert man["meta"]["members"] == [0, 1, 2]
+    assert int(man["fence"]) >= 1
+
+    # resume onto a DIFFERENT world size: classified as a re-layout, and
+    # the continued trajectory matches the single-process reference
+    res2 = drill.run_drill(root, world=2, num_steps=18, save_every=1000,
+                           report_tag="resume", lease_timeout=2.0,
+                           straggler_timeout=10.0, timeout=60.0)
+    assert res2["exitcodes"] == [0, 0], res2["exitcodes"]
+    for rep in res2["reports"].values():
+        assert rep["outcome"] == "resharded"
+        assert rep["start"] == 8
+        assert rep["final_step"] == 18
+    _parity(res2["reports"], 8)
+
+
+def test_kill_nonleader_mid_run_then_resume(tmp_path):
+    root = str(tmp_path)
+    # rank 2 dies at step 5; survivors detect the expired lease, post a
+    # peer_dead stop, converge on one final step, and commit a manifest
+    # whose membership excludes the corpse
+    res = drill.run_drill(root, world=3, num_steps=200, save_every=50,
+                          report_tag="kill",
+                          scenario={2: {"die_at_step": 5}},
+                          lease_timeout=1.0, straggler_timeout=8.0,
+                          step_sleep=0.03, timeout=90.0)
+    assert res["exitcodes"][2] == 3, res["exitcodes"]
+    assert res["exitcodes"][0] == 0 and res["exitcodes"][1] == 0, \
+        res["exitcodes"]
+    r0, r1 = res["reports"][0], res["reports"][1]
+    assert r0["preempted"] and r1["preempted"]
+    assert r0["stop"]["reason"] == "peer_dead"
+    assert r0["final_step"] == r1["final_step"]
+    s = r0["final_step"]
+    steps = _manifest.all_complete_steps(root)
+    assert s in steps, (s, steps)
+    man = _manifest.load(root, s)
+    assert man["meta"]["members"] == [0, 1], man["meta"]
+
+    # the relaunch must ignore the dead incarnation's debris (stale stop
+    # intent, acks, heartbeat files) and continue the exact trajectory
+    res2 = drill.run_drill(root, world=2, num_steps=s + 10,
+                           save_every=1000, report_tag="resume",
+                           lease_timeout=2.0, straggler_timeout=10.0,
+                           timeout=60.0)
+    assert res2["exitcodes"] == [0, 0], res2["exitcodes"]
+    for rep in res2["reports"].values():
+        assert not rep["preempted"], rep["stop"]
+        assert rep["final_step"] == s + 10
+    _parity(res2["reports"], s)
+
+
+def test_kill_leader_mid_commit_then_resume(tmp_path):
+    root = str(tmp_path)
+    # rank 0 (the leader) dies INSIDE the step-4 commit: after writing
+    # its ready marker it leaves a fresh commit lease behind — exactly a
+    # holder dying between lease-take and manifest rename — and exits.
+    # A survivor must take over the stale lease with a bumped fence
+    # token and still land exactly one manifest.
+    res = drill.run_drill(root, world=3, num_steps=200, save_every=4,
+                          report_tag="killlead",
+                          scenario={0: {"die_in_commit_step": 4}},
+                          lease_timeout=1.0, straggler_timeout=8.0,
+                          step_sleep=0.03, timeout=90.0)
+    assert res["exitcodes"][0] == 40, res["exitcodes"]
+    assert res["exitcodes"][1] == 0 and res["exitcodes"][2] == 0, \
+        res["exitcodes"]
+    r1, r2 = res["reports"][1], res["reports"][2]
+    assert r1["preempted"] and r2["preempted"]
+    assert r1["final_step"] == r2["final_step"]
+    s = r1["final_step"]
+    steps = _manifest.all_complete_steps(root)
+    assert s in steps, (s, steps)
+    assert _manifest.load(root, s)["meta"]["members"] == [1, 2]
+    # the step the leader died inside: its marker (hence its chunks) was
+    # complete, so the takeover commit may include it — but the committer
+    # MUST have fenced past the crash lease (token incremented)
+    if 4 in steps:
+        man4 = _manifest.load(root, 4)
+        assert int(man4["fence"]) >= 2, (man4["fence"], man4["meta"])
+        assert set(man4["meta"]["members"]) in ({0, 1, 2}, {1, 2})
+
+    res2 = drill.run_drill(root, world=2, num_steps=s + 10,
+                           save_every=1000, report_tag="resume",
+                           lease_timeout=2.0, straggler_timeout=10.0,
+                           timeout=60.0)
+    assert res2["exitcodes"] == [0, 0], res2["exitcodes"]
+    _parity(res2["reports"], s)
+
+
+def test_commit_race_exactly_one_manifest(tmp_path):
+    root = str(tmp_path)
+    # every host believes it is the leader: the manifest commit lease
+    # must let exactly one win per step; the loser observes the winner's
+    # manifest and converges instead of committing a second one
+    res = drill.run_drill(root, world=2, num_steps=12, save_every=4,
+                          report_tag="race", force_leader=True,
+                          lease_timeout=2.0, straggler_timeout=10.0,
+                          timeout=60.0)
+    assert res["exitcodes"] == [0, 0], res["exitcodes"]
+    steps = _manifest.all_complete_steps(root)
+    assert steps == [4, 8, 12], steps
+    for s in steps:
+        man = _manifest.load(root, s)
+        assert man["meta"]["members"] == [0, 1], man["meta"]
+        sdir = _manifest.step_path(root, s)
+        manifests = [n for n in os.listdir(sdir)
+                     if n.startswith("manifest")]
+        assert manifests == [_manifest.MANIFEST], manifests
+    _parity(res["reports"], 0, count=12)
+
+
+def test_straggler_timeout_aborts_then_recovers(tmp_path):
+    root = str(tmp_path)
+    # rank 1 sits on its final-step ready marker past the straggler
+    # deadline: the peer's commit barrier aborts (booking
+    # mx_snapshot_failures_total{source="straggler"}, leaving NO
+    # manifest hole), and the bounded final-save retry commits once the
+    # straggler's marker finally lands
+    res = drill.run_drill(root, world=2, num_steps=6, save_every=1000,
+                          report_tag="strag",
+                          scenario={1: {"marker_delay": (6, 2.5)}},
+                          lease_timeout=1.0, straggler_timeout=1.0,
+                          timeout=60.0)
+    assert res["exitcodes"] == [0, 0], res["exitcodes"]
+    aborts = sum(rep.get("straggler_aborts") or 0
+                 for rep in res["reports"].values())
+    assert aborts >= 1, res["reports"]
+    assert 6 in _manifest.all_complete_steps(root)
+
+
+def test_hang_watchdog_flag_mode():
+    # action="flag" turns the process-killing watchdog into an in-test
+    # observable: a drain that outlives the deadline trips it
+    with HangWatchdog(0.05, what="drain", action="flag") as wd:
+        import time
+        time.sleep(0.2)
+    assert wd.fired
+    # and a fast exit does not
+    with HangWatchdog(5.0, what="drain", action="flag") as wd2:
+        pass
+    assert not wd2.fired

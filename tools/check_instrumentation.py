@@ -172,6 +172,17 @@ METHOD_CHECKS = [
      {"record_span"}, "call"),
     ("telemetry/__init__.py", None, "record_step",
      {"watch_step_time"}, "call"),
+    # multi-host control plane (ISSUE 15): the group view must book the
+    # live-host gauge + generation epoch on every observation, every
+    # commit-barrier wait must land in the histogram, and a hang-watchdog
+    # firing (an incident by definition) must be counted before the
+    # process exits
+    ("elastic/coordinator.py", "Coordinator", "view",
+     {"record_hosts_live"}, "call"),
+    ("elastic/coordinator.py", "Coordinator", "commit_snapshot",
+     {"record_commit_barrier"}, "call"),
+    ("elastic/coordinator.py", "HangWatchdog", "_fire",
+     {"record_hang_watchdog"}, "call"),
 ]
 
 # (relative file, required substring, rationale)
@@ -290,6 +301,26 @@ TEXT_CHECKS = [
     ("telemetry/__init__.py", "def statusz",
      "the registry must expose the statusz snapshot the debug endpoints "
      "serve (config fingerprint, cache stats, queue depth, recorder tail)"),
+    # multi-host control plane (ISSUE 15)
+    ("telemetry/__init__.py", "mx_hosts_live",
+     "the registry must export the live-host gauge (below fleet size = "
+     "a dead host; the first page of a multi-host incident)"),
+    ("telemetry/__init__.py", "mx_coordinator_generation",
+     "the registry must export the membership generation epoch (climbing "
+     "without deploys = hosts flapping on lease expiry)"),
+    ("telemetry/__init__.py", "mx_commit_barrier_seconds",
+     "the registry must export the cross-host commit-barrier histogram "
+     "(p99 near the straggler deadline predicts the next abort)"),
+    ("telemetry/__init__.py", "mx_hang_watchdog_fires_total",
+     "the registry must export the hang-watchdog counter (every "
+     "increment is an incident with a flight-recorder dump attached)"),
+    ("elastic/coordinator.py", '"straggler"',
+     "a straggler abort must book mx_snapshot_failures_total under its "
+     "own source label — an aborted barrier that books nothing is "
+     "indistinguishable from a hang"),
+    ("telemetry/__init__.py", '"coordinator"',
+     "statusz must carry the coordinator group view (generation, "
+     "live/dead, leader) next to the config fingerprint"),
 ]
 
 
