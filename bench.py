@@ -966,6 +966,88 @@ def bench_pipeline(steps, warmup):
         if a["temp_memory_bytes"] and b["temp_memory_bytes"]:
             extra[sched]["temp_memory_growth_2x"] = round(
                 b["temp_memory_bytes"] / a["temp_memory_bytes"], 3)
+
+    # -- partitioned-tp A/B lane (ISSUE 16) ---------------------------------
+    # weight-sharded tp (per-step full-weight all-gather) vs compute-
+    # partitioned tp (activation collectives only) vs partitioned +
+    # sequence parallelism, all on a pp=2 x tp mesh under 1F1B. The
+    # headline columns: per-chip weight-gather bytes (the >= tp-factor
+    # reduction claim — the gather op vanishes outright) and the compiled
+    # peak/temp activation memory (sequence parallelism shrinks the
+    # LN/dropout/residual stash by ~tp in SP regions).
+    tp = int(os.environ.get("BENCH_PP_TP", 2))
+    if tp > 1 and len(devs) >= 2 * tp:
+        from mxnet_tpu.parallel import shard_params_megatron
+        from mxnet_tpu.recipes.moe import token_cross_entropy
+        mesh_tp = make_mesh({"pp": 2, "tp": tp}, devices=devs[:2 * tp])
+
+        def run_tp(mode, sp):
+            mx.random.seed(0)
+            net = BertModel(vocab_size=vocab, num_layers=layers, units=units,
+                            hidden_size=4 * units,
+                            num_heads=max(units // 64, tp), max_length=seq,
+                            dropout=0.0)
+            with mx.cpu():
+                net.initialize(ctx=mx.cpu())
+                net(nd.zeros((1, seq), ctx=mx.cpu(), dtype="int32"))
+            kw = {}
+            if mode == "sharded":
+                shard_params_megatron(net, axis="tp")
+            else:
+                kw = {"tp_mode": "partitioned", "sequence_parallel": sp}
+            tr = PipelineTrainer(net, token_cross_entropy, optimizer="adamw",
+                                 optimizer_params={"learning_rate": 1e-4},
+                                 mesh=mesh_tp, tp_axis="tp",
+                                 num_microbatch=M, schedule="1f1b", **kw)
+            B = mb * M
+            x = nd.array(rs.randint(0, vocab, (B, seq)), dtype="int32")
+            y = nd.array(rs.randint(0, vocab, (B, seq)), dtype="int32")
+            pending = None
+            for _ in range(max(warmup, 1)):
+                pending = tr.step(x, y)
+            tr.drain()
+            telem.reset()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                pending = tr.step(x, y)
+            tr.drain()
+            dt = time.perf_counter() - t0
+            bytes_c = telem.get_metric("mx_comm_bytes_total")
+            cost = next(iter(tr._program._costs.values()), {}) \
+                if tr._program._costs else {}
+            out = {
+                "step_ms": round(dt / steps * 1e3, 3),
+                "weight_gather_bytes_per_step": int(
+                    (bytes_c.get("tp_weight_all_gather", "mesh")
+                     if bytes_c else 0) // steps),
+                "tp_lane_bytes_per_step": int(
+                    telem.comm_axis_bytes("tp") // steps),
+                "sp_lane_bytes_per_step": int(
+                    telem.comm_axis_bytes("sp") // steps),
+                "temp_memory_bytes": cost.get("temp_memory_bytes"),
+                "peak_memory_bytes": cost.get("peak_memory_bytes"),
+                "final_loss": round(float(pending), 4),
+            }
+            del tr, net, x, y
+            gc.collect()
+            return out
+
+        lane = {"tp": tp}
+        for tag, mode, sp in (("weight_sharded", "sharded", False),
+                              ("partitioned", "partitioned", False),
+                              ("partitioned_sp", "partitioned", True)):
+            lane[tag] = run_tp(mode, sp)
+        wg_a = lane["weight_sharded"]["weight_gather_bytes_per_step"]
+        wg_b = lane["partitioned"]["weight_gather_bytes_per_step"]
+        lane["weight_gather_eliminated"] = wg_b == 0 and wg_a > 0
+        lane["weight_gather_reduction_factor"] = (
+            round(wg_a / wg_b, 2) if wg_b else None)  # None = infinite
+        tm_ns, tm_sp = (lane["partitioned"]["temp_memory_bytes"],
+                        lane["partitioned_sp"]["temp_memory_bytes"])
+        if tm_ns and tm_sp:
+            lane["sp_temp_memory_ratio"] = round(tm_sp / tm_ns, 3)
+        extra["partitioned_tp"] = lane
+
     return {
         "metric": "pipeline_1f1b_step_time_ratio",
         "value": round(extra["1f1b"]["step_ms"]

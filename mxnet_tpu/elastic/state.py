@@ -343,6 +343,13 @@ def _capture_pp(trainer) -> Dict[str, Any]:
         "stack_order": list(trainer._stack_order),
         "zero": trainer._zero,
         "dp_degree": trainer.n_dp,
+        # partitioned tp stores view-shaped GLOBALS (tp-degree-independent:
+        # a tp=2 snapshot restores onto a tp=4 trainer), but the leaf
+        # SHAPES differ from the sharded/no-tp layout — kind-checked on
+        # install. ZeRO carries are per-tp-rank and pin the degree.
+        "tp_mode": getattr(trainer, "tp_mode", "sharded"),
+        "tp_degree": trainer.n_tp,
+        "sequence_parallel": getattr(trainer, "sequence_parallel", False),
     })
     if trainer._zero:
         meta["zero_plan_e"] = [_bucket_dict(b) for b in trainer._zplan_e]
@@ -480,6 +487,13 @@ def _install_pp(trainer, meta, fetch, names):
     _check(bool(meta.get("zero")) == bool(trainer._zero),
            "snapshot and trainer disagree on zero_update; construct the "
            "resuming trainer with the same zero_update setting")
+    saved_mode = meta.get("tp_mode", "sharded")
+    have_mode = getattr(trainer, "tp_mode", "sharded")
+    _check(saved_mode == have_mode,
+           f"snapshot was taken under tp_mode={saved_mode!r} but the "
+           f"resuming trainer uses tp_mode={have_mode!r}; partitioned "
+           "snapshots store blocked view-shaped leaves that only a "
+           "partitioned trainer can install (and vice versa)")
     old_order = meta.get("stack_order") or list(range(trainer.n_layers))
     perm = _stack_perm(old_order, trainer._stack_order)
     same_pp = (int(meta.get("n_stages", -1)) == trainer.n_stages
@@ -509,6 +523,11 @@ def _install_pp(trainer, meta, fetch, names):
                f"v{meta.get('virtual_stages')}, trainer pp="
                f"{trainer.n_stages}xv{trainer.virtual_stages}); resume on "
                "the saved pipeline layout, or save without zero_update")
+        _check(int(meta.get("tp_degree", 1)) == trainer.n_tp,
+               "ZeRO optimizer state under partitioned tp is laid out per "
+               f"tp rank and cannot reshard across tp degrees (saved "
+               f"tp={meta.get('tp_degree', 1)}, trainer tp={trainer.n_tp}); "
+               "resume on the saved tp degree, or save without zero_update")
         olds = {t: [_bucket_from(d) for d in meta.get(f"zero_plan_{t}", [])]
                 for t in ("e", "s", "h")}
         trainer._opt_e = _restore_zero_carry(

@@ -138,6 +138,41 @@ class MoETransformerLM(HybridBlock):
         x = self.cells(x)
         return self.decoder(self.ln(x))
 
+    def pipeline_split(self):
+        """(embed, cells, head) for parallel.PipelineTrainer. The wrappers
+        re-register this model's own child blocks, so parameters are
+        shared and sync() writes straight back into this model."""
+        cells = [self.cells[i] for i in range(len(self.cells))]
+        return _MoEEmbedStage(self), cells, _MoEHeadStage(self)
+
+
+class _MoEEmbedStage(HybridBlock):
+    """Pipeline stage 0 body: MoETransformerLM's embedding section."""
+
+    def __init__(self, lm, **kwargs):
+        super().__init__(**kwargs)
+        self.word_embed = lm.word_embed
+        self.pos_embed = lm.pos_embed
+        self.embed_ln = lm.embed_ln
+
+    def hybrid_forward(self, F, token_ids):
+        pos = _position_ids(F, token_ids)
+        x = self.word_embed(token_ids) \
+            + self.pos_embed(pos).expand_dims(axis=0)
+        return self.embed_ln(x)
+
+
+class _MoEHeadStage(HybridBlock):
+    """Pipeline last-stage tail: final LN + LM decoder."""
+
+    def __init__(self, lm, **kwargs):
+        super().__init__(**kwargs)
+        self.ln = lm.ln
+        self.decoder = lm.decoder
+
+    def hybrid_forward(self, F, x):
+        return self.decoder(self.ln(x))
+
 
 def moe_transformer_tiny(vocab_size=1024, num_experts=4, top_k=2,
                          capacity_factor=2.0, dense_ffn=False, **kw):

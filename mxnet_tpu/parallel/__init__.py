@@ -5,8 +5,12 @@ parameter server — src/kvstore/) collapses here into XLA collectives driven
 by sharding annotations:
 
   - data parallel:   batch sharded over 'dp'; grad allreduce inserted by XLA
-  - tensor parallel: weight matrices sharded over 'tp' (Megatron col/row)
-  - sequence/context parallel: ring attention over 'sp' via ppermute
+  - tensor parallel: weight matrices sharded over 'tp' (Megatron col/row);
+    tp_mode='partitioned' runs the compute partitioned with manual
+    activation collectives instead of gathering weights (megatron.py)
+  - sequence/context parallel: ring attention over 'sp' via ppermute;
+    sequence_parallel=True seq-shards the LN/dropout/residual regions
+    between the partitioned matmuls
   - pipeline:        layer stages over 'pp' with microbatch scan
   - multi-host:      same collectives; DCN is just an outer mesh axis
 
@@ -18,7 +22,11 @@ from .data_parallel import DataParallelTrainer, functional_optimizer
 from .ring_attention import ring_attention, blockwise_attention
 from .tensor_parallel import (column_parallel_spec, row_parallel_spec,
                               shard_params_megatron, tp_shard_dim,
-                              gather_tp, slice_tp)
+                              gather_tp, slice_tp, shard_rules, apply_rules,
+                              DEFAULT_RULES)
+from .megatron import (copy_to_tp, reduce_from_tp, gather_from_sp,
+                       scatter_to_sp, vocab_parallel_embedding,
+                       vocab_parallel_cross_entropy)
 from .pipeline import (pipeline_spec, pipeline_apply, gpipe_schedule,
                        schedule_1f1b, PipelineTrainer)
 from .step_program import StepProgram
@@ -33,6 +41,9 @@ __all__ = ["make_mesh", "local_mesh", "replicate", "shard_batch", "P",
            "functional_optimizer", "ring_attention", "blockwise_attention",
            "column_parallel_spec", "row_parallel_spec", "shard_params_megatron",
            "tp_shard_dim", "gather_tp", "slice_tp",
+           "shard_rules", "apply_rules", "DEFAULT_RULES",
+           "copy_to_tp", "reduce_from_tp", "gather_from_sp", "scatter_to_sp",
+           "vocab_parallel_embedding", "vocab_parallel_cross_entropy",
            "pipeline_spec", "pipeline_apply", "gpipe_schedule",
            "schedule_1f1b", "PipelineTrainer", "StepProgram",
            "moe_ffn", "expert_parallel_moe", "topk_gating",

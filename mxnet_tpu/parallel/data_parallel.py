@@ -713,9 +713,9 @@ class DataParallelTrainer:
                 self._zero_plan, self._dp_degree)
         nb = len(self._zero_plan)
         _telem.record_comm("reduce_scatter", self._rs_bytes * steps,
-                           store="mesh", calls=steps * nb)
+                           store="mesh", calls=steps * nb, axis="dp")
         _telem.record_comm("all_gather", self._ag_bytes * steps,
-                           store="mesh", calls=steps * nb)
+                           store="mesh", calls=steps * nb, axis="dp")
 
     def _record_overlap_telemetry(self, steps):
         """Overlap-mode collective accounting: the per-bucket collectives
@@ -739,14 +739,14 @@ class DataParallelTrainer:
             nb = len(self._zero_plan)
             _telem.record_comm("reduce_scatter", self._rs_bytes * steps,
                                store="mesh", calls=steps * nb,
-                               overlapped=True)
+                               overlapped=True, axis="dp")
             _telem.record_comm("all_gather", self._ag_bytes * steps,
-                               store="mesh", calls=steps * nb)
+                               store="mesh", calls=steps * nb, axis="dp")
         else:
             nb = len(self._overlap_buckets)
             _telem.record_comm("allreduce", self._rs_bytes * steps,
                                store="mesh", calls=steps * nb,
-                               overlapped=True)
+                               overlapped=True, axis="dp")
 
     def _opt_state_replica_bytes(self) -> int:
         if self._opt_bytes is None:
@@ -780,7 +780,7 @@ class DataParallelTrainer:
             else:
                 _telem.record_comm("allreduce",
                                    self._grad_allreduce_bytes() * steps,
-                                   store="mesh", calls=steps)
+                                   store="mesh", calls=steps, axis="dp")
         _telem.record_optimizer_state(self._opt_state_replica_bytes(),
                                       source="data_parallel")
         # roofline ledger + aggregate flops/bytes through the ONE engine

@@ -57,6 +57,7 @@ from .. import optimizer as opt_mod
 from .. import random as _rng
 from .. import sanitize as _sanitize
 from .. import telemetry as _telem
+from . import megatron as _mg
 from . import zero as _zero
 from .mesh import current_mesh, P
 from .step_program import StepProgram
@@ -273,12 +274,24 @@ class PipelineTrainer:
                         per-stage (n_stages, padded) state sharded
                         P(pp, dp); requires dp_axis, excludes tp_axis
       - comm_dtype:     bf16/int8 wire for the zero reduce-scatter
-      - tp_axis:        leaves with Parameter.sharding specs over 'tp' are
-                        STORED sharded (1/tp weight+state memory),
-                        all-gathered once per step outside the
-                        differentiated region, grads sliced back for the
-                        local update lane. Compute-partitioned (Megatron)
-                        TP stays on DataParallelTrainer's auto-sharding jit.
+      - tp_axis + tp_mode="sharded" (default): leaves with
+                        Parameter.sharding specs over 'tp' are STORED
+                        sharded (1/tp weight+state memory), all-gathered
+                        once per step outside the differentiated region,
+                        grads sliced back for the local update lane. The
+                        full weight materializes on every rank each step —
+                        layer size stays capped at one chip's HBM.
+      - tp_axis + tp_mode="partitioned": compute-partitioned (Megatron)
+                        TP inside the 1F1B tick body — weights stay
+                        sharded forever, manual activation collectives at
+                        the region boundaries (parallel/megatron.py).
+                        Composes with zero_update (the optimizer state
+                        gains a tp dim). `sequence_parallel=True`
+                        additionally shards the layernorm/dropout/residual
+                        regions along the sequence axis over the same tp
+                        device group, turning boundary psums into
+                        all_gather/psum_scatter pairs (docs/
+                        tensor_parallel.md for the full rule table).
 
     One jit computes: embed -> schedule -> head -> loss -> backward ->
     collectives -> optimizer update. `loss` must be a mean-reduction
@@ -289,7 +302,9 @@ class PipelineTrainer:
     def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
                  mesh: Optional[Mesh] = None, num_microbatch: Optional[int] = None,
                  pp_axis: str = "pp", dp_axis: Optional[str] = None,
-                 tp_axis: Optional[str] = None, dtype=None, remat: bool = True,
+                 tp_axis: Optional[str] = None, tp_mode: str = "sharded",
+                 sequence_parallel: bool = False,
+                 dtype=None, remat: bool = True,
                  schedule: Optional[str] = None, virtual_stages: int = 1,
                  zero_update: Optional[bool] = None,
                  bucket_bytes: Optional[int] = None, comm_dtype=None):
@@ -303,6 +318,19 @@ class PipelineTrainer:
             if dp_axis else 1
         self.n_tp = require_axis(self.mesh, tp_axis, "tensor parallelism") \
             if tp_axis else 1
+        if tp_mode not in ("sharded", "partitioned"):
+            raise MXNetError(f"unknown tp_mode {tp_mode!r}; use 'sharded' "
+                             "(per-step weight gather) or 'partitioned' "
+                             "(compute-partitioned Megatron collectives)")
+        if tp_mode == "partitioned" and tp_axis is None:
+            raise MXNetError("tp_mode='partitioned' requires a tp_axis")
+        self.tp_mode = tp_mode
+        self._partitioned = tp_axis is not None and tp_mode == "partitioned"
+        self.sequence_parallel = bool(sequence_parallel)
+        if self.sequence_parallel and not self._partitioned:
+            raise MXNetError(
+                "sequence_parallel shards the non-matmul regions over the "
+                "tp device group; it requires tp_mode='partitioned'")
         self.remat = remat
 
         if schedule is None:
@@ -317,6 +345,11 @@ class PipelineTrainer:
         if self.virtual_stages > 1 and schedule != "1f1b":
             raise MXNetError("virtual_stages (interleaved schedule) "
                              "requires schedule='1f1b'")
+        if self._partitioned and schedule != "1f1b":
+            raise MXNetError(
+                "tp_mode='partitioned' runs its manual collectives inside "
+                "the 1F1B tick body; schedule='gpipe' (grad-of-scan) only "
+                "supports weight-sharded tp")
 
         if not hasattr(net, "pipeline_split"):
             raise MXNetError(
@@ -363,8 +396,22 @@ class PipelineTrainer:
                         "layers but not others; freeze a stacked leaf "
                         "uniformly across cells")
 
+        # compute-partitioned TP: structural layer plans decide each leaf's
+        # layout (megatron.plan_*); Parameter.sharding specs are NOT read
+        # (they may carry auto-sharding specs naming other axes)
+        if self._partitioned:
+            self._eplan = _mg.plan_embed(embed, self._embed_plist, self.n_tp)
+            self._cplan = _mg.plan_cell(cells[0], ref, self.n_tp)
+            self._hplan = _mg.plan_head(head, self._head_plist, self.n_tp)
+            self._lay_e = self._eplan.layouts
+            self._lay_s = self._cplan.layouts
+            self._lay_h = self._hplan.layouts
+            self._tp_e = [_mg.view_shard_dim(l) for l in self._lay_e]
+            self._tp_s = [_mg.view_shard_dim(l) for l in self._lay_s]
+            self._tp_h = [_mg.view_shard_dim(l) for l in self._lay_h]
+            self._validate_partitioned_loss()
         # manual weight-sharded TP: which dim of each leaf is sharded
-        if tp_axis is not None:
+        elif tp_axis is not None:
             self._tp_e = [tp_shard_dim(p.sharding, tp_axis)
                           for p in self._embed_plist]
             self._tp_h = [tp_shard_dim(p.sharding, tp_axis)
@@ -426,7 +473,7 @@ class PipelineTrainer:
             from ..optimizer.optimizer import LAMB, LARS
             if isinstance(self.optimizer, (LAMB, LARS)):
                 raise MXNetError(
-                    f"weight-sharded tp does not support "
+                    f"tensor parallelism does not support "
                     f"{type(self.optimizer).__name__}: per-tensor "
                     "trust-ratio norms are wrong on tp shards")
 
@@ -448,20 +495,58 @@ class PipelineTrainer:
                 spec[dim + (1 if stacked else 0)] = tp_axis
             return NamedSharding(self.mesh, P(*spec))
 
-        self._e_sh = [_leaf_sharding(d, p._data._data.ndim, False)
-                      for p, d in zip(self._embed_plist, self._tp_e)]
-        self._h_sh = [_leaf_sharding(d, p._data._data.ndim, False)
-                      for p, d in zip(self._head_plist, self._tp_h)]
-        self._s_sh = [_leaf_sharding(d, ref[i]._data._data.ndim, True)
-                      for i, d in enumerate(self._tp_s)]
-        self._e_raw = [jax.device_put(jnp.array(p._data._data, copy=True), sh)
-                       for p, sh in zip(self._embed_plist, self._e_sh)]
-        self._h_raw = [jax.device_put(jnp.array(p._data._data, copy=True), sh)
-                       for p, sh in zip(self._head_plist, self._h_sh)]
+        # storage (VIEW) shapes: identical to the logical shapes except for
+        # partitioned leaves with blocked layouts (the fused qkv's (3C, C)
+        # stores as (3, C, C) so the tp shard dim is a plain array dim) —
+        # tp-degree-independent globals, which is what lets elastic restore
+        # reshard tp=2 -> tp=4 with a plain reinstall
+        if self._partitioned:
+            self._view_e = [
+                _mg.view_shape(p._data._data.shape, l)
+                for p, l in zip(self._embed_plist, self._lay_e)]
+            self._view_h = [
+                _mg.view_shape(p._data._data.shape, l)
+                for p, l in zip(self._head_plist, self._lay_h)]
+            self._view_s = [
+                _mg.view_shape(ref[i]._data._data.shape, l)
+                for i, l in enumerate(self._lay_s)]
+            for views, dims, plist in (
+                    (self._view_e, self._tp_e, self._embed_plist),
+                    (self._view_h, self._tp_h, self._head_plist),
+                    (self._view_s, self._tp_s, ref)):
+                for vshape, d, p in zip(views, dims, plist):
+                    if d is not None and vshape[d] % self.n_tp != 0:
+                        raise MXNetError(
+                            f"{p.name!r} partitioned dim {d} "
+                            f"({vshape[d]}) does not divide by "
+                            f"tp={self.n_tp}")
+        else:
+            self._view_e = [tuple(p._data._data.shape)
+                            for p in self._embed_plist]
+            self._view_h = [tuple(p._data._data.shape)
+                            for p in self._head_plist]
+            self._view_s = [tuple(ref[i]._data._data.shape)
+                            for i in range(len(ref))]
+        self._e_sh = [_leaf_sharding(d, len(v), False)
+                      for v, d in zip(self._view_e, self._tp_e)]
+        self._h_sh = [_leaf_sharding(d, len(v), False)
+                      for v, d in zip(self._view_h, self._tp_h)]
+        self._s_sh = [_leaf_sharding(d, len(v), True)
+                      for v, d in zip(self._view_s, self._tp_s)]
+        self._e_raw = [
+            jax.device_put(
+                jnp.array(p._data._data, copy=True).reshape(v), sh)
+            for p, v, sh in zip(self._embed_plist, self._view_e, self._e_sh)]
+        self._h_raw = [
+            jax.device_put(
+                jnp.array(p._data._data, copy=True).reshape(v), sh)
+            for p, v, sh in zip(self._head_plist, self._view_h, self._h_sh)]
         # layerwise stack in schedule order: leaf i -> (n_layers, ...)
         self._s_raw = [
-            jax.device_put(jnp.stack([self._cell_plists[m][i]._data._data
-                                      for m in self._stack_order]), sh)
+            jax.device_put(
+                jnp.stack([self._cell_plists[m][i]._data._data
+                           for m in self._stack_order])
+                .reshape((self.n_layers,) + self._view_s[i]), sh)
             for i, sh in enumerate(self._s_sh)]
         # weight-decay indices follow the optimizer's param-idx convention:
         # embed params first, then the stacked cell leaves, then head
@@ -519,6 +604,11 @@ class PipelineTrainer:
                            tuple(self._tr_h)),
                 tp_dims=(tuple(self._tp_e), tuple(self._tp_s),
                          tuple(self._tp_h)),
+                tp_mode=self.tp_mode,
+                sequence_parallel=self.sequence_parallel,
+                tp_layouts=((tuple(self._lay_e), tuple(self._lay_s),
+                             tuple(self._lay_h))
+                            if self._partitioned else None),
                 compute_dtype=str(self.compute_dtype),
                 zero=self._zero,
                 bucket_bytes=self._bucket_bytes if self._zero else None,
@@ -526,14 +616,44 @@ class PipelineTrainer:
         self._program = StepProgram(
             f"pp.step[{type(self.net).__name__}]", self._step_key_base)
 
+    def _validate_partitioned_loss(self):
+        """The partitioned head FUSES the decoder matmul into the
+        vocab-parallel cross-entropy (the full-vocab logits are never
+        materialized), so the trainer must know the loss IS mean token
+        cross-entropy — any other callable would silently compute the
+        wrong thing against the weight-sharded oracle."""
+        from ..gluon.loss import SoftmaxCrossEntropyLoss
+        lo = self.loss
+        if isinstance(lo, SoftmaxCrossEntropyLoss):
+            if (getattr(lo, "_sparse_label", True)
+                    and not getattr(lo, "_from_logits", False)
+                    and getattr(lo, "_axis", -1) in (-1,)
+                    and getattr(lo, "_weight", None) is None):
+                return
+            raise MXNetError(
+                "tp_mode='partitioned' fuses the LM head into a "
+                "vocab-parallel softmax cross-entropy; "
+                "SoftmaxCrossEntropyLoss must use sparse_label=True, "
+                "from_logits=False, axis=-1, weight=None")
+        if getattr(lo, "__name__", "") == "token_cross_entropy":
+            return
+        raise MXNetError(
+            "tp_mode='partitioned' supports mean token cross-entropy "
+            "losses only (gluon SoftmaxCrossEntropyLoss or "
+            "recipes.moe.token_cross_entropy); got "
+            f"{type(lo).__name__}")
+
     # -- ZeRO-over-dp composition -------------------------------------------
     def _validate_zero(self):
         if self.dp_axis is None:
             raise MXNetError("zero_update requires a dp_axis: the sharded "
                              "update distributes over data-parallel replicas")
-        if self.tp_axis is not None:
-            raise MXNetError("zero_update and tp_axis do not compose in "
-                             "PipelineTrainer; pick one memory-sharding axis")
+        if self.tp_axis is not None and self.tp_mode != "partitioned":
+            raise MXNetError(
+                "zero_update and weight-sharded tp_axis do not compose in "
+                "PipelineTrainer (the gathered weights would defeat the "
+                "sharded state); tp_mode='partitioned' composes — its "
+                "optimizer state gains a tp dim")
         from ..optimizer.optimizer import LAMB, LARS
         if isinstance(self.optimizer, (LAMB, LARS)):
             raise MXNetError(
@@ -549,6 +669,9 @@ class PipelineTrainer:
         LOCAL stacked shapes (identical plan on every stage) with per-stage
         state stacked into (n_stages, padded) arrays sharded P(pp, dp) —
         each (pp, dp) group holds 1/(dp) of its own stage's state."""
+        if self._partitioned:
+            self._init_zero_state_partitioned()
+            return
         dp_sh = NamedSharding(self.mesh, P(self.dp_axis))
         stg_sh = NamedSharding(self.mesh, P(self.pp_axis, self.dp_axis))
         ndp, Ld = self.n_dp, self.layers_per_stage
@@ -587,6 +710,85 @@ class PipelineTrainer:
             carry_s.append((wd_dev, state))
         self._opt_s = tuple(carry_s)
 
+    def _init_zero_state_partitioned(self):
+        """ZeRO over dp composed with compute-partitioned tp: every
+        (pp, tp) rank updates only its OWN weight shard, so the bucket
+        plans cover the tp-LOCAL view shapes and the flat state gains a
+        leading tp dim — embed/head (n_tp, padded) sharded P(tp, dp),
+        stage (n_stages, n_tp, padded) sharded P(pp, tp, dp). The wd
+        vectors depend only on the leaf index (identical across tp ranks)
+        and stay P(dp)."""
+        dp_sh = NamedSharding(self.mesh, P(self.dp_axis))
+        tp_sh = NamedSharding(self.mesh, P(self.tp_axis, self.dp_axis))
+        stg_sh = NamedSharding(
+            self.mesh, P(self.pp_axis, self.tp_axis, self.dp_axis))
+        ndp, ntp, Ld = self.n_dp, self.n_tp, self.layers_per_stage
+
+        def _local(shape, d):
+            if d is None:
+                return tuple(shape)
+            return tuple(shape[:d]) + (shape[d] // ntp,) \
+                + tuple(shape[d + 1:])
+
+        def _tp_slice(w, d, r):
+            if d is None:
+                return w
+            sz = w.shape[d] // ntp
+            return lax.slice_in_dim(w, r * sz, (r + 1) * sz, axis=d)
+
+        def _plan(params, trainables, dims, stacked=False):
+            entries = []
+            for i, (w, tr, d) in enumerate(zip(params, trainables, dims)):
+                if not (tr and jnp.issubdtype(w.dtype, jnp.floating)):
+                    continue
+                if stacked:
+                    shape = _local((Ld,) + w.shape[1:],
+                                   d + 1 if d is not None else None)
+                else:
+                    shape = _local(w.shape, d)
+                entries.append((i, shape, w.dtype))
+            return _zero.plan_buckets(entries, ndp, self._bucket_bytes)
+
+        self._zplan_e = _plan(self._e_raw, self._tr_e, self._tp_e)
+        self._zplan_h = _plan(self._h_raw, self._tr_h, self._tp_h)
+
+        def _flat_tp(plan, params, dims, wds):
+            carry = []
+            for b in plan:
+                rows = [_zero.flatten_bucket(
+                            b, [_tp_slice(w, d, r)
+                                for w, d in zip(params, dims)])
+                        for r in range(ntp)]
+                w_glob = jax.device_put(jnp.stack(rows), tp_sh)
+                state = opt_mod.init_functional_state(self._init_fn, w_glob,
+                                                      sharding=tp_sh)
+                wd_dev = jax.device_put(_zero.wd_vector(b, wds), dp_sh)
+                carry.append((wd_dev, state))
+            return tuple(carry)
+
+        self._opt_e = _flat_tp(self._zplan_e, self._e_raw, self._tp_e,
+                               self._wd_e)
+        self._opt_h = _flat_tp(self._zplan_h, self._h_raw, self._tp_h,
+                               self._wd_h)
+        self._zplan_s = _plan(self._s_raw, self._tr_s, self._tp_s,
+                              stacked=True)
+        carry_s = []
+        for b in self._zplan_s:
+            rows = [jnp.stack([
+                        _zero.flatten_bucket(
+                            b, [_tp_slice(w[s * Ld:(s + 1) * Ld],
+                                          d + 1 if d is not None else None,
+                                          r)
+                                for w, d in zip(self._s_raw, self._tp_s)])
+                        for r in range(ntp)])
+                    for s in range(self.n_stages)]
+            w_glob = jax.device_put(jnp.stack(rows), stg_sh)
+            state = opt_mod.init_functional_state(self._init_fn, w_glob,
+                                                  sharding=stg_sh)
+            wd_dev = jax.device_put(_zero.wd_vector(b, self._wd_s), dp_sh)
+            carry_s.append((wd_dev, state))
+        self._opt_s = tuple(carry_s)
+
     # ------------------------------------------------------------------
     def _loss_raw(self, pred_raw, label_raw):
         from .data_parallel import DataParallelTrainer
@@ -608,6 +810,13 @@ class PipelineTrainer:
         sched, remat = self.schedule, self.remat
         zero, ndp, comm = self._zero, self.n_dp, self._comm_dtype
         cdt = self.compute_dtype
+        part, ntp = self._partitioned, self.n_tp
+        if part:
+            cfg = _mg.PartitionConfig(
+                axis=tpax, n_tp=ntp,
+                sp=self.sequence_parallel and ntp > 1)
+            eplan, cplan, hplan = self._eplan, self._cplan, self._hplan
+            lay_e, lay_s, lay_h = self._lay_e, self._lay_s, self._lay_h
 
         def _low(a):
             if cdt is not None and jnp.issubdtype(a.dtype, jnp.floating):
@@ -638,8 +847,10 @@ class PipelineTrainer:
 
             # weight-sharded tp leaves: gather to full size ONCE per step,
             # OUTSIDE the differentiated region — grads w.r.t. the gathered
-            # arrays come out rank-identical, no gradient collective needed
-            if tpax is not None:
+            # arrays come out rank-identical, no gradient collective needed.
+            # (partitioned tp never gathers: the programs below consume the
+            # local view shards directly)
+            if tpax is not None and not part:
                 ep_f = [gather_tp(w, d, tpax) if d is not None else w
                         for w, d in zip(eparams, tp_e)]
                 hp_f = [gather_tp(w, d, tpax) if d is not None else w
@@ -649,33 +860,67 @@ class PipelineTrainer:
             else:
                 ep_f, sp_f, hp_f = eparams, sparams, hparams
 
-            def stage_fn(params_local, h, tick):
-                # fold (tick, layer) so each microbatch draws fresh dropout
-                # masks — tick advances per microbatch in the schedule
-                kt = jax.random.fold_in(kk, tick)
-                low = [_low(q) for q in params_local]
-                nloc = params_local[0].shape[0]
+            if part:
+                def stage_fn(params_local, h, tick):
+                    # same (tick, layer) key schedule as the oracle path so
+                    # dropout draws line up microbatch-for-microbatch
+                    kt = jax.random.fold_in(kk, tick)
+                    low = [_low(q) for q in params_local]
+                    nloc = params_local[0].shape[0]
 
-                def cell_body(hc, xs):
-                    lp, li = xs
-                    klayer = jax.random.key_data(jax.random.fold_in(kt, li))
-                    return _no_aux(cell_apply(klayer, lp, hc), "cell"), None
-                out, _ = lax.scan(cell_body, h, (low, jnp.arange(nloc)))
-                return out
+                    def cell_body(hc, xs):
+                        lp, li = xs
+                        klayer = jax.random.fold_in(kt, li)
+                        return _mg.cell_forward(cplan, cfg, lp, hc,
+                                                klayer), None
+                    out, _ = lax.scan(cell_body, h, (low, jnp.arange(nloc)))
+                    return out
+            else:
+                def stage_fn(params_local, h, tick):
+                    # fold (tick, layer) so each microbatch draws fresh
+                    # dropout masks — tick advances per microbatch in the
+                    # schedule
+                    kt = jax.random.fold_in(kk, tick)
+                    low = [_low(q) for q in params_local]
+                    nloc = params_local[0].shape[0]
+
+                    def cell_body(hc, xs):
+                        lp, li = xs
+                        klayer = jax.random.key_data(
+                            jax.random.fold_in(kt, li))
+                        return _no_aux(cell_apply(klayer, lp, hc),
+                                       "cell"), None
+                    out, _ = lax.scan(cell_body, h, (low, jnp.arange(nloc)))
+                    return out
 
             if sched == "1f1b":
-                def embed_mb(ep, xm, m):
-                    k_e = jax.random.key_data(jax.random.fold_in(
-                        jax.random.fold_in(kk, 10_000), m))
-                    return _no_aux(embed_apply(k_e, [_low(p) for p in ep],
-                                               xm), "embed block")
+                if part:
+                    def embed_mb(ep, xm, m):
+                        k_e = jax.random.fold_in(
+                            jax.random.fold_in(kk, 10_000), m)
+                        return _mg.embed_forward(
+                            eplan, cfg, [_low(p) for p in ep], xm, k_e)
 
-                def head_loss_mb(hp, h, ym, m):
-                    k_h = jax.random.key_data(jax.random.fold_in(
-                        jax.random.fold_in(kk, 10_001), m))
-                    logits = _no_aux(head_apply(k_h, [_low(p) for p in hp],
-                                                h), "head block")
-                    return loss_raw(logits, ym)
+                    def head_loss_mb(hp, h, ym, m):
+                        k_h = jax.random.fold_in(
+                            jax.random.fold_in(kk, 10_001), m)
+                        return _mg.head_loss_forward(
+                            hplan, cfg, [_low(p) for p in hp], h, ym, k_h)
+                else:
+                    def embed_mb(ep, xm, m):
+                        k_e = jax.random.key_data(jax.random.fold_in(
+                            jax.random.fold_in(kk, 10_000), m))
+                        return _no_aux(embed_apply(k_e,
+                                                   [_low(p) for p in ep],
+                                                   xm), "embed block")
+
+                    def head_loss_mb(hp, h, ym, m):
+                        k_h = jax.random.key_data(jax.random.fold_in(
+                            jax.random.fold_in(kk, 10_001), m))
+                        logits = _no_aux(head_apply(k_h,
+                                                    [_low(p) for p in hp],
+                                                    h), "head block")
+                        return loss_raw(logits, ym)
 
                 lsum, ge, gs, gh = schedule_1f1b(
                     embed_mb, stage_fn, head_loss_mb, ep_f, sp_f, hp_f,
@@ -725,7 +970,7 @@ class PipelineTrainer:
                 ge = [lax.pmean(g, dpax) for g in ge]
                 gs = [lax.pmean(g, dpax) for g in gs]
                 gh = [lax.pmean(g, dpax) for g in gh]
-            if tpax is not None:
+            if tpax is not None and not part:
                 # grads are rank-identical over tp; each rank updates its
                 # own weight shard from its slice — no collective
                 ge = [slice_tp(g, d, tpax) if d is not None else g
@@ -734,15 +979,35 @@ class PipelineTrainer:
                       for g, d in zip(gh, tp_h)]
                 gs = [slice_tp(g, d + 1, tpax) if d is not None else g
                       for g, d in zip(gs, tp_s)]
+            elif part and ntp > 1:
+                # partial-sum convention (megatron.py docstring): each
+                # rank's grad for a REPLICATED leaf is a partial term; one
+                # psum over tp completes it. tp-sharded leaves' grads are
+                # already the exact local shard — no collective. This runs
+                # OUTSIDE the differentiated region, so plain psum is safe.
+                ge = [lax.psum(g, tpax) if l is None else g
+                      for g, l in zip(ge, lay_e)]
+                gh = [lax.psum(g, tpax) if l is None else g
+                      for g, l in zip(gh, lay_h)]
+                gs = [lax.psum(g, tpax) if l is None else g
+                      for g, l in zip(gs, lay_s)]
 
             if zero:
                 pos = lax.axis_index(dpax)
 
-                def zupd(plan, grads, params, carry, stage_state):
+                def zupd(plan, grads, params, carry, lead):
+                    # `lead` = number of leading singleton dims carried by
+                    # the optimizer-state leaves relative to the plan's flat
+                    # buckets: stage states carry the per-stage dim, and the
+                    # partitioned-TP variant adds a tp-rank dim in front of
+                    # everything (state was built per tp rank over LOCAL view
+                    # shapes). Strip them for the update, re-add after.
                     new_p, new_c = list(params), []
                     for b, (wd_vec, st) in zip(plan, carry):
-                        stl = jax.tree_util.tree_map(
-                            lambda a: a[0], st) if stage_state else st
+                        stl = st
+                        for _ in range(lead):
+                            stl = jax.tree_util.tree_map(
+                                lambda a: a[0], stl)
                         flat_g = _zero.flatten_bucket(b, grads)
                         g_sh = _zero.reduce_scatter_bucket(
                             flat_g, dpax, ndp, comm) / ndp
@@ -754,18 +1019,19 @@ class PipelineTrainer:
                             w2.astype(w_sh.dtype), dpax)
                         for i, arr in _zero.unflatten_bucket(b, full):
                             new_p[i] = arr.astype(params[i].dtype)
-                        if stage_state:
+                        for _ in range(lead):
                             s2 = jax.tree_util.tree_map(
                                 lambda a: a[None], s2)
                         new_c.append((wd_vec, s2))
                     return new_p, tuple(new_c)
 
+                lead_eh = 1 if part else 0
                 eparams, opt_e = zupd(self._zplan_e, ge, eparams, opt_e,
-                                      False)
+                                      lead_eh)
                 hparams, opt_h = zupd(self._zplan_h, gh, hparams, opt_h,
-                                      False)
+                                      lead_eh)
                 sparams, opt_s = zupd(self._zplan_s, gs, sparams, opt_s,
-                                      True)
+                                      lead_eh + 1)
             else:
                 def upd(grads, params, states, wds, trainables):
                     new_p, new_s = [], []
@@ -788,7 +1054,22 @@ class PipelineTrainer:
         e_in = [sh.spec for sh in self._e_sh]
         s_in = [sh.spec for sh in self._s_sh]
         h_in = [sh.spec for sh in self._h_sh]
-        if zero:
+        if zero and self._partitioned:
+            # partitioned state leaves carry a leading tp-rank dim (plans
+            # ran over tp-LOCAL view shapes); wd vectors stay per-dp-shard
+            opt_e_in = tuple(
+                (P(dpax), jax.tree_util.tree_map(
+                    lambda _: P(tpax, dpax), st))
+                for (_, st) in self._opt_e)
+            opt_h_in = tuple(
+                (P(dpax), jax.tree_util.tree_map(
+                    lambda _: P(tpax, dpax), st))
+                for (_, st) in self._opt_h)
+            opt_s_in = tuple(
+                (P(dpax), jax.tree_util.tree_map(
+                    lambda _: P(ppax, tpax, dpax), st))
+                for (_, st) in self._opt_s)
+        elif zero:
             opt_e_in = tuple(
                 (P(dpax), jax.tree_util.tree_map(lambda _: P(dpax), st))
                 for (_, st) in self._opt_e)
@@ -820,6 +1101,11 @@ class PipelineTrainer:
         if B % (M * self.n_dp) != 0:
             raise MXNetError(
                 f"batch {B} must divide by num_microbatch*dp = {M}*{self.n_dp}")
+        if (self._partitioned and self.sequence_parallel and self.n_tp > 1
+                and xr.ndim >= 2 and xr.shape[1] % self.n_tp != 0):
+            raise MXNetError(
+                f"sequence_parallel shards the sequence axis over tp: "
+                f"seq_len {xr.shape[1]} must divide by n_tp={self.n_tp}")
         xr = xr.reshape((M, B // M) + xr.shape[1:])
         yr = yr.reshape((M, B // M) + yr.shape[1:])
         sig = (xr.shape, str(xr.dtype), yr.shape, str(yr.dtype))
@@ -877,6 +1163,12 @@ class PipelineTrainer:
             itemsize = self.compute_dtype.itemsize \
                 if self.compute_dtype is not None else h.dtype.itemsize
             act_local = int(_np.prod(h.shape)) // self.n_dp * itemsize
+            if self._partitioned and self.sequence_parallel and self.n_tp > 1:
+                # the residual stream crossing stage boundaries is
+                # seq-sharded over tp in SP mode — each ppermute hop moves
+                # a T/tp slice (the peak-activation-memory win shows up on
+                # the wire too)
+                act_local //= self.n_tp
             nv = self.n_stages * self.virtual_stages
             M = self.num_microbatch
             hops = M + 2 * (nv - 1) if self.schedule == "1f1b" \
@@ -884,6 +1176,47 @@ class PipelineTrainer:
             st = (act_local * self.virtual_stages * 2 * hops, 2 * hops)
             self._comm_cache[sig] = st
         return st
+
+    def _record_partitioned_tp_telemetry(self, sig):
+        """Per-step activation-collective volume of compute-partitioned TP
+        (parallel/megatron.py). Non-SP books psums at region exits/entries
+        (axis='tp'); SP books the all_gather/psum_scatter boundary pairs
+        (axis='sp' — they shard/unshard the sequence axis). Ring estimate:
+        (tp-1)/tp of the full activation per collective; shapes from an
+        abstract eval of the embed, cached per signature."""
+        st = self._comm_cache.get(("tp", sig))
+        if st is None:
+            x_shape, x_dtype = sig[0], sig[1]
+            out, _ = jax.eval_shape(
+                self._embed_apply,
+                jax.ShapeDtypeStruct((2,), _np.uint32),
+                [jax.ShapeDtypeStruct(w.shape, w.dtype)
+                 for w in self._e_raw],
+                jax.ShapeDtypeStruct(x_shape[1:], x_dtype))
+            h = out if not isinstance(out, tuple) else out[0]
+            itemsize = self.compute_dtype.itemsize \
+                if self.compute_dtype is not None else h.dtype.itemsize
+            act_full = int(_np.prod(h.shape)) // self.n_dp * itemsize
+            wire = act_full * (self.n_tp - 1) // self.n_tp
+            M = self.num_microbatch
+            L = self.n_layers
+            if self.sequence_parallel:
+                # each region boundary is an all_gather (enter) +
+                # psum_scatter (exit) pair, and autodiff mirrors each as
+                # its dual: 2L+1 region boundaries (2 per cell, embed exit
+                # + head entry share one), ×2 for fwd+bwd
+                calls = M * (2 * L + 1) * 2
+                st = (("tp_act_all_gather", wire * calls, calls, "sp"),
+                      ("tp_act_psum_scatter", wire * calls, calls, "sp"))
+            else:
+                # per cell: reduce_from_tp fwd psum ×2 regions +
+                # copy_to_tp bwd psum ×2 regions; +2 for embed exit psum
+                # and the head entry's bwd psum
+                calls = M * (4 * L + 2)
+                st = (("tp_act_psum", wire * calls, calls, "tp"),)
+            self._comm_cache[("tp", sig)] = st
+        for op, nbytes, calls, ax in st:
+            _telem.record_comm(op, nbytes, store="mesh", calls=calls, axis=ax)
 
     def _record_zero_telemetry(self):
         if self._rs_bytes is None:
@@ -893,9 +1226,9 @@ class PipelineTrainer:
             self._ag_bytes = _zero.all_gather_wire_bytes(plans, self.n_dp)
         nb = len(self._zplan_e) + len(self._zplan_s) + len(self._zplan_h)
         _telem.record_comm("reduce_scatter", self._rs_bytes, store="mesh",
-                           calls=nb)
+                           calls=nb, axis="dp")
         _telem.record_comm("all_gather", self._ag_bytes, store="mesh",
-                           calls=nb)
+                           calls=nb, axis="dp")
 
     def _opt_state_replica_bytes(self) -> int:
         if self._opt_bytes is None:
@@ -915,20 +1248,27 @@ class PipelineTrainer:
             # ppermute rings + the embed/head grad psum over 'pp'
             pp_bytes, pp_calls = self._ppermute_stats(sig)
             _telem.record_comm("ppermute", pp_bytes, store="mesh",
-                               calls=pp_calls)
+                               calls=pp_calls, axis="pp")
             rep_bytes = sum(int(w.nbytes) for w in
                             self._e_raw + self._h_raw)
-            _telem.record_comm("pipeline_grad_psum", rep_bytes, store="mesh")
+            _telem.record_comm("pipeline_grad_psum", rep_bytes, store="mesh",
+                               axis="pp")
         if self._zero:
             self._record_zero_telemetry()
-        if self.tp_axis is not None and self.n_tp > 1:
+        if self.tp_axis is not None and self.n_tp > 1 and not self._partitioned:
             # per-step weight all-gather of the tp-sharded leaves
             # (ring estimate: (tp-1)/tp of the full footprint)
             ag = sum(int(w.nbytes) * (self.n_tp - 1) // self.n_tp
                      for w, d in zip(self._e_raw + self._s_raw + self._h_raw,
                                      self._tp_e + self._tp_s + self._tp_h)
                      if d is not None)
-            _telem.record_comm("tp_weight_all_gather", ag, store="mesh")
+            _telem.record_comm("tp_weight_all_gather", ag, store="mesh",
+                               axis="tp")
+        elif self._partitioned and self.n_tp > 1:
+            # partitioned mode NEVER gathers weights: its collectives move
+            # activations only. Booking them under a separate op/axis lane
+            # is what lets tests assert "no weight gather" from the ledger.
+            self._record_partitioned_tp_telemetry(sig)
         _telem.record_optimizer_state(self._opt_state_replica_bytes(),
                                       source="pipeline")
         # roofline ledger + aggregate flops/bytes through the one engine
@@ -952,6 +1292,18 @@ class PipelineTrainer:
         device-side views — one (lazy) transfer per leaf at most, never a
         host round-trip per layer."""
         self.drain()
+        if self._partitioned:
+            # view-shaped storage (blocked qkv etc.) folds back to the
+            # Parameters' logical shapes
+            for p, w in zip(self._embed_plist, self._e_raw):
+                p._data._set_data(w.reshape(p.shape))
+            for p, w in zip(self._head_plist, self._h_raw):
+                p._data._set_data(w.reshape(p.shape))
+            for i, w in enumerate(self._s_raw):
+                for k, m in enumerate(self._stack_order):
+                    p = self._cell_plists[m][i]
+                    p._data._set_data(w[k].reshape(p.shape))
+            return
         for p, w in zip(self._embed_plist, self._e_raw):
             p._data._set_data(w)
         for p, w in zip(self._head_plist, self._h_raw):

@@ -79,6 +79,92 @@ def slice_tp(g, dim: int, axis: str = "tp"):
                                     axis=dim)
 
 
+# Declarative logical-axis layout table (the SNIPPETS DEFAULT_RULES shape).
+# Keys are LOGICAL tensor roles; values name the mesh axis that shards them
+# (None = replicated). 'seq' -> 'sp' answers the reference table's
+# "# TODO: Can we use sequence parallel?" — with compute-partitioned TP
+# (parallel/megatron.py) the non-matmul regions shard the sequence axis
+# over the same device group, so the role maps to the 'sp' alias of the tp
+# axis group. 'batch' / 'seq' are ACTIVATION roles: validated against the
+# mesh like the rest, but apply_rules attaches only the parameter roles.
+DEFAULT_RULES: Dict[str, Optional[str]] = {
+    "batch": "dp",
+    "vocab": "tp",
+    "embed": None,
+    "heads": "tp",
+    "kv": "tp",
+    "joined_kv": "tp",
+    "mlp": "tp",
+    "seq": "sp",
+}
+
+# logical role -> name patterns + which positional axis of the weight the
+# role occupies (Dense weights are (out, in))
+_ROLE_PATTERNS = [
+    (r".*(qkv|joined_qkv).*weight$", ("joined_kv", "embed")),
+    (r".*(query|key|value|ffn1|inter|fc1).*weight$", ("kv", "embed")),
+    (r".*(proj|ffn2|output|fc2).*weight$", ("embed", "mlp")),
+    (r".*(qkv|joined_qkv).*bias$", ("joined_kv",)),
+    (r".*(query|key|value|ffn1|inter|fc1).*bias$", ("kv",)),
+    (r".*(word_embed|decoder).*weight$", ("vocab", "embed")),
+    (r".*decoder.*bias$", ("vocab",)),
+]
+
+
+def shard_rules(overrides: Optional[Dict[str, Optional[str]]] = None
+                ) -> Dict[str, Optional[str]]:
+    """The default logical-role -> mesh-axis table, optionally overridden
+    per role. Unknown role names raise (catching typos like 'head')."""
+    rules = dict(DEFAULT_RULES)
+    if overrides:
+        for k in overrides:
+            if k not in rules:
+                raise MXNetError(
+                    f"shard_rules: unknown logical axis {k!r}; known roles: "
+                    f"{sorted(rules)}")
+        rules.update(overrides)
+    return rules
+
+
+def apply_rules(block: Block, rules: Optional[Dict[str, Optional[str]]] = None,
+                mesh=None):
+    """Attach PartitionSpecs from a LOGICAL rule table (see DEFAULT_RULES).
+
+    Unlike `shard_params_megatron` (raw name-pattern -> spec), this
+    validates every named mesh axis against `mesh.axis_names` and raises a
+    clear MXNetError for rules naming a nonexistent axis — a silent no-op
+    here means a model silently trains replicated. Returns the number of
+    parameters annotated."""
+    rules = shard_rules(rules)
+    if mesh is not None:
+        names = tuple(mesh.axis_names)
+        for role, ax in rules.items():
+            if ax is not None and ax not in names:
+                raise MXNetError(
+                    f"apply_rules: rule {role!r} -> {ax!r} names a mesh "
+                    f"axis that does not exist (mesh axes: {names}); "
+                    "add the axis to make_mesh or set the rule to None")
+    compiled = [(re.compile(pat), roles) for pat, roles in _ROLE_PATTERNS]
+    n = 0
+    nbytes = 0
+    for name, p in block._collect_params_with_prefix().items():
+        for pat, roles in compiled:
+            if pat.match(name):
+                spec = P(*(rules.get(r) for r in roles))
+                if any(s is not None for s in spec):
+                    p.sharding = spec
+                    n += 1
+                    nbytes += _telem.payload_bytes(p._data)
+                break
+    if _telem._ENABLED:
+        _telem.gauge("mx_tp_sharded_params",
+                     "Parameters carrying TP PartitionSpecs").set(n)
+        _telem.counter("mx_tp_sharded_bytes_total",
+                       "Bytes of parameters annotated for TP sharding") \
+            .inc(nbytes)
+    return n
+
+
 def shard_params_megatron(block: Block, rules: Optional[Dict[str, P]] = None,
                           axis: str = "tp"):
     """Attach TP PartitionSpecs by name pattern. Default rules cover the

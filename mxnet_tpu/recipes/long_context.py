@@ -182,6 +182,49 @@ class LongContextLM(HybridBlock):
         x = self.cells(x)
         return self.decoder(self.ln(x))
 
+    def pipeline_split(self):
+        """(embed, cells, head) for parallel.PipelineTrainer. The wrappers
+        re-register this model's own child blocks, so parameters are
+        shared and sync() writes straight back into this model."""
+        cells = [self.cells[i] for i in range(len(self.cells))]
+        return _LCEmbedStage(self), cells, _LCHeadStage(self)
+
+
+class _LCEmbedStage(HybridBlock):
+    """Pipeline stage 0 body: LongContextLM's embedding section (keeps
+    the sequence-axis position offset so ring runs still see GLOBAL
+    positions)."""
+
+    def __init__(self, lm, **kwargs):
+        super().__init__(**kwargs)
+        self.word_embed = lm.word_embed
+        self.pos_embed = lm.pos_embed
+        self.embed_ln = lm.embed_ln
+
+    def hybrid_forward(self, F, token_ids):
+        if not isinstance(token_ids, NDArray):
+            raise MXNetError("LongContextLM has no symbolic form")
+        Tl = token_ids.shape[1]
+        pos = jnp.arange(Tl, dtype=jnp.int32)
+        ctx = current_sequence_axis()
+        if ctx is not None:
+            pos = pos + lax.axis_index(ctx.axis_name) * Tl
+        x = self.word_embed(token_ids) \
+            + self.pos_embed(NDArray(pos)).expand_dims(axis=0)
+        return self.embed_ln(x)
+
+
+class _LCHeadStage(HybridBlock):
+    """Pipeline last-stage tail: final LN + LM decoder."""
+
+    def __init__(self, lm, **kwargs):
+        super().__init__(**kwargs)
+        self.ln = lm.ln
+        self.decoder = lm.decoder
+
+    def hybrid_forward(self, F, x):
+        return self.decoder(self.ln(x))
+
 
 # -- sequence chunking through DeviceFeed -----------------------------------
 
@@ -360,7 +403,7 @@ class LongContextTrainer(DataParallelTrainer):
         if self._sp_degree > 1:
             nbytes, calls = self._ring_step_bytes(sig[0])
             _telem.record_comm("ppermute", nbytes * steps, store="mesh",
-                               calls=calls * steps)
+                               calls=calls * steps, axis="sp")
         super()._record_telemetry(sig, examples, steps, flops_key=flops_key)
 
     def _ring_step_bytes(self, x_shape):

@@ -357,7 +357,7 @@ class MoETrainer(DataParallelTrainer):
         if self._ep_degree > 1:
             nbytes, calls = self._a2a_step_bytes(sig[0])
             _telem.record_comm("all_to_all", nbytes * steps, store="mesh",
-                               calls=calls * steps)
+                               calls=calls * steps, axis="ep")
         super()._record_telemetry(sig, examples, steps, flops_key=flops_key)
 
 

@@ -681,44 +681,61 @@ def payload_bytes(x) -> int:
 
 def record_comm(op: str, nbytes: int, store: str = "",
                 seconds: Optional[float] = None, calls: int = 1,
-                overlapped: bool = False):
+                overlapped: bool = False, axis: str = ""):
     """Account one collective/comm operation (bytes moved, calls, time).
 
     `op` labels the collective kind — "allreduce", "reduce_scatter",
     "all_gather", the pipeline schedule's "ppermute" activation hops and
-    "pipeline_grad_psum", "tp_weight_all_gather", kvstore "push"/"pull" —
-    so per-kind wire accounting survives aggregation (the
-    check_instrumentation gate pins the trainer paths that must book
-    here). `overlapped` marks traffic issued while backward compute was
-    still pending (the chunked-vjp schedule, parallel/overlap.py); it
-    becomes the "overlap" label and feeds the mx_comm_overlap_ratio gauge.
-    Family.get(op, store) aggregates over the label, so two-label readers
-    see totals unchanged."""
+    "pipeline_grad_psum", "tp_weight_all_gather", the compute-partitioned
+    TP path's "tp_act_psum"/"tp_act_all_gather"/"tp_act_psum_scatter",
+    kvstore "push"/"pull" — so per-kind wire accounting survives
+    aggregation (the check_instrumentation gate pins the trainer paths
+    that must book here). `overlapped` marks traffic issued while backward
+    compute was still pending (the chunked-vjp schedule,
+    parallel/overlap.py); it becomes the "overlap" label and feeds the
+    mx_comm_overlap_ratio gauge. `axis` names the MESH axis the collective
+    crosses ("dp"/"tp"/"sp"/"pp"/"ep") so the ratio and byte totals split
+    per parallelism lane — the signal that distinguishes "the dp grad
+    allreduce overlaps fine" from "the tp weight gather is the
+    unoverlapped remainder". Family.get(op, store) aggregates over the
+    trailing labels, so two-label readers see totals unchanged."""
     ov = "1" if overlapped else "0"
     counter("mx_comm_bytes_total", "Bytes moved by comm/collective ops",
-            ("op", "store", "overlap")).labels(op, store, ov) \
+            ("op", "store", "overlap", "axis")).labels(op, store, ov, axis) \
         .inc(max(int(nbytes), 0))
     counter("mx_comm_calls_total", "Comm/collective operations",
-            ("op", "store", "overlap")).labels(op, store, ov).inc(calls)
+            ("op", "store", "overlap", "axis")).labels(op, store, ov, axis) \
+        .inc(calls)
     if seconds is not None:
         counter("mx_comm_seconds_total", "Wall seconds inside comm ops",
-                ("op", "store", "overlap")).labels(op, store, ov) \
+                ("op", "store", "overlap", "axis")).labels(op, store, ov,
+                                                           axis) \
             .inc(seconds)
 
 
-# gradient-collective kinds eligible for backward overlap — the ratio
-# denominator (kvstore push/pull and the pipeline's ppermute hops have no
-# "issue during backward" notion and would only dilute the signal)
-_OVERLAP_OPS = frozenset({"allreduce", "reduce_scatter", "all_gather"})
+# gradient/weight-collective kinds eligible for backward overlap — the
+# ratio denominator (kvstore push/pull and the pipeline's ppermute hops
+# have no "issue during backward" notion and would only dilute the
+# signal). The weight-sharded TP gather and the compute-partitioned
+# activation collectives count: both are per-step wire traffic a schedule
+# could in principle hide, and their per-axis remainder is the
+# weight-sharded-vs-partitioned acceptance signal.
+_OVERLAP_OPS = frozenset({
+    "allreduce", "reduce_scatter", "all_gather", "tp_weight_all_gather",
+    "tp_act_psum", "tp_act_all_gather", "tp_act_psum_scatter"})
 
 
-def comm_overlap_ratio() -> float:
+def comm_overlap_ratio(axis: Optional[str] = None) -> float:
     """Fraction of gradient-collective wire traffic issued overlapped with
-    backward compute. Byte-weighted over mx_comm_bytes_total's allreduce /
-    reduce_scatter / all_gather series; since estimated collective seconds
-    are bytes / peak_bytes_per_second() (the roofline interval accounting's
+    backward compute. Byte-weighted over mx_comm_bytes_total's
+    _OVERLAP_OPS series; since estimated collective seconds are
+    bytes / peak_bytes_per_second() (the roofline interval accounting's
     conversion), the same number reads as the estimated-collective-time
-    overlap fraction. 0.0 when nothing has been recorded."""
+    overlap fraction. `axis` restricts the accounting to one mesh axis's
+    lane ("dp"/"tp"/"sp"/...): comm_overlap_ratio(axis="tp") == 0 with a
+    zero byte total means the tp lane moved nothing unoverlapped — how the
+    partitioned-TP tests assert the full-weight gather is gone. 0.0 when
+    nothing has been recorded."""
     fam = get_metric("mx_comm_bytes_total")
     if fam is None:
         return 0.0
@@ -728,11 +745,33 @@ def comm_overlap_ratio() -> float:
     for lv, s in series:
         if not lv or lv[0] not in _OVERLAP_OPS:
             continue
+        if axis is not None and (len(lv) < 4 or lv[3] != axis):
+            continue
         v = getattr(s, "value", 0.0)
         total += v
         if len(lv) > 2 and lv[2] == "1":
             overlapped += v
     return overlapped / total if total else 0.0
+
+
+def comm_axis_bytes(axis: str, overlapped: Optional[bool] = None) -> float:
+    """Total mx_comm_bytes_total booked on one mesh axis's lane, optionally
+    filtered to (non-)overlapped traffic. The partitioned-TP acceptance
+    check reads comm_axis_bytes("tp") A/B between the weight-sharded and
+    partitioned steps."""
+    fam = get_metric("mx_comm_bytes_total")
+    if fam is None:
+        return 0.0
+    with _LOCK:
+        series = list(fam._series.items())
+    total = 0.0
+    for lv, s in series:
+        if len(lv) < 4 or lv[3] != axis:
+            continue
+        if overlapped is not None and (lv[2] == "1") != overlapped:
+            continue
+        total += getattr(s, "value", 0.0)
+    return total
 
 
 def record_optimizer_state(nbytes: int, source: str = "trainer"):
@@ -1098,6 +1137,18 @@ def _sync_engine_stats():
               "estimated collective seconds at the roofline bandwidth "
               "peak) issued overlapped with backward compute") \
             .set(comm_overlap_ratio())
+        # per-mesh-axis split of the same ratio: the tp lane going to ~0
+        # bytes (weight gather removed) vs staying a large unoverlapped
+        # remainder is the weight-sharded vs compute-partitioned signal
+        fam = get_metric("mx_comm_bytes_total")
+        with _LOCK:
+            axes = sorted({lv[3] for lv in fam._series
+                           if len(lv) > 3 and lv[3]})
+        for ax in axes:
+            gauge("mx_comm_overlap_ratio_axis",
+                  "Per-mesh-axis fraction of collective wire bytes issued "
+                  "overlapped with backward compute",
+                  ("axis",)).labels(ax).set(comm_overlap_ratio(axis=ax))
     try:
         from .. import engine as _engine
         st = _engine.cache_stats()

@@ -77,6 +77,23 @@ METHOD_CHECKS = [
      {"record_optimizer_state"}, "call"),
     ("parallel/tensor_parallel.py", None, "shard_params_megatron",
      {"record_comm", "counter", "gauge"}, "call"),
+    ("parallel/tensor_parallel.py", None, "apply_rules",
+     {"counter", "gauge"}, "call"),
+    # compute-partitioned TP (ISSUE 16): every manual collective in the
+    # 1F1B tick body must run under a jax.named_scope region name
+    # (mx.tp.* / mx.sp.*) so span traces, the flight recorder, and xplane
+    # profiles can attribute its wire time — an unnamed psum here is
+    # invisible to every per-region diagnosis tool
+    *[("parallel/megatron.py", None, f, {"named_scope"}, "call")
+      for f in ("copy_to_tp", "_copy_bwd", "reduce_from_tp",
+                "gather_from_sp", "_gather_sp_bwd", "scatter_to_sp",
+                "_scatter_sp_bwd", "partial_grad",
+                "vocab_parallel_embedding", "vocab_parallel_cross_entropy")],
+    # ... and the per-step activation-collective volume must be booked on
+    # its per-axis comm lane (the no-weight-gather acceptance signal reads
+    # exactly these series)
+    ("parallel/pipeline.py", "PipelineTrainer",
+     "_record_partitioned_tp_telemetry", {"record_comm"}, "call"),
     ("module/base_module.py", "BaseModule", "fit", {"record_step"}, "call"),
     # async feed + bounded in-flight dispatch (ISSUE 5): the overlap layer
     # must stay observable — feed stalls/queue depth at every delivery,
@@ -199,6 +216,21 @@ TEXT_CHECKS = [
      "the pipeline trainer must book the schedule's activation-hop "
      "ppermute volume under its own comm kind (bubble/ICI accounting — "
      "the grad psum alone undercounts pipeline wire traffic)"),
+    ("parallel/pipeline.py", '"tp_act_psum"',
+     "the partitioned-tp step must book its activation psum volume under "
+     "its own comm kind on the 'tp' lane (the no-weight-gather acceptance "
+     "A/B reads this series against tp_weight_all_gather)"),
+    ("parallel/pipeline.py", '"tp_act_all_gather"',
+     "the sequence-parallel step must book its boundary all_gather volume "
+     "on the 'sp' lane"),
+    ("parallel/pipeline.py", '"tp_act_psum_scatter"',
+     "the sequence-parallel step must book its boundary psum_scatter "
+     "volume on the 'sp' lane"),
+    ("telemetry/__init__.py", "def comm_axis_bytes",
+     "the registry must expose per-mesh-axis comm byte totals (the "
+     "dp-vs-tp-vs-sp split of mx_comm_overlap_ratio accounting)"),
+    ("telemetry/__init__.py", "mx_comm_overlap_ratio_axis",
+     "the registry must export the per-axis comm-overlap ratio gauge"),
     ("telemetry/__init__.py", "def record_optimizer_state",
      "the registry must expose the per-replica optimizer-state gauge "
      "(the zero-update memory acceptance signal)"),

@@ -28,8 +28,15 @@ HOT_FUNCTIONS = [
      r"_loss_raw|_put_batch|_grad_allreduce_bytes)\b"),
     ("mxnet_tpu/parallel/data_parallel.py", r"\b_make_apply_fn\b"),
     ("mxnet_tpu/parallel/pipeline.py",
-     r"(PipelineTrainer\.(step|_build_step|_loss_raw|_record_telemetry)\b"
+     r"(PipelineTrainer\.(step|_build_step|_loss_raw|_record_telemetry|"
+     r"_record_partitioned_tp_telemetry|_init_zero_state_partitioned)\b"
      r"|\bpipeline_apply\b|\bschedule_1f1b\b)"),
+    # compute-partitioned TP program bodies run INSIDE the 1F1B tick scan:
+    # any host sync here happens per tick x per microbatch
+    ("mxnet_tpu/parallel/megatron.py",
+     r"\b(cell_forward|embed_forward|head_loss_forward|_attention|_tp_moe|"
+     r"copy_to_tp|reduce_from_tp|gather_from_sp|scatter_to_sp|partial_grad|"
+     r"vocab_parallel_embedding|vocab_parallel_cross_entropy)\b"),
     ("mxnet_tpu/parallel/step_program.py",
      r"StepProgram\.(get|region|capture_cost|cost)\b"),
     ("mxnet_tpu/kvstore/kvstore.py",
