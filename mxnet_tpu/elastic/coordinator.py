@@ -63,6 +63,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..base import MXNetError, env
 from .. import faults as _faults
 from .. import telemetry as _telem
+from ..telemetry import goodput as _goodput
 from ..telemetry import tracing as _tracing
 from . import manifest as _manifest
 
@@ -355,6 +356,11 @@ class Coordinator:
         self.generation = rec["generation"]
         self.fence = rec["generation"]
         self._joined = True
+        if _goodput._ENABLED:
+            # goodput ring records carry the group epoch they were written
+            # under — how an evicted host's partial series still merges
+            # without a hole
+            _goodput.set_generation(self.generation)
         self._sweep_expired_members()
         self.heartbeat(step=None, force=True)
         return self.generation
@@ -472,6 +478,8 @@ class Coordinator:
             generation = self._update_generation(_mutate)["generation"]
         if self._joined and self.rank in live:
             self.generation = generation
+            if _goodput._ENABLED:
+                _goodput.set_generation(generation)
         self._live_seen.update(live)
         v = GroupView(generation, members, live, dead)
         if _telem._ENABLED:
@@ -571,6 +579,11 @@ class Coordinator:
                           and r not in self._dead_seen]
             if newly_dead:
                 self._dead_seen.update(newly_dead)
+                if _goodput._ENABLED:
+                    # incident path (once per eviction): score the fleet
+                    # from the on-disk series and flight-record whether
+                    # the dead peer was the straggler
+                    _goodput.on_eviction(newly_dead, root=self.root)
                 stop = self.post_stop(step, reason="peer_dead")
         return stop
 

@@ -181,6 +181,14 @@ def _host_main(cfg: Dict[str, Any]):
     if cfg.get("telemetry"):
         from .. import telemetry as _telem
         _telem.enable()
+    goodput_on = bool(cfg.get("goodput"))
+    if goodput_on:
+        # arm the goodput ledger over the shared root: each host appends
+        # its per-step waterfall to <root>/telemetry/host-<rank>.tsr; the
+        # parent aggregates after the drill (straggler lane). note_step
+        # keeps the child jax-free — the ledger is pure host arithmetic.
+        from ..telemetry import goodput as _goodput
+        _goodput.enable(root=root, rank=rank)
     coord = Coordinator(
         root, rank,
         lease_timeout=float(cfg.get("lease_timeout", 1.0)),
@@ -214,6 +222,9 @@ def _host_main(cfg: Dict[str, Any]):
 
     def on_step(t, loss):
         losses[str(t)] = float(loss)
+        if goodput_on:
+            from ..telemetry import goodput as _goodput
+            _goodput.note_step(source="drill")
         if die_at is not None and t >= int(die_at):
             os._exit(3)         # simulated hard host loss: no cleanup
         if step_sleep:
@@ -233,6 +244,13 @@ def _host_main(cfg: Dict[str, Any]):
         report["straggler_aborts"] = float(m.get("straggler")) if m else 0.0
         m = _telem.get_metric("mx_hosts_live")
         report["hosts_live"] = float(m.get("elastic")) if m else None
+    if goodput_on:
+        from ..telemetry import goodput as _goodput
+        t = _goodput.totals()
+        report["goodput"] = {"steps": t["steps"],
+                             "wall_seconds": t["wall_seconds"],
+                             "goodput_ratio": t["goodput_ratio"],
+                             "generation": t["generation"]}
     path = os.path.join(cfg["report_dir"], f"report-{rank:05d}.json")
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -244,12 +262,18 @@ def _host_main(cfg: Dict[str, Any]):
 def run_drill(root: str, world: int, num_steps: int, save_every: int = 5,
               scenario: Optional[Dict[str, Any]] = None,
               timeout: float = 120.0, report_tag: str = "r0",
-              telemetry: bool = True,
+              telemetry: bool = True, goodput: bool = False,
               **overrides) -> Dict[str, Any]:
     """Spawn ``world`` real OS processes over the shared ``root`` and run
     one drill phase. ``scenario`` maps PER-RANK overrides, e.g.
     ``{2: {"die_at_step": 6}}``; ``overrides`` apply to every host
     (lease_timeout, straggler_timeout, step_sleep, ...).
+
+    With ``goodput=True`` every host arms the goodput ledger over the
+    shared root; after the drill ``telemetry.goodput.aggregate(root)``
+    merges the per-host series (the straggler-detection lane: slow one
+    rank via ``scenario={r: {"step_sleep": ...}}`` and the merged summary
+    flags it).
 
     Returns ``{"exitcodes": [...], "reports": {rank: {...}}}`` — a rank
     that died mid-drill has its scripted exit code and no report."""
@@ -260,7 +284,8 @@ def run_drill(root: str, world: int, num_steps: int, save_every: int = 5,
     for r in range(int(world)):
         cfg = {"root": root, "rank": r, "world": int(world),
                "num_steps": int(num_steps), "save_every": int(save_every),
-               "report_dir": report_dir, "telemetry": bool(telemetry)}
+               "report_dir": report_dir, "telemetry": bool(telemetry),
+               "goodput": bool(goodput)}
         cfg.update(overrides)
         cfg.update((scenario or {}).get(r, {}))
         p = ctx.Process(target=_host_main, args=(cfg,),

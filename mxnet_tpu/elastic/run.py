@@ -112,6 +112,13 @@ def resume_or_init(directory: str, make_trainer: Callable[[], Any],
 def _record_resume(outcome: str):
     if _telem._ENABLED:
         _telem.record_resume(outcome, source="elastic")
+    from ..telemetry import goodput as _goodput
+    if _goodput._ENABLED and outcome != "fresh":
+        # boot-to-resume wall time is the run's restart downtime: booked
+        # run-level (ring + totals), never folded into one step's
+        # waterfall. Anchored at goodput's module import — the earliest
+        # process stamp available without patching the interpreter.
+        _goodput.record_restart_downtime(outcome)
 
 
 class PreemptionGuard:

@@ -238,6 +238,10 @@ class DispatchWindow:
         from .. import telemetry as _telem
         if _telem._ENABLED:
             _telem.record_inflight(len(self._pending), source=self.name)
+            # cumulative block time for the goodput waterfall's
+            # dispatch_backpressure lane — the float this window already
+            # accumulated, no extra clock read
+            _telem.record_dispatch_wait(self.wait_seconds, source=self.name)
 
     def drain(self):
         """Block until every admitted step completed (epoch/eval boundary)."""
@@ -257,6 +261,7 @@ class DispatchWindow:
         from .. import telemetry as _telem
         if _telem._ENABLED:
             _telem.record_inflight(0, source=self.name)
+            _telem.record_dispatch_wait(self.wait_seconds, source=self.name)
 
 
 # ---------------------------------------------------------------------------

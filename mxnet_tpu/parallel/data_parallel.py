@@ -791,7 +791,8 @@ class DataParallelTrainer:
             region=self._region_name(cost_key), steps=steps, cost=cost)
         _telem.record_step(examples, source="data_parallel", steps=steps,
                            flops_per_step=(flops / steps if flops else None),
-                           lr=float(self.optimizer.learning_rate))
+                           lr=float(self.optimizer.learning_rate),
+                           dispatch_wait_seconds=self._window.wait_seconds)
 
     # -- loss plumbing -------------------------------------------------------
     def _loss_raw(self, pred_raw, label_raw):

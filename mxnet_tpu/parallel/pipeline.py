@@ -1278,8 +1278,20 @@ class PipelineTrainer:
             "step", flops or 0.0,
             bytes_accessed=cost.get("bytes_accessed", 0.0),
             region=self._program.region(sig), cost=cost)
+        from ..telemetry import goodput as _goodput
+        if _goodput._ENABLED and self.n_stages > 1:
+            # analytic schedule bubble: idle ticks over total ticks for
+            # this schedule's tick count (the same counts _ppermute_stats
+            # uses); the ledger multiplies it into the measured
+            # device-bound share of each step (the tick slope)
+            nv = self.n_stages * self.virtual_stages
+            M = self.num_microbatch
+            ticks = M + 2 * (nv - 1) if self.schedule == "1f1b" \
+                else M + nv - 1
+            _goodput.set_pipeline_bubble("pipeline", (ticks - M) / ticks)
         _telem.record_step(examples, source="pipeline", flops_per_step=flops,
-                           lr=float(self.optimizer.learning_rate))
+                           lr=float(self.optimizer.learning_rate),
+                           dispatch_wait_seconds=self._window.wait_seconds)
 
     def drain(self):
         """Block until every dispatched step completed (epoch/eval

@@ -141,7 +141,10 @@ class Server:
     # -- HTTP front door -----------------------------------------------------
     def start_http(self, port: int = 0, addr: str = "127.0.0.1") -> int:
         """Serve the JSON predict API + /metrics on a daemon thread;
-        returns the bound port (0 picks a free one)."""
+        returns the bound port (0 picks a free one). /statusz carries the
+        full telemetry.statusz() debug snapshot — including the goodput
+        waterfall section (per-category totals, goodput ratio, straggler
+        scores when booked) — plus this server's model/queue view."""
         import http.server
         server = self
 

@@ -51,6 +51,7 @@ __all__ = [
     "peak_bytes_per_second", "ridge_point", "roofline", "trace_steps",
     "trace_active",
     "record_feed_depth", "record_feed_stall", "record_inflight",
+    "record_dispatch_wait",
     "record_checkpoint_save", "record_resume", "record_moe_dropped",
     "set_epoch", "timed", "annotate", "start_http_server",
     "stop_http_server", "DEFAULT_LATENCY_BUCKETS", "record_serving_enqueue",
@@ -59,7 +60,7 @@ __all__ = [
     "record_request_shed", "record_feed_producer_leak",
     "record_feed_producer_restart", "record_serving_queue_wait",
     "record_hosts_live", "record_commit_barrier", "record_hang_watchdog",
-    "statusz", "tracing",
+    "statusz", "tracing", "goodput",
 ]
 
 env.declare("MXNET_TELEMETRY", False, bool,
@@ -102,12 +103,47 @@ def is_enabled() -> bool:
     return _ENABLED
 
 
+# process-rank label for multi-host scrapes: "" (single process) leaves
+# every family's label set — and therefore the exposition — byte-identical
+# to the single-host build; a nonempty value is appended as a TRAILING
+# "host" label, so MetricFamily.get()'s prefix aggregation keeps every
+# existing reader working unchanged.
+_HOST_LABEL: List[Optional[str]] = [None]
+
+
+def _host_label() -> str:
+    """Resolve (once) the process-rank label value. Consults jax only if
+    something else already imported it — a multi-host job necessarily
+    initialized jax.distributed, while pure host-side processes (the
+    elastic drill's children) must never pay a jax import for a label."""
+    v = _HOST_LABEL[0]
+    if v is None:
+        v = ""
+        jx = sys.modules.get("jax")
+        if jx is not None:
+            try:
+                if int(jx.process_count()) > 1:
+                    v = str(int(jx.process_index()))
+            except Exception:
+                v = ""
+        with _LOCK:
+            _HOST_LABEL[0] = v
+    return v
+
+
 # ---------------------------------------------------------------------------
 # Metric model: family (name + label names) -> labeled series
 # ---------------------------------------------------------------------------
 
 def _escape(v: str) -> str:
     return str(v).replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+
+def _escape_help(v: str) -> str:
+    # HELP-line escaping per the exposition format: backslash and newline
+    # only (quotes are legal in help text). A doc with a raw newline would
+    # otherwise split the HELP line and corrupt the whole scrape.
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
 
 
 def _fmt_labels(names: Tuple[str, ...], values: Tuple[str, ...],
@@ -281,7 +317,7 @@ class MetricFamily:
         return getattr(s, "value", getattr(s, "sum", 0.0))
 
     def _render(self, out: List[str]):
-        out.append(f"# HELP {self.name} {self.doc}")
+        out.append(f"# HELP {self.name} {_escape_help(self.doc)}")
         out.append(f"# TYPE {self.name} {self.kind}")
         with _LOCK:
             series = list(self._series.values())
@@ -327,7 +363,7 @@ class HistogramFamily(MetricFamily):
         return _HistogramSeries(values, self.buckets)
 
     def _render(self, out: List[str]):
-        out.append(f"# HELP {self.name} {self.doc}")
+        out.append(f"# HELP {self.name} {_escape_help(self.doc)}")
         out.append(f"# TYPE {self.name} histogram")
         with _LOCK:
             series = [(s.label_values, list(s.counts), s.sum, s.count)
@@ -406,10 +442,13 @@ def reset():
         _FAMILIES.clear()
         _STEP_ANCHOR.clear()
         _mem_peak = 0.0
+        _HOST_LABEL[0] = None
     from . import roofline as _roofline
     _roofline.reset()
     from . import tracing as _tracing
     _tracing.reset()
+    from . import goodput as _goodput
+    _goodput.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -570,7 +609,8 @@ def _engine_flops() -> float:
 def record_step(examples: int, source: str = "trainer", steps: int = 1,
                 seconds: Optional[float] = None,
                 flops_per_step: Optional[float] = None,
-                lr: Optional[float] = None):
+                lr: Optional[float] = None,
+                dispatch_wait_seconds: Optional[float] = None):
     """Record `steps` completed training steps covering `examples` examples.
 
     With seconds=None the duration is the wall time since the previous
@@ -579,6 +619,11 @@ def record_step(examples: int, source: str = "trainer", steps: int = 1,
     (forward+backward+update), the way Speedometer does. flops_per_step
     defaults to the engine's executed-FLOPs counter delta (compiled-artifact
     cost_analysis accounting), which yields the MFU estimate.
+
+    ``dispatch_wait_seconds`` is the caller's CUMULATIVE DispatchWindow
+    block time (trainers pass ``self._window.wait_seconds``): the goodput
+    ledger deltas it into the step's dispatch_backpressure category —
+    a host float the window already accumulated, no extra clock read.
     """
     now = time.perf_counter()
     eng_flops = _engine_flops() if flops_per_step is None else 0.0
@@ -604,11 +649,19 @@ def record_step(examples: int, source: str = "trainer", steps: int = 1,
     # DEFAULT_LATENCY_BUCKETS exposition as serving, so training p50/p99
     # step latency is a real histogram_quantile() query too. Recorded at
     # the same window-admission pace (completion-paced, sync-free).
-    histogram("mx_step_seconds",
-              "Training-step latency on the documented "
-              "DEFAULT_LATENCY_BUCKETS ladder",
-              ("source",), buckets=DEFAULT_LATENCY_BUCKETS) \
-        .labels(source).observe(seconds / max(steps, 1))
+    host = _host_label()
+    if host:
+        histogram("mx_step_seconds",
+                  "Training-step latency on the documented "
+                  "DEFAULT_LATENCY_BUCKETS ladder",
+                  ("source", "host"), buckets=DEFAULT_LATENCY_BUCKETS) \
+            .labels(source, host).observe(seconds / max(steps, 1))
+    else:
+        histogram("mx_step_seconds",
+                  "Training-step latency on the documented "
+                  "DEFAULT_LATENCY_BUCKETS ladder",
+                  ("source",), buckets=DEFAULT_LATENCY_BUCKETS) \
+            .labels(source).observe(seconds / max(steps, 1))
     _trace_tick(steps)
     if tracing._ENABLED:
         # feed the anomaly watchdog the per-step seconds this function just
@@ -634,6 +687,12 @@ def record_step(examples: int, source: str = "trainer", steps: int = 1,
     if lr is not None:
         gauge("mx_learning_rate", "Optimizer learning rate",
               ("source",)).labels(source).set(lr)
+    if goodput._ENABLED:
+        # the goodput waterfall rides THIS funnel: one flag check while
+        # disarmed, and armed attribution consumes only cumulative stamps
+        # the layers already took (no extra syncs or clock reads)
+        goodput._on_step(source, seconds, steps,
+                         dispatch_wait=dispatch_wait_seconds)
     sample_memory()
 
 
@@ -698,19 +757,22 @@ def record_comm(op: str, nbytes: int, store: str = "",
     per parallelism lane — the signal that distinguishes "the dp grad
     allreduce overlaps fine" from "the tp weight gather is the
     unoverlapped remainder". Family.get(op, store) aggregates over the
-    trailing labels, so two-label readers see totals unchanged."""
+    trailing labels, so two-label readers see totals unchanged. On a
+    multi-process job the process rank rides as a trailing "host" label
+    (same prefix-aggregation contract; comm_overlap_ratio and
+    comm_axis_bytes index lv[2]/lv[3] positionally and are unaffected)."""
     ov = "1" if overlapped else "0"
+    h = _host_label()
+    names = ("op", "store", "overlap", "axis", "host") if h \
+        else ("op", "store", "overlap", "axis")
+    vals = (op, store, ov, axis, h) if h else (op, store, ov, axis)
     counter("mx_comm_bytes_total", "Bytes moved by comm/collective ops",
-            ("op", "store", "overlap", "axis")).labels(op, store, ov, axis) \
-        .inc(max(int(nbytes), 0))
+            names).labels(*vals).inc(max(int(nbytes), 0))
     counter("mx_comm_calls_total", "Comm/collective operations",
-            ("op", "store", "overlap", "axis")).labels(op, store, ov, axis) \
-        .inc(calls)
+            names).labels(*vals).inc(calls)
     if seconds is not None:
         counter("mx_comm_seconds_total", "Wall seconds inside comm ops",
-                ("op", "store", "overlap", "axis")).labels(op, store, ov,
-                                                           axis) \
-            .inc(seconds)
+                names).labels(*vals).inc(seconds)
 
 
 # gradient/weight-collective kinds eligible for backward overlap — the
@@ -813,6 +875,19 @@ def record_inflight(n: int, source: str = "step"):
           "in-flight window", ("source",)).labels(source).set(int(n))
 
 
+def record_dispatch_wait(total_seconds: float, source: str = "step"):
+    """Cumulative seconds a DispatchWindow blocked in admit()/drain()
+    waiting on in-flight step completion (``window.wait_seconds``, a host
+    float the window already accumulated — set-style like
+    record_feed_stall). The goodput ledger's dispatch_backpressure
+    category deltas this family when the trainer doesn't hand its window
+    wait down through record_step directly."""
+    gauge("mx_dispatch_wait_seconds_total",
+          "Cumulative seconds the bounded in-flight window blocked on "
+          "step completion", ("source",)).labels(source) \
+        .set(total_seconds)
+
+
 # ---------------------------------------------------------------------------
 # Elastic fault tolerance (mxnet_tpu/elastic — docs/checkpointing.md)
 # ---------------------------------------------------------------------------
@@ -824,9 +899,21 @@ def record_checkpoint_save(seconds: float, nbytes: int,
     commit, and payload bytes this process wrote. save_seconds trending
     toward the snapshot interval means cadence outruns write bandwidth —
     the tuning signal docs/checkpointing.md's cadence section reads."""
-    gauge("mx_checkpoint_save_seconds",
-          "Wall seconds of the last snapshot, dispatch to manifest commit",
-          ("source",)).labels(source).set(float(seconds))
+    h = _host_label()
+    if h:
+        gauge("mx_checkpoint_save_seconds",
+              "Wall seconds of the last snapshot, dispatch to manifest "
+              "commit", ("source", "host")).labels(source, h) \
+            .set(float(seconds))
+    else:
+        gauge("mx_checkpoint_save_seconds",
+              "Wall seconds of the last snapshot, dispatch to manifest "
+              "commit", ("source",)).labels(source).set(float(seconds))
+    # the cumulative twin the goodput waterfall deltas into its
+    # "snapshot" category (the last-save gauge above can't be deltaed)
+    counter("mx_checkpoint_save_seconds_total",
+            "Cumulative snapshot wall seconds written by this process",
+            ("source",)).labels(source).inc(max(float(seconds), 0.0))
     counter("mx_checkpoint_bytes_total",
             "Cumulative snapshot payload bytes written by this process",
             ("source",)).labels(source).inc(int(nbytes))
@@ -1269,6 +1356,7 @@ def statusz(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         "anomalies": _family_snapshot("mx_anomalies_total"),
         "recorder_events": tracing.recent(),
         "coordinator": coordinator,
+        "goodput": goodput.statusz_view(),
     }
     if extra:
         d.update(extra)
@@ -1338,3 +1426,6 @@ from . import roofline  # noqa: E402
 # the span-tracing plane + flight recorder (same stdlib-only constraint;
 # record_step and statusz() above reference it at call time)
 from . import tracing  # noqa: E402
+# the goodput waterfall ledger (stdlib-only at module scope; record_step
+# and statusz() above reference it at call time)
+from . import goodput  # noqa: E402

@@ -191,3 +191,61 @@ def test_hang_watchdog_flag_mode():
     with HangWatchdog(5.0, what="drain", action="flag") as wd2:
         pass
     assert not wd2.fired
+
+
+def test_goodput_straggler_lane_flags_slowed_rank(tmp_path):
+    """ISSUE 17: arm the goodput ledger across a real 3-process drill with
+    one artificially slowed host. Every host's on-disk series must land
+    under <root>/telemetry/, the merged generation-stamped summary must
+    cover all hosts, and straggler scoring must flag exactly the slow
+    rank."""
+    from mxnet_tpu.telemetry import goodput
+
+    root = str(tmp_path)
+    res = drill.run_drill(root, world=3, num_steps=12, save_every=1000,
+                          report_tag="straggler", goodput=True,
+                          scenario={2: {"step_sleep": 0.05}},
+                          step_sleep=0.005, lease_timeout=5.0,
+                          straggler_timeout=30.0, timeout=120.0)
+    assert res["exitcodes"] == [0, 0, 0], res["exitcodes"]
+    for rank, rep in res["reports"].items():
+        assert rep["goodput"]["steps"] > 0, rank
+
+    for r in range(3):
+        assert os.path.exists(
+            os.path.join(root, "telemetry", f"host-{r}.tsr")), r
+    summary = goodput.aggregate(root, book_metrics=False)
+    assert sorted(summary["hosts"]) == [0, 1, 2]
+    assert summary["straggler"]["flagged"] == [2], summary["straggler"]
+    scores = summary["straggler"]["scores"]
+    assert scores["2"] > scores["0"] and scores["2"] > scores["1"]
+    # the run's membership generation stamps the summary (coord/ rides
+    # next to telemetry/ under the same shared root)
+    assert summary["generation"] >= 1
+    assert summary["fleet"]["steps"] > 0
+
+
+def test_goodput_evicted_host_partial_series_merges(tmp_path):
+    """A host hard-killed mid-drill (os._exit, no cleanup — possibly a
+    torn final ring line) still contributes its partial series to the
+    merged summary, stamped with the generations it lived through."""
+    from mxnet_tpu.telemetry import goodput
+
+    root = str(tmp_path)
+    res = drill.run_drill(root, world=3, num_steps=10, save_every=4,
+                          report_tag="evict", goodput=True,
+                          scenario={1: {"die_at_step": 4}},
+                          lease_timeout=2.0, straggler_timeout=30.0,
+                          timeout=120.0)
+    assert res["exitcodes"][1] == 3           # scripted hard loss
+    assert res["exitcodes"][0] == 0 and res["exitcodes"][2] == 0
+
+    summary = goodput.aggregate(root, book_metrics=False)
+    assert sorted(summary["hosts"]) == [0, 1, 2]
+    dead = summary["hosts"][1]
+    assert 0 < dead["steps"] <= 4             # partial series merged
+    assert dead["steps"] < summary["hosts"][0]["steps"]
+    lo, hi = dead["generation_range"]
+    assert lo >= 1                            # generation-stamped records
+    # survivors lived into a later (post-eviction) generation
+    assert summary["generation"] >= hi

@@ -319,3 +319,95 @@ def test_check_instrumentation_catches_regression(tmp_path):
         '@_telem.instrument_comm("push")', "", 1))
     violations = ci.check(pkg)
     assert any("push" in v for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# hostile exposition inputs (ISSUE 17 satellite): escaping must keep the
+# scrape parseable no matter what lands in a label value or a HELP doc
+# ---------------------------------------------------------------------------
+
+def test_scrape_escapes_hostile_label_values():
+    telem.counter("mx_hostile_total", "doc", ("k",)) \
+        .labels('a"b\\c\nd').inc()
+    text = telem.scrape()
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("mx_hostile_total{")]
+    # one physical line: the raw newline in the value must not split it
+    assert len(lines) == 1, lines
+    ln = lines[0]
+    assert '\\"' in ln and "\\\\" in ln and "\\n" in ln
+    assert ln.endswith(" 1.0")
+
+
+def test_scrape_escapes_hostile_help_docs():
+    """A metric doc with newlines/backslashes must render as ONE escaped
+    HELP line — a raw newline would truncate the HELP comment and leave
+    the doc's tail as garbage samples, corrupting the whole scrape."""
+    telem.counter("mx_hostile_help_total",
+                  'line1\nline2 has "quotes" and a \\backslash').inc()
+    telem.histogram("mx_hostile_help_h", "histo doc\nwith newline",
+                    buckets=(1.0,)).observe(0.5)
+    text = telem.scrape()
+    for name in ("mx_hostile_help_total", "mx_hostile_help_h"):
+        helps = [ln for ln in text.splitlines()
+                 if ln.startswith(f"# HELP {name} ")]
+        assert len(helps) == 1, (name, helps)
+        assert "\\n" in helps[0]
+    assert "\\\\backslash" in text
+    # every comment line in the scrape is still a well-formed comment
+    for ln in text.strip().splitlines():
+        if ln.startswith("#"):
+            assert ln.startswith(("# HELP ", "# TYPE ")), ln
+
+
+# ---------------------------------------------------------------------------
+# multi-host `host` label (ISSUE 17 satellite): single-process exposition
+# stays byte-identical; multi-process rides a TRAILING label
+# ---------------------------------------------------------------------------
+
+def test_single_process_exposition_has_no_host_label_pinned():
+    """jax.process_count() == 1 in the unit suite: the label sets — and
+    therefore the exposition bytes — must match the single-host build
+    exactly. These pinned series strings ARE the compatibility contract
+    for existing scrape configs."""
+    assert telem._host_label() == ""
+    telem.record_step(8, source="t", seconds=0.01)
+    telem.record_step(8, source="t", seconds=0.01)
+    telem.record_comm("allreduce", 1024, store="mesh")
+    telem.record_checkpoint_save(0.5, 100)
+    text = telem.scrape()
+    assert "host=" not in text
+    assert ('mx_comm_bytes_total{op="allreduce",store="mesh",'
+            'overlap="0",axis=""} 1024') in text
+    assert 'mx_step_seconds_count{source="t"} 2' in text
+    assert 'mx_checkpoint_save_seconds{source="elastic"} 0.5' in text
+
+
+def test_multi_process_host_label_is_trailing_and_aggregates():
+    """Simulated rank 3 (the resolver caches its answer in _HOST_LABEL):
+    host rides as the TRAILING label so MetricFamily.get()'s
+    prefix-aggregation keeps every existing reader working unchanged."""
+    telem._HOST_LABEL[0] = "3"
+    telem.record_step(8, source="t", seconds=0.01)
+    telem.record_step(8, source="t", seconds=0.01)
+    telem.record_comm("allreduce", 2048, store="mesh", axis="dp")
+    telem.record_checkpoint_save(0.5, 100)
+    text = telem.scrape()
+    assert ('mx_comm_bytes_total{op="allreduce",store="mesh",'
+            'overlap="0",axis="dp",host="3"} 2048') in text
+    assert 'mx_step_seconds_count{source="t",host="3"} 2' in text
+    assert 'mx_checkpoint_save_seconds{source="elastic",host="3"} 0.5' \
+        in text
+    # prefix aggregation: two-label readers see the same totals
+    assert telem.get_metric("mx_comm_bytes_total") \
+        .get("allreduce", "mesh") == 2048
+    # positional lv[2]/lv[3] consumers are unaffected by the new label
+    assert telem.comm_axis_bytes("dp") == 2048
+    assert telem.comm_axis_bytes("dp", overlapped=False) == 2048
+
+
+def test_record_dispatch_wait_is_set_style():
+    telem.record_dispatch_wait(1.5, source="step")
+    telem.record_dispatch_wait(2.25, source="step")  # cumulative, not +=
+    fam = telem.get_metric("mx_dispatch_wait_seconds_total")
+    assert fam.get("step") == 2.25

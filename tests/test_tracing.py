@@ -543,3 +543,48 @@ def test_io_retry_attempt_spans_and_retry_events():
         assert [a["attrs"]["attempt"] for a in attempts] == [0, 1, 2]
         retries = [e for e in tracing.spans() if e["name"] == "mx.io_retry"]
         assert len(retries) == 2
+
+
+def test_concurrent_dump_while_recording_is_consistent(tmp_path):
+    """ISSUE 17 satellite: dumping the flight recorder while another
+    thread is spinning spans into the ring must never crash (deque
+    mutation during iteration) and every dump must be self-consistent —
+    a meta line whose `entries` count matches the NDJSON body, every
+    line parseable."""
+    import threading
+
+    with _armed():
+        old_cap = tracing._RING.maxlen
+        tracing.set_max_spans(2000)  # keep each dump cheap: the race,
+        stop = threading.Event()     # not the volume, is under test
+        errs = []
+
+        def writer():
+            i = 0
+            try:
+                while not stop.is_set():
+                    with tracing.span("w", i=i):
+                        pass
+                    tracing.event("we", i=i)
+                    i += 1
+            except Exception as e:  # surfaced below: the race under test
+                errs.append(e)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            for k in range(20):
+                path = str(tmp_path / f"fr{k}.ndjson")
+                tracing.dump_flight_recorder(path, reason="race")
+                lines = [json.loads(ln) for ln in
+                         (tmp_path / f"fr{k}.ndjson").read_text()
+                         .splitlines()]
+                meta, entries = lines[0], lines[1:]
+                assert meta["kind"] == "meta"
+                assert meta["entries"] == len(entries)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+            tracing.set_max_spans(old_cap)
+        assert not errs, errs
+        assert not t.is_alive()

@@ -200,6 +200,21 @@ METHOD_CHECKS = [
      {"record_commit_barrier"}, "call"),
     ("elastic/coordinator.py", "HangWatchdog", "_fire",
      {"record_hang_watchdog"}, "call"),
+    # goodput ledger (ISSUE 17): record_step is THE waterfall funnel —
+    # every armed step must flow into goodput._on_step; the dispatch
+    # window must book its cumulative wait (the dispatch_backpressure
+    # source); restarts must land as run-level downtime; and an eviction
+    # must trigger the fleet aggregation + flight-recorder stamp
+    ("telemetry/__init__.py", None, "record_step",
+     {"_on_step"}, "call"),
+    ("engine/async_feed.py", "DispatchWindow", "admit",
+     {"record_dispatch_wait"}, "call"),
+    ("engine/async_feed.py", "DispatchWindow", "drain",
+     {"record_dispatch_wait"}, "call"),
+    ("elastic/run.py", None, "_record_resume",
+     {"record_restart_downtime"}, "call"),
+    ("elastic/coordinator.py", "Coordinator", "step_poll",
+     {"on_eviction"}, "call"),
 ]
 
 # (relative file, required substring, rationale)
@@ -353,6 +368,25 @@ TEXT_CHECKS = [
     ("telemetry/__init__.py", '"coordinator"',
      "statusz must carry the coordinator group view (generation, "
      "live/dead, leader) next to the config fingerprint"),
+    # goodput ledger (ISSUE 17)
+    ("telemetry/goodput.py", "mx_goodput_seconds_total",
+     "the ledger must export per-category waterfall seconds (the "
+     "Prometheus twin of the on-disk time-series)"),
+    ("telemetry/goodput.py", "mx_goodput_ratio",
+     "the ledger must export the live goodput ratio gauge (compute "
+     "share of wall — the headline fleet-efficiency signal)"),
+    ("telemetry/goodput.py", "mx_straggler_score",
+     "fleet aggregation must book per-rank straggler scores (median "
+     "step-wall skew vs the fleet median) so a slow host pages"),
+    ("telemetry/__init__.py", "mx_checkpoint_save_seconds_total",
+     "the registry must export cumulative snapshot wall seconds (the "
+     "waterfall's snapshot category is a delta of this counter)"),
+    ("telemetry/__init__.py", "mx_dispatch_wait_seconds_total",
+     "the registry must export the cumulative dispatch-window wait "
+     "(the waterfall's dispatch_backpressure fallback source)"),
+    ("telemetry/__init__.py", '"goodput"',
+     "statusz must carry the goodput waterfall view next to the "
+     "coordinator group view"),
 ]
 
 

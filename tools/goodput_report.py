@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""Render a run's merged goodput waterfall offline.
+
+Reads the per-host time-series rings every armed host appended under
+``<root>/telemetry/host-<rank>.tsr`` (mxnet_tpu/telemetry/goodput.py),
+merges them into the generation-stamped fleet summary, and prints the
+human waterfall table with straggler scores — the offline twin of the
+live ``goodput.report()`` / ``/statusz`` views.
+
+    python tools/goodput_report.py <root>             # waterfall table
+    python tools/goodput_report.py <root> --json      # machine summary
+    python tools/goodput_report.py <root> --per-host  # + per-host rows
+
+Exit codes: 0 on success, 2 when the root has no series to merge, and
+3 with ``--fail-on-straggler`` when any host exceeds the
+MXNET_TPU_STRAGGLER_SKEW threshold (a CI-able fleet-health gate).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from mxnet_tpu.telemetry import goodput  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="offline goodput waterfall for a run's shared root")
+    ap.add_argument("root", help="the run's shared root (the directory "
+                                 "holding telemetry/ and, for elastic "
+                                 "runs, coord/)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the merged summary as JSON")
+    ap.add_argument("--per-host", action="store_true",
+                    help="append per-host category totals to the table")
+    ap.add_argument("--fail-on-straggler", action="store_true",
+                    help="exit 3 when any host is flagged as a straggler")
+    args = ap.parse_args(argv)
+
+    summary = goodput.aggregate(args.root, book_metrics=False)
+    if not summary["hosts"]:
+        print(f"no goodput series under {args.root}/telemetry/",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        print(goodput.report(summary))
+        if args.per_host:
+            for rank in sorted(summary["hosts"]):
+                h = summary["hosts"][rank]
+                cats = ", ".join(f"{c}={v:.3f}s"
+                                 for c, v in sorted(h["categories"].items())
+                                 if v > 0)
+                print(f"  host {rank}: {h['steps']} steps, "
+                      f"{h['wall_seconds']:.3f}s wall, median "
+                      f"{h['median_step_seconds'] * 1e3:.1f}ms/step, "
+                      f"generations {h['generation_range']}"
+                      + (f" [{cats}]" if cats else ""))
+    if args.fail_on_straggler and summary["straggler"]["flagged"]:
+        print(f"stragglers flagged: {summary['straggler']['flagged']}",
+              file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

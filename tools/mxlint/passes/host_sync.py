@@ -53,7 +53,16 @@ HOT_FUNCTIONS = [
     ("mxnet_tpu/telemetry/roofline.py",
      r"\b(record|register_cost|total_flops|wrap)\b"),
     ("mxnet_tpu/telemetry/__init__.py",
-     r"\b(record_step|_trace_tick)\b"),
+     r"\b(record_step|_trace_tick|record_dispatch_wait)\b"),
+    # goodput ledger (ISSUE 17): the per-step waterfall is pure host
+    # arithmetic over cumulative stamps the layers already took — a
+    # float()/asarray of a device value in the funnel (or any category
+    # source it snapshots) would charge every armed step for a sync the
+    # ledger exists to expose, not cause
+    ("mxnet_tpu/telemetry/goodput.py",
+     r"(\b(_on_step|note_step|_snapshot_upstream|_fam_sum|"
+     r"_compile_seconds|_comm_unoverlapped_bytes|set_generation|"
+     r"set_pipeline_bubble)\b|_Ring\.append\b)"),
     # per-batch metric updates: accumulation must stay on device; the one
     # designed host sync is get()/get_global(), which are not hot-listed
     ("mxnet_tpu/metric.py",

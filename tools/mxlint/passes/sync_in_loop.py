@@ -61,6 +61,12 @@ LOOP_FUNCTIONS = [
     ("mxnet_tpu/telemetry/tracing.py",
      r"\b(record_span|event|watch_step_time|check_loss|dump_chrome_trace|"
      r"dump_flight_recorder)\b"),
+    # goodput ledger (ISSUE 17): the waterfall funnel and ring append run
+    # inside every armed training loop at step pace — syncing on a step
+    # output here would serialize exactly the pipeline whose stalls the
+    # ledger attributes
+    ("mxnet_tpu/telemetry/goodput.py",
+     r"\b(_on_step|note_step|_snapshot_upstream)\b"),
 ]
 
 # calls whose result is a step output: loss/metric/output handles the loop
