@@ -2065,6 +2065,7 @@ def bench_lint_walltime():
         "extra": {
             "pass_10s": best < 10.0,
             "passes": len(all_passes()),
+            "pass_names": sorted(all_passes()),
             "findings_total": len(findings),
             "first_run_s": round(warm, 3),
         },

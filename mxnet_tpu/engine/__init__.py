@@ -27,7 +27,10 @@ import time
 from contextlib import contextmanager
 from typing import Any, Dict, Optional, Tuple
 
+from . import hlo_audit
+
 __all__ = ["lookup", "insert", "clear_compilation_cache", "cache_stats",
+           "hlo_audit",
            "reset_stats", "donation_enabled", "record_donation",
            "compile_timer", "record_trace", "record_execution",
            "estimate_cost", "structural_fingerprint", "graph_fingerprint",
@@ -249,7 +252,9 @@ _COST_KEYS = (("flops", "flops"), ("bytes accessed", "bytes_accessed"),
               ("transcendentals", "transcendentals"))
 
 
-def estimate_cost(jitted, *args, kind: str = "artifact") -> Dict[str, float]:
+def estimate_cost(jitted, *args, kind: str = "artifact",
+                  region: Optional[str] = None,
+                  overlap_expected: bool = False) -> Dict[str, float]:
     """XLA cost-model + memory estimate for a jitted callable at example
     args: ``{"flops", "bytes_accessed", "bytes_in", "bytes_out",
     "transcendentals", "peak_memory_bytes", "temp_memory_bytes"}`` (keys
@@ -265,6 +270,20 @@ def estimate_cost(jitted, *args, kind: str = "artifact") -> Dict[str, float]:
     every ledger row."""
     try:
         compiled = jitted.lower(*args).compile()
+        try:
+            # post-lowering hazard audit (mxcheck, docs/static_analysis.md):
+            # same AOT compile, one extra text scan per artifact. Donation
+            # expectation is best-effort introspection of the jit wrapper;
+            # the alias-pair count lands in the fingerprint either way and
+            # tools/hlo_audit_gate.py diffs it.
+            donate = bool(getattr(getattr(jitted, "_jit_info", None),
+                                  "donate_argnums", ()) or ())
+            hlo_audit.audit_compiled(
+                compiled, kind=kind, region=region or kind,
+                overlap_expected=overlap_expected,
+                donation_expected=donate)
+        except Exception:
+            pass  # the audit must never fail a cost capture
         c = compiled.cost_analysis()
         if isinstance(c, (list, tuple)):
             c = c[0] if c else {}

@@ -430,8 +430,8 @@ class KVStoreDist(KVStore):
             jax.distributed.initialize(coordinator_address=coord,
                                        num_processes=self._size,
                                        process_id=self._rank)
-        self._bigarray_bound = int(os.environ.get(
-            "MXNET_KVSTORE_BIGARRAY_BOUND", 1_000_000))
+        self._bigarray_bound = self._agree_bigarray_bound(int(os.environ.get(
+            "MXNET_KVSTORE_BIGARRAY_BOUND", 1_000_000)))
         self._allreduce_cache = {}
         # real async parameter server: one daemon server thread per process
         # owning this rank's home keys; rendezvous via the coordinator KV
@@ -441,6 +441,25 @@ class KVStoreDist(KVStore):
             self._ps_server = _ps.PSServer(lambda: self._updater)
             _ps.publish_address(self.rank, self._ps_server.port)
             self._ps_client = _ps.PSClient(_ps.resolve_address)
+
+    @staticmethod
+    def _agree_bigarray_bound(bound: int) -> int:
+        """Every process must agree on the bound: it selects WHICH
+        cross-process collective ``_cross`` runs (the proc-mesh XLA
+        all-reduce above the bound, eager ``process_allgather`` below), so
+        a per-host MXNET_KVSTORE_BIGARRAY_BOUND would send rank A into one
+        rendezvous and rank B into the other — a silent fleet-wide hang,
+        not a wrong answer (mxcheck collective-rank-conditional). Rank 0's
+        value wins, matching the reference's server-side authority
+        (kvstore_dist.h InitImpl). Construction is a uniform program point,
+        so the broadcast itself is safe."""
+        if jax.process_count() <= 1:
+            return int(bound)
+        import numpy as _np
+        from jax.experimental import multihost_utils
+        agreed = multihost_utils.broadcast_one_to_all(
+            _np.asarray(bound, dtype=_np.int64))
+        return int(agreed)
 
     def _home(self, key) -> int:
         """Key -> owning rank (reference kvstore_dist.h:606

@@ -1437,7 +1437,7 @@ class DataParallelTrainer:
         self._program.capture_cost(
             cost_key, fn, self._params_raw, self._opt_state,
             self._comp_resid, key_in, xr, yr, lr_in, t_in, scale_in,
-            kind="dp_multi")
+            kind="dp_multi", overlap_expected=self._overlap)
         t_sp = time.perf_counter() if _tracing._ENABLED else 0.0
         with _telem.annotate("mx.dp.run_steps"), _sanitize.guard():
             (self._params_raw, self._opt_state, self._comp_resid, losses,
@@ -1496,7 +1496,8 @@ class DataParallelTrainer:
                            lr, t_in, scale))
         # cost_analysis FLOPs of the fused step, captured once per
         # signature at artifact-build time (AOT lower shares XLA caches)
-        self._program.capture_cost(sig, fn, *call_args, kind="dp_step")
+        self._program.capture_cost(sig, fn, *call_args, kind="dp_step",
+                                   overlap_expected=self._overlap)
         t_sp = time.perf_counter() if _tracing._ENABLED else 0.0
         with _telem.annotate("mx.dp.step"), _sanitize.guard():
             if self._compression:

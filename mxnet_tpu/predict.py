@@ -154,8 +154,12 @@ def acquire_forward(symbol, arg_avals: Dict[str, Tuple[Tuple[int, ...], str]],
             cost = {}
             from . import telemetry as _telem
             if _telem._ENABLED:
+                # ledger/audit region mirrors the artifact cache key, so
+                # two distinct exported graphs fingerprint apart while
+                # re-binds of the same graph+signature share one row
                 cost = _engine.estimate_cost(
-                    jitted, warm_args, warm_aux, rng_key, kind="predict")
+                    jitted, warm_args, warm_aux, rng_key, kind="predict",
+                    region=f"predict#{_engine.region_digest(key, 'fwd')}")
             outs, _ = jitted(warm_args, warm_aux, rng_key)
             jax.block_until_ready(outs)  # the single compile, at bind time
             art = ForwardArtifact(key, jitted, arg_names, aux_names,

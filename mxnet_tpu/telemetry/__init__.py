@@ -1354,6 +1354,10 @@ def statusz(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         "serving_queue_depth": _family_snapshot("mx_serving_queue_depth"),
         "inflight_steps": _family_snapshot("mx_inflight_steps"),
         "anomalies": _family_snapshot("mx_anomalies_total"),
+        # compiled-HLO hazard audit (engine/hlo_audit.py): per-{kind,region}
+        # hazard counts for every artifact built this process — the same
+        # series Prometheus scrapes as mx_hlo_hazards_total
+        "hlo_audit": _family_snapshot("mx_hlo_hazards_total"),
         "recorder_events": tracing.recent(),
         "coordinator": coordinator,
         "goodput": goodput.statusz_view(),

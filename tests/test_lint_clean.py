@@ -48,3 +48,37 @@ def test_lint_walltime_budget():
     assert proc.returncode == 0
     elapsed = json.loads(proc.stdout)["elapsed_seconds"]
     assert elapsed < 10.0, f"mxlint took {elapsed}s over mxnet_tpu/"
+
+
+def test_stale_baseline_entry_is_a_hard_failure(tmp_path):
+    """A baseline row matching nothing means the debt was paid — keeping
+    the row would silently shield the NEXT regression with the same ident,
+    so the CLI exits 1 (ISSUE 18 satellite)."""
+    target = tmp_path / "mxnet_tpu" / "x.py"
+    target.parent.mkdir(parents=True)
+    target.write_text("X = 1\n")
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({"findings": [
+        {"rule": "host-sync", "path": "mxnet_tpu/x.py",
+         "symbol": "gone", "message": "paid off"}]}))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", str(target),
+         "--baseline", str(baseline)],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "stale baseline entry" in proc.stdout
+
+    # --write-baseline prunes the entry and says so
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", str(target),
+         "--baseline", str(baseline), "--write-baseline"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pruned stale entry mxnet_tpu/x.py:gone [host-sync]" \
+        in proc.stdout
+    assert json.loads(baseline.read_text())["findings"] == []
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", str(target),
+         "--baseline", str(baseline)],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -561,7 +561,8 @@ def test_all_passes_registered():
     names = set(all_passes())
     assert {"host-sync", "retrace-hazard", "donation-safety", "jit-purity",
             "lock-discipline", "mutable-default", "sync-in-loop",
-            "instrumentation", "broad-except"} <= names
+            "instrumentation", "broad-except",
+            "collective-order", "partition-spec"} <= names
 
 
 def test_cli_json_format_and_exit_codes(tmp_path):

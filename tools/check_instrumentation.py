@@ -215,6 +215,16 @@ METHOD_CHECKS = [
      {"record_restart_downtime"}, "call"),
     ("elastic/coordinator.py", "Coordinator", "step_poll",
      {"on_eviction"}, "call"),
+    # compiled-HLO hazard audit (ISSUE 18): estimate_cost is THE audit
+    # funnel — every AOT lower+compile must hand its optimized HLO to
+    # hlo_audit (a step artifact with a host callback / f64 promotion /
+    # lost overlap must fingerprint, never build silently); and every
+    # StepProgram cost capture must thread its region so fingerprints
+    # carry the same dp.step/pp.step labels the roofline ledger uses
+    ("engine/__init__.py", None, "estimate_cost",
+     {"audit_compiled"}, "call"),
+    ("parallel/step_program.py", "StepProgram", "capture_cost",
+     {"region"}, "call"),
 ]
 
 # (relative file, required substring, rationale)
@@ -387,6 +397,14 @@ TEXT_CHECKS = [
     ("telemetry/__init__.py", '"goodput"',
      "statusz must carry the goodput waterfall view next to the "
      "coordinator group view"),
+    # compiled-HLO hazard audit (ISSUE 18)
+    ("engine/hlo_audit.py", "mx_hlo_hazards_total",
+     "the HLO audit must book every hazard on the per-kind/per-region "
+     "counter — a hazard that only lives in the JSON fingerprint never "
+     "pages anyone"),
+    ("telemetry/__init__.py", '"hlo_audit"',
+     "statusz must carry the compiled-HLO hazard counters next to the "
+     "cache stats (the first place to look when a step artifact slows)"),
 ]
 
 

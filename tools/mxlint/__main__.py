@@ -1,6 +1,9 @@
 """CLI: ``python -m tools.mxlint [paths...] [options]``.
 
-Exit codes: 0 = clean modulo baseline, 1 = new findings, 2 = bad usage.
+Exit codes: 0 = clean modulo baseline, 1 = new findings OR stale baseline
+entries (a baseline row matching nothing means the debt was paid — the
+entry must be pruned the same commit, or it silently shields the next
+regression with the same ident), 2 = bad usage.
 """
 from __future__ import annotations
 
@@ -56,8 +59,12 @@ def main(argv=None) -> int:
             print("mxlint: --write-baseline needs --baseline",
                   file=sys.stderr)
             return 2
+        _, _, stale = diff_baseline(findings, load_baseline(baseline_path))
         write_baseline(baseline_path, findings)
         print(f"mxlint: wrote {len(findings)} finding(s) to {baseline_path}")
+        for b in stale:
+            print(f"mxlint: pruned stale entry {b.get('path')}:"
+                  f"{b.get('symbol')} [{b.get('rule')}]")
         return 0
 
     baseline = load_baseline(baseline_path) if baseline_path else []
@@ -77,12 +84,12 @@ def main(argv=None) -> int:
             print(f"mxlint: {len(waived)} finding(s) waived by baseline "
                   f"({baseline_path})")
         for b in stale:
-            print("mxlint: stale baseline entry (fixed? run "
-                  f"--write-baseline): {b.get('path')}:{b.get('symbol')} "
-                  f"[{b.get('rule')}]")
-        print(f"mxlint: {len(new)} new finding(s), "
-              f"{len(findings)} total, {elapsed:.2f}s")
-    return 1 if new else 0
+            print("mxlint: FAIL stale baseline entry (fixed code? prune "
+                  f"with --write-baseline): {b.get('path')}:"
+                  f"{b.get('symbol')} [{b.get('rule')}]")
+        print(f"mxlint: {len(new)} new finding(s), {len(stale)} stale "
+              f"baseline entr(ies), {len(findings)} total, {elapsed:.2f}s")
+    return 1 if (new or stale) else 0
 
 
 if __name__ == "__main__":

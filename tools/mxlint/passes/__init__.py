@@ -1,4 +1,4 @@
 """Importing this package registers every built-in mxlint pass."""
-from . import (broad_except, donation, host_sync,  # noqa: F401
-               instrumentation, locks, mutable_defaults, purity, retrace,
-               sync_in_loop)
+from . import (broad_except, collective_order, donation,  # noqa: F401
+               host_sync, instrumentation, locks, mutable_defaults,
+               partition_spec, purity, retrace, sync_in_loop)

@@ -1,0 +1,682 @@
+"""collective-order: whole-program SPMD collective-consistency analysis.
+
+Every rank in a multi-host mesh must execute the SAME sequence of
+collectives with the SAME mesh axes — a single rank that skips (or
+reorders) one does not produce a wrong answer, it produces a fleet-wide
+hang that the PR 15 heartbeat/watchdog can only report after the fact.
+MXNet's reference runtime ordered operations with a dependency engine at
+execution time; the TPU-native port compiles the whole step, so ordering
+must be proven *statically*, the way TVM-style stacks push correctness to
+build time (arXiv:1802.04799).
+
+The pass seeds from functions known to run inside ``shard_map``/``jit``
+step bodies (the StepProgram builders, ``schedule_1f1b``, the megatron
+boundary collectives, the zero bucket kernels, ``moe.wire_all_to_all``,
+the kvstore sync path) plus anything passed to / decorated with a jit
+wrapper, closes over the intra-module call graph, and checks four rules:
+
+  collective-rank-conditional   a collective (or a call that transitively
+                                traces one) guarded by a condition derived
+                                from rank/process/env identity, unless the
+                                branches trace EQUAL collective sequences
+  collective-branch-mismatch    ``lax.cond``/``lax.switch`` branches that
+                                trace different collective sequences
+  collective-unknown-axis       a literal mesh-axis name no mesh contract
+                                declares
+  collective-data-loop          a collective inside a python loop whose
+                                trip count derives from rank/env identity
+
+Taint model (documented limits — see docs/static_analysis.md): sources are
+``process_index``/``axis_index``/``host_id``/env reads; taint flows through
+local assignments, ``self.X`` attributes, and function return values within
+one module. Values routed through an agreement sanitizer (a call matching
+``agree``/``broadcast_one_to_all`` — uniform on every host by construction)
+are deliberately NOT tainted: that is the designed fix pattern for
+host-divergent configuration (see ``KVStoreDist._agree_bigarray_bound``).
+The pass cannot see cross-module dataflow or prove runtime predicate
+uniformity; it proves the *absence of the static pattern*, not liveness.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..core import (Finding, ModuleInfo, call_name, call_target,
+                    register_pass, unparse)
+
+# -- collective vocabulary ---------------------------------------------------
+# jax.lax primitives + this repo's named custom_vjp wrappers + eager
+# cross-process collectives. Every entry is a fleet rendezvous: a rank that
+# skips one strands every other rank at the barrier.
+DEVICE_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle", "pbroadcast",
+    "all_gather", "psum_scatter", "all_to_all",
+}
+WRAPPER_COLLECTIVES = {
+    # parallel/megatron.py boundary collectives
+    "copy_to_tp", "reduce_from_tp", "gather_from_sp", "scatter_to_sp",
+    "partial_grad",
+    # parallel/zero.py bucket kernels, parallel/tensor_parallel.py
+    "reduce_scatter_bucket", "all_gather_bucket", "gather_tp", "slice_tp",
+    # parallel/moe.py expert dispatch, ops/attention.py sequence parallel
+    "wire_all_to_all", "ring_attention", "ulysses_attention",
+}
+HOST_COLLECTIVES = {
+    "process_allgather", "sync_global_devices", "broadcast_one_to_all",
+}
+ALL_COLLECTIVES = DEVICE_COLLECTIVES | WRAPPER_COLLECTIVES | HOST_COLLECTIVES
+
+# raw-text prefilter: a module whose source never mentions a collective or
+# lax.cond/switch cannot produce a finding — skip it before any AST walk
+# (most of the package; keeps the lint_walltime budget honest)
+_ANY_COLLECTIVE_RE = re.compile(
+    "|".join(re.escape(n) for n in sorted(ALL_COLLECTIVES)))
+
+# -- mesh-axis contract ------------------------------------------------------
+# The repo's canonical axis names (docs/tensor_parallel.md): data, tensor,
+# pipeline, sequence, expert parallelism + the kvstore's one-device-per-
+# process DCN mesh. Module-local declarations (Mesh/make_mesh/PartitionSpec
+# literals, axis-parameter defaults) extend this set.
+GLOBAL_AXES = {"dp", "tp", "pp", "sp", "ep", "proc"}
+
+_AXIS_PARAM = re.compile(r"(^|_)ax(is|es)?(_|$)|axis")
+_MESH_DECLS = {"Mesh", "AbstractMesh", "make_mesh"}
+_SPEC_DECLS = {"PartitionSpec", "P", "NamedSharding", "PartitionConfig"}
+
+# -- taint sources / sanitizers ---------------------------------------------
+# matched structurally against Name ids / Attribute attrs (no unparse on
+# the taint path — it dominates walltime at package scale)
+_SOURCE_NAMES = {"environ", "getenv", "process_index", "axis_index",
+                 "host_id", "local_rank", "is_leader"}
+# agreement points: the value is made uniform across hosts by construction
+# (rank-0 broadcast), so conditioning on it cannot diverge
+_SANITIZER_RE = re.compile(r"agree|broadcast_one_to_all|make_uniform")
+
+# -- seeding -----------------------------------------------------------------
+# (path suffix, qualname regex) — functions that run inside compiled/
+# multi-host step bodies. Nested defs carry the builder in their qualname
+# (host_sync.py uses the same convention).
+STEP_SEEDS = [
+    ("mxnet_tpu/parallel/data_parallel.py",
+     r"(_build_step|_build_step_compressed|\b_make_apply_fn\b)"),
+    ("mxnet_tpu/parallel/pipeline.py",
+     r"(_build_step|\bpipeline_apply\b|\bschedule_1f1b\b|"
+     r"_init_zero_state_partitioned)"),
+    ("mxnet_tpu/parallel/megatron.py",
+     r"\b(cell_forward|embed_forward|head_loss_forward|_attention|_tp_moe|"
+     r"copy_to_tp|reduce_from_tp|gather_from_sp|scatter_to_sp|partial_grad|"
+     r"vocab_parallel_embedding|vocab_parallel_cross_entropy)\b"),
+    ("mxnet_tpu/parallel/zero.py",
+     r"\b(reduce_scatter_bucket|all_gather_bucket|sharded_update|"
+     r"_bucket_step)\b"),
+    ("mxnet_tpu/parallel/moe.py",
+     r"\b(wire_all_to_all|_wire_exchange|expert_parallel_moe)\b"),
+    ("mxnet_tpu/parallel/tensor_parallel.py", r"\b(gather_tp|slice_tp)\b"),
+    ("mxnet_tpu/recipes/moe.py", r"_build_step"),
+    ("mxnet_tpu/recipes/long_context.py", r"_build_step"),
+    ("mxnet_tpu/ops/attention.py",
+     r"\b(ring_attention|ulysses_attention|blockwise_attention)\b"),
+    ("mxnet_tpu/kvstore/kvstore.py",
+     r"KVStore\w*\.(init|push|pull|pushpull|broadcast|_cross|_cross_bucket|"
+     r"_allreduce_xla|barrier)\b"),
+]
+# step-body naming conventions seed regardless of path (covers fixtures and
+# new trainers before they earn a STEP_SEEDS row)
+_NAME_SEED = re.compile(r"(_build_step|\bstep_body\b|\btrain_step\b)")
+# a function handed to (or decorated with) one of these runs as a traced
+# step body
+_JIT_WRAPPERS = {"shard_map", "shard_map_compat", "jit", "pjit", "pmap",
+                 "custom_vjp"}
+
+
+# ---------------------------------------------------------------------------
+# AST walking (source order, nested scopes excluded)
+# ---------------------------------------------------------------------------
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                ast.Lambda)
+
+
+def _iter_calls(node: ast.AST) -> Iterable[ast.Call]:
+    """Calls within `node` in field order, not descending into nested
+    function/class/lambda scopes (they are separate reachability targets)."""
+    if isinstance(node, _SCOPE_NODES):
+        return
+    if isinstance(node, ast.Call):
+        yield node
+    for child in ast.iter_child_nodes(node):
+        yield from _iter_calls(child)
+
+
+def _terminates(stmts: Sequence[ast.stmt]) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+# ---------------------------------------------------------------------------
+# Collective call shape
+# ---------------------------------------------------------------------------
+
+def _axis_node(call: ast.Call) -> Optional[ast.AST]:
+    """The mesh-axis operand: ``axis_name=`` keyword, else the second
+    positional (lax collectives and the repo wrappers are ``(x, axis, ...)``;
+    the ``axis=`` keyword on all_gather/all_to_all is the tensor DIMENSION,
+    not the mesh axis, and is deliberately ignored)."""
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    if call_name(call) in HOST_COLLECTIVES:
+        return None  # cross-process; no mesh-axis operand
+    if len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+def _axis_str(call: ast.Call) -> str:
+    node = _axis_node(call)
+    return unparse(node) if node is not None else ""
+
+
+def _literal_axes(node: Optional[ast.AST]) -> List[str]:
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in node.elts:
+            out.extend(_literal_axes(e))
+        return out
+    return []
+
+
+def _fmt_op(op: Tuple[str, str]) -> str:
+    name, ax = op
+    return f"{name}[{ax}]" if ax else name
+
+
+def _fmt_seq(seq: Sequence[Tuple[str, str]]) -> str:
+    if not seq:
+        return "no collectives"
+    s = ", ".join(_fmt_op(op) for op in seq[:6])
+    if len(seq) > 6:
+        s += f", ... ({len(seq)} total)"
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Mesh-axis contract of a module
+# ---------------------------------------------------------------------------
+
+def declared_axes(mod: ModuleInfo, *,
+                  include_specs: bool = True) -> Set[str]:
+    """GLOBAL_AXES + every axis name the module itself declares: string
+    literals in Mesh/make_mesh constructor calls (including dict keys of
+    ``make_mesh({"dp": 2})``), string defaults/assignments of axis-named
+    parameters and variables, and ``axis_name=``-style keywords anywhere.
+
+    ``include_specs`` additionally counts literals inside PartitionSpec/
+    NamedSharding calls as declarations — right for the collective pass
+    (an axis the module shards over is an axis its collectives may name),
+    wrong for validating the specs THEMSELVES (a typo'd spec axis would
+    self-declare), so partition_spec passes ``include_specs=False``.
+    Both variants are cached on the ModuleInfo."""
+    key = "_mxcheck_axes_all" if include_specs else "_mxcheck_axes_mesh"
+    cached = getattr(mod, key, None)
+    if cached is not None:
+        return cached
+    axes = set(GLOBAL_AXES)
+
+    def _grab(node):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                axes.add(sub.value)
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in _MESH_DECLS or (include_specs
+                                       and name in _SPEC_DECLS):
+                for a in node.args:
+                    _grab(a)
+                for kw in node.keywords:
+                    _grab(kw.value)
+            else:
+                for kw in node.keywords:
+                    if kw.arg and _AXIS_PARAM.search(kw.arg):
+                        _grab(kw.value)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            named = args.posonlyargs + args.args + args.kwonlyargs
+            defaults = ([None] * (len(args.posonlyargs) + len(args.args)
+                                  - len(args.defaults))
+                        + list(args.defaults) + list(args.kw_defaults))
+            for arg, d in zip(named, defaults):
+                if d is not None and _AXIS_PARAM.search(arg.arg):
+                    _grab(d)
+        elif isinstance(node, ast.Assign):
+            if any(isinstance(t, ast.Name) and _AXIS_PARAM.search(t.id)
+                   for t in node.targets):
+                _grab(node.value)
+    setattr(mod, key, axes)
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# Intra-module call graph
+# ---------------------------------------------------------------------------
+
+def _function_map(mod: ModuleInfo) -> Dict[str, ast.FunctionDef]:
+    """bare name -> FunctionDef, unique names only (ambiguous names are
+    conservatively unresolvable — no expansion, no reachability edge)."""
+    out: Dict[str, ast.FunctionDef] = {}
+    dupes: Set[str] = set()
+    for fn in mod.functions():
+        if fn.name in dupes:
+            continue
+        if fn.name in out:
+            del out[fn.name]
+            dupes.add(fn.name)
+        else:
+            out[fn.name] = fn
+    return out
+
+
+def _fn_seq(name: str, funcmap: Dict[str, ast.FunctionDef],
+            stack: frozenset,
+            cache: Dict[str, List[Tuple[str, str]]]) -> List[Tuple[str, str]]:
+    """Transitive collective sequence traced by calling `name` (both sides
+    of internal branches concatenated — an over-approximation that is exact
+    for the symmetry/mismatch comparisons it feeds)."""
+    if name in stack or len(stack) > 6:
+        return []
+    if name in cache:
+        return cache[name]
+    fn = funcmap.get(name)
+    if fn is None:
+        return []
+    seq: List[Tuple[str, str]] = []
+    for st in fn.body:
+        seq.extend(_stmts_seq([st], funcmap, stack | {name}, cache))
+    cache[name] = seq
+    return seq
+
+
+def _stmts_seq(stmts, funcmap, stack, cache) -> List[Tuple[str, str]]:
+    seq: List[Tuple[str, str]] = []
+    for st in stmts:
+        for call in _iter_calls(st):
+            nm = call_name(call)
+            if nm in ALL_COLLECTIVES:
+                seq.append((nm, _axis_str(call)))
+            elif nm in funcmap:
+                seq.extend(_fn_seq(nm, funcmap, stack, cache))
+    return seq
+
+
+# ---------------------------------------------------------------------------
+# Taint
+# ---------------------------------------------------------------------------
+
+def _sanitized(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Call):
+        nm = call_name(expr)
+        if nm and _SANITIZER_RE.search(nm):
+            return True
+    return False
+
+
+def _target_names(target: ast.AST) -> Iterable[str]:
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, ast.Starred):
+        yield from _target_names(target.value)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            yield from _target_names(e)
+
+
+def _expr_tainted(expr: ast.AST, local: Set[str], module: Set[str]) -> bool:
+    if _sanitized(expr):
+        return False
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and (n.id in _SOURCE_NAMES
+                                        or n.id in local or n.id in module):
+            return True
+        if isinstance(n, ast.Attribute) and (n.attr in _SOURCE_NAMES
+                                             or n.attr in module):
+            return True
+    return False
+
+
+def _local_taint(fn, module: Set[str]) -> Set[str]:
+    """Names locally assigned from tainted expressions (two forward passes
+    cover one level of chaining; nested scopes excluded)."""
+    local: Set[str] = set()
+    stmts = [st for st in ast.walk(fn)
+             if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                                ast.NamedExpr))]
+    for _ in range(2):
+        for st in stmts:
+            value = st.value
+            if value is None:
+                continue
+            if not _expr_tainted(value, local, module):
+                continue
+            targets = (st.targets if isinstance(st, ast.Assign)
+                       else [st.target])
+            for t in targets:
+                local.update(_target_names(t))
+    return local
+
+
+def _module_taint(mod: ModuleInfo) -> Set[str]:
+    """Attribute names (``self.X = <rank/env expr>``), module-level
+    variables, and functions whose return value derives from a taint
+    source. Fixpoint over the module (3 rounds bound the chains seen in
+    practice)."""
+    tainted: Set[str] = set()
+    fns = list(mod.functions())
+    for _ in range(3):
+        before = len(tainted)
+        # module-level names
+        for st in ast.iter_child_nodes(mod.tree):
+            if isinstance(st, ast.Assign) \
+                    and _expr_tainted(st.value, set(), tainted):
+                for t in st.targets:
+                    tainted.update(_target_names(t))
+        for fn in fns:
+            local = _local_taint(fn, tainted)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    if not _expr_tainted(node.value, local, tainted):
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute):
+                            tainted.add(t.attr)
+                elif isinstance(node, ast.Return) and node.value is not None:
+                    if mod.enclosing_function(node) is not fn:
+                        continue  # nested def's return
+                    if _expr_tainted(node.value, local, tainted):
+                        tainted.add(fn.name)
+        if len(tainted) == before:
+            break
+    return tainted
+
+
+# ---------------------------------------------------------------------------
+# Seeding + reachability
+# ---------------------------------------------------------------------------
+
+def _seed_functions(mod: ModuleInfo) -> List[ast.FunctionDef]:
+    seeds: List[ast.FunctionDef] = []
+    wrapper_args: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and call_name(node) in _JIT_WRAPPERS:
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, ast.Name):
+                    wrapper_args.add(a.id)
+    for fn in mod.functions():
+        qn = mod.qualname(fn)
+        hot = any(mod.relpath.endswith(suffix) and re.search(pat, qn)
+                  for suffix, pat in STEP_SEEDS)
+        if (hot or _NAME_SEED.search(qn) or fn.name in wrapper_args
+                or _JIT_WRAPPERS & {d for d in _decorators(fn)}):
+            seeds.append(fn)
+    return seeds
+
+
+def _decorators(fn) -> Set[str]:
+    out = set()
+    for d in fn.decorator_list:
+        node = d.func if isinstance(d, ast.Call) else d
+        if isinstance(node, ast.Attribute):
+            out.add(node.attr)
+        elif isinstance(node, ast.Name):
+            out.add(node.id)
+        # functools.partial(jax.custom_vjp, ...) style
+        if isinstance(d, ast.Call):
+            for a in ast.walk(d):
+                if isinstance(a, ast.Attribute) and a.attr in _JIT_WRAPPERS:
+                    out.add(a.attr)
+    return out
+
+
+def _reachable(seeds: Sequence[ast.FunctionDef],
+               funcmap: Dict[str, ast.FunctionDef]) -> List[ast.FunctionDef]:
+    """Closure over the intra-module call graph: direct calls by terminal
+    name + any bare-name reference to a module function (covers callables
+    handed to jit/scan/cond and builders returning nested steps)."""
+    out: List[ast.FunctionDef] = []
+    seen: Set[int] = set()
+    work = list(seeds)
+    while work:
+        fn = work.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        out.append(fn)
+        for node in ast.walk(fn):
+            ref = None
+            if isinstance(node, ast.Call):
+                ref = call_name(node)
+            elif isinstance(node, ast.Name):
+                ref = node.id
+            elif isinstance(node, ast.Attribute):
+                ref = node.attr
+            if ref and ref in funcmap and id(funcmap[ref]) not in seen:
+                work.append(funcmap[ref])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Per-function scan
+# ---------------------------------------------------------------------------
+
+class _Guard:
+    __slots__ = ("test", "kind", "tainted")
+
+    def __init__(self, test, kind, tainted):
+        self.test = test
+        self.kind = kind          # 'if' | 'loop'
+        self.tainted = tainted
+
+
+class _Scanner:
+    def __init__(self, mod: ModuleInfo, fn, funcmap, module_taint, axes,
+                 seq_cache):
+        self.mod = mod
+        self.fn = fn
+        self.qn = mod.qualname(fn)
+        self.funcmap = funcmap
+        self.axes = axes
+        self.seq_cache = seq_cache
+        self.local = _local_taint(fn, module_taint)
+        self.module_taint = module_taint
+        self.findings: List[Finding] = []
+
+    # -- helpers -------------------------------------------------------------
+    def _tainted(self, expr) -> bool:
+        return _expr_tainted(expr, self.local, self.module_taint)
+
+    def _seq(self, stmts) -> List[Tuple[str, str]]:
+        return _stmts_seq(stmts, self.funcmap, frozenset(), self.seq_cache)
+
+    def _ops_of_call(self, call) -> List[Tuple[str, str]]:
+        nm = call_name(call)
+        if nm in ALL_COLLECTIVES:
+            return [(nm, _axis_str(call))]
+        if nm in self.funcmap:
+            return _fn_seq(nm, self.funcmap, frozenset(), self.seq_cache)
+        return []
+
+    def _emit(self, rule, line, message):
+        self.findings.append(
+            Finding(rule, self.mod.relpath, line, self.qn, message))
+
+    # -- entry ---------------------------------------------------------------
+    def scan(self):
+        self._block(self.fn.body, [])
+        return self.findings
+
+    # -- block walker --------------------------------------------------------
+    def _block(self, stmts, guards):
+        i = 0
+        n = len(stmts)
+        while i < n:
+            st = stmts[i]
+            if isinstance(st, ast.If):
+                tainted = self._tainted(st.test)
+                symmetric = False
+                if tainted:
+                    body_seq = self._seq(st.body)
+                    if st.orelse:
+                        other_seq = self._seq(st.orelse)
+                    elif _terminates(st.body):
+                        other_seq = self._seq(stmts[i + 1:])
+                    else:
+                        other_seq = []
+                    # equal sequences on both sides cannot diverge the
+                    # schedule (e.g. `psum(x)` vs `psum(-x)`)
+                    symmetric = body_seq == other_seq
+                g = _Guard(st.test, "if", tainted and not symmetric)
+                self._expr_calls(st.test, guards)
+                self._block(st.body, guards + [g])
+                if st.orelse:
+                    self._block(st.orelse, guards + [g])
+                if g.tainted and _terminates(st.body) and not st.orelse:
+                    # `if <rank>: return ...` guards everything after it
+                    self._block(stmts[i + 1:], guards + [g])
+                    return
+                i += 1
+            elif isinstance(st, (ast.For, ast.While)):
+                src = st.iter if isinstance(st, ast.For) else st.test
+                g = _Guard(src, "loop", self._tainted(src))
+                self._expr_calls(src, guards)
+                self._block(st.body, guards + [g])
+                if st.orelse:
+                    self._block(st.orelse, guards + [g])
+                i += 1
+            elif isinstance(st, ast.Try):
+                self._block(st.body, guards)
+                for h in st.handlers:
+                    self._block(h.body, guards)
+                self._block(st.orelse, guards)
+                self._block(st.finalbody, guards)
+                i += 1
+            elif isinstance(st, ast.With):
+                for item in st.items:
+                    self._expr_calls(item.context_expr, guards)
+                self._block(st.body, guards)
+                i += 1
+            elif isinstance(st, _SCOPE_NODES):
+                i += 1  # nested scope: reachability scans it separately
+            else:
+                self._expr_calls(st, guards)
+                i += 1
+
+    def _expr_calls(self, node, guards):
+        for call in _iter_calls(node):
+            self._check_call(call, guards)
+
+    # -- rules ---------------------------------------------------------------
+    def _check_call(self, call, guards):
+        nm = call_name(call)
+        tgt = call_target(call)
+        if nm in ("cond", "switch") and re.search(r"\blax\.(cond|switch)$",
+                                                  tgt):
+            self._check_branches(call, nm)
+        ops = self._ops_of_call(call)
+        if not ops:
+            return
+        if nm in ALL_COLLECTIVES:
+            self._check_axes(call, nm)
+            desc = _fmt_op(ops[0])
+        else:
+            desc = f"{nm}() (traces {_fmt_seq(ops)})"
+        guard = next((g for g in reversed(guards) if g.tainted), None)
+        if guard is None:
+            return
+        cond = unparse(guard.test)[:60]
+        if guard.kind == "loop":
+            self._emit(
+                "collective-data-loop", call.lineno,
+                f"collective {desc} inside a loop bounded by `{cond}` — "
+                f"rank/env-dependent trip counts desynchronize the "
+                f"collective schedule across hosts")
+        else:
+            self._emit(
+                "collective-rank-conditional", call.lineno,
+                f"collective {desc} runs only under `{cond}`, which derives "
+                f"from rank/process/env identity — ranks taking different "
+                f"branches hang the fleet")
+
+    def _check_axes(self, call, nm):
+        for ax in _literal_axes(_axis_node(call)):
+            if ax not in self.axes:
+                self._emit(
+                    "collective-unknown-axis", call.lineno,
+                    f"axis '{ax}' in {nm}(...) is not declared by the "
+                    f"enclosing mesh contract")
+
+    def _check_branches(self, call, nm):
+        if nm == "cond":
+            branch_nodes = call.args[1:3]
+        else:  # switch(index, branches, *operands)
+            b = call.args[1] if len(call.args) > 1 else None
+            branch_nodes = list(b.elts) if isinstance(
+                b, (ast.Tuple, ast.List)) else []
+        resolved = []
+        for bn in branch_nodes:
+            ok, seq = self._branch_ops(bn)
+            if not ok:
+                return  # unresolvable branch: nothing provable
+            resolved.append(seq)
+        if len(resolved) < 2:
+            return
+        first = resolved[0]
+        for other in resolved[1:]:
+            if other != first:
+                self._emit(
+                    "collective-branch-mismatch", call.lineno,
+                    f"lax.{nm} branches trace different collective "
+                    f"sequences ({_fmt_seq(first)} vs {_fmt_seq(other)}) — "
+                    f"every rank must execute the same collectives "
+                    f"regardless of the predicate")
+                return
+
+    def _branch_ops(self, node):
+        if isinstance(node, ast.Lambda):
+            return True, _stmts_seq([node.body], self.funcmap, frozenset(),
+                                    self.seq_cache)
+        if isinstance(node, ast.Name) and node.id in self.funcmap:
+            return True, _fn_seq(node.id, self.funcmap, frozenset(),
+                                 self.seq_cache)
+        if isinstance(node, ast.Call) and call_name(node) == "partial" \
+                and node.args:
+            return self._branch_ops(node.args[0])
+        return False, []
+
+
+# ---------------------------------------------------------------------------
+# Pass entry
+# ---------------------------------------------------------------------------
+
+@register_pass(
+    "collective-order",
+    "SPMD collective-consistency: rank-conditional / branch-mismatched / "
+    "unknown-axis / loop-divergent collectives in step bodies")
+def check(mod: ModuleInfo):
+    if not _ANY_COLLECTIVE_RE.search(mod.text):
+        return
+    funcmap = _function_map(mod)
+    seeds = _seed_functions(mod)
+    if not seeds:
+        return
+    module_taint = _module_taint(mod)
+    axes = declared_axes(mod)
+    seq_cache: Dict[str, List[Tuple[str, str]]] = {}
+    for fn in _reachable(seeds, funcmap):
+        yield from _Scanner(mod, fn, funcmap, module_taint, axes,
+                            seq_cache).scan()

@@ -1,0 +1,170 @@
+"""partition-spec: layout-contract validation for PartitionSpec/shard_rules.
+
+A PartitionSpec naming a nonexistent mesh axis is a silent no-op in most
+jax APIs — the model trains fully replicated and nothing fails until the
+memory or throughput numbers look wrong. ``apply_rules`` validates at
+runtime (parallel/tensor_parallel.py raises on unknown axes); this pass
+pushes the same contract to lint time and covers the raw ``P(...)`` sites
+``apply_rules`` never sees:
+
+  pspec-unknown-axis   a literal axis in P()/PartitionSpec() not declared
+                       by any mesh contract; also shard_rules/apply_rules
+                       dict literals with unknown logical ROLES or mesh
+                       axes
+  pspec-duplicate-axis a mesh axis used by two dims of one spec (XLA
+                       rejects it at lowering — surface it at lint time)
+  pspec-rank-mismatch  a spec provably longer than the array it annotates
+                       (literal-shape creation paired with the spec in the
+                       same call; shorter specs are legal — trailing dims
+                       replicate)
+
+The mesh-axis contract is shared with the collective-order pass
+(``declared_axes``): GLOBAL_AXES + module-local declarations.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Tuple
+
+from ..core import Finding, ModuleInfo, call_name, register_pass, unparse
+from .collective_order import declared_axes, _literal_axes
+
+_SPEC_CTORS = {"P", "PartitionSpec"}
+
+# logical roles of the apply_rules table (parallel/tensor_parallel.py
+# DEFAULT_RULES) — shard_rules raises on anything else at runtime
+SHARD_RULE_ROLES = {"batch", "vocab", "embed", "heads", "kv", "joined_kv",
+                    "mlp", "seq"}
+
+_ARRAY_CTORS = {"zeros", "ones", "full", "empty"}
+
+# raw-text prefilter: no spec constructor / rules table in the source means
+# no possible finding — skip the AST walk entirely
+_ANY_SPEC_RE = re.compile(
+    r"PartitionSpec|\bP\s*\(|shard_rules|apply_rules")
+
+
+def _spec_axes(call: ast.Call) -> List[Tuple[str, int]]:
+    """(axis, lineno) for every literal axis string in one P(...) call,
+    in dim order (tuple dims like P(("dp","tp"), None) flatten)."""
+    out: List[Tuple[str, int]] = []
+    for a in call.args:
+        for ax in _literal_axes(a):
+            out.append((ax, call.lineno))
+    return out
+
+
+def _spec_len(call: ast.Call) -> Optional[int]:
+    """Number of dims the spec constrains, when statically knowable
+    (no *args)."""
+    if any(isinstance(a, ast.Starred) for a in call.args):
+        return None
+    return len(call.args)
+
+
+def _literal_shape_rank(node: ast.AST) -> Optional[int]:
+    """Rank of jnp.zeros((2,3))-style creations with a literal shape."""
+    if not isinstance(node, ast.Call) or call_name(node) not in _ARRAY_CTORS:
+        return None
+    if not node.args:
+        return None
+    shp = node.args[0]
+    if isinstance(shp, (ast.Tuple, ast.List)):
+        if all(isinstance(e, ast.Constant) for e in shp.elts):
+            return len(shp.elts)
+        return None
+    if isinstance(shp, ast.Constant) and isinstance(shp.value, int):
+        return 1
+    return None
+
+
+def _find_specs(node: ast.AST) -> List[ast.Call]:
+    return [n for n in ast.walk(node)
+            if isinstance(n, ast.Call) and call_name(n) in _SPEC_CTORS]
+
+
+@register_pass(
+    "partition-spec",
+    "layout contracts: unknown/duplicate mesh axes in PartitionSpecs, "
+    "unknown shard_rules roles, provable spec/rank mismatches")
+def check(mod: ModuleInfo):
+    if not _ANY_SPEC_RE.search(mod.text):
+        return
+    # mesh-declaring sites only: literals inside the specs being validated
+    # must NOT count as declarations, or a typo'd axis self-declares
+    axes = declared_axes(mod, include_specs=False)
+    qn = mod.qualname
+
+    def _encl(node):
+        fn = mod.enclosing_function(node)
+        return qn(fn) if fn is not None else ""
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+
+        if name in _SPEC_CTORS:
+            seen = {}
+            for ax, line in _spec_axes(node):
+                if ax not in axes:
+                    yield Finding(
+                        "pspec-unknown-axis", mod.relpath, line, _encl(node),
+                        f"PartitionSpec axis '{ax}' is not declared by any "
+                        f"mesh contract — the annotation silently no-ops "
+                        f"and the leaf trains replicated")
+                if ax in seen:
+                    yield Finding(
+                        "pspec-duplicate-axis", mod.relpath, line,
+                        _encl(node),
+                        f"mesh axis '{ax}' shards two dimensions of one "
+                        f"PartitionSpec (`{unparse(node)[:60]}`) — XLA "
+                        f"rejects the sharding at lowering")
+                seen[ax] = True
+
+        elif name in ("shard_rules", "apply_rules"):
+            dicts = [a for a in node.args if isinstance(a, ast.Dict)]
+            dicts += [kw.value for kw in node.keywords
+                      if kw.arg in ("overrides", "rules")
+                      and isinstance(kw.value, ast.Dict)]
+            for d in dicts:
+                for k, v in zip(d.keys, d.values):
+                    if isinstance(k, ast.Constant) and isinstance(k.value,
+                                                                  str):
+                        if k.value not in SHARD_RULE_ROLES:
+                            yield Finding(
+                                "pspec-unknown-axis", mod.relpath,
+                                k.lineno, _encl(node),
+                                f"shard_rules role '{k.value}' is not in "
+                                f"the apply_rules role table "
+                                f"({sorted(SHARD_RULE_ROLES)})")
+                    if isinstance(v, ast.Constant) and isinstance(v.value,
+                                                                  str):
+                        if v.value not in axes:
+                            yield Finding(
+                                "pspec-unknown-axis", mod.relpath,
+                                v.lineno, _encl(node),
+                                f"shard_rules maps to mesh axis "
+                                f"'{v.value}', which no mesh contract "
+                                f"declares")
+
+        else:
+            # provable rank mismatch: a literal-shape array creation and a
+            # spec travelling in the same call (device_put/make_array_*/
+            # NamedSharding wrapping)
+            ranks = [r for r in (_literal_shape_rank(a) for a in node.args)
+                     if r is not None]
+            if not ranks:
+                continue
+            rank = min(ranks)
+            for spec in _find_specs(node):
+                n = _spec_len(spec)
+                if n is not None and n > rank:
+                    yield Finding(
+                        "pspec-rank-mismatch", mod.relpath, spec.lineno,
+                        _encl(node),
+                        f"PartitionSpec constrains {n} dims but the "
+                        f"array created alongside it has rank {rank} "
+                        f"(`{unparse(node)[:70]}`) — jax raises at "
+                        f"sharding time")
